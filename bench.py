@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Benchmark driver — HIGGS-like GBM training wall-clock (the BASELINE.json
+flagship config: H2OGradientBoostingEstimator, 100 trees,
+histogram_type=UniformAdaptive, binary response).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The real HIGGS csv is not shipped in this image; the synthetic generator
+reproduces its shape (11M rows × 28 numeric features in the full set; we
+default to 1M rows to keep the bench under control) with an XOR-ish nonlinear
+response so the trees actually learn. vs_baseline is wall-clock relative to
+BASELINE.md's reference number when one exists (none published in-repo —
+SURVEY.md §6), else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
+    logits = (
+        1.2 * X[:, 0]
+        - 0.8 * X[:, 1]
+        + 1.5 * X[:, 2] * X[:, 3]
+        + 0.7 * np.sin(3 * X[:, 4])
+        + 0.5 * (X[:, 5] ** 2 - 1)
+    )
+    y = (rng.random(n_rows) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    return X, y
+
+
+def main():
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 100))
+    max_depth = int(os.environ.get("BENCH_DEPTH", 6))
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    X, y = make_higgs_like(n_rows)
+    names = [f"f{i}" for i in range(X.shape[1])] + ["label"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names).asfactor("label")
+
+    # warmup: compile the per-tree program on a small prefix
+    warm = fr.take(np.arange(min(65536, n_rows)))
+    H2OGradientBoostingEstimator(
+        ntrees=2, max_depth=max_depth, histogram_type="UniformAdaptive", seed=1
+    ).train(y="label", training_frame=warm)
+
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=ntrees, max_depth=max_depth, learn_rate=0.1,
+        histogram_type="UniformAdaptive", seed=42,
+    )
+    t0 = time.time()
+    gbm.train(y="label", training_frame=fr)
+    wall = time.time() - t0
+    auc = gbm.auc()
+
+    result = {
+        "metric": f"higgs_gbm_{n_rows//1000}k_{ntrees}trees_wall_s",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "auc": round(float(auc), 5),
+        "backend": __import__("jax").default_backend(),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

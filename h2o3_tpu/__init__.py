@@ -1,0 +1,119 @@
+"""h2o3_tpu — a TPU-native ML platform with H2O-3's capabilities.
+
+The public surface mirrors `h2o-py/h2o/h2o.py` (`h2o.init`, `h2o.import_file`,
+`h2o.H2OFrame`, …) so reference users can switch imports; the engine under it
+is JAX/XLA/Pallas on TPU meshes instead of a JVM cloud — see SURVEY.md for
+the layer-by-layer mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .frame.frame import Frame
+from .frame.frame import Frame as H2OFrame
+from .frame.parse import import_file as _import_file
+from .parallel import mesh as _mesh
+
+__version__ = "0.1.0"
+
+_frames = {}  # the user-visible corner of the DKV (water/DKV.java)
+_models = {}
+
+
+def init(url=None, ip=None, port=None, nthreads=-1, max_mem_size=None,
+         strict_version_check=False, **kw):
+    """`h2o.init()` — form the local cloud (mesh over visible devices)."""
+    return _mesh.init()
+
+
+def cluster():
+    c = _mesh.cloud()
+
+    class _ClusterInfo:
+        cloud_size = c.size
+        version = __version__
+
+        def show_status(self):
+            print(f"h2o3_tpu cloud: {c.size} device(s): {c.devices}")
+
+    return _ClusterInfo()
+
+
+def connect(**kw):
+    return init()
+
+
+def shutdown(prompt=False):
+    _mesh.reset()
+    _frames.clear()
+    _models.clear()
+
+
+def import_file(path: str, destination_frame=None, header=0, sep=None,
+                col_names=None, col_types=None, **kw) -> Frame:
+    fr = _import_file(
+        path,
+        sep=sep,
+        header=None if header == 0 else bool(header > 0),
+        col_names=col_names,
+        col_types=col_types,
+    )
+    if destination_frame:
+        fr.key = destination_frame
+    _frames[fr.key] = fr
+    return fr
+
+
+upload_file = import_file
+
+
+def H2OFrame_from_python(data, column_types=None) -> Frame:
+    if isinstance(data, dict):
+        return Frame.from_dict(data, column_types=column_types)
+    return Frame.from_numpy(np.asarray(data), column_types=column_types)
+
+
+def get_frame(key: str) -> Frame:
+    return _frames[key]
+
+
+def remove(obj) -> None:
+    key = obj if isinstance(obj, str) else getattr(obj, "key", None)
+    _frames.pop(key, None)
+    _models.pop(key, None)
+
+
+def ls():
+    return list(_frames) + list(_models)
+
+
+def no_progress():
+    pass
+
+
+def show_progress():
+    pass
+
+
+# model save/load (h2o.save_model / h2o.load_model → /3/Models.bin)
+def save_model(model, path: str = ".", force: bool = False, filename=None) -> str:
+    from .mojo import save_model as _save
+
+    return _save(model, path, filename=filename)
+
+
+def load_model(path: str):
+    from .mojo import load_model as _load
+
+    return _load(path)
+
+
+def download_mojo(model, path: str = ".", **kw) -> str:
+    return save_model(model, path)
+
+
+def import_mojo(path: str):
+    return load_model(path)

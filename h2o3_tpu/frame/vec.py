@@ -1,0 +1,147 @@
+"""Vec — one column of a distributed Frame.
+
+Reference parity: `h2o-core/src/main/java/water/fvec/Vec.java` and the ~20
+compressed `Chunk` encodings (`C0DChunk`…`CXIChunk`). The reference keeps a
+Vec as a homed array of per-node compressed chunks read through
+`Chunk.atd(row)`; on TPU a Vec is a single dense `jax.Array` whose leading
+axis is (optionally) sharded over the ``hosts`` mesh axis. Compression is
+XLA's problem (bf16/int8 casts at op boundaries), not the storage layer's —
+dense HBM arrays feed the MXU; chunk decompression per element would not.
+
+Type system (mirrors `Vec.get_type_str()`): ``real``, ``int``, ``enum``
+(categorical with a string domain), ``time``, ``string``. NA encodings:
+NaN for real/int (stored f32/f64), -1 for enum codes, None in string pool.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+TYPES = ("real", "int", "enum", "time", "string")
+
+
+class Vec:
+    __slots__ = ("data", "type", "domain", "_strings")
+
+    def __init__(
+        self,
+        data,
+        type: str = "real",
+        domain: Optional[List[str]] = None,
+        strings: Optional[np.ndarray] = None,
+    ):
+        if type not in TYPES:
+            raise ValueError(f"bad vec type {type!r}")
+        self.type = type
+        self.domain = list(domain) if domain is not None else None
+        self._strings = strings  # host-side object array for type == "string"
+        if type == "string":
+            self.data = None
+        else:
+            arr = jnp.asarray(data)
+            if type == "enum":
+                arr = arr.astype(jnp.int32)
+            elif arr.dtype not in (jnp.float32, jnp.float64):
+                arr = arr.astype(jnp.float32)
+            self.data = arr
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_numpy(col: np.ndarray, type_hint: Optional[str] = None) -> "Vec":
+        """Build a Vec from a host column, inferring type like
+        `water/parser/ParseSetup.java` column-type guessing."""
+        if col.dtype.kind in "OUS":
+            if type_hint == "enum":
+                mask = np.asarray([v in ("", "NA", "na", None) for v in col])
+                domain, codes = np.unique(np.asarray(col)[~mask], return_inverse=True)
+                full = np.full(len(col), -1, dtype=np.int32)
+                full[~mask] = codes.astype(np.int32)
+                return Vec(full, "enum", domain=[str(d) for d in domain])
+            # try numeric, else categorical intern (water/parser/Categorical.java)
+            try:
+                as_num = np.asarray(
+                    [np.nan if v in ("", "NA", "na", "nan", None) else float(v) for v in col],
+                    dtype=np.float32,
+                )
+                return Vec(as_num, "real" if not _all_int(as_num) else "int")
+            except (TypeError, ValueError):
+                pass
+            if type_hint == "string":
+                return Vec(None, "string", strings=np.asarray(col, dtype=object))
+            mask = np.asarray([v in ("", "NA", "na", None) for v in col])
+            domain, codes = np.unique(np.asarray(col)[~mask], return_inverse=True)
+            full = np.full(len(col), -1, dtype=np.int32)
+            full[~mask] = codes.astype(np.int32)
+            return Vec(full, "enum", domain=[str(d) for d in domain])
+        col = np.asarray(col)
+        if type_hint == "enum":
+            valid = ~np.isnan(col.astype(np.float64))
+            domain, codes = np.unique(col[valid], return_inverse=True)
+            full = np.full(len(col), -1, dtype=np.int32)
+            full[valid] = codes.astype(np.int32)
+            # integral numeric levels print without the ".0" (h2o's asfactor)
+            labels = [
+                str(int(d)) if float(d) == int(d) else str(d) for d in domain
+            ]
+            return Vec(full, "enum", domain=labels)
+        t = "int" if col.dtype.kind in "iub" or _all_int(col) else "real"
+        return Vec(col.astype(np.float32), t)
+
+    # -- properties ---------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._strings) if self.type == "string" else int(self.data.shape[0])
+
+    @property
+    def nlevels(self) -> int:
+        return len(self.domain) if self.domain else 0
+
+    def isna_np(self) -> np.ndarray:
+        if self.type == "string":
+            return np.asarray([s is None for s in self._strings])
+        a = np.asarray(self.data)
+        return (a < 0) if self.type == "enum" else np.isnan(a)
+
+    def to_numpy(self) -> np.ndarray:
+        if self.type == "string":
+            return self._strings
+        return np.asarray(self.data)
+
+    def numeric_np(self) -> np.ndarray:
+        """Column as float64 with NaN NAs (enum -> code as float)."""
+        a = np.asarray(self.data, dtype=np.float64)
+        if self.type == "enum":
+            a = np.where(a < 0, np.nan, a)
+        return a
+
+    # -- stats (the rollups of water/fvec/RollupStats.java) ------------------
+    def mean(self) -> float:
+        return float(np.nanmean(self.numeric_np()))
+
+    def sd(self) -> float:
+        return float(np.nanstd(self.numeric_np(), ddof=1))
+
+    def min(self) -> float:
+        return float(np.nanmin(self.numeric_np()))
+
+    def max(self) -> float:
+        return float(np.nanmax(self.numeric_np()))
+
+    def nacnt(self) -> int:
+        return int(self.isna_np().sum())
+
+    def take(self, idx: np.ndarray) -> "Vec":
+        if self.type == "string":
+            return Vec(None, "string", strings=self._strings[idx])
+        return Vec(np.asarray(self.data)[idx], self.type, domain=self.domain)
+
+    def __repr__(self):
+        return f"Vec(type={self.type}, len={len(self)}, domain={self.nlevels or None})"
+
+
+def _all_int(a: np.ndarray) -> bool:
+    with np.errstate(invalid="ignore"):
+        fin = a[np.isfinite(a)]
+        return fin.size > 0 and bool(np.all(fin == np.round(fin)))

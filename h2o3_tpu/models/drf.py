@@ -1,0 +1,48 @@
+"""H2ORandomForestEstimator — Distributed Random Forest (and XRT).
+
+Reference parity: `h2o-algos/src/main/java/hex/tree/drf/DRF.java` /
+`DRFModel.java` — bootstrap row sampling (sample_rate 0.632), per-split
+`mtries` column sampling, vote-averaged scoring; XRT = DRF with
+`histogram_type=Random` (`ai.h2o.automl` XRT step). Estimator surface:
+`h2o-py/h2o/estimators/random_forest.py`.
+
+Round-1 note: training metrics are in-bag (the reference reports OOB);
+OOB scoring is tracked for a follow-up round.
+"""
+
+from __future__ import annotations
+
+from .shared_tree import H2OSharedTreeEstimator
+
+
+class H2ORandomForestEstimator(H2OSharedTreeEstimator):
+    algo = "drf"
+    _mode = "drf"
+    _param_defaults = dict(
+        ntrees=50,
+        max_depth=20,
+        min_rows=1.0,
+        nbins=20,
+        nbins_cats=1024,
+        nbins_top_level=1024,
+        mtries=-1,
+        sample_rate=0.632,
+        sample_rate_per_class=None,
+        col_sample_rate_change_per_level=1.0,
+        col_sample_rate_per_tree=1.0,
+        min_split_improvement=1e-5,
+        histogram_type="AUTO",
+        distribution="AUTO",
+        binomial_double_trees=False,
+        score_tree_interval=0,
+        balance_classes=False,
+        class_sampling_factors=None,
+        max_after_balance_size=5.0,
+        build_tree_one_node=False,
+        calibrate_model=False,
+        reg_lambda=None,
+    )
+
+
+H2OXGBRandomForestEstimator = H2ORandomForestEstimator  # alias convenience
+DRF = H2ORandomForestEstimator

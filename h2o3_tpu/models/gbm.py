@@ -1,0 +1,54 @@
+"""H2OGradientBoostingEstimator — GBM.
+
+Reference parity: `h2o-algos/src/main/java/hex/tree/gbm/GBM.java`
+(`GBMDriver.buildNextKTrees` — k trees/iteration, learn-rate annealing,
+row/col sampling) and the generated estimator
+`h2o-py/h2o/estimators/gbm.py` (parameter names are the API contract; the
+HIGGS baseline config is `ntrees=100, histogram_type=UniformAdaptive`).
+
+The training loop lives in `shared_tree.py`; histograms in
+`ops/histogram.py` (the Pallas/onehot `tpu_hist` kernels).
+"""
+
+from __future__ import annotations
+
+from .shared_tree import H2OSharedTreeEstimator
+
+
+class H2OGradientBoostingEstimator(H2OSharedTreeEstimator):
+    algo = "gbm"
+    _mode = "gbm"
+    _param_defaults = dict(
+        ntrees=50,
+        max_depth=5,
+        min_rows=10.0,
+        nbins=20,
+        nbins_cats=1024,
+        nbins_top_level=1024,
+        learn_rate=0.1,
+        learn_rate_annealing=1.0,
+        sample_rate=1.0,
+        sample_rate_per_class=None,
+        col_sample_rate=1.0,
+        col_sample_rate_change_per_level=1.0,
+        col_sample_rate_per_tree=1.0,
+        min_split_improvement=1e-5,
+        histogram_type="AUTO",
+        distribution="AUTO",
+        tweedie_power=1.5,
+        quantile_alpha=0.5,
+        huber_alpha=0.9,
+        max_abs_leafnode_pred=float("inf"),
+        pred_noise_bandwidth=0.0,
+        calibrate_model=False,
+        monotone_constraints=None,
+        score_tree_interval=0,
+        balance_classes=False,
+        class_sampling_factors=None,
+        max_after_balance_size=5.0,
+        build_tree_one_node=False,
+        reg_lambda=None,
+    )
+
+
+GBM = H2OGradientBoostingEstimator

@@ -1,0 +1,464 @@
+"""H2OGeneralizedLinearEstimator — GLM.
+
+Reference parity: `h2o-algos/src/main/java/hex/glm/GLM.java` (IRLSM /
+L_BFGS / COORDINATE_DESCENT solvers), `hex/glm/GLMTask.java`
+(`GLMIterationTask` — the distributed Gram `X'WX` MRTask),
+`hex/gram/Gram.java` (Cholesky solve), `hex/DataInfo.java` (standardize /
+one-hot — see `model_base.DataInfo`), and the estimator surface
+`h2o-py/h2o/estimators/glm.py`. The Airlines-logistic IRLS config is a
+BASELINE.json headline.
+
+TPU-first shape of IRLSM: the per-iteration Gram is ONE jitted einsum over
+row-sharded X — XLA inserts the `psum` over the ``hosts`` axis automatically
+(pjit/GSPMD), which is exactly `GLMIterationTask.reduce()`'s tree-add,
+compiled. The tiny (p×p) Cholesky solve happens replicated on-device.
+Elastic-net L1 is handled by ISTA (soft-thresholded proximal steps) on the
+per-iteration quadratic — the same quadratic COORDINATE_DESCENT minimizes.
+Multinomial uses full-batch L-BFGS (optax) on the softmax deviance, the
+reference's multinomial L_BFGS path.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from ..parallel import mesh as cloudlib
+from .metrics import (
+    ModelMetricsBinomial,
+    ModelMetricsMultinomial,
+    ModelMetricsRegression,
+)
+from .model_base import DataInfo, H2OEstimator, H2OModel, response_info
+
+FAMILIES = (
+    "AUTO", "gaussian", "binomial", "quasibinomial", "multinomial",
+    "poisson", "gamma", "tweedie", "negativebinomial", "ordinal", "fractionalbinomial",
+)
+
+
+# -- link functions (hex/glm/GLMModel.GLMParameters.Link) --------------------
+def _linkinv(family: str, eta):
+    if family in ("binomial", "quasibinomial", "fractionalbinomial"):
+        return jax.nn.sigmoid(eta)
+    if family in ("poisson", "gamma", "tweedie", "negativebinomial"):
+        return jnp.exp(eta)
+    return eta
+
+
+def _irls_weights(family: str, eta, mu, y, tweedie_p=1.5):
+    """(W, z): working weights and response for one IRLS iteration."""
+    if family in ("binomial", "quasibinomial", "fractionalbinomial"):
+        W = jnp.clip(mu * (1 - mu), 1e-10, None)
+        z = eta + (y - mu) / W
+    elif family == "poisson":
+        W = jnp.clip(mu, 1e-10, None)
+        z = eta + (y - mu) / W
+    elif family == "gamma":
+        W = jnp.ones_like(mu)
+        z = eta + (y - mu) / jnp.clip(mu, 1e-10, None)
+    elif family == "tweedie":
+        W = jnp.clip(mu ** (2 - tweedie_p), 1e-10, None)
+        z = eta + (y - mu) / jnp.clip(mu, 1e-10, None)  # log link
+    else:  # gaussian
+        W = jnp.ones_like(mu)
+        z = y
+    return W, z
+
+
+@functools.partial(jax.jit, static_argnames=("family",))
+def _gram_step(X, y, w, beta, family: str, tweedie_p: float = 1.5):
+    """One GLMIterationTask: distributed Gram X'WX and X'Wz (+ psum by XLA
+    when X is row-sharded)."""
+    eta = X @ beta
+    mu = _linkinv(family, eta)
+    W, z = _irls_weights(family, eta, mu, y, tweedie_p)
+    Ww = W * w
+    gram = jnp.einsum("np,n,nq->pq", X, Ww, X)
+    xy = jnp.einsum("np,n->p", X, Ww * z)
+    return gram, xy
+
+
+def _solve_penalized(gram, xy, lam, alpha, n_obs, intercept_idx, beta0):
+    """Solve the IRLS quadratic with elastic-net penalty (host, p×p).
+
+    Ridge part closed-form via Cholesky; L1 via ISTA on the quadratic —
+    the same subproblem hex/glm COORDINATE_DESCENT iterates on."""
+    p = gram.shape[0]
+    pen_mask = np.ones(p)
+    pen_mask[intercept_idx] = 0.0  # intercept is never penalized
+    l2 = lam * (1 - alpha) * n_obs
+    l1 = lam * alpha * n_obs
+    A = gram + np.diag(pen_mask * l2)
+    if l1 == 0:
+        try:
+            return np.linalg.solve(A + 1e-8 * np.eye(p), xy)
+        except np.linalg.LinAlgError:
+            return np.linalg.lstsq(A, xy, rcond=None)[0]
+    # ISTA
+    L = np.linalg.eigvalsh(A).max() + 1e-8
+    b = beta0.copy()
+    for _ in range(200):
+        grad = A @ b - xy
+        b_new = b - grad / L
+        thr = l1 / L * pen_mask
+        b_new = np.sign(b_new) * np.maximum(np.abs(b_new) - thr, 0)
+        if np.max(np.abs(b_new - b)) < 1e-9:
+            b = b_new
+            break
+        b = b_new
+    return b
+
+
+class GLMModel(H2OModel):
+    algo = "glm"
+
+    def __init__(self, params, x, y, dinfo: DataInfo, family, beta, domain,
+                 lambda_best=0.0, stderr=None, full_path=None):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = y
+        self.dinfo = dinfo
+        self.family = family
+        self.beta = beta  # (p+1,) with intercept last, or (K, p+1) multinomial
+        self.domain = domain
+        self.lambda_best = lambda_best
+        self.stderr = stderr
+        self.full_path = full_path  # lambda-search path [(lam, beta), ...]
+
+    def _names(self) -> List[str]:
+        return self.dinfo.coef_names + ["Intercept"]
+
+    def coef(self) -> Dict[str, float]:
+        """De-standardized coefficients (GLMModel.coefficients)."""
+        if self.family == "multinomial":
+            return {
+                f"{cls}": dict(zip(self._names(), self._destandardize(self.beta[k])))
+                for k, cls in enumerate(self.domain)
+            }
+        return dict(zip(self._names(), self._destandardize(self.beta)))
+
+    def coef_norm(self) -> Dict[str, float]:
+        if self.family == "multinomial":
+            return {
+                f"{cls}": dict(zip(self._names(), np.asarray(self.beta[k])))
+                for k, cls in enumerate(self.domain)
+            }
+        return dict(zip(self._names(), np.asarray(self.beta)))
+
+    def _destandardize(self, b):
+        b = np.asarray(b, np.float64)
+        if not self.dinfo.standardize or self.dinfo.means is None:
+            return b
+        out = b.copy()
+        out[:-1] = b[:-1] / self.dinfo.stds
+        out[-1] = b[-1] - float((b[:-1] * self.dinfo.means / self.dinfo.stds).sum())
+        return out
+
+    def _eta(self, frame: Frame) -> np.ndarray:
+        X = self.dinfo.transform(frame)
+        Xi = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], axis=1)
+        return Xi @ np.asarray(self.beta).T  # (n,) or (n, K)
+
+    def _score(self, frame: Frame) -> np.ndarray:
+        eta = self._eta(frame)
+        if self.family == "multinomial":
+            e = np.exp(eta - eta.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        return np.asarray(_linkinv(self.family, jnp.asarray(eta)))
+
+    def predict(self, test_data: Frame) -> Frame:
+        out = self._score(test_data)
+        if self.family in ("binomial", "quasibinomial"):
+            p1 = out
+            d = {"predict": np.asarray(self.domain, dtype=object)[(p1 > 0.5).astype(int)],
+                 str(self.domain[0]): 1 - p1, str(self.domain[1]): p1}
+            return Frame.from_dict(d, column_types={"predict": "enum"})
+        if self.family == "multinomial":
+            lab = out.argmax(axis=1)
+            d = {"predict": np.asarray(self.domain, dtype=object)[lab]}
+            for i, cls in enumerate(self.domain):
+                d[str(cls)] = out[:, i]
+            return Frame.from_dict(d, column_types={"predict": "enum"})
+        return Frame.from_dict({"predict": out})
+
+    def _make_metrics(self, frame: Frame):
+        out = self._score(frame)
+        yv = frame.vec(self.y)
+        if self.family in ("binomial", "quasibinomial"):
+            return ModelMetricsBinomial.make(np.asarray(yv.data), out)
+        if self.family == "multinomial":
+            return ModelMetricsMultinomial.make(np.asarray(yv.data), out)
+        return ModelMetricsRegression.make(yv.numeric_np(), out)
+
+
+class H2OGeneralizedLinearEstimator(H2OEstimator):
+    algo = "glm"
+    _param_defaults = dict(
+        family="AUTO",
+        solver="AUTO",
+        alpha=None,
+        lambda_=None,
+        lambda_search=False,
+        nlambdas=-1,
+        lambda_min_ratio=-1.0,
+        standardize=True,
+        intercept=True,
+        non_negative=False,
+        max_iterations=-1,
+        beta_epsilon=1e-4,
+        objective_epsilon=-1.0,
+        gradient_epsilon=-1.0,
+        link="family_default",
+        tweedie_variance_power=0.0,
+        tweedie_link_power=1.0,
+        theta=1e-10,
+        missing_values_handling="MeanImputation",
+        compute_p_values=False,
+        remove_collinear_columns=False,
+        balance_classes=False,
+        class_sampling_factors=None,
+        max_after_balance_size=5.0,
+        prior=-1.0,
+        cold_start=False,
+        interactions=None,
+        beta_constraints=None,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> GLMModel:
+        p = self._parms
+        yvec = train.vec(y)
+        problem, nclass, domain = response_info(yvec)
+        family = p.get("family", "AUTO")
+        if family == "AUTO":
+            family = {"binomial": "binomial", "multinomial": "multinomial"}.get(
+                problem, "gaussian"
+            )
+        dinfo = DataInfo(train, x, standardize=bool(p.get("standardize", True)))
+        X = dinfo.fit_transform(train)
+        n, nfeat = X.shape
+        Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
+        w = (
+            train.vec(p["weights_column"]).numeric_np()
+            if p.get("weights_column")
+            else np.ones(n)
+        ).astype(np.float32)
+
+        if family in ("binomial", "quasibinomial", "fractionalbinomial"):
+            yarr = np.asarray(yvec.data, np.float32) if yvec.type == "enum" else yvec.numeric_np().astype(np.float32)
+        elif family == "multinomial":
+            yarr = np.asarray(yvec.data, np.int32)
+        else:
+            yarr = yvec.numeric_np().astype(np.float32)
+
+        alpha = p.get("alpha")
+        alpha = float(alpha[0] if isinstance(alpha, (list, tuple)) else (alpha if alpha is not None else 0.5))
+        lam = p.get("lambda_")
+        lambda_search = bool(p.get("lambda_search"))
+        tweedie_p = float(p.get("tweedie_variance_power") or 1.5)
+        max_iter = int(p.get("max_iterations", -1))
+        if max_iter <= 0:
+            max_iter = 50
+        beta_eps = float(p.get("beta_epsilon", 1e-4))
+
+        cloud = cloudlib.cloud()
+        Xd = jnp.asarray(Xi)
+        yd = jnp.asarray(yarr if family != "multinomial" else yarr.astype(np.float32))
+        wd = jnp.asarray(w)
+        if cloud.size > 1 and n >= cloud.size:
+            npad = cloudlib.pad_to_multiple(n, cloud.size)
+            padn = npad - n
+            Xd = jnp.asarray(np.concatenate([Xi, np.zeros((padn, Xi.shape[1]), np.float32)]))
+            yd = jnp.asarray(np.concatenate([np.asarray(yd), np.zeros(padn, np.float32)]))
+            wd = jnp.asarray(np.concatenate([w, np.zeros(padn, np.float32)]))
+            rs = cloud.row_sharding()
+            Xd, yd, wd = jax.device_put(Xd, rs), jax.device_put(yd, rs), jax.device_put(wd, rs)
+
+        full_path = None
+        stderr = None
+        if family == "multinomial":
+            beta = self._fit_multinomial(Xd, yarr, wd, nclass, alpha, lam or 0.0, max_iter)
+            lam_best = lam or 0.0
+        else:
+            if lambda_search:
+                beta, lam_best, full_path = self._lambda_path(
+                    Xd, yd, wd, family, alpha, n, nfeat, max_iter, beta_eps, tweedie_p, p
+                )
+            else:
+                lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
+                beta = self._irls(Xd, yd, wd, family, lam_v, alpha, max_iter, beta_eps, tweedie_p)
+                lam_best = lam_v
+            if p.get("compute_p_values") and (lam_best == 0):
+                gram, _ = _gram_step(Xd, yd, wd, jnp.asarray(beta), family, tweedie_p)
+                try:
+                    stderr = np.sqrt(np.diag(np.linalg.inv(np.asarray(gram, np.float64))))
+                except np.linalg.LinAlgError:
+                    stderr = None
+
+        model = GLMModel(self, x, y, dinfo, family, beta, domain,
+                         lambda_best=lam_best, stderr=stderr, full_path=full_path)
+        model.training_metrics = model._make_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._make_metrics(valid)
+        # GLM varimp = |standardized coefficient| (GLMModel standardized coef magnitudes)
+        b = np.asarray(beta if family != "multinomial" else np.abs(beta).mean(axis=0))
+        mags = np.abs(b[:-1])
+        if mags.sum() > 0:
+            order = np.argsort(-mags)
+            model.varimp_table = [
+                (dinfo.coef_names[i], float(mags[i]), float(mags[i] / mags.max()),
+                 float(mags[i] / mags.sum()))
+                for i in order if mags[i] > 0
+            ]
+        return model
+
+    def _irls(self, Xd, yd, wd, family, lam, alpha, max_iter, beta_eps, tweedie_p):
+        pdim = Xd.shape[1]
+        n_obs = float(np.asarray(wd).sum())
+        beta = np.zeros(pdim, np.float64)
+        if family in ("binomial", "quasibinomial", "fractionalbinomial"):
+            mu0 = float(np.average(np.asarray(yd), weights=np.asarray(wd) + 1e-12))
+            mu0 = min(max(mu0, 1e-6), 1 - 1e-6)
+            beta[-1] = np.log(mu0 / (1 - mu0))
+        elif family in ("poisson", "gamma", "tweedie"):
+            beta[-1] = np.log(max(float(np.average(np.asarray(yd), weights=np.asarray(wd) + 1e-12)), 1e-6))
+        for it in range(max_iter):
+            gram, xy = _gram_step(Xd, yd, wd, jnp.asarray(beta, jnp.float32), family, tweedie_p)
+            new_beta = _solve_penalized(
+                np.asarray(gram, np.float64), np.asarray(xy, np.float64),
+                lam, alpha, n_obs, pdim - 1, beta,
+            )
+            delta = np.max(np.abs(new_beta - beta))
+            beta = new_beta
+            if delta < beta_eps:
+                break
+            if family == "gaussian" and lam >= 0 and alpha * lam == 0:
+                break  # gaussian ridge/OLS is exact in one step
+        return beta
+
+    def _lambda_path(self, Xd, yd, wd, family, alpha, n, nfeat, max_iter, beta_eps, tweedie_p, p):
+        """lambda_search: geometric path from lambda_max down, warm starts
+        (hex/glm/GLM.java regularization path)."""
+        gram0, xy0 = _gram_step(
+            Xd, yd, wd, jnp.zeros(Xd.shape[1], jnp.float32), family, tweedie_p
+        )
+        lam_max = float(np.max(np.abs(np.asarray(xy0)[:-1])) / max(n * max(alpha, 1e-3), 1e-12))
+        nlam = int(p.get("nlambdas", -1))
+        if nlam <= 0:
+            nlam = 30
+        ratio = float(p.get("lambda_min_ratio", -1))
+        if ratio <= 0:
+            ratio = 1e-4 if n > nfeat else 1e-2
+        lams = lam_max * np.power(ratio, np.linspace(0, 1, nlam))
+        beta = np.zeros(Xd.shape[1], np.float64)
+        path = []
+        best = (None, np.inf, 0.0)
+        for lv in lams:
+            beta = self._irls_warm(Xd, yd, wd, family, float(lv), alpha,
+                                   max_iter, beta_eps, tweedie_p, beta)
+            dev = self._deviance(Xd, yd, wd, family, beta)
+            path.append((float(lv), beta.copy()))
+            if dev < best[1]:
+                best = (beta.copy(), dev, float(lv))
+        return best[0], best[2], path
+
+    def _irls_warm(self, Xd, yd, wd, family, lam, alpha, max_iter, beta_eps, tweedie_p, beta0):
+        beta = beta0.copy()
+        n_obs = float(np.asarray(wd).sum())
+        for it in range(max_iter):
+            gram, xy = _gram_step(Xd, yd, wd, jnp.asarray(beta, jnp.float32), family, tweedie_p)
+            new_beta = _solve_penalized(
+                np.asarray(gram, np.float64), np.asarray(xy, np.float64),
+                lam, alpha, n_obs, Xd.shape[1] - 1, beta,
+            )
+            delta = np.max(np.abs(new_beta - beta))
+            beta = new_beta
+            if delta < beta_eps:
+                break
+        return beta
+
+    def _deviance(self, Xd, yd, wd, family, beta):
+        eta = np.asarray(Xd @ jnp.asarray(beta, jnp.float32), np.float64)
+        y = np.asarray(yd, np.float64)
+        w = np.asarray(wd, np.float64)
+        mu = np.asarray(_linkinv(family, jnp.asarray(eta)), np.float64)
+        if family in ("binomial", "quasibinomial"):
+            mu = np.clip(mu, 1e-15, 1 - 1e-15)
+            return float(-2 * np.sum(w * (y * np.log(mu) + (1 - y) * np.log(1 - mu))))
+        return float(np.sum(w * (y - mu) ** 2))
+
+    def _fit_multinomial(self, Xd, ycodes, wd, K, alpha, lam, max_iter):
+        """Softmax GLM via optax L-BFGS (the reference's multinomial L_BFGS)."""
+        import optax
+
+        pdim = Xd.shape[1]
+        n = len(ycodes)
+        Y = np.zeros((Xd.shape[0], K), np.float32)
+        Y[np.arange(n), ycodes] = 1.0
+        Yd = jnp.asarray(Y)
+        lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
+
+        def loss(B):
+            logits = Xd @ B.T  # (n, K)
+            lse = jax.scipy.special.logsumexp(logits, axis=1)
+            ll = (jnp.sum(logits * Yd, axis=1) - lse) * wd
+            ridge = 0.5 * lam_v * (1 - alpha) * jnp.sum(B[:, :-1] ** 2)
+            return -jnp.mean(ll) + ridge
+
+        B = jnp.zeros((K, pdim), jnp.float32)
+        try:
+            opt = optax.lbfgs()
+            state = opt.init(B)
+
+            @jax.jit
+            def step(B, state):
+                v, g = jax.value_and_grad(loss)(B)
+                updates, state = opt.update(g, state, B, value=v, grad=g, value_fn=loss)
+                return optax.apply_updates(B, updates), state, v
+
+            prev = np.inf
+            for it in range(max(100, max_iter * 4)):
+                B, state, v = step(B, state)
+                v = float(v)
+                if abs(prev - v) < 1e-9:
+                    break
+                prev = v
+        except (AttributeError, TypeError):
+            opt = optax.adam(0.1)
+            state = opt.init(B)
+            vg = jax.jit(jax.value_and_grad(loss))
+            for it in range(500):
+                v, g = vg(B)
+                updates, state = opt.update(g, state)
+                B = optax.apply_updates(B, updates)
+        return np.asarray(B, np.float64)
+
+    def _cv_predict(self, model: GLMModel, frame: Frame) -> np.ndarray:
+        out = model._score(frame)
+        return out
+
+    # h2o-py convenience
+    @staticmethod
+    def getGLMRegularizationPath(model):
+        m = model.model if isinstance(model, H2OGeneralizedLinearEstimator) else model
+        if m.full_path is None:
+            return {"lambdas": [m.lambda_best], "coefficients": [m.coef()]}
+        return {
+            "lambdas": [l for l, _ in m.full_path],
+            "coefficients": [dict(zip(m._names(), b)) for _, b in m.full_path],
+        }
+
+    def coef(self):
+        return self.model.coef()
+
+    def coef_norm(self):
+        return self.model.coef_norm()
+
+
+GLM = H2OGeneralizedLinearEstimator

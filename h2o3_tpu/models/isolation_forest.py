@@ -1,0 +1,145 @@
+"""H2OIsolationForestEstimator — anomaly detection.
+
+Reference parity: `h2o-algos/src/main/java/hex/tree/isofor/IsolationForest.java`
+(+ `isoforextended/`): trees isolate rows by random (feature, threshold)
+splits on subsamples; the anomaly score is the normalized mean path length
+(`IsolationForestModel.score0`). Built on the same static-heap tree arrays
+as GBM (`models/tree.py`), with random splits instead of gain search —
+growing each random tree is a tiny jitted partition program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.binning import build_bins
+from ..frame.frame import Frame
+from .metrics import ModelMetricsBase
+from .model_base import H2OEstimator, H2OModel
+from .shared_tree import frame_to_matrix
+from . import tree as treelib
+
+
+def _avg_path_length(n: float) -> float:
+    """c(n) from the IF paper — expected path length of an unsuccessful BST
+    search (used to normalize scores, IsolationForestModel)."""
+    if n <= 1:
+        return 0.0
+    h = np.log(n - 1) + 0.5772156649
+    return 2.0 * h - 2.0 * (n - 1) / n
+
+
+class IsolationForestModel(H2OModel):
+    algo = "isolationforest"
+
+    def __init__(self, params, x, trees, sample_size, max_depth):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = None
+        self.trees = trees  # list of (feat (T,), thr (T,), is_split (T,))
+        self.sample_size = sample_size
+        self.max_depth = max_depth
+
+    def _path_lengths(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        D = self.max_depth
+        total = np.zeros(n)
+        for feat, thr, split, leaf_n in self.trees:
+            node = np.zeros(n, np.int64)
+            depth = np.zeros(n)
+            for _ in range(D):
+                s = split[node]
+                xv = X[np.arange(n), feat[node]]
+                right = np.isnan(xv) | (xv > thr[node])
+                child = 2 * node + 1 + (right & s).astype(np.int64)
+                depth = depth + s.astype(np.float64)
+                node = np.where(s, child, node)
+            # add c(leaf size): unresolved subtree correction
+            total += depth + np.asarray([_avg_path_length(m) for m in leaf_n[node]])
+        return total / max(len(self.trees), 1)
+
+    def predict(self, test_data: Frame) -> Frame:
+        X, _, _ = frame_to_matrix(test_data, self.x)
+        pl = self._path_lengths(X)
+        c = _avg_path_length(self.sample_size)
+        score = np.power(2.0, -pl / max(c, 1e-12))
+        return Frame.from_dict({"predict": score, "mean_length": pl})
+
+    def _make_metrics(self, frame: Frame):
+        return ModelMetricsBase(nobs=frame.nrow)
+
+
+class H2OIsolationForestEstimator(H2OEstimator):
+    algo = "isolationforest"
+    supervised = False
+    _param_defaults = dict(
+        ntrees=50,
+        max_depth=8,
+        sample_size=256,
+        sample_rate=-1.0,
+        mtries=-1,
+        contamination=-1.0,
+        score_tree_interval=0,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> IsolationForestModel:
+        p = self._parms
+        seed = p["_actual_seed"]
+        X, _, _ = frame_to_matrix(train, x)
+        n, F = X.shape
+        rng = np.random.default_rng(seed)
+        sample_size = int(p.get("sample_size", 256))
+        if p.get("sample_rate", -1.0) and float(p.get("sample_rate", -1.0)) > 0:
+            sample_size = max(2, int(float(p["sample_rate"]) * n))
+        sample_size = min(sample_size, n)
+        D = int(p.get("max_depth", 8))
+        T = treelib.heap_size(D)
+        ntrees = int(p.get("ntrees", 50))
+
+        lo = np.nanmin(X, axis=0)
+        hi = np.nanmax(X, axis=0)
+        trees = []
+        for t in range(ntrees):
+            idx = rng.choice(n, sample_size, replace=False)
+            Xs = X[idx]
+            feat = np.zeros(T, np.int64)
+            thr = np.zeros(T)
+            split = np.zeros(T, bool)
+            leaf_n = np.zeros(T)
+            # iterative random splitting over the static heap
+            members = {0: np.arange(sample_size)}
+            for node in range(T):
+                rows = members.get(node)
+                if rows is None:
+                    leaf_n[node] = 0
+                    continue
+                leaf_n[node] = len(rows)
+                depth = int(np.floor(np.log2(node + 1)))
+                if depth >= D or len(rows) <= 1:
+                    continue
+                f = rng.integers(0, F)
+                col = Xs[rows, f]
+                cmin, cmax = np.nanmin(col), np.nanmax(col)
+                if not np.isfinite(cmin) or cmin >= cmax:
+                    continue
+                cut = rng.uniform(cmin, cmax)
+                feat[node] = f
+                thr[node] = cut
+                split[node] = True
+                right = np.isnan(col) | (col > cut)
+                members[2 * node + 1] = rows[~right]
+                members[2 * node + 2] = rows[right]
+            trees.append((feat, thr, split, leaf_n))
+
+        model = IsolationForestModel(self, x, trees, sample_size, D)
+        model.training_metrics = ModelMetricsBase(nobs=n)
+        scores = model.predict(train).vec("predict").numeric_np()
+        model.training_metrics.description = f"mean_score={scores.mean():.4f}"
+        return model
+
+
+IsolationForest = H2OIsolationForestEstimator

@@ -1,0 +1,159 @@
+"""H2OKMeansEstimator — K-Means clustering.
+
+Reference parity: `h2o-algos/src/main/java/hex/kmeans/KMeans.java` — Lloyd
+iterations with k-means|| (parallel) initialization, `init` ∈
+{Random, PlusPlus, Furthest, User}, standardization, categorical one-hot;
+estimator surface `h2o-py/h2o/estimators/kmeans.py`.
+
+TPU shape: one Lloyd iteration = a single jitted program — pairwise
+distances ride the MXU (‖x−c‖² expanded to x·cᵀ), assignment is an argmin,
+and the centroid update is a segment-sum; with rows sharded over ``hosts``
+the per-cluster sums/counts psum across hosts exactly like the reference's
+MRTask reduce (`KMeans.Lloyds`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from .metrics import ModelMetricsClustering
+from .model_base import DataInfo, H2OEstimator, H2OModel
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(X, cents, w, k: int):
+    d2 = (
+        jnp.sum(X * X, axis=1, keepdims=True)
+        - 2.0 * X @ cents.T
+        + jnp.sum(cents * cents, axis=1)[None, :]
+    )
+    assign = jnp.argmin(d2, axis=1)
+    mind2 = jnp.min(d2, axis=1)
+    sums = jax.ops.segment_sum(X * w[:, None], assign, num_segments=k)
+    cnts = jax.ops.segment_sum(w, assign, num_segments=k)
+    new_cents = jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts[:, None], 1e-12), cents)
+    wss = jnp.sum(jnp.maximum(mind2, 0.0) * w)
+    return new_cents, assign, wss, cnts
+
+
+class KMeansModel(H2OModel):
+    algo = "kmeans"
+
+    def __init__(self, params, x, dinfo, centers_std, k):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = None
+        self.dinfo = dinfo
+        self.centers_std = centers_std  # in standardized space
+        self.k = k
+
+    def centers(self) -> np.ndarray:
+        """De-standardized centroids (KMeansModel._output._centers_raw)."""
+        c = np.asarray(self.centers_std, np.float64)
+        if self.dinfo.standardize and self.dinfo.means is not None:
+            c = c * self.dinfo.stds + self.dinfo.means
+        return c
+
+    def predict(self, test_data: Frame) -> Frame:
+        X = self.dinfo.transform(test_data)
+        d2 = (
+            np.sum(X * X, axis=1, keepdims=True)
+            - 2.0 * X @ np.asarray(self.centers_std).T
+            + np.sum(np.asarray(self.centers_std) ** 2, axis=1)[None, :]
+        )
+        return Frame.from_dict({"predict": d2.argmin(axis=1).astype(np.float64)})
+
+    def _make_metrics(self, frame: Frame):
+        X = self.dinfo.transform(frame)
+        c = np.asarray(self.centers_std)
+        d2 = (
+            np.sum(X * X, axis=1, keepdims=True) - 2.0 * X @ c.T
+            + np.sum(c * c, axis=1)[None, :]
+        )
+        wss = float(np.maximum(d2.min(axis=1), 0).sum())
+        mu = X.mean(axis=0)
+        totss = float(((X - mu) ** 2).sum())
+        m = ModelMetricsClustering(
+            tot_withinss=wss, totss=totss, betweenss=totss - wss, nobs=X.shape[0]
+        )
+        m.mse = wss / max(X.shape[0], 1)
+        m.rmse = float(np.sqrt(m.mse))
+        return m
+
+    def tot_withinss(self):
+        return self.training_metrics.tot_withinss
+
+    def betweenss(self):
+        return self.training_metrics.betweenss
+
+    def totss(self):
+        return self.training_metrics.totss
+
+
+class H2OKMeansEstimator(H2OEstimator):
+    algo = "kmeans"
+    supervised = False
+    _param_defaults = dict(
+        k=1,
+        estimate_k=False,
+        max_iterations=10,
+        init="Furthest",
+        user_points=None,
+        standardize=True,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> KMeansModel:
+        p = self._parms
+        seed = p["_actual_seed"]
+        k = int(p.get("k", 1))
+        dinfo = DataInfo(train, x, standardize=bool(p.get("standardize", True)),
+                         use_all_factor_levels=True)
+        X = dinfo.fit_transform(train)
+        n = X.shape[0]
+        rng = np.random.default_rng(seed)
+        init = p.get("init", "Furthest")
+
+        if p.get("user_points") is not None:
+            up = p["user_points"]
+            cents = np.asarray(up.to_numpy() if isinstance(up, Frame) else up, np.float32)
+        elif init == "Random":
+            cents = X[rng.choice(n, k, replace=False)]
+        else:
+            # PlusPlus / Furthest seeding (k-means|| degenerate single pass)
+            cents = [X[rng.integers(n)]]
+            for _ in range(k - 1):
+                d2 = np.min(
+                    [(np.sum((X - c) ** 2, axis=1)) for c in cents], axis=0
+                )
+                if init == "Furthest":
+                    cents.append(X[int(d2.argmax())])
+                else:
+                    probs = d2 / max(d2.sum(), 1e-12)
+                    cents.append(X[rng.choice(n, p=probs)])
+            cents = np.asarray(cents, np.float32)
+
+        Xd = jnp.asarray(X)
+        wd = jnp.ones(n, jnp.float32)
+        cd = jnp.asarray(cents, jnp.float32)
+        prev = np.inf
+        for it in range(int(p.get("max_iterations", 10))):
+            cd, assign, wss, cnts = _lloyd_step(Xd, cd, wd, k)
+            wss = float(wss)
+            if abs(prev - wss) < 1e-7 * max(abs(prev), 1):
+                break
+            prev = wss
+
+        model = KMeansModel(self, x, dinfo, np.asarray(cd), k)
+        model.training_metrics = model._make_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._make_metrics(valid)
+        return model
+
+
+KMeans = H2OKMeansEstimator

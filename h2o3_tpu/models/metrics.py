@@ -1,0 +1,202 @@
+"""ModelMetrics — per-problem metric hierarchy.
+
+Reference parity: `h2o-core/src/main/java/hex/ModelMetrics*.java`
+(`ModelMetricsBinomial`, `ModelMetricsMultinomial`, `ModelMetricsRegression`,
+`ModelMetricsClustering`), `hex/AUC2.java` (threshold-binned ROC: 400-bin
+score histogram → AUC / pr-AUC / max-F1 and friends), `hex/ConfusionMatrix.java`.
+
+The reference computes these inside scoring MRTasks via
+`ModelMetrics.MetricBuilder` map/reduce; here the reductions are numpy on
+gathered predictions (cheap relative to training) with the same binned-AUC
+design available for the distributed path. Gini = 2·AUC−1 as in AUC2.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+MAX_AUC_BINS = 400  # AUC2.NBINS
+
+
+def roc_curve_binned(y: np.ndarray, p: np.ndarray, nbins: int = MAX_AUC_BINS):
+    """AUC2's design: histogram scores into <=400 threshold bins, then sweep."""
+    y = np.asarray(y).astype(np.float64)
+    p = np.asarray(p).astype(np.float64)
+    qs = np.unique(np.quantile(p, np.linspace(0, 1, nbins)))
+    bins = np.searchsorted(qs, p, side="left")
+    npos = np.bincount(bins, weights=y, minlength=len(qs) + 1)
+    nneg = np.bincount(bins, weights=1 - y, minlength=len(qs) + 1)
+    # descending threshold sweep
+    tp = np.cumsum(npos[::-1])[::-1]
+    fp = np.cumsum(nneg[::-1])[::-1]
+    P, Ntot = y.sum(), (1 - y).sum()
+    tpr = tp / max(P, 1e-12)
+    fpr = fp / max(Ntot, 1e-12)
+    return qs, tpr, fpr, tp, fp, P, Ntot
+
+
+def auc_exact(y: np.ndarray, p: np.ndarray) -> float:
+    """Exact rank AUC (ties handled) — matches AUC2 in the limit of one bin
+    per distinct score."""
+    y = np.asarray(y).astype(np.float64)
+    order = np.argsort(p, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(p) + 1)
+    # average ranks over ties
+    ps = np.asarray(p)[order]
+    uniq, start = np.unique(ps, return_index=True)
+    end = np.append(start[1:], len(ps))
+    avg = (start + 1 + end) / 2.0
+    tie_rank = np.empty(len(ps))
+    for s, e, a in zip(start, end, avg):
+        tie_rank[s:e] = a
+    r = np.empty_like(tie_rank)
+    r[order] = tie_rank
+    npos = y.sum()
+    nneg = len(y) - npos
+    if npos == 0 or nneg == 0:
+        return float("nan")
+    return float((r[y == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg))
+
+
+@dataclass
+class ModelMetricsBase:
+    mse: float = float("nan")
+    rmse: float = float("nan")
+    nobs: int = 0
+    description: str = ""
+
+    def _ser(self) -> Dict:
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+
+
+@dataclass
+class ModelMetricsRegression(ModelMetricsBase):
+    mae: float = float("nan")
+    rmsle: float = float("nan")
+    r2: float = float("nan")
+    mean_residual_deviance: float = float("nan")
+
+    @staticmethod
+    def make(y: np.ndarray, pred: np.ndarray) -> "ModelMetricsRegression":
+        y = np.asarray(y, np.float64)
+        pred = np.asarray(pred, np.float64)
+        err = pred - y
+        mse = float(np.mean(err**2))
+        with np.errstate(invalid="ignore"):
+            rmsle = (
+                float(np.sqrt(np.mean((np.log1p(pred) - np.log1p(y)) ** 2)))
+                if (pred > -1).all() and (y > -1).all()
+                else float("nan")
+            )
+        var = float(np.var(y))
+        return ModelMetricsRegression(
+            mse=mse, rmse=float(np.sqrt(mse)), nobs=len(y),
+            mae=float(np.mean(np.abs(err))), rmsle=rmsle,
+            r2=1.0 - mse / var if var > 0 else float("nan"),
+            mean_residual_deviance=mse,
+        )
+
+
+@dataclass
+class ModelMetricsBinomial(ModelMetricsBase):
+    auc: float = float("nan")
+    pr_auc: float = float("nan")
+    logloss: float = float("nan")
+    gini: float = float("nan")
+    mean_per_class_error: float = float("nan")
+    f1: float = float("nan")
+    accuracy: float = float("nan")
+    confusion_matrix: Optional[np.ndarray] = None
+    threshold: float = 0.5
+
+    @staticmethod
+    def make(y: np.ndarray, p: np.ndarray) -> "ModelMetricsBinomial":
+        y = np.asarray(y, np.float64)
+        p = np.clip(np.asarray(p, np.float64), 1e-15, 1 - 1e-15)
+        auc = auc_exact(y, p)
+        logloss = float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+        mse = float(np.mean((p - y) ** 2))
+        # max-F1 threshold via the AUC2-style binned sweep
+        qs, tpr, fpr, tp, fp, P, Ntot = roc_curve_binned(y, p)
+        fn = P - tp
+        prec = tp / np.maximum(tp + fp, 1e-12)
+        rec = tp / max(P, 1e-12)
+        f1s = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+        bi = int(np.argmax(f1s))
+        thr = float(qs[min(bi, len(qs) - 1)]) if len(qs) else 0.5
+        yhat = (p >= thr).astype(np.float64)
+        tp_, fp_ = float(((yhat == 1) & (y == 1)).sum()), float(((yhat == 1) & (y == 0)).sum())
+        tn_, fn_ = float(((yhat == 0) & (y == 0)).sum()), float(((yhat == 0) & (y == 1)).sum())
+        cm = np.asarray([[tn_, fp_], [fn_, tp_]])
+        err0 = fp_ / max(tn_ + fp_, 1e-12)
+        err1 = fn_ / max(tp_ + fn_, 1e-12)
+        # pr_auc by trapezoid over recall
+        order = np.argsort(rec)
+        pr_auc = float(np.trapezoid(prec[order], rec[order])) if len(rec) > 1 else float("nan")
+        return ModelMetricsBinomial(
+            mse=mse, rmse=float(np.sqrt(mse)), nobs=len(y),
+            auc=auc, pr_auc=pr_auc, logloss=logloss, gini=2 * auc - 1,
+            mean_per_class_error=(err0 + err1) / 2, f1=float(f1s[bi]),
+            accuracy=float((yhat == y).mean()), confusion_matrix=cm, threshold=thr,
+        )
+
+
+@dataclass
+class ModelMetricsMultinomial(ModelMetricsBase):
+    logloss: float = float("nan")
+    mean_per_class_error: float = float("nan")
+    accuracy: float = float("nan")
+    confusion_matrix: Optional[np.ndarray] = None
+
+    @staticmethod
+    def make(y: np.ndarray, probs: np.ndarray) -> "ModelMetricsMultinomial":
+        y = np.asarray(y).astype(np.int64)
+        probs = np.clip(np.asarray(probs, np.float64), 1e-15, 1.0)
+        probs = probs / probs.sum(axis=1, keepdims=True)
+        K = probs.shape[1]
+        n = len(y)
+        logloss = float(-np.mean(np.log(probs[np.arange(n), y])))
+        yhat = probs.argmax(axis=1)
+        cm = np.zeros((K, K))
+        np.add.at(cm, (y, yhat), 1)
+        with np.errstate(invalid="ignore"):
+            per_class_err = 1 - np.diag(cm) / np.maximum(cm.sum(axis=1), 1e-12)
+        onehot = np.zeros((n, K))
+        onehot[np.arange(n), y] = 1
+        mse = float(np.mean((probs - onehot) ** 2))
+        return ModelMetricsMultinomial(
+            mse=mse, rmse=float(np.sqrt(mse)), nobs=n, logloss=logloss,
+            mean_per_class_error=float(np.nanmean(per_class_err)),
+            accuracy=float((yhat == y).mean()), confusion_matrix=cm,
+        )
+
+
+@dataclass
+class ModelMetricsClustering(ModelMetricsBase):
+    tot_withinss: float = float("nan")
+    betweenss: float = float("nan")
+    totss: float = float("nan")
+
+
+def ndcg_at_k(y: np.ndarray, score: np.ndarray, qid: np.ndarray, k: int = 10) -> float:
+    """NDCG@k grouped by query — the lambdarank objective's eval metric
+    (XGBoost `rank:ndcg`, used by the MSLR-WEB30K baseline config)."""
+    total, nq = 0.0, 0
+    for q in np.unique(qid):
+        m = qid == q
+        rel = np.asarray(y)[m]
+        s = np.asarray(score)[m]
+        if len(rel) < 2:
+            continue
+        order = np.argsort(-s, kind="mergesort")
+        gains = (2 ** rel[order][:k] - 1) / np.log2(np.arange(2, min(k, len(rel)) + 2))
+        ideal = np.sort(rel)[::-1]
+        igains = (2 ** ideal[:k] - 1) / np.log2(np.arange(2, min(k, len(rel)) + 2))
+        if igains.sum() > 0:
+            total += gains.sum() / igains.sum()
+            nq += 1
+    return total / max(nq, 1)

@@ -1,0 +1,467 @@
+"""Model framework — estimator lifecycle, jobs, CV, early stopping, DataInfo.
+
+Reference parity:
+* `h2o-core/src/main/java/hex/Model.java` / `hex/ModelBuilder.java` — the
+  train/score lifecycle, n-fold CV orchestration (`computeCrossValidation`),
+  parameter validation.
+* `water/Job.java` — async job tracking (here: synchronous with progress).
+* `hex/ScoreKeeper.java` — early stopping on a moving average of the
+  stopping metric.
+* `hex/DataInfo.java` — the numeric adapter reused by GLM/DeepLearning/PCA/
+  KMeans: categorical one-hot expansion, standardization, NA mean-imputation.
+* `h2o-py/h2o/estimators/estimator_base.py` — the Python estimator facade
+  whose signatures (`train(x, y, training_frame, validation_frame)`,
+  `predict`, `model_performance`) are the compatibility contract.
+
+TPU note: builders prepare host-side numpy, then hand dense arrays to jitted
+training programs; the padded/sharded device placement happens inside each
+algorithm (see `tree.py`, `glm.py`, `deeplearning.py`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from .metrics import (
+    ModelMetricsBase,
+    ModelMetricsBinomial,
+    ModelMetricsMultinomial,
+    ModelMetricsRegression,
+)
+
+_model_counter = itertools.count()
+
+
+@dataclass
+class Job:
+    """`water.Job` — progress/cancel tracking for a training run."""
+
+    dest: str
+    description: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    progress: float = 0.0
+    status: str = "CREATED"  # CREATED/RUNNING/DONE/FAILED/CANCELLED
+    warnings: List[str] = field(default_factory=list)
+
+    def start(self):
+        self.start_time = time.time()
+        self.status = "RUNNING"
+        return self
+
+    def update(self, progress: float):
+        self.progress = float(progress)
+
+    def done(self):
+        self.end_time = time.time()
+        self.progress = 1.0
+        self.status = "DONE"
+
+    @property
+    def run_time(self) -> float:
+        return (self.end_time or time.time()) - self.start_time
+
+
+class ScoreKeeper:
+    """`hex.ScoreKeeper.stopEarly` — moving-average early stopping."""
+
+    def __init__(self, stopping_rounds: int, stopping_metric: str, tolerance: float,
+                 larger_is_better: Optional[bool] = None):
+        self.k = stopping_rounds
+        self.metric = stopping_metric
+        self.tol = tolerance
+        if larger_is_better is None:
+            larger_is_better = stopping_metric.lower() in ("auc", "pr_auc", "accuracy", "r2")
+        self.more = larger_is_better
+        self.history: List[float] = []
+
+    def record(self, value: float) -> bool:
+        """Record a scoring event; True ⇒ stop now (moving average of the
+        last k events is not better than the best before them by > tol)."""
+        self.history.append(float(value))
+        k = self.k
+        if k <= 0 or len(self.history) < 2 * k:
+            return False
+        hist = np.asarray(self.history)
+        recent = hist[-k:].mean()
+        prior = hist[:-k]
+        best_prior = prior.max() if self.more else prior.min()
+        margin = self.tol * max(abs(best_prior), 1e-12)
+        if self.more:
+            return recent <= best_prior + margin
+        return recent >= best_prior - margin
+
+
+class DataInfo:
+    """`hex.DataInfo` — Frame → dense numeric design matrix.
+
+    use_all_factor_levels / standardize / imputeMissing mirror the reference
+    flags; categorical expansion is one-hot (the reference's default enum
+    encoding for GLM/DL)."""
+
+    def __init__(
+        self,
+        frame: Frame,
+        x: Sequence[str],
+        standardize: bool = True,
+        use_all_factor_levels: bool = False,
+        impute_missing: bool = True,
+        max_categorical_levels: int = 1000,
+    ):
+        self.x = list(x)
+        self.standardize = standardize
+        self.use_all = use_all_factor_levels
+        self.coef_names: List[str] = []
+        self._spec = []  # per input col: ("num", name) | ("cat", name, domain)
+        for n in self.x:
+            v = frame.vec(n)
+            if v.type == "enum":
+                dom = (v.domain or [])[:max_categorical_levels]
+                self._spec.append(("cat", n, dom))
+                levels = dom if use_all_factor_levels else dom[1:]
+                self.coef_names += [f"{n}.{d}" for d in levels]
+            else:
+                self._spec.append(("num", n, None))
+                self.coef_names.append(n)
+        self.means: Optional[np.ndarray] = None
+        self.stds: Optional[np.ndarray] = None
+        self.impute_missing = impute_missing
+        self.col_means: Dict[str, float] = {}
+
+    def fit_transform(self, frame: Frame) -> np.ndarray:
+        X = self._expand(frame, fit=True)
+        if self.standardize:
+            self.means = np.nanmean(X, axis=0)
+            self.stds = np.nanstd(X, axis=0)
+            self.stds = np.where(self.stds < 1e-10, 1.0, self.stds)
+            X = (X - self.means) / self.stds
+        return np.nan_to_num(X, nan=0.0).astype(np.float32)
+
+    def transform(self, frame: Frame) -> np.ndarray:
+        X = self._expand(frame, fit=False)
+        if self.standardize and self.means is not None:
+            X = (X - self.means) / self.stds
+        return np.nan_to_num(X, nan=0.0).astype(np.float32)
+
+    def _expand(self, frame: Frame, fit: bool) -> np.ndarray:
+        cols = []
+        for kind, n, dom in self._spec:
+            v = frame.vec(n)
+            if kind == "num":
+                c = v.numeric_np()
+                if self.impute_missing:
+                    if fit:
+                        self.col_means[n] = float(np.nanmean(c))
+                    c = np.where(np.isnan(c), self.col_means.get(n, 0.0), c)
+                cols.append(c[:, None])
+            else:
+                codes = np.asarray(v.data)
+                if v.domain != dom and v.domain:
+                    remap = np.asarray(
+                        [dom.index(d) if d in dom else -1 for d in v.domain], np.int64
+                    )
+                    codes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+                K = len(dom)
+                oh = np.zeros((len(codes), K))
+                valid = codes >= 0
+                oh[np.nonzero(valid)[0], codes[valid]] = 1.0
+                if not self.use_all and K > 0:
+                    oh = oh[:, 1:]
+                cols.append(oh)
+        return np.concatenate(cols, axis=1) if cols else np.zeros((frame.nrow, 0))
+
+
+class H2OModel:
+    """Trained-model half of `hex.Model` + the `h2o-py` ModelBase surface."""
+
+    algo = "base"
+
+    def __init__(self, params: "H2OEstimator"):
+        self.parms = params
+        self.model_id = f"{self.algo}_{next(_model_counter)}"
+        self.training_metrics: Optional[ModelMetricsBase] = None
+        self.validation_metrics: Optional[ModelMetricsBase] = None
+        self.cross_validation_metrics: Optional[ModelMetricsBase] = None
+        self.scoring_history: List[Dict[str, Any]] = []
+        self.varimp_table: Optional[List] = None
+        self.run_time: float = 0.0
+        self._cv_holdout_pred: Optional[np.ndarray] = None
+
+    # -- metric accessors (h2o-py ModelBase) --------------------------------
+    def _m(self, valid=False, xval=False):
+        if xval and self.cross_validation_metrics:
+            return self.cross_validation_metrics
+        if valid and self.validation_metrics:
+            return self.validation_metrics
+        return self.training_metrics
+
+    def auc(self, valid=False, xval=False):
+        return getattr(self._m(valid, xval), "auc", float("nan"))
+
+    def logloss(self, valid=False, xval=False):
+        return getattr(self._m(valid, xval), "logloss", float("nan"))
+
+    def rmse(self, valid=False, xval=False):
+        return self._m(valid, xval).rmse
+
+    def mse(self, valid=False, xval=False):
+        return self._m(valid, xval).mse
+
+    def mae(self, valid=False, xval=False):
+        return getattr(self._m(valid, xval), "mae", float("nan"))
+
+    def r2(self, valid=False, xval=False):
+        return getattr(self._m(valid, xval), "r2", float("nan"))
+
+    def mean_per_class_error(self, valid=False, xval=False):
+        return getattr(self._m(valid, xval), "mean_per_class_error", float("nan"))
+
+    def varimp(self, use_pandas=False):
+        return self.varimp_table
+
+    def predict(self, test_data: Frame) -> Frame:
+        raise NotImplementedError
+
+    def model_performance(self, test_data: Optional[Frame] = None, **kw):
+        if test_data is None:
+            return self.training_metrics
+        return self._make_metrics(test_data)
+
+    def _make_metrics(self, frame: Frame):
+        raise NotImplementedError
+
+
+class H2OEstimator:
+    """Parameter-holder + builder — `hex.ModelBuilder` merged with the
+    generated `h2o-py` estimator classes (h2o-bindings/bin/gen_python.py).
+
+    Subclasses define `_param_defaults` and `_fit`; unknown kwargs raise like
+    the reference's schema validation does."""
+
+    algo = "base"
+    supervised = True
+    _param_defaults: Dict[str, Any] = {}
+    _common_defaults: Dict[str, Any] = dict(
+        model_id=None,
+        seed=-1,
+        max_runtime_secs=0.0,
+        ignored_columns=None,
+        ignore_const_cols=True,
+        weights_column=None,
+        offset_column=None,
+        fold_column=None,
+        nfolds=0,
+        fold_assignment="AUTO",
+        keep_cross_validation_predictions=False,
+        keep_cross_validation_models=True,
+        stopping_rounds=0,
+        stopping_metric="AUTO",
+        stopping_tolerance=0.001,
+        score_each_iteration=False,
+        categorical_encoding="AUTO",
+        export_checkpoints_dir=None,
+        checkpoint=None,
+    )
+
+    def __init__(self, **kwargs):
+        self._parms: Dict[str, Any] = dict(self._common_defaults)
+        self._parms.update(self._param_defaults)
+        for k, v in kwargs.items():
+            if k not in self._parms:
+                raise TypeError(f"{type(self).__name__}: unknown parameter {k!r}")
+            self._parms[k] = v
+        self._model: Optional[H2OModel] = None
+        self.job: Optional[Job] = None
+
+    def __getattr__(self, name):
+        parms = object.__getattribute__(self, "_parms")
+        if name in parms:
+            return parms[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or name in ("job",):
+            object.__setattr__(self, name, value)
+        elif name in self._parms:
+            self._parms[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    @property
+    def actual_params(self) -> Dict[str, Any]:
+        return dict(self._parms)
+
+    # -- training entrypoint (estimator_base.train) -------------------------
+    def train(
+        self,
+        x: Optional[Sequence[str]] = None,
+        y: Optional[str] = None,
+        training_frame: Optional[Frame] = None,
+        validation_frame: Optional[Frame] = None,
+        **kw,
+    ) -> "H2OEstimator":
+        if training_frame is None:
+            raise ValueError("training_frame is required")
+        if self.supervised and y is None:
+            raise ValueError(f"{self.algo}: response column y is required")
+        ignored = set(self._parms.get("ignored_columns") or [])
+        if x is None:
+            x = [
+                n for n in training_frame.names
+                if n != y and n not in ignored
+                and n not in (self._parms.get("weights_column"),
+                              self._parms.get("offset_column"),
+                              self._parms.get("fold_column"))
+            ]
+        else:
+            x = [training_frame.names[i] if isinstance(i, int) else i for i in x]
+            x = [n for n in x if n != y and n not in ignored]
+        if self._parms.get("ignore_const_cols", True):
+            x = [n for n in x if not _is_const(training_frame.vec(n))]
+
+        if self.supervised and y is not None:
+            # rows with a missing response are dropped before training —
+            # ModelBuilder.init response filtering (hex/ModelBuilder.java)
+            na = training_frame.vec(y).isna_np()
+            if na.any():
+                training_frame = training_frame.take(np.nonzero(~na)[0])
+            if validation_frame is not None:
+                nav = validation_frame.vec(y).isna_np()
+                if nav.any():
+                    validation_frame = validation_frame.take(np.nonzero(~nav)[0])
+
+        self.job = Job(dest=f"{self.algo}_{next(_model_counter)}",
+                       description=f"{self.algo} train").start()
+        t0 = time.time()
+        seed = int(self._parms.get("seed", -1))
+        if seed in (-1, None):
+            self._parms["_actual_seed"] = 1234
+        else:
+            self._parms["_actual_seed"] = seed
+
+        nfolds = int(self._parms.get("nfolds") or 0)
+        model = self._fit(x, y, training_frame, validation_frame)
+        if nfolds >= 2 and self.supervised:
+            self._run_cv(model, x, y, training_frame, nfolds)
+        model.run_time = time.time() - t0
+        self.job.done()
+        self._model = model
+        return self
+
+    # -- n-fold CV (ModelBuilder.computeCrossValidation) --------------------
+    def _run_cv(self, model: H2OModel, x, y, train: Frame, nfolds: int):
+        n = train.nrow
+        rng = np.random.default_rng(self._parms["_actual_seed"])
+        fold_col = self._parms.get("fold_column")
+        if fold_col:
+            assign = train.vec(fold_col).numeric_np().astype(np.int64)
+            folds = np.unique(assign)
+        else:
+            mode = self._parms.get("fold_assignment", "AUTO")
+            if mode in ("AUTO", "Random"):
+                assign = rng.integers(0, nfolds, n)
+            elif mode == "Modulo":
+                assign = np.arange(n) % nfolds
+            else:  # Stratified — approximate by per-class modulo
+                yv = train.vec(y).numeric_np()
+                order = np.argsort(yv, kind="mergesort")
+                assign = np.empty(n, np.int64)
+                assign[order] = np.arange(n) % nfolds
+            folds = np.arange(nfolds)
+        holdout = None
+        ys, ps = [], []
+        for f in folds:
+            tr = train.take(np.nonzero(assign != f)[0])
+            ho = train.take(np.nonzero(assign == f)[0])
+            sub = type(self)()
+            sub._parms.update(
+                {k: v for k, v in self._parms.items() if not k.startswith("_")}
+            )
+            sub._parms["nfolds"] = 0
+            sub._parms["_actual_seed"] = self._parms["_actual_seed"]
+            cvm = sub._fit(x, y, tr, None)
+            pred = sub._cv_predict(cvm, ho)
+            if holdout is None:
+                holdout = np.zeros((n,) + pred.shape[1:], dtype=np.float64)
+            holdout[assign == f] = pred
+            ys.append(ho.vec(y))
+            ps.append(pred)
+        model._cv_holdout_pred = holdout
+        model.cross_validation_metrics = self._metrics_from_cv(train.vec(y), assign, holdout)
+
+    def _metrics_from_cv(self, yvec: Vec, assign, holdout):
+        if yvec.type == "enum" and yvec.nlevels == 2:
+            return ModelMetricsBinomial.make(np.asarray(yvec.data), holdout[:, -1] if holdout.ndim > 1 else holdout)
+        if yvec.type == "enum":
+            return ModelMetricsMultinomial.make(np.asarray(yvec.data), holdout)
+        return ModelMetricsRegression.make(yvec.numeric_np(), holdout if holdout.ndim == 1 else holdout[:, 0])
+
+    def _cv_predict(self, model: H2OModel, frame: Frame) -> np.ndarray:
+        """Holdout prediction as probabilities (classif) or values (regr)."""
+        raise NotImplementedError
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> H2OModel:
+        raise NotImplementedError
+
+    # -- model delegation ---------------------------------------------------
+    @property
+    def model(self) -> H2OModel:
+        if self._model is None:
+            raise ValueError("model not trained; call train() first")
+        return self._model
+
+    def predict(self, test_data: Frame) -> Frame:
+        return self.model.predict(test_data)
+
+    def model_performance(self, test_data=None, valid=False, xval=False):
+        if test_data is not None:
+            return self.model.model_performance(test_data)
+        return self.model._m(valid=valid, xval=xval)
+
+    # metric passthroughs
+    def auc(self, **kw):
+        return self.model.auc(**kw)
+
+    def logloss(self, **kw):
+        return self.model.logloss(**kw)
+
+    def rmse(self, **kw):
+        return self.model.rmse(**kw)
+
+    def mse(self, **kw):
+        return self.model.mse(**kw)
+
+    def varimp(self, **kw):
+        return self.model.varimp(**kw)
+
+    @property
+    def scoring_history(self):
+        return self.model.scoring_history
+
+    @property
+    def model_id(self):
+        return self.model.model_id
+
+
+def _is_const(v: Vec) -> bool:
+    if v.type == "string":
+        return False
+    a = v.numeric_np()
+    fin = a[~np.isnan(a)]
+    return fin.size > 0 and float(fin.min()) == float(fin.max())
+
+
+def response_info(yvec: Vec):
+    """(problem_kind, nclass, domain) from the response Vec — mirrors
+    ModelBuilder's distribution inference from response type."""
+    if yvec.type == "enum":
+        k = yvec.nlevels
+        return ("binomial" if k == 2 else "multinomial"), k, yvec.domain
+    return "regression", 1, None

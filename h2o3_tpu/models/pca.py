@@ -1,0 +1,117 @@
+"""H2OPrincipalComponentAnalysisEstimator (+ SVD) — dimensionality reduction.
+
+Reference parity: `h2o-algos/src/main/java/hex/pca/PCA.java`
+(`pca_method` ∈ {GramSVD, Power, GLRM, Randomized}) and `hex/svd/SVD.java`.
+GramSVD — the reference default — is exactly the TPU-friendly path: the
+(p×p) Gram `X'X` is one einsum over row-sharded data (psum inserted by XLA,
+replacing the Gram MRTask of `hex/gram/Gram.java`), then a tiny host-side
+eigendecomposition. Randomized projection (Halko) is provided for wide data.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from .metrics import ModelMetricsBase
+from .model_base import DataInfo, H2OEstimator, H2OModel
+
+
+class PCAModel(H2OModel):
+    algo = "pca"
+
+    def __init__(self, params, x, dinfo, eigenvectors, eigenvalues, k):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = None
+        self.dinfo = dinfo
+        self.eigenvectors = eigenvectors  # (p, k)
+        self.eigenvalues = eigenvalues    # (k,) variances
+        self.k = k
+
+    @property
+    def importance(self):
+        ev = np.asarray(self.eigenvalues, np.float64)
+        sd = np.sqrt(np.maximum(ev, 0))
+        prop = ev / max(ev.sum(), 1e-300)
+        return {
+            "Standard deviation": sd,
+            "Proportion of Variance": prop,
+            "Cumulative Proportion": np.cumsum(prop),
+        }
+
+    def predict(self, test_data: Frame) -> Frame:
+        X = self.dinfo.transform(test_data)
+        scores = X @ np.asarray(self.eigenvectors)
+        return Frame.from_dict({f"PC{i+1}": scores[:, i] for i in range(self.k)})
+
+    transform = predict
+
+    def _make_metrics(self, frame: Frame):
+        return self.training_metrics
+
+
+class H2OPrincipalComponentAnalysisEstimator(H2OEstimator):
+    algo = "pca"
+    supervised = False
+    _param_defaults = dict(
+        k=1,
+        transform="NONE",
+        pca_method="GramSVD",
+        use_all_factor_levels=False,
+        compute_metrics=True,
+        impute_missing=True,
+        max_iterations=1000,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> PCAModel:
+        p = self._parms
+        k = int(p.get("k", 1))
+        transform = p.get("transform", "NONE")
+        standardize = transform in ("STANDARDIZE", "NORMALIZE")
+        dinfo = DataInfo(
+            train, x, standardize=standardize,
+            use_all_factor_levels=bool(p.get("use_all_factor_levels", False)),
+        )
+        X = dinfo.fit_transform(train)
+        n, pdim = X.shape
+        if transform in ("DEMEAN", "DESCALE") or transform == "NONE":
+            mu = X.mean(axis=0) if transform == "DEMEAN" else np.zeros(pdim)
+            if transform == "DEMEAN":
+                X = X - mu
+        k = min(k, pdim)
+        method = p.get("pca_method", "GramSVD")
+
+        Xd = jnp.asarray(X)
+        if method in ("GramSVD", "GLRM", "Power"):
+            gram = np.asarray(jax.jit(lambda X: X.T @ X)(Xd), np.float64) / max(n - 1, 1)
+            evals, evecs = np.linalg.eigh(gram)
+            order = np.argsort(-evals)
+            evals = np.maximum(evals[order][:k], 0)
+            evecs = evecs[:, order][:, :k]
+        else:  # Randomized (Halko) — sketch on device, QR/SVD on host
+            rng = np.random.default_rng(p["_actual_seed"])
+            om = jnp.asarray(rng.normal(size=(pdim, min(k + 10, pdim))).astype(np.float32))
+            Y = np.asarray(jax.jit(lambda X, om: X @ om)(Xd, om), np.float64)
+            Q, _ = np.linalg.qr(Y)
+            B = np.asarray(jax.jit(lambda X, Q: Q.T @ X)(Xd, jnp.asarray(Q, jnp.float32)))
+            _, s, Vt = np.linalg.svd(B, full_matrices=False)
+            evecs = Vt[:k].T
+            evals = (s[:k] ** 2) / max(n - 1, 1)
+
+        # deterministic sign (largest |loading| positive)
+        for j in range(evecs.shape[1]):
+            i = np.abs(evecs[:, j]).argmax()
+            if evecs[i, j] < 0:
+                evecs[:, j] = -evecs[:, j]
+
+        model = PCAModel(self, x, dinfo, evecs, evals, k)
+        model.training_metrics = ModelMetricsBase(nobs=n)
+        return model
+
+
+PCA = H2OPrincipalComponentAnalysisEstimator

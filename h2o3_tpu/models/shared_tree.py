@@ -1,0 +1,484 @@
+"""SharedTree driver — the boosting/forest loop over the jitted tree builder.
+
+Reference parity: `h2o-algos/src/main/java/hex/tree/SharedTree.java`
+(`Driver.computeImpl`: init counts → outer tree loop → score/early-stop) and
+`hex/tree/gbm/GBM.java` (`GBMDriver.buildNextKTrees`: k trees per iteration,
+one per class). Scoring cadence follows `score_tree_interval` /
+`score_each_iteration`; early stopping is `hex/ScoreKeeper.java` semantics;
+variable importance is squared-error-reduction per feature
+(`hex/tree/SharedTree.java` varimp from split gains).
+
+The per-tree step (gradients → histograms → splits → partition) is one XLA
+program (see `tree.py`); on a multi-device cloud it runs under `shard_map`
+with rows sharded over ``hosts`` and histogram merges as `lax.psum` —
+replacing the MRTask RPC-tree reduce of `ScoreBuildHistogram2.java`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..frame.binning import BinnedMatrix, bin_apply, build_bins
+from ..frame.frame import Frame
+from ..parallel import mesh as cloudlib
+from . import distributions as dist_mod
+from . import tree as treelib
+from .metrics import (
+    ModelMetricsBinomial,
+    ModelMetricsMultinomial,
+    ModelMetricsRegression,
+)
+from .model_base import DataInfo, H2OEstimator, H2OModel, ScoreKeeper, response_info
+
+
+_predict_codes_jit = jax.jit(treelib.predict_codes, static_argnames=("max_depth",))
+
+
+def frame_to_matrix(frame: Frame, x: Sequence[str], expected_domains=None):
+    """Frame → (X float64 with NaN NAs, is_categorical, domains). Enums stay
+    as integer codes (the DHistogram categorical-bins path), not one-hot.
+
+    expected_domains (training-time domains, aligned with x) triggers test-
+    frame adaptation: codes are remapped label→training-code, unseen levels
+    become NA — `hex/Model.adaptTestForTrain` semantics."""
+    cols, cats, doms = [], [], []
+    for i, n in enumerate(x):
+        v = frame.vec(n)
+        col = v.numeric_np()
+        exp = expected_domains[i] if expected_domains is not None else None
+        if v.type == "enum" and exp is not None and v.domain != exp:
+            lookup = {lbl: j for j, lbl in enumerate(exp)}
+            remap = np.asarray(
+                [lookup.get(lbl, -1) for lbl in (v.domain or [])], np.float64
+            )
+            codes = np.asarray(v.data)
+            col = np.where(
+                codes >= 0,
+                remap[np.maximum(codes, 0)] if len(remap) else -1.0,
+                -1.0,
+            )
+            col = np.where(col < 0, np.nan, col)
+        cols.append(col)
+        cats.append(v.type == "enum")
+        doms.append(v.domain)
+    return np.column_stack(cols), np.asarray(cats), doms
+
+
+class SharedTreeModel(H2OModel):
+    algo = "sharedtree"
+
+    def __init__(self, params, x, y, bm: BinnedMatrix, problem, nclass, domain,
+                 distribution, f0, forest, max_depth, mode="gbm"):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = y
+        self.bm = bm
+        self.problem = problem
+        self.nclass = nclass
+        self.domain = domain
+        self.distribution = distribution
+        self.f0 = f0              # scalar or (K,) initial margin
+        self.forest = forest      # list over classes of stacked Tree arrays
+        self.max_depth = max_depth
+        self.mode = mode          # 'gbm' (summed margins) | 'drf' (averaged leaves)
+        self.ntrees_built = int(forest[0].feat.shape[0]) if forest else 0
+
+    def _matrix(self, frame: Frame) -> np.ndarray:
+        X, _, _ = frame_to_matrix(frame, self.x, expected_domains=self.bm.domains)
+        return X
+
+    # margin(s) on raw feature matrix
+    def _margins(self, X: np.ndarray) -> np.ndarray:
+        Xj = jnp.asarray(X, jnp.float32)
+        outs = []
+        for k, stacked in enumerate(self.forest):
+            s = treelib.predict_forest_raw(stacked, Xj, self.max_depth)
+            f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[k]
+            outs.append(np.asarray(s, np.float64) + f0k)
+        return np.column_stack(outs)
+
+    def _score_probs(self, X: np.ndarray, offset: Optional[np.ndarray] = None) -> np.ndarray:
+        m = self._margins(X)
+        if offset is not None and self.mode != "drf":
+            m = m + offset[:, None]
+        if self.mode == "drf":
+            # DRF: leaf values are per-leaf response means; prediction is the
+            # forest average (hex/tree/drf/DRFModel.score0 vote averaging)
+            m = m / max(self.ntrees_built, 1)
+            if self.problem == "binomial":
+                p1 = np.clip(m[:, 0], 0.0, 1.0)
+                return np.column_stack([1 - p1, p1])
+            if self.problem == "multinomial":
+                p = np.clip(m, 0.0, None)
+                s = p.sum(axis=1, keepdims=True)
+                return np.where(s > 0, p / np.maximum(s, 1e-12), 1.0 / p.shape[1])
+            return m[:, :1]
+        if self.problem == "binomial":
+            p1 = 1 / (1 + np.exp(-m[:, 0]))
+            return np.column_stack([1 - p1, p1])
+        if self.problem == "multinomial":
+            e = np.exp(m - m.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        mm = m[:, 0]
+        if self.distribution in ("poisson", "gamma", "tweedie"):
+            return np.exp(mm)[:, None]
+        return mm[:, None]
+
+    def _offset_of(self, frame: Frame) -> Optional[np.ndarray]:
+        oc = self.parms._parms.get("offset_column") if hasattr(self.parms, "_parms") else None
+        if oc and oc in frame.names:
+            return frame.vec(oc).numeric_np()
+        return None
+
+    def predict(self, test_data: Frame) -> Frame:
+        out = self._score_probs(self._matrix(test_data), self._offset_of(test_data))
+        if self.problem in ("binomial", "multinomial"):
+            lab = out.argmax(axis=1)
+            d = {"predict": np.asarray(self.domain, dtype=object)[lab]}
+            for i, cls in enumerate(self.domain):
+                d[str(cls)] = out[:, i]
+            fr = Frame.from_dict(d, column_types={"predict": "enum"})
+            return fr
+        return Frame.from_dict({"predict": out[:, 0]})
+
+    def _make_metrics(self, frame: Frame):
+        out = self._score_probs(self._matrix(frame), self._offset_of(frame))
+        yv = frame.vec(self.y)
+        if self.problem == "binomial":
+            return ModelMetricsBinomial.make(np.asarray(yv.data), out[:, 1])
+        if self.problem == "multinomial":
+            return ModelMetricsMultinomial.make(np.asarray(yv.data), out)
+        return ModelMetricsRegression.make(yv.numeric_np(), out[:, 0])
+
+
+class H2OSharedTreeEstimator(H2OEstimator):
+    """Common GBM/DRF/IF driver. Subclasses set `_mode` ('gbm'|'drf')."""
+
+    _mode = "gbm"
+
+    def _tree_params(self) -> Dict:
+        p = self._parms
+        return dict(
+            ntrees=int(p.get("ntrees", 50)),
+            max_depth=int(p.get("max_depth", 5 if self._mode == "gbm" else 20)),
+            min_rows=float(p.get("min_rows", 10.0 if self._mode == "gbm" else 1.0)),
+            nbins=int(p.get("nbins", 20)),
+            learn_rate=float(p.get("learn_rate", 0.1)),
+            learn_rate_annealing=float(p.get("learn_rate_annealing", 1.0)),
+            sample_rate=float(p.get("sample_rate", 1.0 if self._mode == "gbm" else 0.632)),
+            col_sample_rate=float(p.get("col_sample_rate", 1.0)),
+            col_sample_rate_per_tree=float(p.get("col_sample_rate_per_tree", 1.0)),
+            min_split_improvement=float(p.get("min_split_improvement", 1e-5)),
+            histogram_type=p.get("histogram_type", "AUTO"),
+            mtries=int(p.get("mtries", -1)) if "mtries" in p else 0,
+            reg_lambda=float(p.get("reg_lambda"))
+            if p.get("reg_lambda") is not None
+            else (0.0 if self._mode == "drf" else 1.0),
+        )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
+        tp = self._tree_params()
+        seed = self._parms["_actual_seed"]
+        yvec = train.vec(y)
+        problem, nclass, domain = response_info(yvec)
+        dist = dist_mod.infer_distribution(
+            problem, self._parms.get("distribution", "AUTO")
+        )
+        if self._mode == "drf":
+            # DRF trees fit raw response means (no boosting margin)
+            dist = "gaussian" if problem == "regression" else dist
+
+        X, is_cat, doms = frame_to_matrix(train, x)
+        n, F = X.shape
+        # clamp nbins to max categorical cardinality like nbins_cats
+        max_card = int(max([len(d) for d, c in zip(doms, is_cat) if c and d], default=0))
+        nbins = max(tp["nbins"] + 1, min(max_card + 1, 1 << 10))
+        bm = build_bins(
+            X, nbins=nbins, histogram_type=tp["histogram_type"], names=list(x),
+            is_categorical=is_cat, domains=doms, seed=seed,
+        )
+
+        w = (
+            train.vec(self._parms["weights_column"]).numeric_np()
+            if self._parms.get("weights_column")
+            else np.ones(n)
+        ).astype(np.float32)
+        offset = (
+            train.vec(self._parms["offset_column"]).numeric_np().astype(np.float32)
+            if self._parms.get("offset_column")
+            else None
+        )
+
+        if problem == "regression":
+            yk = yvec.numeric_np().astype(np.float32)[:, None]
+            K = 1
+        elif problem == "binomial":
+            yk = np.asarray(yvec.data, np.float32)[:, None]
+            K = 1
+        else:
+            K = nclass
+            codes = np.asarray(yvec.data)
+            yk = np.zeros((n, K), np.float32)
+            yk[np.arange(n), codes] = 1.0
+
+        # initial margins
+        if self._mode == "drf":
+            f0 = np.zeros(K, np.float32)
+        elif problem == "multinomial":
+            pri = np.average(yk, axis=0, weights=w)
+            f0 = np.log(np.clip(pri, 1e-10, 1.0)).astype(np.float32)
+        else:
+            f0 = np.float32(dist_mod.init_margin(dist, yk[:, 0], w))
+            f0 = np.asarray([f0])
+
+        cloud = cloudlib.cloud()
+        ndev = cloud.size
+        npad = cloudlib.pad_to_multiple(n, max(ndev * 8, 8))
+        pad = npad - n
+
+        def padr(a, fill=0):
+            if a.ndim == 1:
+                return np.concatenate([a, np.full(pad, fill, a.dtype)])
+            return np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+
+        codes_d = jnp.asarray(padr(bm.codes))
+        y_d = jnp.asarray(padr(yk))
+        w_d = jnp.asarray(padr(w))
+        edges = np.full((F, nbins - 2), np.inf, np.float32)
+        for j, e in enumerate(bm.edges):
+            edges[j, : min(len(e), nbins - 2)] = e[: nbins - 2]
+        edges_d = jnp.asarray(edges)
+
+        if ndev > 1:
+            rs = cloud.row_sharding()
+            codes_d = jax.device_put(codes_d, rs)
+            y_d = jax.device_put(y_d, rs)
+            w_d = jax.device_put(w_d, rs)
+            edges_d = jax.device_put(edges_d, cloud.replicated())
+
+        margins = jnp.broadcast_to(jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
+        if offset is not None:
+            margins = margins + jnp.asarray(padr(offset))[:, None]
+        if ndev > 1:
+            margins = jax.device_put(margins, cloud.row_sharding())
+
+        # validation margins tracked incrementally per tree (the Score pass of
+        # SharedTree.Driver on the validation frame) — early stopping uses the
+        # validation metric when a validation_frame is given (ScoreKeeper)
+        valid_state = None
+        if valid is not None:
+            Xv, _, _ = frame_to_matrix(valid, x, expected_domains=bm.domains)
+            codes_v = jnp.asarray(bin_apply(bm, Xv))
+            yvv = valid.vec(y)
+            if problem == "regression":
+                ykv = yvv.numeric_np().astype(np.float32)[:, None]
+            elif problem == "binomial":
+                ykv = np.asarray(yvv.data, np.float32)[:, None]
+            else:
+                cv = np.asarray(yvv.data)
+                ykv = np.zeros((valid.nrow, K), np.float32)
+                ykv[np.arange(valid.nrow), cv] = 1.0
+            margins_v = jnp.broadcast_to(
+                jnp.asarray(f0)[None, :], (valid.nrow, K)
+            ).astype(jnp.float32)
+            if self._parms.get("offset_column") and self._parms["offset_column"] in valid.names:
+                off_v = valid.vec(self._parms["offset_column"]).numeric_np().astype(np.float32)
+                margins_v = margins_v + jnp.asarray(off_v)[:, None]
+            valid_state = [codes_v, ykv, margins_v]
+
+        key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
+        mtries = tp["mtries"]
+        if self._mode == "drf":
+            if mtries in (-1, 0):
+                mtries = max(1, int(np.sqrt(F))) if problem != "regression" else max(1, F // 3)
+            elif mtries == -2:
+                mtries = F
+        else:
+            mtries = 0
+
+        trees: List[List] = [[] for _ in range(K)]
+        gain_total = np.zeros(F, np.float64)
+        stopper = (
+            ScoreKeeper(
+                int(self._parms.get("stopping_rounds", 0)),
+                self._default_stopping_metric(problem),
+                float(self._parms.get("stopping_tolerance", 1e-3)),
+            )
+            if int(self._parms.get("stopping_rounds", 0)) > 0
+            else None
+        )
+        score_interval = int(self._parms.get("score_tree_interval", 0) or 0)
+        lr = tp["learn_rate"] if self._mode == "gbm" else 1.0
+        max_runtime = float(self._parms.get("max_runtime_secs", 0) or 0)
+        t0 = time.time()
+        history: List[Dict] = []
+        built = 0
+
+        for m in range(tp["ntrees"]):
+            key, krow, kcol, ktree = jax.random.split(key, 4)
+            row_mask = (
+                jax.random.uniform(krow, (npad,)) < tp["sample_rate"]
+            ).astype(jnp.float32)
+            wt = w_d * row_mask
+            colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
+            if colp < 1.0:
+                fm = (jax.random.uniform(kcol, (F,)) < colp).astype(jnp.float32)
+                fm = fm.at[0].set(jnp.maximum(fm[0], 1 - fm.sum().clip(0, 1)))
+            else:
+                fm = jnp.ones(F, jnp.float32)
+
+            for k in range(K):
+                if self._mode == "drf":
+                    g = -y_d[:, k]
+                    h = jnp.ones_like(g)
+                else:
+                    if problem == "multinomial":
+                        p = jax.nn.softmax(margins, axis=1)
+                        g = p[:, k] - y_d[:, k]
+                        h = p[:, k] * (1 - p[:, k])
+                    else:
+                        g, h = dist_mod.grad_hess(
+                            dist, margins[:, 0], y_d[:, 0],
+                            tweedie_power=float(self._parms.get("tweedie_power", 1.5))
+                            if "tweedie_power" in self._parms else 1.5,
+                            alpha=float(self._parms.get("quantile_alpha", 0.5))
+                            if "quantile_alpha" in self._parms else 0.5,
+                        )
+                tr, leaf_idx, gains = self._build_one(
+                    codes_d, g, h, wt, fm, edges_d, tp, nbins, mtries, ktree, cloud
+                )
+                scale = lr * (tp["learn_rate_annealing"] ** m)
+                tr = tr._replace(value=tr.value * scale)
+                if self._mode != "drf":
+                    margins = margins.at[:, k].add(tr.value[leaf_idx])
+                    if valid_state is not None:
+                        vleaf = _predict_codes_jit(tr, valid_state[0], tp["max_depth"])
+                        valid_state[2] = valid_state[2].at[:, k].add(vleaf)
+                trees[k].append(jax.tree.map(np.asarray, tr))
+                gain_total += np.asarray(gains, np.float64)
+            built = m + 1
+
+            do_score = (
+                (score_interval and built % score_interval == 0)
+                or self._parms.get("score_each_iteration")
+                or (stopper is not None and not score_interval)
+            )
+            if do_score:
+                ev = self._score_event(problem, dist, margins, y_d, w_d, n, built)
+                if valid_state is not None:
+                    vev = self._score_event(
+                        problem, dist, valid_state[2],
+                        jnp.asarray(valid_state[1]), None, valid_state[1].shape[0],
+                        built,
+                    )
+                    ev.update({f"validation_{k2}": v for k2, v in vev.items()
+                               if k2 not in ("number_of_trees", "timestamp")})
+                history.append(ev)
+                if stopper is not None:
+                    # ScoreKeeper watches validation when present (hex.ScoreKeeper)
+                    key_name = (
+                        f"validation_{stopper.metric}"
+                        if valid_state is not None else stopper.metric
+                    )
+                    val = ev.get(key_name)
+                    if val is None:
+                        val = ev.get(
+                            "validation_training_deviance"
+                            if valid_state is not None else "training_deviance",
+                            np.nan,
+                        )
+                    if stopper.record(val):
+                        break
+            if max_runtime and time.time() - t0 > max_runtime:
+                break
+            if self.job:
+                self.job.update(built / tp["ntrees"])
+
+        forest = [treelib.stack_trees([t for t in trees[k]]) for k in range(K)]
+        model = SharedTreeModel(
+            self, x, y, bm, problem, nclass, domain, dist,
+            np.asarray(f0) if K > 1 else float(f0[0]),
+            forest, tp["max_depth"], mode=self._mode,
+        )
+        model.scoring_history = history
+        if gain_total.sum() > 0:
+            order = np.argsort(-gain_total)
+            model.varimp_table = [
+                (list(x)[i], float(gain_total[i]),
+                 float(gain_total[i] / gain_total.max()),
+                 float(gain_total[i] / gain_total.sum()))
+                for i in order
+            ]
+        model.training_metrics = model._make_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._make_metrics(valid)
+        return model
+
+    def _build_one(self, codes, g, h, w, fm, edges, tp, nbins, mtries, key, cloud):
+        kwargs = dict(
+            max_depth=tp["max_depth"], nbins=nbins, min_rows=tp["min_rows"],
+            min_split_improvement=tp["min_split_improvement"],
+            reg_lambda=tp["reg_lambda"], mtries=mtries,
+        )
+        if cloud.size > 1:
+            from jax import shard_map
+
+            rspec = P(cloudlib.ROWS_AXIS)
+
+            def inner(codes, g, h, w, fm, edges, key):
+                return treelib.build_tree(
+                    codes, g, h, w, fm, edges, key=key,
+                    axis_name=cloudlib.ROWS_AXIS, **kwargs,
+                )
+
+            fn = shard_map(
+                inner, mesh=cloud.mesh,
+                in_specs=(rspec, rspec, rspec, rspec, P(), P(), P()),
+                out_specs=(
+                    treelib.Tree(P(), P(), P(), P(), P()), rspec, P(),
+                ),
+            )
+            return fn(codes, g, h, w, fm, edges, key)
+        return treelib.build_tree(codes, g, h, w, fm, edges, key=key, **kwargs)
+
+    def _default_stopping_metric(self, problem):
+        sm = self._parms.get("stopping_metric", "AUTO")
+        if sm and sm != "AUTO":
+            return sm.lower()
+        return "logloss" if problem in ("binomial", "multinomial") else "deviance"
+
+    def _score_event(self, problem, dist, margins, y_d, w_d, n, ntrees) -> Dict:
+        m = np.asarray(margins)[:n].astype(np.float64)
+        y = np.asarray(y_d)[:n].astype(np.float64)
+        ev: Dict = {"number_of_trees": ntrees, "timestamp": time.time()}
+        if problem == "binomial":
+            p = 1 / (1 + np.exp(-m[:, 0]))
+            p = np.clip(p, 1e-15, 1 - 1e-15)
+            ev["logloss"] = float(-np.mean(np.log(np.where(y[:, 0] > 0.5, p, 1 - p))))
+            ev["auc"] = float("nan")  # full AUC computed at final scoring
+            ev["training_deviance"] = ev["logloss"]
+        elif problem == "multinomial":
+            e = np.exp(m - m.max(axis=1, keepdims=True))
+            p = np.clip(e / e.sum(axis=1, keepdims=True), 1e-15, 1)
+            ev["logloss"] = float(-np.mean(np.log(p[y.astype(bool)])))
+            ev["training_deviance"] = ev["logloss"]
+        else:
+            mu = np.asarray(dist_mod.link_inv(dist, m[:, 0]))
+            ev["deviance"] = float(np.mean((mu - y[:, 0]) ** 2))
+            ev["rmse"] = float(np.sqrt(ev["deviance"]))
+            ev["training_deviance"] = ev["deviance"]
+        return ev
+
+    def _cv_predict(self, model: SharedTreeModel, frame: Frame) -> np.ndarray:
+        out = model._score_probs(model._matrix(frame))
+        if model.problem == "binomial":
+            return out[:, 1]
+        if model.problem == "multinomial":
+            return out
+        return out[:, 0]

@@ -1,0 +1,218 @@
+"""Shared histogram-tree machinery — the engine under GBM / DRF / IF / XGBoost.
+
+Reference parity: `h2o-algos/src/main/java/hex/tree/SharedTree.java`
+(per-level driver loop), `hex/tree/DTree.java` (`DecidedNode`,
+`UndecidedNode`, `Split.findBestSplitPoint` — argmax squared-error reduction
+over bins), `hex/tree/ScoreBuildHistogram2.java` (the fused
+score-build-histogram MRTask), and XGBoost's `gpu_hist` updater.
+
+TPU-first redesign, not a translation:
+
+* The reference grows trees with dynamic node objects and per-level chunk
+  scans. Here a tree is a **perfect binary heap of static depth** (arrays of
+  size 2^(D+1)-1) so the whole per-tree build is ONE jitted XLA program:
+  unrolled levels, each = histogram → best-split → partition, all fused.
+* Row partition state is a per-row level-local node index (the reference's
+  "row-to-leaf assignment vec", `SharedTree` nids Vec); rows in decided-leaf
+  subtrees keep flowing left so every depth-D cell inherits its deciding
+  ancestor's rows — which makes the cell's Newton value equal the ancestor
+  leaf's value, eliminating all dynamic control flow.
+* Cross-host histogram merge is `lax.psum` (MRTask.reduce / Rabit allreduce).
+* NAs live in a reserved last bin and traverse right; the split search can
+  therefore isolate them (DHistogram's NA bucket semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.histogram import build_histograms
+
+
+class Tree(NamedTuple):
+    """One decision tree as flat heap arrays (length 2^(D+1)-1)."""
+
+    feat: jax.Array      # int32, split feature per node (0 where not split)
+    bin: jax.Array       # int32, split bin per node
+    thr: jax.Array       # f32, raw-value threshold (left iff x <= thr)
+    is_split: jax.Array  # bool
+    value: jax.Array     # f32, Newton leaf value at every node
+
+
+def heap_size(depth: int) -> int:
+    return 2 ** (depth + 1) - 1
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth", "nbins", "min_rows", "min_split_improvement",
+        "reg_lambda", "hist_method", "axis_name", "mtries",
+    ),
+)
+def build_tree(
+    codes: jax.Array,        # (N, F) uint bin codes
+    g: jax.Array,            # (N,) gradients
+    h: jax.Array,            # (N,) hessians
+    w: jax.Array,            # (N,) row weights (0 = masked/pad/OOB)
+    feat_mask: jax.Array,    # (F,) f32 1/0 — column sampling
+    edges: jax.Array,        # (F, nbins-2) raw-value right edges (+inf padded)
+    max_depth: int,
+    nbins: int,
+    min_rows: float = 10.0,
+    min_split_improvement: float = 0.0,
+    reg_lambda: float = 1.0,
+    hist_method: str = "auto",
+    axis_name: Optional[str] = None,
+    mtries: int = 0,
+    key: Optional[jax.Array] = None,
+):
+    """Build one tree; returns (Tree, final_leaf_heap_idx (N,), gain_per_feature (F,)).
+
+    mtries > 0 samples ~mtries of F features per node per level (DRF's
+    per-split column sampling, `hex/tree/drf/DRF.java` _mtry) — bernoulli
+    approximation of exact without-replacement draws, same expectation.
+    """
+    N, F = codes.shape
+    T = heap_size(max_depth)
+    feat_a = jnp.zeros(T, jnp.int32)
+    bin_a = jnp.zeros(T, jnp.int32)
+    thr_a = jnp.zeros(T, jnp.float32)
+    split_a = jnp.zeros(T, bool)
+    value_a = jnp.zeros(T, jnp.float32)
+
+    idx = jnp.zeros(N, jnp.int32)          # level-local node index
+    active = jnp.ones(1, bool)             # per-level-node: may still split
+    gain_per_feature = jnp.zeros(F, jnp.float32)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    for d in range(max_depth):
+        L = 2 ** d
+        base = L - 1                        # heap offset of this level
+        hist = build_histograms(
+            codes, idx, g, h, w, L, nbins, method=hist_method, axis_name=axis_name
+        )  # (L, F, B, 3)
+
+        wsum = hist[..., 0].sum(axis=2)[:, 0]   # (L,) totals (same for all F)
+        gsum = hist[..., 1].sum(axis=2)[:, 0]
+        hsum = hist[..., 2].sum(axis=2)[:, 0]
+        value_a = value_a.at[base : base + L].set(
+            (-gsum / (hsum + reg_lambda + 1e-12)).astype(jnp.float32)
+        )
+
+        # split search: cumulative over bins → gain per (L, F, B)
+        cw = jnp.cumsum(hist[..., 0], axis=2)
+        cg = jnp.cumsum(hist[..., 1], axis=2)
+        ch = jnp.cumsum(hist[..., 2], axis=2)
+        GL, HL, WL = cg, ch, cw
+        G = gsum[:, None, None]
+        H = hsum[:, None, None]
+        W = wsum[:, None, None]
+        GR, HR, WR = G - GL, H - HL, W - WL
+        gain = (
+            GL * GL / (HL + reg_lambda)
+            + GR * GR / (HR + reg_lambda)
+            - G * G / (H + reg_lambda)
+        )
+        ok = (WL >= min_rows) & (WR >= min_rows)
+        ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)   # no split at NA bin
+        ok = ok & (feat_mask[None, :, None] > 0)
+        ok = ok & active[:, None, None]
+        if mtries > 0:
+            key, sub = jax.random.split(key)
+            # per-(node,feature) bernoulli keep with the same node psum'd RNG
+            # on every host (key is replicated) so partitions stay consistent
+            keep = jax.random.uniform(sub, (L, F)) < (mtries / F)
+            keep = keep.at[:, 0].set(keep[:, 0] | ~keep.any(axis=1))  # >=1 kept
+            ok = ok & keep[:, :, None]
+        gain = jnp.where(ok, gain, -jnp.inf)
+
+        flat = gain.reshape(L, F * nbins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // nbins).astype(jnp.int32)
+        bb = (best % nbins).astype(jnp.int32)
+        do_split = best_gain > jnp.maximum(min_split_improvement, 1e-10)
+        gain_per_feature = gain_per_feature + jax.ops.segment_sum(
+            jnp.where(do_split, best_gain, 0.0).astype(jnp.float32), bf, num_segments=F
+        )
+
+        # raw threshold: edges[f][b] for b < nbins-2, +inf at the last value bin
+        pad_edges = jnp.concatenate(
+            [edges.astype(jnp.float32), jnp.full((F, 1), jnp.inf, jnp.float32)], axis=1
+        )
+        bthr = pad_edges[bf, jnp.minimum(bb, nbins - 2)]
+
+        feat_a = feat_a.at[base : base + L].set(jnp.where(do_split, bf, 0))
+        bin_a = bin_a.at[base : base + L].set(jnp.where(do_split, bb, 0))
+        thr_a = thr_a.at[base : base + L].set(jnp.where(do_split, bthr, 0.0))
+        split_a = split_a.at[base : base + L].set(do_split)
+
+        # partition rows: decided-leaf rows flow left; splitters route by code
+        rf = bf[idx]
+        rb = bb[idx]
+        rcode = jnp.take_along_axis(codes, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
+        go_right = (rcode.astype(jnp.int32) > rb) & do_split[idx]
+        idx = 2 * idx + go_right.astype(jnp.int32)
+        active = jnp.repeat(do_split, 2)
+
+    # final level values from exact per-cell totals
+    Lf = 2 ** max_depth
+    basef = Lf - 1
+    vals = jnp.stack([w, g * w, h * w], axis=1)
+    tot = jax.ops.segment_sum(vals, idx, num_segments=Lf)       # (Lf, 3)
+    if axis_name is not None:
+        tot = jax.lax.psum(tot, axis_name)
+    value_a = value_a.at[basef:].set(
+        (-tot[:, 1] / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
+    )
+    return Tree(feat_a, bin_a, thr_a, split_a, value_a), idx + basef, gain_per_feature
+
+
+def predict_codes(tree: Tree, codes: jax.Array, max_depth: int) -> jax.Array:
+    """Leaf value per row, traversing on binned codes (training-time path)."""
+    N = codes.shape[0]
+    node = jnp.zeros(N, jnp.int32)
+    for _ in range(max_depth):
+        f = tree.feat[node]
+        b = tree.bin[node]
+        s = tree.is_split[node]
+        c = jnp.take_along_axis(codes, f[:, None].astype(jnp.int32), axis=1)[:, 0]
+        child = 2 * node + 1 + ((c.astype(jnp.int32) > b) & s).astype(jnp.int32)
+        node = jnp.where(s, child, node)
+    return tree.value[node]
+
+
+def predict_raw(tree: Tree, X: jax.Array, max_depth: int) -> jax.Array:
+    """Leaf value per row on raw features (scoring path; NaN → right,
+    mirroring the NA-bin-is-last training semantics)."""
+    N = X.shape[0]
+    node = jnp.zeros(N, jnp.int32)
+    for _ in range(max_depth):
+        f = tree.feat[node]
+        t = tree.thr[node]
+        s = tree.is_split[node]
+        x = jnp.take_along_axis(X, f[:, None].astype(jnp.int32), axis=1)[:, 0]
+        right = jnp.isnan(x) | (x > t)
+        child = 2 * node + 1 + (right & s).astype(jnp.int32)
+        node = jnp.where(s, child, node)
+    return tree.value[node]
+
+
+def stack_trees(trees) -> Tree:
+    """Stack per-tree arrays into (ntrees, T) for vmapped forest scoring."""
+    return Tree(*[jnp.stack([getattr(t, f) for t in trees]) for f in Tree._fields])
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_forest_raw(forest: Tree, X: jax.Array, max_depth: int) -> jax.Array:
+    """Σ over trees of leaf values — (N,) or (ntrees, N) summed. The scoring
+    analog of `hex/Model.score0` / `BigScore` MRTask (hex/Model.java)."""
+    per_tree = jax.vmap(lambda t: predict_raw(t, X, max_depth))(forest)
+    return per_tree.sum(axis=0)

@@ -1,0 +1,290 @@
+"""Model artifacts — save/load + standalone scoring.
+
+Reference parity: `h2o-genmodel/src/main/java/hex/genmodel/` (`MojoModel`,
+`MojoReaderBackend`, `easy/EasyPredictModelWrapper`) and the in-cluster
+binary save (`h2o.save_model` → `/3/Models.bin`, Iced serialization of the
+model). The MOJO design — a zip of `model.ini` metadata + binary arrays,
+scoreable with zero h2o-core dependency — maps here to an `.npz` bundle of
+(params json + numpy arrays); `MojoScorer` below scores GBM/DRF/GLM/DL
+artifacts with numpy only (no JAX import needed at serve time).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _model_payload(model) -> Dict[str, Any]:
+    """Extract (meta, arrays) from a trained H2OModel."""
+    from .models.shared_tree import SharedTreeModel
+    from .models.glm import GLMModel
+    from .models.deeplearning import DeepLearningModel
+
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Any] = {
+        "format_version": FORMAT_VERSION,
+        "model_id": model.model_id,
+        "algo": model.algo,
+        "x": model.x,
+        "y": model.y,
+    }
+    if isinstance(model, SharedTreeModel):
+        meta.update(
+            kind="tree", problem=model.problem, nclass=model.nclass,
+            domain=model.domain, distribution=model.distribution,
+            max_depth=model.max_depth, mode=model.mode,
+            ntrees=model.ntrees_built,
+            f0=np.asarray(model.f0).tolist(),
+            feature_domains=model.bm.domains,
+        )
+        for k, stacked in enumerate(model.forest):
+            for field in ("feat", "bin", "thr", "is_split", "value"):
+                arrays[f"forest{k}_{field}"] = np.asarray(getattr(stacked, field))
+        meta["n_forests"] = len(model.forest)
+    elif isinstance(model, GLMModel):
+        meta.update(
+            kind="glm", family=model.family, domain=model.domain,
+            coef_names=model._names(), standardize=model.dinfo.standardize,
+        )
+        arrays["beta"] = np.asarray(model.beta)
+        if model.dinfo.means is not None:
+            arrays["means"] = model.dinfo.means
+            arrays["stds"] = model.dinfo.stds
+        meta["dinfo"] = _dinfo_meta(model.dinfo)
+    elif isinstance(model, DeepLearningModel):
+        meta.update(
+            kind="deeplearning", problem=model.problem, nclass=model.nclass,
+            domain=model.domain, activation=model.activation,
+            distribution=model.distribution, n_layers=len(model.net_params),
+        )
+        for i, (W, b) in enumerate(model.net_params):
+            arrays[f"W{i}"] = np.asarray(W)
+            arrays[f"b{i}"] = np.asarray(b)
+        if model.dinfo.means is not None:
+            arrays["means"] = model.dinfo.means
+            arrays["stds"] = model.dinfo.stds
+        meta["dinfo"] = _dinfo_meta(model.dinfo)
+    else:
+        raise TypeError(f"cannot export model of type {type(model).__name__}")
+    return {"meta": meta, "arrays": arrays}
+
+
+def _dinfo_meta(dinfo) -> Dict:
+    return {
+        "spec": [[k, n, d] for (k, n, d) in dinfo._spec],
+        "coef_names": dinfo.coef_names,
+        "standardize": dinfo.standardize,
+        "use_all": dinfo.use_all,
+        "col_means": dinfo.col_means,
+    }
+
+
+def save_model(est_or_model, path: str = ".", filename: Optional[str] = None) -> str:
+    model = getattr(est_or_model, "model", est_or_model)
+    payload = _model_payload(model)
+    os.makedirs(path, exist_ok=True) if not os.path.splitext(path)[1] else None
+    if os.path.isdir(path) or not os.path.splitext(path)[1]:
+        fn = filename or f"{model.model_id}.h2o3"
+        out = os.path.join(path, fn)
+    else:
+        out = path
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.json", json.dumps(payload["meta"]))
+        buf = io.BytesIO()
+        np.savez(buf, **payload["arrays"])
+        z.writestr("arrays.npz", buf.getvalue())
+    return out
+
+
+def load_model(path: str) -> "MojoScorer":
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("model.json"))
+        arrays = dict(np.load(io.BytesIO(z.read("arrays.npz"))))
+    return MojoScorer(meta, arrays)
+
+
+class MojoScorer:
+    """Numpy-only offline scorer — `EasyPredictModelWrapper` equivalent.
+
+    predict() accepts a Frame or a numpy matrix in training-column order and
+    returns the same columns the in-cluster scorer produces."""
+
+    def __init__(self, meta: Dict, arrays: Dict[str, np.ndarray]):
+        self.meta = meta
+        self.arrays = arrays
+        self.algo = meta["algo"]
+        self.x = meta["x"]
+        self.y = meta["y"]
+
+    # -- shared helpers -----------------------------------------------------
+    def _matrix(self, data) -> np.ndarray:
+        from .frame.frame import Frame
+
+        if isinstance(data, Frame):
+            from .models.shared_tree import frame_to_matrix
+
+            X, _, _ = frame_to_matrix(
+                data, self.x, expected_domains=self.meta.get("feature_domains")
+            )
+            return X
+        return np.asarray(data, np.float64)
+
+    def _tree_scores(self, X: np.ndarray) -> np.ndarray:
+        meta = self.meta
+        D = meta["max_depth"]
+        outs = []
+        for k in range(meta["n_forests"]):
+            feat = self.arrays[f"forest{k}_feat"]
+            thr = self.arrays[f"forest{k}_thr"]
+            split = self.arrays[f"forest{k}_is_split"]
+            value = self.arrays[f"forest{k}_value"]
+            ntrees = feat.shape[0]
+            total = np.zeros(X.shape[0])
+            for t in range(ntrees):
+                node = np.zeros(X.shape[0], np.int64)
+                for _ in range(D):
+                    f = feat[t][node]
+                    s = split[t][node]
+                    xv = X[np.arange(X.shape[0]), f]
+                    right = np.isnan(xv) | (xv > thr[t][node])
+                    child = 2 * node + 1 + (right & s).astype(np.int64)
+                    node = np.where(s, child, node)
+                total += value[t][node]
+            f0 = meta["f0"]
+            f0k = f0[k] if isinstance(f0, list) else f0
+            outs.append(total + (f0k if meta["mode"] != "drf" else 0.0))
+        return np.column_stack(outs)
+
+    def _expand_dinfo(self, data) -> np.ndarray:
+        from .frame.frame import Frame
+
+        di = self.meta["dinfo"]
+        cols = []
+        for kind, n, dom in di["spec"]:
+            if isinstance(data, Frame):
+                v = data.vec(n)
+                raw = v.numeric_np()
+                codes = np.asarray(v.data) if v.type == "enum" else None
+                vdom = v.domain
+            else:
+                raise TypeError("dinfo models require a Frame input")
+            if kind == "num":
+                c = np.where(np.isnan(raw), di["col_means"].get(n, 0.0), raw)
+                cols.append(c[:, None])
+            else:
+                if vdom != dom and vdom:
+                    remap = np.asarray(
+                        [dom.index(d) if d in dom else -1 for d in vdom], np.int64
+                    )
+                    codes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+                K = len(dom)
+                oh = np.zeros((len(codes), K))
+                valid = codes >= 0
+                oh[np.nonzero(valid)[0], codes[valid]] = 1.0
+                if not di["use_all"] and K > 0:
+                    oh = oh[:, 1:]
+                cols.append(oh)
+        X = np.concatenate(cols, axis=1)
+        if di["standardize"] and "means" in self.arrays:
+            X = (X - self.arrays["means"]) / self.arrays["stds"]
+        return np.nan_to_num(X, nan=0.0)
+
+    # -- prediction ---------------------------------------------------------
+    def predict(self, data):
+        from .frame.frame import Frame
+
+        meta = self.meta
+        kind = meta["kind"]
+        if kind == "tree":
+            X = self._matrix(data)
+            m = self._tree_scores(X)
+            problem = meta["problem"]
+            if meta["mode"] == "drf":
+                m = m / max(meta["ntrees"], 1)
+                if problem == "binomial":
+                    p1 = np.clip(m[:, 0], 0, 1)
+                    probs = np.column_stack([1 - p1, p1])
+                elif problem == "multinomial":
+                    p = np.clip(m, 0, None)
+                    probs = p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
+                else:
+                    return Frame.from_dict({"predict": m[:, 0]})
+            else:
+                if problem == "binomial":
+                    p1 = 1 / (1 + np.exp(-m[:, 0]))
+                    probs = np.column_stack([1 - p1, p1])
+                elif problem == "multinomial":
+                    e = np.exp(m - m.max(axis=1, keepdims=True))
+                    probs = e / e.sum(axis=1, keepdims=True)
+                else:
+                    out = m[:, 0]
+                    if meta["distribution"] in ("poisson", "gamma", "tweedie"):
+                        out = np.exp(out)
+                    return Frame.from_dict({"predict": out})
+            dom = meta["domain"]
+            d = {"predict": np.asarray(dom, dtype=object)[probs.argmax(axis=1)]}
+            for i, cls in enumerate(dom):
+                d[str(cls)] = probs[:, i]
+            return Frame.from_dict(d, column_types={"predict": "enum"})
+        if kind == "glm":
+            X = self._expand_dinfo(data)
+            Xi = np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
+            beta = self.arrays["beta"]
+            eta = Xi @ beta.T
+            fam = meta["family"]
+            if fam in ("binomial", "quasibinomial", "fractionalbinomial"):
+                p1 = 1 / (1 + np.exp(-eta))
+                dom = meta["domain"]
+                return Frame.from_dict({
+                    "predict": np.asarray(dom, dtype=object)[(p1 > 0.5).astype(int)],
+                    str(dom[0]): 1 - p1, str(dom[1]): p1,
+                }, column_types={"predict": "enum"})
+            if fam == "multinomial":
+                e = np.exp(eta - eta.max(axis=1, keepdims=True))
+                probs = e / e.sum(axis=1, keepdims=True)
+                dom = meta["domain"]
+                d = {"predict": np.asarray(dom, dtype=object)[probs.argmax(axis=1)]}
+                for i, cls in enumerate(dom):
+                    d[str(cls)] = probs[:, i]
+                return Frame.from_dict(d, column_types={"predict": "enum"})
+            if fam in ("poisson", "gamma", "tweedie"):
+                eta = np.exp(eta)
+            return Frame.from_dict({"predict": eta})
+        if kind == "deeplearning":
+            X = self._expand_dinfo(data)
+            h = X
+            L = meta["n_layers"]
+            act = meta["activation"].replace("WithDropout", "")
+            for i in range(L):
+                z = h @ self.arrays[f"W{i}"] + self.arrays[f"b{i}"]
+                if i < L - 1:
+                    if act == "Rectifier":
+                        h = np.maximum(z, 0)
+                    elif act == "Tanh":
+                        h = np.tanh(z)
+                    else:  # Maxout
+                        h = z.reshape(z.shape[0], -1, 2).max(axis=2)
+                else:
+                    h = z
+            problem = meta["problem"]
+            if problem in ("binomial", "multinomial"):
+                e = np.exp(h - h.max(axis=1, keepdims=True))
+                probs = e / e.sum(axis=1, keepdims=True)
+                dom = meta["domain"]
+                d = {"predict": np.asarray(dom, dtype=object)[probs.argmax(axis=1)]}
+                for i, cls in enumerate(dom):
+                    d[str(cls)] = probs[:, i]
+                return Frame.from_dict(d, column_types={"predict": "enum"})
+            out = h[:, 0]
+            if meta["distribution"] in ("poisson", "gamma", "tweedie"):
+                out = np.exp(out)
+            return Frame.from_dict({"predict": out})
+        raise ValueError(f"unknown artifact kind {kind!r}")

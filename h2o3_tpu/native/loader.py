@@ -1,0 +1,69 @@
+"""ctypes bridge to the native C++ data-plane helpers.
+
+Reference parity: the reference's only native code enters via prebuilt
+XGBoost `.so`s (`h2o-ext-xgboost`, see SURVEY.md §2.3); its parser is Java
+(`water/parser/CsvParser.java`). Here the hot host-side paths (CSV
+tokenization) get a real C++ implementation (`csv_parser.cpp`) compiled to
+`libh2o3native.so` and loaded lazily; every caller must tolerate `None`
+returns and fall back to the numpy path so the framework works without the
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _lib():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    so = os.path.join(os.path.dirname(__file__), "libh2o3native.so")
+    if os.path.exists(so):
+        try:
+            _LIB = ctypes.CDLL(so)
+        except OSError:
+            _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def tokenize_csv(path: str, sep: str, header: bool, ncol: int) -> Optional[List[np.ndarray]]:
+    """Fast numeric-first CSV tokenize. Returns per-column object arrays, or
+    None when the native lib is absent (callers fall back to numpy)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        lib.h2o3_csv_parse_numeric.restype = ctypes.c_longlong
+        lib.h2o3_csv_parse_numeric.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_longlong,
+        ]
+        # first pass: count rows
+        nrows = lib.h2o3_csv_parse_numeric(
+            path.encode(), sep.encode()[0], 1 if header else 0, ncol, None, 0
+        )
+        if nrows < 0:
+            return None  # non-numeric content: let python path handle enums
+        buf = np.empty((nrows, ncol), dtype=np.float64)
+        got = lib.h2o3_csv_parse_numeric(
+            path.encode(), sep.encode()[0], 1 if header else 0, ncol,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), nrows * ncol,
+        )
+        if got != nrows:
+            return None
+        return [buf[:, i] for i in range(ncol)]
+    except (AttributeError, OSError):
+        return None

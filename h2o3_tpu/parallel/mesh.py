@@ -1,0 +1,110 @@
+"""Cloud/mesh management — the TPU-native replacement for H2O's clouding layer.
+
+Reference parity: `h2o-core/src/main/java/water/H2O.java` (node bootstrap),
+`water/Paxos.java` + `water/HeartBeatThread.java` (cloud membership). In the
+reference a "cloud" is a set of JVM peers discovered by gossip; here a cloud
+is a `jax.sharding.Mesh` over the devices JAX already knows about —
+`jax.distributed.initialize()` plays the role of Paxos (one process per TPU
+host ≡ one H2O node), and membership is fixed at init, matching H2O's
+"cloud locks at first job" semantics (`water/Paxos.java`).
+
+The data-parallel axis is named ``"hosts"`` everywhere: rows of a Frame are
+sharded over it, and every MRTask-style reduction lowers to an XLA collective
+(`lax.psum`) over it instead of H2O's binary RPC tree (`water/MRTask.java`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS_AXIS = "hosts"  # the one inter-node axis H2O has: row/data parallelism
+
+_lock = threading.Lock()
+_cloud: Optional["Cloud"] = None
+
+
+@dataclass
+class Cloud:
+    """A locked set of devices arranged in a 1-D data-parallel mesh.
+
+    Mirrors `water.H2O.CLOUD` (static cloud singleton). `size` ≡
+    `H2O.CLOUD.size()`; `self_idx` ≡ `H2O.SELF.index()`.
+    """
+
+    mesh: Mesh
+    name: str = "h2o-tpu"
+
+    @property
+    def size(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def self_idx(self) -> int:
+        return jax.process_index()
+
+    @property
+    def devices(self):
+        return list(self.mesh.devices.flat)
+
+    def row_sharding(self) -> NamedSharding:
+        """Sharding for per-row (leading-axis) data — H2O's chunk layout."""
+        return NamedSharding(self.mesh, P(ROWS_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        """Sharding for model state: replicated on every node (like DKV
+        cached values on every H2O node)."""
+        return NamedSharding(self.mesh, P())
+
+
+def init(
+    devices: Optional[Sequence[jax.Device]] = None,
+    name: str = "h2o-tpu",
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Cloud:
+    """Form the cloud. Single-process: mesh over local devices. Multi-host:
+    pass coordinator_address/num_processes/process_id (wraps
+    `jax.distributed.initialize`, replacing `water/init/NetworkInit.java`).
+    """
+    global _cloud
+    with _lock:
+        if coordinator_address is not None and num_processes and num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        if devices is None:
+            devices = jax.devices()
+        mesh = Mesh(np.asarray(devices), (ROWS_AXIS,))
+        _cloud = Cloud(mesh=mesh, name=name)
+        return _cloud
+
+
+def cloud() -> Cloud:
+    """The current cloud, forming a local one lazily (like `H2O.main` being
+    auto-started by the Python client, `h2o-py/h2o/backend/server.py`)."""
+    global _cloud
+    if _cloud is None:
+        init()
+    return _cloud
+
+
+def reset() -> None:
+    global _cloud
+    with _lock:
+        _cloud = None
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Rows are padded so each mesh shard is equal-sized (XLA needs static,
+    uniform shards; H2O chunks could be ragged — ours cannot)."""
+    return ((n + k - 1) // k) * k

@@ -1,0 +1,81 @@
+"""DeepLearning tests — `testdir_algos/deeplearning` analog. Accuracy
+targets, not trajectories (Hogwild → sync-DP semantic change, SURVEY §2.4)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+
+from conftest import make_classification, make_regression
+
+
+def test_dl_binomial(cloud1):
+    X, y = make_classification(2000, 8, seed=0)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(8)] + ["y"]).asfactor("y")
+    dl = H2ODeepLearningEstimator(hidden=[32, 32], epochs=30, seed=1,
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.auc() > 0.85
+    pred = dl.predict(fr)
+    assert pred.names == ["predict", "0", "1"]
+    p1 = pred.vec("1").numeric_np()
+    assert ((p1 >= 0) & (p1 <= 1)).all()
+
+
+def test_dl_regression(cloud1):
+    X, y = make_regression(1500, 6, seed=1, noise=0.05)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"])
+    dl = H2ODeepLearningEstimator(hidden=[64, 64], epochs=40, seed=2,
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.mse() < 0.5 * float(np.var(y))
+
+
+def test_dl_multinomial_tanh(cloud1):
+    rng = np.random.default_rng(3)
+    n = 2000
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (np.arctan2(X[:, 0], X[:, 1]) // (2 * np.pi / 3) + 1).astype(int) % 3
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "y"]).asfactor("y")
+    dl = H2ODeepLearningEstimator(hidden=[32], activation="Tanh", epochs=30,
+                                  seed=3, mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.model.training_metrics.accuracy > 0.8
+
+
+def test_dl_dropout_and_maxout(cloud1):
+    X, y = make_classification(1200, 6, seed=4)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"]).asfactor("y")
+    for act in ("RectifierWithDropout", "Maxout"):
+        dl = H2ODeepLearningEstimator(hidden=[32], activation=act, epochs=15,
+                                      seed=5, mini_batch_size=128,
+                                      input_dropout_ratio=0.1)
+        dl.train(y="y", training_frame=fr)
+        assert dl.auc() > 0.7, act
+
+
+def test_dl_momentum_sgd(cloud1):
+    X, y = make_classification(1200, 6, seed=6)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"]).asfactor("y")
+    dl = H2ODeepLearningEstimator(hidden=[32], epochs=25, seed=7,
+                                  adaptive_rate=False, rate=0.01,
+                                  momentum_start=0.5, momentum_stable=0.9,
+                                  mini_batch_size=128)
+    dl.train(y="y", training_frame=fr)
+    assert dl.auc() > 0.8
+
+
+def test_dl_multichip_dp(cloud8):
+    X, y = make_classification(2048, 6, seed=8)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"]).asfactor("y")
+    dl = H2ODeepLearningEstimator(hidden=[16], epochs=10, seed=9,
+                                  mini_batch_size=256)
+    dl.train(y="y", training_frame=fr)
+    assert dl.auc() > 0.75

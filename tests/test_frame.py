@@ -1,0 +1,93 @@
+"""Frame/Vec/parse tests — the `h2o-py/tests/testdir_munging` analog."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.frame.binning import bin_apply, build_bins
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+
+
+def test_vec_types():
+    v = Vec.from_numpy(np.asarray([1.0, 2.0, 3.0]))
+    assert v.type == "int"
+    v = Vec.from_numpy(np.asarray([1.5, 2.0, np.nan]))
+    assert v.type == "real" and v.nacnt() == 1
+    v = Vec.from_numpy(np.asarray(["a", "b", "a", "NA"], dtype=object))
+    assert v.type == "enum" and v.domain == ["a", "b"] and v.nacnt() == 1
+
+
+def test_frame_basics():
+    fr = Frame.from_dict({"a": [1, 2, 3, 4], "b": [1.5, 2.5, 3.5, np.nan],
+                          "c": ["x", "y", "x", "y"]})
+    assert fr.shape == (4, 3)
+    assert fr.types == {"a": "int", "b": "real", "c": "enum"}
+    sub = fr[["a", "c"]]
+    assert sub.names == ["a", "c"]
+    rows = fr[np.asarray([0, 2])]
+    assert rows.nrow == 2
+    masked = fr[np.asarray([True, False, True, False])]
+    assert masked.nrow == 2
+    d = fr.describe()
+    assert d["a"]["mean"] == pytest.approx(2.5)
+
+
+def test_split_frame_and_rbind_cbind():
+    fr = Frame.from_dict({"a": np.arange(100), "c": ["u", "v"] * 50})
+    tr, te = fr.split_frame([0.75], seed=42)
+    assert tr.nrow + te.nrow == 100
+    assert 60 < tr.nrow < 90
+    both = tr.rbind(te)
+    assert both.nrow == 100
+    wide = fr.cbind(fr)
+    assert wide.ncol == 4
+
+
+def test_csv_roundtrip(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b,cat\n1,2.5,x\n2,NA,y\n3,4.5,x\n")
+    fr = h2o.import_file(str(p))
+    assert fr.names == ["a", "b", "cat"]
+    assert fr.nrow == 3
+    assert fr.vec("b").nacnt() == 1
+    assert fr.vec("cat").type == "enum"
+    assert fr.vec("cat").domain == ["x", "y"]
+
+
+def test_headerless_csv(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("1,2.5\n2,3.5\n")
+    fr = h2o.import_file(str(p))
+    assert fr.names == ["C1", "C2"] and fr.nrow == 2
+
+
+def test_binning_uniform_and_quantile():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 3))
+    X[::17, 1] = np.nan
+    for ht in ("UniformAdaptive", "QuantilesGlobal", "Random"):
+        bm = build_bins(X, nbins=32, histogram_type=ht)
+        assert bm.codes.shape == (500, 3)
+        assert bm.codes.max() <= 31
+        # NA rows land in the NA bin
+        assert (bm.codes[::17, 1] == bm.na_bin).all()
+        # re-apply matches
+        again = bin_apply(bm, X)
+        np.testing.assert_array_equal(again, bm.codes)
+
+
+def test_binning_monotone():
+    X = np.linspace(0, 1, 100)[:, None]
+    bm = build_bins(X, nbins=16)
+    assert (np.diff(bm.codes[:, 0].astype(int)) >= 0).all()
+
+
+def test_asfactor():
+    fr = Frame.from_dict({"y": [0, 1, 0, 1]})
+    fr2 = fr.asfactor("y")
+    assert fr2.vec("y").type == "enum"
+    assert fr2.vec("y").nlevels == 2

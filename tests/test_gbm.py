@@ -1,0 +1,167 @@
+"""GBM/DRF end-to-end — the `h2o-py/tests/testdir_algos/gbm` analog:
+train on synthetic data, assert metric quality with tolerances."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.drf import H2ORandomForestEstimator
+
+from conftest import make_classification, make_regression
+
+
+def _cls_frame(n=2000, f=10, seed=0):
+    X, y = make_classification(n, f, seed)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(f)] + ["y"])
+    return fr.asfactor("y")
+
+
+def test_gbm_binomial_auc(cloud1):
+    fr = _cls_frame()
+    train, valid = fr.split_frame([0.8], seed=7)
+    gbm = H2OGradientBoostingEstimator(ntrees=30, max_depth=4, learn_rate=0.2, seed=42)
+    gbm.train(y="y", training_frame=train, validation_frame=valid)
+    assert gbm.auc() > 0.90
+    assert gbm.auc(valid=True) > 0.80
+    assert gbm.logloss() < 0.45
+    pred = gbm.predict(valid)
+    assert pred.names == ["predict", "0", "1"]
+    assert pred.nrow == valid.nrow
+    p1 = pred.vec("1").numeric_np()
+    assert ((p1 >= 0) & (p1 <= 1)).all()
+
+
+def test_gbm_regression(cloud1):
+    X, y = make_regression(1500, 6, seed=3)
+    names = [f"x{i}" for i in range(6)] + ["y"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names)
+    gbm = H2OGradientBoostingEstimator(ntrees=40, max_depth=5, learn_rate=0.2, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    base = float(np.var(y))
+    assert gbm.mse() < 0.3 * base
+    assert gbm.model.varimp_table is not None
+    top = gbm.model.varimp_table[0][0]
+    assert top in ("x0", "x1", "x2")
+
+
+def test_gbm_multinomial(cloud1):
+    rng = np.random.default_rng(5)
+    n = 1800
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)  # 3 classes
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "e", "y"]).asfactor("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=25, max_depth=4, learn_rate=0.3, seed=2)
+    gbm.train(y="y", training_frame=fr)
+    m = gbm.model.training_metrics
+    assert m.logloss < 0.4
+    assert m.accuracy > 0.85
+    pred = gbm.predict(fr)
+    assert pred.ncol == 4  # predict + 3 class probs
+
+
+def test_gbm_with_nas(cloud1):
+    X, y = make_classification(1200, 6, seed=9)
+    X[::5, 2] = np.nan
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"]).asfactor("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=20, max_depth=4, seed=3)
+    gbm.train(y="y", training_frame=fr)
+    assert gbm.auc() > 0.80
+    pred = gbm.predict(fr)
+    assert not np.isnan(pred.vec("1").numeric_np()).any()
+
+
+def test_gbm_categorical_features(cloud1):
+    rng = np.random.default_rng(11)
+    n = 1500
+    cat = rng.integers(0, 4, n)
+    x1 = rng.normal(size=n)
+    y = ((cat >= 2) ^ (x1 > 0)).astype(int)
+    fr = Frame.from_dict({
+        "cat": np.asarray(["lvl%d" % c for c in cat], dtype=object),
+        "x1": x1,
+        "y": y,
+    }).asfactor("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=30, max_depth=4, learn_rate=0.3, seed=4)
+    gbm.train(y="y", training_frame=fr)
+    assert gbm.auc() > 0.95
+
+
+def test_gbm_early_stopping(cloud1):
+    # noisy response ⇒ validation logloss bottoms out and overfits back up;
+    # ScoreKeeper watches the validation metric (hex.ScoreKeeper semantics)
+    fr = _cls_frame(1500, 8, seed=13)
+    train, valid = fr.split_frame([0.7], seed=13)
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=500, max_depth=3, learn_rate=0.3, seed=5,
+        stopping_rounds=3, stopping_tolerance=1e-3, score_tree_interval=5,
+    )
+    gbm.train(y="y", training_frame=train, validation_frame=valid)
+    assert len(gbm.scoring_history) > 0
+    assert gbm.model.forest[0].feat.shape[0] < 500  # stopped early
+    assert "validation_logloss" in gbm.scoring_history[-1]
+
+
+def test_gbm_weights_column(cloud1):
+    X, y = make_classification(1000, 5, seed=17)
+    w = np.where(y == 1, 2.0, 1.0)
+    fr = Frame.from_numpy(np.column_stack([X, y, w]),
+                          names=["a", "b", "c", "d", "e", "y", "w"]).asfactor("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, weights_column="w", seed=6)
+    gbm.train(y="y", training_frame=fr, x=["a", "b", "c", "d", "e"])
+    assert gbm.auc() > 0.75
+
+
+def test_gbm_distribution_poisson(cloud1):
+    rng = np.random.default_rng(21)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    lam = np.exp(0.5 * X[:, 0] - 0.3 * X[:, 1])
+    y = rng.poisson(lam)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "d", "y"])
+    gbm = H2OGradientBoostingEstimator(ntrees=30, distribution="poisson", seed=7)
+    gbm.train(y="y", training_frame=fr)
+    pred = gbm.predict(fr).vec("predict").numeric_np()
+    assert (pred >= 0).all()  # log link ⇒ positive means
+    assert np.corrcoef(pred, lam)[0, 1] > 0.7
+
+
+def test_drf_binomial(cloud1):
+    fr = _cls_frame(2000, 8, seed=23)
+    drf = H2ORandomForestEstimator(ntrees=30, max_depth=12, seed=8)
+    drf.train(y="y", training_frame=fr)
+    assert drf.auc() > 0.88
+    p = drf.predict(fr).vec("1").numeric_np()
+    assert ((p >= 0) & (p <= 1)).all()
+
+
+def test_drf_regression(cloud1):
+    X, y = make_regression(1500, 6, seed=29)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"])
+    drf = H2ORandomForestEstimator(ntrees=40, max_depth=14, seed=9)
+    drf.train(y="y", training_frame=fr)
+    assert drf.mse() < 0.5 * float(np.var(y))
+
+
+def test_gbm_cv(cloud1):
+    fr = _cls_frame(1200, 6, seed=31)
+    gbm = H2OGradientBoostingEstimator(ntrees=15, max_depth=3, nfolds=3, seed=10,
+                                       keep_cross_validation_predictions=True)
+    gbm.train(y="y", training_frame=fr)
+    assert gbm.model.cross_validation_metrics is not None
+    assert gbm.auc(xval=True) > 0.75
+    assert gbm.model._cv_holdout_pred is not None
+    assert gbm.model._cv_holdout_pred.shape[0] == fr.nrow
+
+
+def test_gbm_multichip_shard_map(cloud8):
+    """The distributed path: rows sharded over 8 devices, histogram psum."""
+    fr = _cls_frame(2048, 6, seed=37)
+    gbm = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=11)
+    gbm.train(y="y", training_frame=fr)
+    auc8 = gbm.auc()
+    assert auc8 > 0.85

@@ -1,0 +1,154 @@
+"""GLM tests — `h2o-py/tests/testdir_algos/glm` analog: coefficient recovery
+and metric quality vs known generating models."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+
+def test_glm_gaussian_ols_recovers_coefficients(cloud1):
+    rng = np.random.default_rng(0)
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    beta = np.asarray([2.0, -1.0, 0.5])
+    y = X @ beta + 3.0 + 0.01 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "y"])
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0)
+    glm.train(y="y", training_frame=fr)
+    coef = glm.coef()
+    assert coef["a"] == pytest.approx(2.0, abs=0.02)
+    assert coef["b"] == pytest.approx(-1.0, abs=0.02)
+    assert coef["c"] == pytest.approx(0.5, abs=0.02)
+    assert coef["Intercept"] == pytest.approx(3.0, abs=0.02)
+    assert glm.model.r2() > 0.99 if hasattr(glm.model, "r2") else True
+
+
+def test_glm_binomial_logistic(cloud1):
+    rng = np.random.default_rng(1)
+    n = 4000
+    X = rng.normal(size=(n, 2))
+    logits = 1.5 * X[:, 0] - 2.0 * X[:, 1] + 0.3
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(int)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "y"]).asfactor("y")
+    glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
+    glm.train(y="y", training_frame=fr)
+    coef = glm.coef()
+    assert coef["a"] == pytest.approx(1.5, abs=0.25)
+    assert coef["b"] == pytest.approx(-2.0, abs=0.3)
+    assert glm.auc() > 0.85
+    pred = glm.predict(fr)
+    assert pred.names == ["predict", "0", "1"]
+
+
+def test_glm_ridge_shrinks(cloud1):
+    rng = np.random.default_rng(2)
+    n = 500
+    X = rng.normal(size=(n, 4))
+    y = X[:, 0] + 0.1 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "d", "y"])
+    g0 = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0, alpha=0.0)
+    g0.train(y="y", training_frame=fr)
+    g1 = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=10.0, alpha=0.0)
+    g1.train(y="y", training_frame=fr)
+    assert abs(g1.coef()["a"]) < abs(g0.coef()["a"])
+
+
+def test_glm_lasso_sparsifies(cloud1):
+    rng = np.random.default_rng(3)
+    n = 800
+    X = rng.normal(size=(n, 6))
+    y = 2 * X[:, 0] + 0.05 * rng.normal(size=n)  # only x0 matters
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"])
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.05, alpha=1.0)
+    glm.train(y="y", training_frame=fr)
+    cn = glm.coef_norm()
+    noise = [abs(cn[f"x{i}"]) for i in range(1, 6)]
+    assert max(noise) < 0.02
+    assert abs(cn["x0"]) > 0.5
+
+
+def test_glm_lambda_search(cloud1):
+    rng = np.random.default_rng(4)
+    n = 600
+    X = rng.normal(size=(n, 5))
+    y = X[:, 0] - X[:, 1] + 0.1 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(5)] + ["y"])
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_search=True, alpha=0.5)
+    glm.train(y="y", training_frame=fr)
+    path = H2OGeneralizedLinearEstimator.getGLMRegularizationPath(glm)
+    assert len(path["lambdas"]) > 5
+    assert glm.model.training_metrics.mse < 0.05
+
+
+def test_glm_poisson(cloud1):
+    rng = np.random.default_rng(5)
+    n = 3000
+    X = rng.normal(size=(n, 2))
+    lam = np.exp(0.8 * X[:, 0] - 0.4 * X[:, 1] + 0.2)
+    y = rng.poisson(lam)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "y"])
+    glm = H2OGeneralizedLinearEstimator(family="poisson", lambda_=0.0)
+    glm.train(y="y", training_frame=fr)
+    coef = glm.coef()
+    assert coef["a"] == pytest.approx(0.8, abs=0.1)
+    assert coef["b"] == pytest.approx(-0.4, abs=0.1)
+
+
+def test_glm_multinomial(cloud1):
+    rng = np.random.default_rng(6)
+    n = 3000
+    X = rng.normal(size=(n, 4))
+    scores = np.column_stack([X[:, 0], X[:, 1], -X[:, 0] - X[:, 1]])
+    y = scores.argmax(axis=1)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "y"]).asfactor("y")
+    glm = H2OGeneralizedLinearEstimator(family="multinomial", lambda_=0.0)
+    glm.train(y="y", training_frame=fr)
+    m = glm.model.training_metrics
+    assert m.accuracy > 0.85
+    assert m.logloss < 0.5
+
+
+def test_glm_categorical_expansion(cloud1):
+    rng = np.random.default_rng(7)
+    n = 1500
+    cat = rng.integers(0, 3, n)
+    effect = np.asarray([0.0, 1.0, -1.0])[cat]
+    y = effect + 0.05 * rng.normal(size=n)
+    fr = Frame.from_dict({
+        "g": np.asarray(["a", "b", "c"], dtype=object)[cat], "y": y,
+    })
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0)
+    glm.train(y="y", training_frame=fr)
+    coef = glm.coef()
+    assert "g.b" in coef and "g.c" in coef
+    assert coef["g.b"] == pytest.approx(1.0, abs=0.05)
+    assert coef["g.c"] == pytest.approx(-1.0, abs=0.05)
+
+
+def test_glm_pvalues(cloud1):
+    rng = np.random.default_rng(8)
+    n = 1000
+    X = rng.normal(size=(n, 2))
+    y = X @ np.asarray([1.0, 0.0]) + 0.5 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "y"])
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0,
+                                        compute_p_values=True, standardize=False)
+    glm.train(y="y", training_frame=fr)
+    assert glm.model.stderr is not None
+    assert glm.model.stderr.shape[0] == 3
+
+
+def test_glm_multichip(cloud8):
+    rng = np.random.default_rng(9)
+    n = 4096
+    X = rng.normal(size=(n, 3))
+    y = X @ np.asarray([1.0, -0.5, 0.25]) + 2.0 + 0.01 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "y"])
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0)
+    glm.train(y="y", training_frame=fr)
+    assert glm.coef()["a"] == pytest.approx(1.0, abs=0.02)

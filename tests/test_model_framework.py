@@ -1,0 +1,131 @@
+"""Cross-cutting model-framework regressions: NA responses, col_types hints,
+test-frame domain adaptation, CV param propagation, artifacts."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+from conftest import make_classification
+
+
+def test_na_response_rows_dropped(cloud1):
+    X, y = make_classification(1000, 5, seed=0)
+    yf = y.astype(float)
+    yf[::10] = np.nan
+    fr = Frame.from_numpy(np.column_stack([X, yf]),
+                          names=["a", "b", "c", "d", "e", "y"])
+    fr["y"] = Frame.from_dict({"y": yf}).asfactor("y").vec("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    # all-NA rows dropped: nobs reflects only labeled rows
+    assert gbm.model.training_metrics.nobs == int((~np.isnan(yf)).sum())
+    assert gbm.auc() > 0.7
+
+
+def test_col_types_enum_hint(tmp_path, cloud1):
+    p = tmp_path / "t.csv"
+    p.write_text("x,label\n" + "\n".join(f"{i*0.1:.1f},{i%2}" for i in range(50)) + "\n")
+    fr = h2o.import_file(str(p), col_types={"label": "enum"})
+    assert fr.vec("label").type == "enum"
+    assert fr.vec("label").nlevels == 2
+
+
+def test_predict_domain_adaptation(cloud1):
+    rng = np.random.default_rng(5)
+    n = 1200
+    lv = np.asarray(["blue", "green", "red"], dtype=object)
+    cat = lv[rng.integers(0, 3, n)]
+    y = (cat == "red").astype(int) ^ (rng.random(n) < 0.05)
+    fr = Frame.from_dict({"color": cat, "y": y.astype(int)}).asfactor("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=2)
+    gbm.train(y="y", training_frame=fr)
+    # test frame interns only a subset => different code mapping
+    test = Frame.from_dict({"color": np.asarray(["red"] * 10 + ["green"] * 10, dtype=object)})
+    pred = gbm.predict(test).vec("1").numeric_np()
+    assert pred[:10].mean() > 0.7      # red => class 1
+    assert pred[10:].mean() < 0.3      # green => class 0
+    # unseen level behaves like NA, doesn't crash
+    test2 = Frame.from_dict({"color": np.asarray(["purple"] * 5, dtype=object)})
+    p2 = gbm.predict(test2).vec("1").numeric_np()
+    assert np.isfinite(p2).all()
+
+
+def test_cv_propagates_weights(cloud1):
+    X, y = make_classification(900, 5, seed=3)
+    w = np.where(y == 1, 3.0, 1.0)
+    fr = Frame.from_numpy(np.column_stack([X, y, w]),
+                          names=["a", "b", "c", "d", "e", "y", "w"]).asfactor("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, nfolds=2,
+                                       weights_column="w", seed=4)
+    gbm.train(y="y", training_frame=fr, x=["a", "b", "c", "d", "e"])
+    assert gbm.model.cross_validation_metrics is not None
+
+
+def test_glm_lambda_actually_regularizes(cloud1):
+    # review regression: penalty must scale with n (sum-scale Gram)
+    rng = np.random.default_rng(6)
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = 2 * X[:, 0] + 0.05 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "y"])
+    strong = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=1.0, alpha=0.0)
+    strong.train(y="y", training_frame=fr)
+    # λ=1 ridge must shrink the true coef visibly (≈ x0_coef/(1+λ) on std scale)
+    assert abs(strong.coef_norm()["a"]) < 1.5
+    lasso = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=1.0, alpha=1.0)
+    lasso.train(y="y", training_frame=fr)
+    cn = lasso.coef_norm()
+    assert all(abs(cn[c]) < 1e-6 for c in ("b", "c", "d"))  # exactly zeroed
+
+
+def test_mojo_roundtrip_gbm(tmp_path, cloud1):
+    X, y = make_classification(800, 5, seed=7)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "e", "y"]).asfactor("y")
+    gbm = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=5)
+    gbm.train(y="y", training_frame=fr)
+    path = h2o.save_model(gbm, str(tmp_path))
+    scorer = h2o.load_model(path)
+    p_live = gbm.predict(fr).vec("1").numeric_np()
+    p_mojo = scorer.predict(fr).vec("1").numeric_np()
+    np.testing.assert_allclose(p_live, p_mojo, rtol=1e-5, atol=1e-6)
+
+
+def test_mojo_roundtrip_glm(tmp_path, cloud1):
+    rng = np.random.default_rng(8)
+    n = 600
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0).astype(int)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "y"]).asfactor("y")
+    glm = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0)
+    glm.train(y="y", training_frame=fr)
+    path = h2o.save_model(glm, str(tmp_path))
+    scorer = h2o.load_model(path)
+    np.testing.assert_allclose(
+        glm.predict(fr).vec("1").numeric_np(),
+        scorer.predict(fr).vec("1").numeric_np(),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_mojo_roundtrip_dl(tmp_path, cloud1):
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+
+    X, y = make_classification(600, 4, seed=9)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "y"]).asfactor("y")
+    dl = H2ODeepLearningEstimator(hidden=[8], epochs=3, seed=6, mini_batch_size=64)
+    dl.train(y="y", training_frame=fr)
+    path = h2o.save_model(dl, str(tmp_path))
+    scorer = h2o.load_model(path)
+    np.testing.assert_allclose(
+        dl.predict(fr).vec("1").numeric_np(),
+        scorer.predict(fr).vec("1").numeric_np(),
+        rtol=1e-4, atol=1e-5,
+    )

@@ -1,0 +1,114 @@
+"""Histogram-op and tree-builder unit tests (the ScoreBuildHistogram2 /
+DTree.findBestSplitPoint layer, SURVEY.md §3.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from h2o3_tpu.ops.histogram import build_histograms
+from h2o3_tpu.models import tree as treelib
+
+
+def _ref_hist(codes, node_id, g, h, w, n_nodes, nbins):
+    out = np.zeros((n_nodes, codes.shape[1], nbins, 3))
+    for i in range(codes.shape[0]):
+        for f in range(codes.shape[1]):
+            out[node_id[i], f, codes[i, f], 0] += w[i]
+            out[node_id[i], f, codes[i, f], 1] += g[i] * w[i]
+            out[node_id[i], f, codes[i, f], 2] += h[i] * w[i]
+    return out
+
+
+@pytest.mark.parametrize("method", ["segment", "onehot"])
+def test_histogram_matches_reference(method):
+    rng = np.random.default_rng(0)
+    N, F, B, L = 256, 5, 8, 4
+    codes = rng.integers(0, B, (N, F)).astype(np.uint8)
+    node = rng.integers(0, L, N).astype(np.int32)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.uniform(0.1, 1, N).astype(np.float32)
+    w = (rng.random(N) > 0.1).astype(np.float32)
+    got = np.asarray(
+        build_histograms(jnp.asarray(codes), jnp.asarray(node), jnp.asarray(g),
+                         jnp.asarray(h), jnp.asarray(w), L, B, method=method)
+    )
+    want = _ref_hist(codes, node, g, h, w, L, B)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=1e-2)
+
+
+def test_build_tree_learns_threshold_split():
+    # y = 1[x0 > 0.37]: a depth-1 tree must find (almost exactly) that split
+    rng = np.random.default_rng(1)
+    N, B = 4096, 32
+    x = rng.uniform(0, 1, N).astype(np.float32)
+    y = (x > 0.37).astype(np.float32)
+    edges = np.linspace(0, 1, B)[1:-1]
+    codes = np.searchsorted(edges, x).astype(np.uint8)[:, None]
+    g = (0.5 - y)  # bernoulli grad at margin 0
+    h = np.full(N, 0.25, np.float32)
+    pad_edges = np.full((1, B - 2), np.inf, np.float32)
+    pad_edges[0, : len(edges)] = edges
+    tree, leaf_idx, gains = treelib.build_tree(
+        jnp.asarray(codes), jnp.asarray(g), jnp.asarray(h),
+        jnp.ones(N, jnp.float32), jnp.ones(1, jnp.float32),
+        jnp.asarray(pad_edges), max_depth=2, nbins=B, min_rows=10.0,
+    )
+    assert bool(tree.is_split[0])
+    thr = float(tree.thr[0])
+    assert abs(thr - 0.37) < 0.05
+    # left leaf value negative margin? left = y=0 rows: g=0.5 → value < 0
+    v = np.asarray(tree.value)
+    assert v[1] < 0 < v[2]
+    assert float(gains[0]) > 0
+
+
+def test_build_tree_respects_min_rows():
+    N, B = 64, 8
+    codes = np.zeros((N, 1), np.uint8)
+    codes[:2, 0] = 1  # only 2 rows distinguishable
+    g = np.ones(N, np.float32)
+    g[:2] = -1
+    tree, _, _ = treelib.build_tree(
+        jnp.asarray(codes), jnp.asarray(g), jnp.ones(N, jnp.float32),
+        jnp.ones(N, jnp.float32), jnp.ones(1, jnp.float32),
+        jnp.full((1, B - 2), jnp.inf, jnp.float32),
+        max_depth=1, nbins=B, min_rows=10.0,
+    )
+    assert not bool(tree.is_split[0])
+
+
+def test_predict_raw_matches_codes_path():
+    rng = np.random.default_rng(2)
+    N, Fn, B = 1024, 4, 16
+    X = rng.normal(size=(N, Fn)).astype(np.float32)
+    from h2o3_tpu.frame.binning import build_bins
+
+    bm = build_bins(X, nbins=B)
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(np.float32)
+    g = 0.5 - y
+    h = np.full(N, 0.25, np.float32)
+    pad_edges = np.full((Fn, B - 2), np.inf, np.float32)
+    for j, e in enumerate(bm.edges):
+        pad_edges[j, : len(e)] = e
+    tree, leaf_idx, _ = treelib.build_tree(
+        jnp.asarray(bm.codes), jnp.asarray(g), jnp.asarray(h),
+        jnp.ones(N, jnp.float32), jnp.ones(Fn, jnp.float32),
+        jnp.asarray(pad_edges), max_depth=4, nbins=B, min_rows=5.0,
+    )
+    v_codes = np.asarray(treelib.predict_codes(tree, jnp.asarray(bm.codes), 4))
+    v_raw = np.asarray(treelib.predict_raw(tree, jnp.asarray(X), 4))
+    np.testing.assert_allclose(v_codes, v_raw, rtol=1e-5, atol=1e-6)
+    # the returned training leaf idx agrees with traversal
+    v_leaf = np.asarray(tree.value)[np.asarray(leaf_idx)]
+    np.testing.assert_allclose(v_leaf, v_codes, rtol=1e-5, atol=1e-6)
+
+
+def test_nan_goes_right():
+    N, B = 512, 8
+    x = np.linspace(-1, 1, N).astype(np.float32)
+    x[::7] = np.nan
+    from h2o3_tpu.frame.binning import build_bins
+
+    bm = build_bins(x[:, None], nbins=B)
+    assert (bm.codes[::7, 0] == B - 1).all()

@@ -1,0 +1,88 @@
+"""KMeans / PCA / IsolationForest tests — `testdir_algos/{kmeans,pca}` analog."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.kmeans import H2OKMeansEstimator
+from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+from h2o3_tpu.models.isolation_forest import H2OIsolationForestEstimator
+
+
+def _blobs(n=900, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, (k, 4))
+    X = np.concatenate([c + rng.normal(size=(n // k, 4)) for c in centers])
+    labels = np.repeat(np.arange(k), n // k)
+    return X, labels, centers
+
+
+def test_kmeans_recovers_blobs(cloud1):
+    X, labels, centers = _blobs()
+    fr = Frame.from_numpy(X, names=["a", "b", "c", "d"])
+    km = H2OKMeansEstimator(k=3, max_iterations=20, standardize=False, seed=1)
+    km.train(training_frame=fr)
+    got = km.model.centers()
+    # every true center matched within 0.5 by some found center
+    for c in centers:
+        assert np.min(np.linalg.norm(got - c, axis=1)) < 0.5
+    pred = km.predict(fr).vec("predict").numeric_np().astype(int)
+    # cluster assignments align with blob structure (same-blob rows agree)
+    for b in range(3):
+        vals, counts = np.unique(pred[labels == b], return_counts=True)
+        assert counts.max() / counts.sum() > 0.95
+    assert km.model.tot_withinss() < km.model.totss()
+
+
+def test_kmeans_plusplus_and_random_init(cloud1):
+    X, _, _ = _blobs(seed=2)
+    fr = Frame.from_numpy(X)
+    for init in ("PlusPlus", "Random", "Furthest"):
+        km = H2OKMeansEstimator(k=3, init=init, seed=3, max_iterations=15)
+        km.train(training_frame=fr)
+        assert km.model.training_metrics.tot_withinss < 0.2 * km.model.totss()
+
+
+def test_pca_variance_order(cloud1):
+    rng = np.random.default_rng(4)
+    n = 1000
+    z = rng.normal(size=(n, 2))
+    X = np.column_stack([3 * z[:, 0], 1 * z[:, 1], 0.1 * rng.normal(size=n)])
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    pca = H2OPrincipalComponentAnalysisEstimator(k=3, transform="DEMEAN")
+    pca.train(training_frame=fr)
+    imp = pca.model.importance
+    sd = imp["Standard deviation"]
+    assert sd[0] > sd[1] > sd[2]
+    assert sd[0] == pytest.approx(3.0, rel=0.1)
+    scores = pca.model.predict(fr)
+    assert scores.ncol == 3
+    # PC1 aligns with the dominant axis
+    pc1 = scores.vec("PC1").numeric_np()
+    assert abs(np.corrcoef(pc1, z[:, 0])[0, 1]) > 0.99
+
+
+def test_pca_randomized(cloud1):
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(500, 10)) @ np.diag([5, 3] + [0.1] * 8)
+    fr = Frame.from_numpy(X)
+    pca = H2OPrincipalComponentAnalysisEstimator(k=2, pca_method="Randomized",
+                                                 transform="DEMEAN", seed=6)
+    pca.train(training_frame=fr)
+    sd = pca.model.importance["Standard deviation"]
+    assert sd[0] == pytest.approx(5.0, rel=0.15)
+
+
+def test_isolation_forest_flags_outliers(cloud1):
+    rng = np.random.default_rng(7)
+    inliers = rng.normal(size=(500, 3))
+    outliers = rng.normal(loc=8.0, size=(10, 3))
+    X = np.concatenate([inliers, outliers])
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    iso = H2OIsolationForestEstimator(ntrees=50, sample_size=128, seed=8)
+    iso.train(training_frame=fr)
+    scores = iso.predict(fr).vec("predict").numeric_np()
+    assert scores[-10:].mean() > scores[:-10].mean() + 0.1
+    # outliers rank in the top 5%
+    thresh = np.quantile(scores, 0.95)
+    assert (scores[-10:] > thresh).mean() > 0.8
