@@ -8,9 +8,23 @@ the layer-by-layer mapping.
 
 from __future__ import annotations
 
+import os as _os
 from typing import Optional, Sequence
 
 import numpy as np
+
+# Persistent XLA compilation cache: tree programs are large and (on remote
+# axon TPU) each compile pays a tunnel round-trip — cache across processes.
+if not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    try:
+        import jax as _jax
+
+        _cache = _os.path.expanduser("~/.cache/h2o3_tpu/jax_cache")
+        _os.makedirs(_cache, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
 
 from .frame.frame import Frame
 from .frame.frame import Frame as H2OFrame
@@ -88,6 +102,20 @@ def remove(obj) -> None:
 
 def ls():
     return list(_frames) + list(_models)
+
+
+def merge(x: Frame, y: Frame, all_x: bool = False, all_y: bool = False,
+          by_x=None, by_y=None, method="auto") -> Frame:
+    """`h2o.merge` — AstMerge radix join (see frame/rapids.py). by_x/by_y
+    pair key columns with different names (right keys renamed pre-join)."""
+    from .frame.rapids import merge as _m
+
+    if by_y is not None:
+        if by_x is None or len(by_x) != len(by_y):
+            raise ValueError("merge: by_x and by_y must be same-length lists")
+        renames = dict(zip(by_y, by_x))
+        y = Frame({renames.get(n, n): v for n, v in zip(y.names, y.vecs())})
+    return _m(x, y, by=by_x, all_x=all_x, all_y=all_y)
 
 
 def no_progress():

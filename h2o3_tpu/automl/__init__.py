@@ -1,1 +1,5 @@
+"""`h2o.automl` — reference parity: `h2o-py/h2o/automl/` + `h2o-automl/`."""
 
+from .automl import EventLog, H2OAutoML, Leaderboard
+
+__all__ = ["H2OAutoML", "Leaderboard", "EventLog"]

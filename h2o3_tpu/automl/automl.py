@@ -1,0 +1,238 @@
+"""H2OAutoML — automatic model selection under a budget.
+
+Reference parity: `h2o-automl/src/main/java/ai/h2o/automl/AutoML.java`,
+`ModelingStepsExecutor.java`, `modeling/*Steps.java` (the step sequence:
+XGBoost defaults ×3, GLM, DRF + XRT, GBM ×5, DeepLearning ×3, random grids,
+then two StackedEnsembles — BestOfFamily and AllModels), `Leaderboard.java`
+(rank by CV metric), `events/EventLog.java`. Client surface
+`h2o-py/h2o/automl/_estimator.py` (`H2OAutoML(max_models=, max_runtime_secs=)
+.train()`, `.leaderboard`, `.leader`).
+
+Budgeting: `max_models` counts base models (as upstream); `max_runtime_secs`
+is checked between steps. Every base model trains with nfolds=5 CV so the
+ensembles can stack holdout predictions.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..models.model_base import response_info
+
+
+class EventLog:
+    """ai.h2o.automl.events.EventLog — timestamped progress records."""
+
+    def __init__(self):
+        self.events: List[Dict] = []
+
+    def log(self, stage: str, message: str):
+        self.events.append({"timestamp": time.time(), "stage": stage, "message": message})
+
+
+class Leaderboard:
+    """ai.h2o.automl.Leaderboard — models ranked by CV metric."""
+
+    def __init__(self, sort_metric: str, decreasing: bool):
+        self.sort_metric = sort_metric
+        self.decreasing = decreasing
+        self.rows: List[Dict] = []
+
+    def add(self, est):
+        m = est.model._m(xval=True)
+        row = {
+            "model_id": est.model_id,
+            "algo": est.algo,
+            "_est": est,
+        }
+        for name in ("auc", "logloss", "mean_per_class_error", "rmse", "mse", "mae"):
+            row[name] = getattr(m, name, float("nan"))
+        self.rows.append(row)
+        self._sort()
+
+    def _sort(self):
+        key = self.sort_metric
+
+        def sk(r):
+            v = r.get(key, float("nan"))
+            bad = v is None or (isinstance(v, float) and np.isnan(v))
+            return (bad, -v if (self.decreasing and not bad) else (v if not bad else 0))
+
+        self.rows.sort(key=sk)
+
+    def as_data_frame(self, use_pandas=False):
+        return [
+            {k: v for k, v in r.items() if not k.startswith("_")} for r in self.rows
+        ]
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+
+class H2OAutoML:
+    def __init__(
+        self,
+        max_models: Optional[int] = None,
+        max_runtime_secs: float = 3600.0,
+        max_runtime_secs_per_model: float = 0.0,
+        seed: Optional[int] = None,
+        nfolds: int = 5,
+        sort_metric: str = "AUTO",
+        stopping_metric: str = "AUTO",
+        stopping_rounds: int = 3,
+        stopping_tolerance: float = -1.0,
+        exclude_algos: Optional[List[str]] = None,
+        include_algos: Optional[List[str]] = None,
+        balance_classes: bool = False,
+        project_name: Optional[str] = None,
+        verbosity: Optional[str] = None,
+        keep_cross_validation_predictions: bool = True,
+        **kw,
+    ):
+        self.max_models = max_models
+        self.max_runtime_secs = max_runtime_secs
+        self.max_runtime_secs_per_model = max_runtime_secs_per_model
+        self.seed = seed if seed is not None else 1234
+        self.nfolds = max(int(nfolds), 2)
+        self.sort_metric = sort_metric
+        self.exclude_algos = set(a.upper() for a in (exclude_algos or []))
+        self.include_algos = (
+            set(a.upper() for a in include_algos) if include_algos else None
+        )
+        self.project_name = project_name or f"automl_{int(time.time())}"
+        self.event_log = EventLog()
+        self.leaderboard: Optional[Leaderboard] = None
+        self.leader = None
+        self._models: List = []
+
+    def _allowed(self, algo: str) -> bool:
+        algo = algo.upper()
+        if self.include_algos is not None:
+            return algo in self.include_algos
+        return algo not in self.exclude_algos
+
+    # the fixed modeling plan of ai.h2o.automl.modeling.*Steps
+    def _steps(self, problem: str) -> List[Dict[str, Any]]:
+        from ..models.deeplearning import H2ODeepLearningEstimator
+        from ..models.drf import H2ORandomForestEstimator
+        from ..models.gbm import H2OGradientBoostingEstimator
+        from ..models.glm import H2OGeneralizedLinearEstimator
+        from ..models.xgboost import H2OXGBoostEstimator
+
+        steps = []
+
+        def add(algo, cls, name, **parms):
+            if self._allowed(algo):
+                steps.append({"algo": algo, "cls": cls, "name": name, "parms": parms})
+
+        # XGBoost defaults ×3 (XGBoostSteps def_1..3)
+        add("XGBOOST", H2OXGBoostEstimator, "XGBoost_1",
+            ntrees=50, max_depth=6, learn_rate=0.3, sample_rate=0.8,
+            col_sample_rate_per_tree=0.8, reg_lambda=1.0)
+        add("XGBOOST", H2OXGBoostEstimator, "XGBoost_2",
+            ntrees=50, max_depth=10, learn_rate=0.2, sample_rate=0.6,
+            col_sample_rate_per_tree=0.8, reg_lambda=1.0, min_rows=5.0)
+        add("XGBOOST", H2OXGBoostEstimator, "XGBoost_3",
+            ntrees=50, max_depth=3, learn_rate=0.3, sample_rate=0.8,
+            col_sample_rate_per_tree=0.8, reg_lambda=1.0)
+        # GLM (GLMSteps def_1: lambda search)
+        add("GLM", H2OGeneralizedLinearEstimator, "GLM_1",
+            lambda_search=True, alpha=0.5)
+        # DRF + XRT (DRFSteps)
+        add("DRF", H2ORandomForestEstimator, "DRF_1", ntrees=50)
+        add("DRF", H2ORandomForestEstimator, "XRT_1", ntrees=50,
+            histogram_type="Random")
+        # GBM ×5 (GBMSteps def_1..5)
+        for i, (d, r) in enumerate([(6, 0.8), (7, 0.8), (8, 0.8), (10, 0.6), (15, 0.6)], 1):
+            add("GBM", H2OGradientBoostingEstimator, f"GBM_{i}",
+                ntrees=60, max_depth=d, sample_rate=r, learn_rate=0.1,
+                col_sample_rate=0.8)
+        # DeepLearning ×3 (DeepLearningSteps)
+        add("DEEPLEARNING", H2ODeepLearningEstimator, "DeepLearning_1",
+            hidden=[64, 64], epochs=10, mini_batch_size=128)
+        add("DEEPLEARNING", H2ODeepLearningEstimator, "DeepLearning_2",
+            hidden=[128], epochs=10, mini_batch_size=128)
+        add("DEEPLEARNING", H2ODeepLearningEstimator, "DeepLearning_3",
+            hidden=[32, 32, 32], epochs=10, mini_batch_size=128)
+        return steps
+
+    def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
+              validation_frame=None, leaderboard_frame=None, blending_frame=None,
+              **kw):
+        assert training_frame is not None and y is not None
+        t0 = time.time()
+        problem, nclass, domain = response_info(training_frame.vec(y))
+        sort_metric = self.sort_metric
+        if sort_metric == "AUTO":
+            sort_metric = {"binomial": "auc", "multinomial": "mean_per_class_error"}.get(
+                problem, "rmse"
+            )
+        decreasing = sort_metric in ("auc", "pr_auc", "accuracy", "r2")
+        self.leaderboard = Leaderboard(sort_metric, decreasing)
+        self.event_log.log("init", f"AutoML {self.project_name} problem={problem}")
+
+        budget_left = lambda: (
+            self.max_runtime_secs <= 0 or time.time() - t0 < self.max_runtime_secs
+        )
+        for step in self._steps(problem):
+            if not budget_left():
+                self.event_log.log("budget", "max_runtime_secs reached")
+                break
+            if self.max_models and len(self._models) >= self.max_models:
+                break
+            parms = dict(step["parms"])
+            parms["seed"] = self.seed
+            parms["nfolds"] = self.nfolds
+            parms["keep_cross_validation_predictions"] = True
+            if self.max_runtime_secs_per_model:
+                parms["max_runtime_secs"] = self.max_runtime_secs_per_model
+            try:
+                est = step["cls"](**parms)
+                est.train(x=x, y=y, training_frame=training_frame)
+                est._automl_name = step["name"]
+                self._models.append(est)
+                self.leaderboard.add(est)
+                self.event_log.log("model", f"built {step['name']} ({est.model_id})")
+            except Exception as e:
+                self.event_log.log("error", f"{step['name']} failed: {e}")
+
+        # StackedEnsembles (SE BestOfFamily + AllModels)
+        if self._allowed("STACKEDENSEMBLE") and len(self._models) >= 2 and budget_left():
+            from ..models.ensemble import H2OStackedEnsembleEstimator
+
+            best_of_family: Dict[str, Any] = {}
+            for r in self.leaderboard.rows:
+                best_of_family.setdefault(r["algo"], r["_est"])
+            for name, base in (
+                ("StackedEnsemble_BestOfFamily", list(best_of_family.values())),
+                ("StackedEnsemble_AllModels", list(self._models)),
+            ):
+                try:
+                    se = H2OStackedEnsembleEstimator(base_models=base)
+                    se.train(x=x, y=y, training_frame=training_frame)
+                    se._automl_name = name
+                    # SE has no CV — rank by training metrics as proxy
+                    se.model.cross_validation_metrics = se.model.training_metrics
+                    self.leaderboard.add(se)
+                    self.event_log.log("model", f"built {name}")
+                except Exception as e:
+                    self.event_log.log("error", f"{name} failed: {e}")
+
+        if len(self.leaderboard):
+            self.leader = self.leaderboard[0]["_est"]
+        self.event_log.log("done", f"{len(self.leaderboard)} models")
+        return self
+
+    def predict(self, frame: Frame) -> Frame:
+        assert self.leader is not None, "AutoML has no leader; call train() first"
+        return self.leader.predict(frame)
+
+    def get_leaderboard(self, extra_columns=None):
+        return self.leaderboard

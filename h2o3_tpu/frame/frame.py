@@ -211,5 +211,89 @@ class Frame:
                 out[n] = Vec.from_numpy(np.asarray(v.numeric_np()), "enum")
         return Frame(out)
 
+    # -- munging entry points (water/rapids subset, see rapids.py) -----------
+    def group_by(self, by):
+        from .rapids import GroupBy
+
+        return GroupBy(self, by)
+
+    def merge(self, other: "Frame", all_x: bool = False, all_y: bool = False,
+              by: Optional[Sequence[str]] = None) -> "Frame":
+        from .rapids import merge as _merge
+
+        return _merge(self, other, by=by, all_x=all_x, all_y=all_y)
+
+    def quantile(self, prob=None, combine_method: str = "interpolate") -> "Frame":
+        from .rapids import quantile as _quantile
+
+        return _quantile(self, prob or [0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9, 0.99],
+                         combine_method)
+
+    def table(self) -> "Frame":
+        from .rapids import table as _table
+
+        return _table(self)
+
+    # -- elementwise arithmetic/comparison (lazy-ExprNode surface, eager) ----
+    def _col0(self) -> np.ndarray:
+        return self.vecs()[0].numeric_np()
+
+    def _binop(self, other, op):
+        a = self._col0()
+        b = other._col0() if isinstance(other, Frame) else other
+        return op(a, b)
+
+    def _arith(self, other, op, name):
+        return Frame({name: Vec(self._binop(other, op).astype(np.float32), "real")})
+
+    def __add__(self, other):
+        return self._arith(other, np.add, self.names[0])
+
+    def __sub__(self, other):
+        return self._arith(other, np.subtract, self.names[0])
+
+    def __mul__(self, other):
+        return self._arith(other, np.multiply, self.names[0])
+
+    def __truediv__(self, other):
+        return self._arith(other, np.divide, self.names[0])
+
+    def __gt__(self, other):
+        return self._binop(other, np.greater)
+
+    def __lt__(self, other):
+        return self._binop(other, np.less)
+
+    def __ge__(self, other):
+        return self._binop(other, np.greater_equal)
+
+    def __le__(self, other):
+        return self._binop(other, np.less_equal)
+
+    def __eq__(self, other):  # noqa: comparisons return row masks like H2OFrame
+        if isinstance(other, (int, float, np.number, Frame)):
+            return self._binop(other, np.equal)
+        if isinstance(other, str):
+            v = self.vecs()[0]
+            if v.type == "enum":
+                code = v.domain.index(other) if other in (v.domain or []) else -2
+                return np.asarray(v.data) == code
+            if v.type == "string":
+                return np.asarray([s == other for s in v.to_numpy()])
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else ~eq
+
+    def __hash__(self):
+        return id(self)
+
+    def mean(self):
+        return [v.mean() for v in self.vecs()]
+
+    def sum_col(self, name: str) -> float:
+        return float(np.nansum(self.vec(name).numeric_np()))
+
     def __repr__(self):
         return f"Frame({self.nrow}x{self.ncol} {list(self.types.items())[:6]}...)"

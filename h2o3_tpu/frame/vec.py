@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 TYPES = ("real", "int", "enum", "time", "string")
@@ -41,11 +40,15 @@ class Vec:
         if type == "string":
             self.data = None
         else:
-            arr = jnp.asarray(data)
+            # columns are HOST-resident numpy; device placement (HBM, row-
+            # sharded) happens once per training run inside the algorithms —
+            # eager per-column device_put would round-trip the axon tunnel
+            # on every munging op
+            arr = np.asarray(data)
             if type == "enum":
-                arr = arr.astype(jnp.int32)
-            elif arr.dtype not in (jnp.float32, jnp.float64):
-                arr = arr.astype(jnp.float32)
+                arr = arr.astype(np.int32)
+            elif arr.dtype not in (np.float32, np.float64):
+                arr = arr.astype(np.float32)
             self.data = arr
 
     # -- construction -------------------------------------------------------

@@ -85,30 +85,35 @@ def _gram_step(X, y, w, beta, family: str, tweedie_p: float = 1.5):
     return gram, xy
 
 
-def _solve_penalized(gram, xy, lam, alpha, n_obs, intercept_idx, beta0):
+def _solve_penalized(gram, xy, lam, alpha, n_obs, intercept_idx, beta0,
+                     non_negative=False):
     """Solve the IRLS quadratic with elastic-net penalty (host, p×p).
 
-    Ridge part closed-form via Cholesky; L1 via ISTA on the quadratic —
-    the same subproblem hex/glm COORDINATE_DESCENT iterates on."""
+    Ridge part closed-form via Cholesky; L1 (and the non_negative
+    constraint, used by the StackedEnsemble metalearner) via projected ISTA
+    on the quadratic — the same subproblem hex/glm COORDINATE_DESCENT
+    iterates on."""
     p = gram.shape[0]
     pen_mask = np.ones(p)
     pen_mask[intercept_idx] = 0.0  # intercept is never penalized
     l2 = lam * (1 - alpha) * n_obs
     l1 = lam * alpha * n_obs
     A = gram + np.diag(pen_mask * l2)
-    if l1 == 0:
+    if l1 == 0 and not non_negative:
         try:
             return np.linalg.solve(A + 1e-8 * np.eye(p), xy)
         except np.linalg.LinAlgError:
             return np.linalg.lstsq(A, xy, rcond=None)[0]
-    # ISTA
+    # (projected) ISTA
     L = np.linalg.eigvalsh(A).max() + 1e-8
     b = beta0.copy()
-    for _ in range(200):
+    for _ in range(500):
         grad = A @ b - xy
         b_new = b - grad / L
         thr = l1 / L * pen_mask
         b_new = np.sign(b_new) * np.maximum(np.abs(b_new) - thr, 0)
+        if non_negative:
+            b_new[:intercept_idx] = np.maximum(b_new[:intercept_idx], 0.0)
         if np.max(np.abs(b_new - b)) < 1e-9:
             b = b_new
             break
@@ -333,6 +338,7 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             new_beta = _solve_penalized(
                 np.asarray(gram, np.float64), np.asarray(xy, np.float64),
                 lam, alpha, n_obs, pdim - 1, beta,
+                non_negative=bool(self._parms.get("non_negative")),
             )
             delta = np.max(np.abs(new_beta - beta))
             beta = new_beta
@@ -376,6 +382,7 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             new_beta = _solve_penalized(
                 np.asarray(gram, np.float64), np.asarray(xy, np.float64),
                 lam, alpha, n_obs, Xd.shape[1] - 1, beta,
+                non_negative=bool(self._parms.get("non_negative")),
             )
             delta = np.max(np.abs(new_beta - beta))
             beta = new_beta

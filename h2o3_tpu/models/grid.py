@@ -1,0 +1,118 @@
+"""H2OGridSearch — hyperparameter search.
+
+Reference parity: `h2o-algos/src/main/java/hex/grid/GridSearch.java`,
+`hex/grid/HyperSpaceWalker.java` (Cartesian + RandomDiscrete strategies,
+`search_criteria`: max_models / max_runtime_secs / seed / stopping_*),
+`hex/grid/Grid.java` (keyed store of built models) and the client surface
+`h2o-py/h2o/grid/grid_search.py` (`H2OGridSearch(model, hyper_params,
+search_criteria)`, `get_grid(sort_by, decreasing)`).
+
+Models in a grid are independent → on a pod this is embarrassingly parallel
+across hosts; round 1 builds sequentially (each build already uses the full
+mesh), which matches the reference's default parallelism=1 sequential walk.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..frame.frame import Frame
+
+
+class H2OGridSearch:
+    def __init__(
+        self,
+        model,
+        hyper_params: Dict[str, Sequence[Any]],
+        grid_id: Optional[str] = None,
+        search_criteria: Optional[Dict[str, Any]] = None,
+    ):
+        # `model` may be an estimator class or a template instance (h2o-py
+        # accepts both)
+        if isinstance(model, type):
+            self.model_class = model
+            self.base_parms: Dict[str, Any] = {}
+        else:
+            self.model_class = type(model)
+            self.base_parms = {
+                k: v for k, v in model._parms.items() if not k.startswith("_")
+            }
+        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        self.grid_id = grid_id or f"grid_{int(time.time())}"
+        self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
+        self.models: List = []
+        self.failed: List[Dict] = []
+
+    def _combos(self) -> List[Dict[str, Any]]:
+        keys = list(self.hyper_params)
+        combos = [
+            dict(zip(keys, vals))
+            for vals in itertools.product(*(self.hyper_params[k] for k in keys))
+        ]
+        strat = self.search_criteria.get("strategy", "Cartesian")
+        if strat == "RandomDiscrete":
+            seed = int(self.search_criteria.get("seed", 1234) or 1234)
+            rng = np.random.default_rng(seed)
+            rng.shuffle(combos)
+            mm = self.search_criteria.get("max_models")
+            if mm:
+                combos = combos[: int(mm)]
+        return combos
+
+    def train(self, x=None, y=None, training_frame: Optional[Frame] = None, **kw):
+        t0 = time.time()
+        budget = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
+        for combo in self._combos():
+            if budget and time.time() - t0 > budget:
+                break
+            parms = dict(self.base_parms)
+            parms.update(combo)
+            parms.pop("model_id", None)
+            try:
+                est = self.model_class(**parms)
+                est.train(x=x, y=y, training_frame=training_frame, **kw)
+                est._grid_combo = combo
+                self.models.append(est)
+            except Exception as e:  # failed combos are recorded, walk continues
+                self.failed.append({"params": combo, "error": str(e)})
+        return self
+
+    # -- h2o-py surface ------------------------------------------------------
+    def get_grid(self, sort_by: Optional[str] = None, decreasing: Optional[bool] = None):
+        if sort_by:
+            if decreasing is None:
+                decreasing = sort_by.lower() in ("auc", "pr_auc", "accuracy", "r2")
+            xval = any(m._parms.get("nfolds", 0) for m in self.models)
+
+            def metric(m):
+                try:
+                    return getattr(m, sort_by)(xval=xval) if callable(getattr(m, sort_by, None)) \
+                        else getattr(m.model._m(xval=xval), sort_by)
+                except Exception:
+                    return float("nan")
+
+            self.models.sort(key=lambda m: (np.isnan(metric(m)), -metric(m) if decreasing else metric(m)))
+        return self
+
+    @property
+    def model_ids(self) -> List[str]:
+        return [m.model_id for m in self.models]
+
+    def __iter__(self):
+        return iter(self.models)
+
+    def __len__(self):
+        return len(self.models)
+
+    def __getitem__(self, i):
+        return self.models[i]
+
+    def summary(self):
+        return [
+            {**getattr(m, "_grid_combo", {}), "model_id": m.model_id}
+            for m in self.models
+        ]

@@ -16,6 +16,7 @@ replacing the MRTask RPC-tree reduce of `ScoreBuildHistogram2.java`.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -38,6 +39,13 @@ from .model_base import DataInfo, H2OEstimator, H2OModel, ScoreKeeper, response_
 
 
 _predict_codes_jit = jax.jit(treelib.predict_codes, static_argnames=("max_depth",))
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _predict_forest_codes_jit(forest, codes, max_depth: int):
+    """Σ over a stacked forest of per-row leaf values on binned codes."""
+    per_tree = jax.vmap(lambda t: treelib.predict_codes(t, codes, max_depth))(forest)
+    return per_tree.sum(axis=0)
 
 
 def frame_to_matrix(frame: Frame, x: Sequence[str], expected_domains=None):
@@ -233,6 +241,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
         elif problem == "multinomial":
             pri = np.average(yk, axis=0, weights=w)
             f0 = np.log(np.clip(pri, 1e-10, 1.0)).astype(np.float32)
+        elif getattr(self, "_objective_fn", None) is not None:
+            f0 = np.zeros(1, np.float32)  # custom objectives start at 0 margin
         else:
             f0 = np.float32(dist_mod.init_margin(dist, yk[:, 0], w))
             f0 = np.asarray([f0])
@@ -268,9 +278,56 @@ class H2OSharedTreeEstimator(H2OEstimator):
         if ndev > 1:
             margins = jax.device_put(margins, cloud.row_sharding())
 
+        # checkpoint= continue-training: restore the prior forest and fast-
+        # forward margins (SharedTree checkpoint restart — `_parms.checkpoint`
+        # compat checks + tree restore in hex/tree/SharedTree.java)
+        prior_trees: List[List] = [[] for _ in range(K)]
+        prior_stacked: List = []
+        n_prior = 0
+        ckpt = self._parms.get("checkpoint")
+        if ckpt is not None:
+            pm = ckpt.model if hasattr(ckpt, "model") else ckpt
+            if not isinstance(pm, SharedTreeModel):
+                raise ValueError("checkpoint must be a prior tree model")
+            if pm.max_depth != tp["max_depth"] or pm.nclass != nclass:
+                raise ValueError(
+                    "checkpoint incompatible: max_depth/nclass must match "
+                    "(SharedTree checkpoint parameter compatibility checks)"
+                )
+            # re-bin the CURRENT training data with the prior model's edges so
+            # split bins stay aligned with the restored trees
+            bm = pm.bm
+            nbins = bm.nbins
+            codes_d = jnp.asarray(padr(bin_apply(bm, X)))
+            edges_np = np.full((F, nbins - 2), np.inf, np.float32)
+            for j, e in enumerate(bm.edges):
+                edges_np[j, : min(len(e), nbins - 2)] = e[: nbins - 2]
+            edges_d = jnp.asarray(edges_np)
+            n_prior = pm.ntrees_built
+            f0 = np.asarray(pm.f0).reshape(-1).astype(np.float32)
+            margins = jnp.broadcast_to(jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
+            prior_stacked = list(pm.forest)
+            for k in range(K):
+                stacked = pm.forest[k]
+                nt = stacked.feat.shape[0]
+                for t in range(nt):
+                    prior_trees[k].append(
+                        treelib.Tree(*[np.asarray(getattr(stacked, fld)[t])
+                                       for fld in treelib.Tree._fields])
+                    )
+                if self._mode != "drf":
+                    vsum = _predict_forest_codes_jit(
+                        jax.tree.map(jnp.asarray, stacked), codes_d, tp["max_depth"]
+                    )
+                    margins = margins.at[:, k].add(vsum)
+            if offset is not None:
+                margins = margins + jnp.asarray(padr(offset))[:, None]
+
         # validation margins tracked incrementally per tree (the Score pass of
         # SharedTree.Driver on the validation frame) — early stopping uses the
-        # validation metric when a validation_frame is given (ScoreKeeper)
+        # validation metric when a validation_frame is given (ScoreKeeper).
+        # Built AFTER the checkpoint block so codes_v uses the active binning
+        # and margins_v is fast-forwarded through the restored forest.
         valid_state = None
         if valid is not None:
             Xv, _, _ = frame_to_matrix(valid, x, expected_domains=bm.domains)
@@ -285,8 +342,15 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 ykv = np.zeros((valid.nrow, K), np.float32)
                 ykv[np.arange(valid.nrow), cv] = 1.0
             margins_v = jnp.broadcast_to(
-                jnp.asarray(f0)[None, :], (valid.nrow, K)
+                jnp.asarray(np.asarray(f0).reshape(-1))[None, :], (valid.nrow, K)
             ).astype(jnp.float32)
+            if n_prior and self._mode != "drf":
+                for k in range(K):
+                    vsum = _predict_forest_codes_jit(
+                        jax.tree.map(jnp.asarray, prior_stacked[k]), codes_v,
+                        tp["max_depth"],
+                    )
+                    margins_v = margins_v.at[:, k].add(vsum)
             if self._parms.get("offset_column") and self._parms["offset_column"] in valid.names:
                 off_v = valid.vec(self._parms["offset_column"]).numeric_np().astype(np.float32)
                 margins_v = margins_v + jnp.asarray(off_v)[:, None]
@@ -302,7 +366,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
         else:
             mtries = 0
 
-        trees: List[List] = [[] for _ in range(K)]
+        trees: List[List] = [list(prior_trees[k]) for k in range(K)]
+        ntrees_target = max(int(tp["ntrees"]) - n_prior, 0)
         gain_total = np.zeros(F, np.float64)
         stopper = (
             ScoreKeeper(
@@ -320,49 +385,138 @@ class H2OSharedTreeEstimator(H2OEstimator):
         history: List[Dict] = []
         built = 0
 
-        for m in range(tp["ntrees"]):
-            key, krow, kcol, ktree = jax.random.split(key, 4)
+        # ---- ONE jitted program per boosting iteration -------------------
+        # Per-call overhead matters: a remote/axon TPU pays a full tunnel
+        # round-trip per dispatch, so sampling, gradients, the K tree builds,
+        # and the margin updates are fused into a single XLA program — the
+        # analog of the fused ScoreBuildHistogram2 pass (hex/tree/
+        # ScoreBuildHistogram2.java fuses scoring into histogram building).
+        tweedie_power = float(self._parms.get("tweedie_power", 1.5)) \
+            if "tweedie_power" in self._parms else 1.5
+        quantile_alpha = float(self._parms.get("quantile_alpha", 0.5)) \
+            if "quantile_alpha" in self._parms else 0.5
+        colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
+        custom_obj = getattr(self, "_objective_fn", None)
+
+        def _grads(margins, y_d, k):
+            if self._mode == "drf":
+                return -y_d[:, k], jnp.ones_like(y_d[:, k])
+            if problem == "multinomial":
+                p = jax.nn.softmax(margins, axis=1)
+                return p[:, k] - y_d[:, k], p[:, k] * (1 - p[:, k])
+            return dist_mod.grad_hess(
+                dist, margins[:, 0], y_d[:, 0],
+                tweedie_power=tweedie_power, alpha=quantile_alpha,
+            )
+
+        annealing = tp["learn_rate_annealing"]
+
+        def _one_tree(margins, key, m, g_ext=None, h_ext=None):
+            """Build the K trees of boosting iteration m (traced int)."""
+            krow, kcol, ktree = jax.random.split(jax.random.fold_in(key, 0), 3)
             row_mask = (
                 jax.random.uniform(krow, (npad,)) < tp["sample_rate"]
             ).astype(jnp.float32)
-            wt = w_d * row_mask
-            colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
+            wt = w_d_ref[0] * row_mask
             if colp < 1.0:
                 fm = (jax.random.uniform(kcol, (F,)) < colp).astype(jnp.float32)
                 fm = fm.at[0].set(jnp.maximum(fm[0], 1 - fm.sum().clip(0, 1)))
             else:
                 fm = jnp.ones(F, jnp.float32)
-
+            scale = (lr * jnp.power(annealing, m.astype(jnp.float32))).astype(jnp.float32)
+            trs, gains_acc = [], jnp.zeros(F, jnp.float32)
             for k in range(K):
-                if self._mode == "drf":
-                    g = -y_d[:, k]
-                    h = jnp.ones_like(g)
+                ktree = jax.random.fold_in(ktree, k)
+                if g_ext is not None:
+                    g, h = g_ext, h_ext
                 else:
-                    if problem == "multinomial":
-                        p = jax.nn.softmax(margins, axis=1)
-                        g = p[:, k] - y_d[:, k]
-                        h = p[:, k] * (1 - p[:, k])
-                    else:
-                        g, h = dist_mod.grad_hess(
-                            dist, margins[:, 0], y_d[:, 0],
-                            tweedie_power=float(self._parms.get("tweedie_power", 1.5))
-                            if "tweedie_power" in self._parms else 1.5,
-                            alpha=float(self._parms.get("quantile_alpha", 0.5))
-                            if "quantile_alpha" in self._parms else 0.5,
-                        )
+                    g, h = _grads(margins, y_d_ref[0], k)
                 tr, leaf_idx, gains = self._build_one(
-                    codes_d, g, h, wt, fm, edges_d, tp, nbins, mtries, ktree, cloud
+                    codes_ref[0], g, h, wt, fm, edges_ref[0], tp, nbins, mtries,
+                    ktree, cloud
                 )
-                scale = lr * (tp["learn_rate_annealing"] ** m)
                 tr = tr._replace(value=tr.value * scale)
                 if self._mode != "drf":
                     margins = margins.at[:, k].add(tr.value[leaf_idx])
-                    if valid_state is not None:
-                        vleaf = _predict_codes_jit(tr, valid_state[0], tp["max_depth"])
-                        valid_state[2] = valid_state[2].at[:, k].add(vleaf)
-                trees[k].append(jax.tree.map(np.asarray, tr))
-                gain_total += np.asarray(gains, np.float64)
-            built = m + 1
+                trs.append(tr)
+                gains_acc = gains_acc + gains
+            stacked = treelib.Tree(
+                *[jnp.stack([getattr(t, f) for t in trs]) for f in treelib.Tree._fields]
+            )
+            return margins, stacked, gains_acc
+
+        # closure refs so the scan body captures device arrays as constants
+        codes_ref, y_d_ref, w_d_ref, edges_ref = [codes_d], [y_d], [w_d], [edges_d]
+
+        @functools.partial(jax.jit, static_argnames=("nsteps",), donate_argnums=(0,))
+        def _train_chunk(margins, key, m0, nsteps: int):
+            def body(carry, m):
+                margins = carry
+                margins, stacked, gains = _one_tree(
+                    margins, jax.random.fold_in(key, m), m
+                )
+                return margins, (stacked, gains)
+
+            margins, (trees_stack, gains) = jax.lax.scan(
+                body, margins, m0 + jnp.arange(nsteps)
+            )
+            return margins, trees_stack, gains.sum(axis=0)
+
+        _single_jit = jax.jit(
+            lambda margins, key, m, g_ext, h_ext: _one_tree(
+                margins, jax.random.fold_in(key, m), m, g_ext, h_ext
+            ),
+            donate_argnums=(0,),
+        )
+
+        # chunking: one device dispatch per `chunk` trees (remote dispatch
+        # latency amortization); scoring/stopping checks at chunk boundaries
+        need_host_each = (
+            custom_obj is not None
+            or bool(self._parms.get("score_each_iteration"))
+        )
+        if need_host_each:
+            chunk = 1
+        elif score_interval:
+            chunk = score_interval
+        elif stopper is not None:
+            chunk = max(1, min(10, ntrees_target))
+        else:
+            chunk = min(25, max(ntrees_target, 1))
+
+        m = 0
+        while m < ntrees_target:
+            nsteps = min(chunk, ntrees_target - m)
+            if custom_obj is not None:
+                g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
+                margins, stacked, gains = _single_jit(
+                    margins, key, jnp.int32(m), g_ext, h_ext
+                )
+                stacked = jax.tree.map(lambda a: a[None], stacked)
+            else:
+                margins, stacked, gains = _train_chunk(
+                    margins, key, jnp.int32(m), nsteps=nsteps
+                )
+            stacked_host = jax.tree.map(np.asarray, stacked)  # (nsteps, K, T)
+            for t in range(stacked_host.feat.shape[0]):
+                for k in range(K):
+                    tr_k = treelib.Tree(*[a[t, k] for a in stacked_host])
+                    trees[k].append(tr_k)
+            if valid_state is not None and self._mode != "drf":
+                # batch-update validation margins with the whole chunk
+                chunk_forest = treelib.Tree(
+                    *[jnp.asarray(a.reshape((-1,) + a.shape[2:]))
+                      for a in stacked_host]
+                )  # (nsteps*K, T) — K-major within each step
+                for k in range(K):
+                    sel = treelib.Tree(*[a[k::K] for a in chunk_forest])
+                    vsum = _predict_forest_codes_jit(
+                        sel, valid_state[0], tp["max_depth"]
+                    )
+                    valid_state[2] = valid_state[2].at[:, k].add(vsum)
+            gain_total += np.asarray(gains, np.float64)
+            m += stacked_host.feat.shape[0] if custom_obj is not None else nsteps
+            built = m
 
             do_score = (
                 (score_interval and built % score_interval == 0)
@@ -398,7 +552,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if max_runtime and time.time() - t0 > max_runtime:
                 break
             if self.job:
-                self.job.update(built / tp["ntrees"])
+                self.job.update(built / max(ntrees_target, 1))
 
         forest = [treelib.stack_trees([t for t in trees[k]]) for k in range(K)]
         model = SharedTreeModel(

@@ -1,0 +1,213 @@
+"""H2OWord2vecEstimator — word embeddings.
+
+Reference parity: `h2o-algos/src/main/java/hex/word2vec/Word2Vec.java`
+(skip-gram with hierarchical softmax / negative sampling, HogWild updates,
+`WordVectorTrainer` MRTask) and the client surface
+`h2o-py/h2o/estimators/word2vec.py` (`find_synonyms`, `transform` with
+aggregate_method="AVERAGE", pre-trained import).
+
+TPU rebuild: HogWild per-word races → synchronous minibatch skip-gram with
+negative sampling (SGNS): each step gathers (center, context, negatives)
+batches built host-side from the unigram table, and the device does two
+embedding matmuls + a sigmoid loss under jit — the dense MXU formulation of
+what the reference scatters one word at a time.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from .metrics import ModelMetricsBase
+from .model_base import H2OEstimator, H2OModel
+
+
+class Word2VecModel(H2OModel):
+    algo = "word2vec"
+
+    def __init__(self, params, vocab: List[str], vectors: np.ndarray):
+        super().__init__(params)
+        self.vocab = vocab
+        self.index: Dict[str, int] = {w: i for i, w in enumerate(vocab)}
+        self.vectors = vectors  # (V, dim)
+        self.x = []
+        self.y = None
+
+    def find_synonyms(self, word: str, count: int = 20) -> Dict[str, float]:
+        if word not in self.index:
+            return {}
+        v = self.vectors[self.index[word]]
+        norms = np.linalg.norm(self.vectors, axis=1) * max(np.linalg.norm(v), 1e-12)
+        sims = self.vectors @ v / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = {}
+        for i in order:
+            if self.vocab[i] == word:
+                continue
+            out[self.vocab[i]] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
+
+    def transform(self, words_frame: Frame, aggregate_method: str = "NONE") -> Frame:
+        """words → vectors; AVERAGE aggregates consecutive non-NA runs
+        (h2o's sentence embedding convention: NA rows delimit sentences)."""
+        col = words_frame.vecs()[0]
+        words = col.to_numpy() if col.type == "string" else np.asarray(
+            [col.domain[c] if c >= 0 else None for c in np.asarray(col.data)],
+            dtype=object,
+        )
+        dim = self.vectors.shape[1]
+        if aggregate_method.upper() == "NONE":
+            out = np.full((len(words), dim), np.nan)
+            for i, w in enumerate(words):
+                if w is not None and w in self.index:
+                    out[i] = self.vectors[self.index[w]]
+            return Frame.from_dict({f"C{j+1}": out[:, j] for j in range(dim)})
+        # AVERAGE
+        sents, cur = [], []
+        for w in words:
+            if w is None:
+                sents.append(cur)
+                cur = []
+            else:
+                cur.append(w)
+        sents.append(cur)
+        out = np.full((len(sents), dim), np.nan)
+        for i, sent in enumerate(sents):
+            vecs = [self.vectors[self.index[w]] for w in sent if w in self.index]
+            if vecs:
+                out[i] = np.mean(vecs, axis=0)
+        return Frame.from_dict({f"C{j+1}": out[:, j] for j in range(dim)})
+
+    def predict(self, test_data: Frame) -> Frame:
+        return self.transform(test_data)
+
+    def _make_metrics(self, frame):
+        return ModelMetricsBase()
+
+
+class H2OWord2vecEstimator(H2OEstimator):
+    algo = "word2vec"
+    supervised = False
+    _param_defaults = dict(
+        vec_size=100,
+        min_word_freq=5,
+        window_size=5,
+        sent_sample_rate=0.001,
+        init_learning_rate=0.025,
+        epochs=5,
+        negative_samples=5,
+        norm_model="HSM",
+        word_model="SkipGram",
+        pre_trained=None,
+    )
+
+    @staticmethod
+    def from_external(frame: Frame) -> Word2VecModel:
+        """Import pre-trained embeddings (h2o.word2vec pre_trained path):
+        first column words, rest the vector."""
+        words = frame.vecs()[0]
+        vocab = [str(w) for w in (words.to_numpy() if words.type == "string"
+                                  else words.domain)]
+        mat = np.column_stack([v.numeric_np() for v in frame.vecs()[1:]])
+        est = H2OWord2vecEstimator()
+        return Word2VecModel(est, vocab, mat)
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> Word2VecModel:
+        p = self._parms
+        seed = p["_actual_seed"]
+        col = train.vecs()[0]
+        if col.type == "string":
+            words = col.to_numpy()
+        elif col.type == "enum":
+            dom = np.asarray(col.domain + [None], dtype=object)
+            words = dom[np.asarray(col.data)]
+        else:
+            raise ValueError("word2vec needs a string/enum column of words")
+
+        min_freq = int(p.get("min_word_freq", 5))
+        toks = [w for w in words if w is not None]
+        uniq, counts = np.unique(np.asarray(toks, dtype=object), return_counts=True)
+        keep = counts >= min_freq
+        vocab = [str(w) for w in uniq[keep]]
+        freq = counts[keep].astype(np.float64)
+        V = len(vocab)
+        if V == 0:
+            raise ValueError(f"no words with frequency >= {min_freq}")
+        index = {w: i for i, w in enumerate(vocab)}
+        seq = np.asarray([index.get(w, -1) if w is not None else -1 for w in words],
+                         np.int64)
+
+        dim = int(p.get("vec_size", 100))
+        window = int(p.get("window_size", 5))
+        neg = int(p.get("negative_samples", 5))
+        lr = float(p.get("init_learning_rate", 0.025))
+        epochs = int(p.get("epochs", 5))
+
+        # skip-gram pairs within sentences (NA-delimited)
+        centers, contexts = [], []
+        nvalid = len(seq)
+        for i in range(nvalid):
+            if seq[i] < 0:
+                continue
+            for d in range(1, window + 1):
+                j = i + d
+                if j >= nvalid or seq[j] < 0:
+                    break
+                centers.append(seq[i]); contexts.append(seq[j])
+                centers.append(seq[j]); contexts.append(seq[i])
+        if not centers:
+            raise ValueError("no skip-gram pairs (input too short)")
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+
+        # unigram^0.75 negative-sampling table
+        probs = freq ** 0.75
+        probs = probs / probs.sum()
+
+        rng = np.random.default_rng(seed)
+        key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
+        Wc = (rng.random((V, dim)).astype(np.float32) - 0.5) / dim
+        Wo = np.zeros((V, dim), np.float32)
+        Wc, Wo = jnp.asarray(Wc), jnp.asarray(Wo)
+
+        @jax.jit
+        def step(Wc, Wo, c_idx, o_idx, n_idx, lr_t):
+            def loss_fn(params):
+                Wc_, Wo_ = params
+                vc = Wc_[c_idx]                     # (B, d)
+                vo = Wo_[o_idx]                     # (B, d)
+                vn = Wo_[n_idx]                     # (B, neg, d)
+                pos = jax.nn.log_sigmoid(jnp.sum(vc * vo, axis=1))
+                negs = jax.nn.log_sigmoid(-jnp.einsum("bd,bnd->bn", vc, vn)).sum(axis=1)
+                return -jnp.mean(pos + negs)
+
+            g = jax.grad(loss_fn)((Wc, Wo))
+            return Wc - lr_t * g[0], Wo - lr_t * g[1]
+
+        B = min(8192, len(centers))
+        steps_per_epoch = max(len(centers) // B, 1)
+        total = epochs * steps_per_epoch
+        t = 0
+        for ep in range(epochs):
+            perm = rng.permutation(len(centers))
+            for s in range(steps_per_epoch):
+                sel = perm[s * B : (s + 1) * B]
+                n_idx = rng.choice(V, size=(len(sel), neg), p=probs).astype(np.int32)
+                lr_t = np.float32(lr * max(1 - t / total, 1e-4))
+                Wc, Wo = step(Wc, Wo, jnp.asarray(centers[sel]),
+                              jnp.asarray(contexts[sel]), jnp.asarray(n_idx), lr_t)
+                t += 1
+
+        model = Word2VecModel(self, vocab, np.asarray(Wc))
+        model.training_metrics = ModelMetricsBase(nobs=len(centers))
+        return model
+
+
+Word2Vec = H2OWord2vecEstimator

@@ -1,0 +1,111 @@
+// Native CSV parse — the hot host-side ingest path.
+//
+// Reference parity: the reference's distributed parse tokenizes byte ranges
+// in Java (`water/parser/CsvParser.java` state machine inside the
+// `MultiFileParseTask` MRTask); its only native code is the prebuilt XGBoost
+// .so. Here the tokenizer itself is native: a single-pass, zero-allocation
+// scan with strtod for numerics. The Python layer (frame/parse.py) handles
+// setup-guessing and categorical interning; this handles the bandwidth.
+//
+// Exposed via ctypes (native/loader.py):
+//   h2o3_csv_parse_numeric(path, sep, header, ncol, out, cap) -> long long
+//     out == NULL: count data rows; returns -1 if any field is non-numeric
+//     (caller falls back to the Python object-column tokenizer), -2 on IO
+//     error. out != NULL: fill row-major doubles (NaN for NA tokens),
+//     returns rows written.
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+static bool is_na_token(const char* s, size_t n) {
+  if (n == 0) return true;
+  static const char* kNA[] = {"NA", "na", "N/A", "nan", "NaN", "null", "NULL", "?"};
+  for (const char* t : kNA) {
+    if (strlen(t) == n && strncmp(s, t, n) == 0) return true;
+  }
+  return false;
+}
+
+extern "C" long long h2o3_csv_parse_numeric(
+    const char* path, char sep, int header, int ncol,
+    double* out, long long cap) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -2;
+  fseek(f, 0, SEEK_END);
+  long long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string buf;
+  buf.resize(sz);
+  if (sz > 0 && fread(&buf[0], 1, sz, f) != (size_t)sz) {
+    fclose(f);
+    return -2;
+  }
+  fclose(f);
+
+  const char* p = buf.data();
+  const char* end = p + sz;
+  long long row = 0;
+  bool skipped_header = (header == 0);
+
+  if (!out) {
+    // count pass: non-blank data lines only (no field parsing)
+    while (p < end) {
+      const char* line_end = (const char*)memchr(p, '\n', end - p);
+      if (!line_end) line_end = end;
+      const char* le = line_end;
+      if (le > p && le[-1] == '\r') --le;
+      if (le != p) {
+        if (!skipped_header) skipped_header = true;
+        else ++row;
+      }
+      p = line_end + 1;
+    }
+    return row;
+  }
+
+  while (p < end) {
+    const char* line_end = (const char*)memchr(p, '\n', end - p);
+    if (!line_end) line_end = end;
+    const char* q = p;
+    const char* le = line_end;
+    if (le > p && le[-1] == '\r') --le;
+    if (le == p) {  // blank line
+      p = line_end + 1;
+      continue;
+    }
+    if (!skipped_header) {
+      skipped_header = true;
+      p = line_end + 1;
+      continue;
+    }
+    if ((row + 1) * (long long)ncol > cap) return -2;
+    for (int c = 0; c < ncol; ++c) {
+      const char* field_end = q;
+      while (field_end < le && *field_end != sep) ++field_end;
+      // trim spaces and quotes
+      const char* a = q;
+      const char* b = field_end;
+      while (a < b && (*a == ' ' || *a == '"')) ++a;
+      while (b > a && (b[-1] == ' ' || b[-1] == '"')) --b;
+      double v;
+      if (is_na_token(a, b - a)) {
+        v = NAN;
+      } else {
+        // strtod in place: fields terminate at sep/newline, both of which
+        // stop the conversion (buf is contiguous, so reads stay in bounds)
+        char* conv_end = nullptr;
+        v = strtod(a, &conv_end);
+        if (conv_end != b) return -1;  // non-numeric → python fallback
+      }
+      out[row * ncol + c] = v;
+      q = (field_end < le) ? field_end + 1 : le;
+    }
+    ++row;
+    p = line_end + 1;
+  }
+  return row;
+}

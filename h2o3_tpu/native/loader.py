@@ -26,7 +26,18 @@ def _lib():
     if _TRIED:
         return _LIB
     _TRIED = True
-    so = os.path.join(os.path.dirname(__file__), "libh2o3native.so")
+    here = os.path.dirname(__file__)
+    so = os.path.join(here, "libh2o3native.so")
+    if not os.path.exists(so):
+        # build on first use — the .so is not shipped (platform-specific)
+        import subprocess
+
+        try:
+            subprocess.run(
+                ["make", "-C", here], capture_output=True, timeout=120, check=True
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
     if os.path.exists(so):
         try:
             _LIB = ctypes.CDLL(so)

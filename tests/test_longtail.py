@@ -1,0 +1,83 @@
+"""NaiveBayes / Word2Vec / GLRM tests — long-tail algorithm coverage."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+from h2o3_tpu.models.naive_bayes import H2ONaiveBayesEstimator
+from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
+from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
+
+
+def test_naive_bayes_gaussian(cloud1):
+    rng = np.random.default_rng(0)
+    n = 2000
+    y = rng.integers(0, 2, n)
+    X = rng.normal(size=(n, 3)) + y[:, None] * np.asarray([2.0, -1.5, 0.0])
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "y"]).asfactor("y")
+    nb = H2ONaiveBayesEstimator()
+    nb.train(y="y", training_frame=fr)
+    assert nb.auc() > 0.9
+    pred = nb.predict(fr)
+    assert pred.names == ["predict", "0", "1"]
+
+
+def test_naive_bayes_categorical_laplace(cloud1):
+    rng = np.random.default_rng(1)
+    n = 1500
+    c1 = rng.integers(0, 3, n)
+    y = ((c1 == 2) ^ (rng.random(n) < 0.1)).astype(int)
+    fr = Frame.from_dict({
+        "c1": np.asarray(["a", "b", "c"], dtype=object)[c1],
+        "y": y,
+    }).asfactor("y")
+    nb = H2ONaiveBayesEstimator(laplace=1.0)
+    nb.train(y="y", training_frame=fr)
+    assert nb.auc() > 0.85
+
+
+def test_word2vec_synonyms(cloud1):
+    # tiny corpus with two topic clusters
+    rng = np.random.default_rng(2)
+    animals = ["cat", "dog", "mouse", "horse"]
+    foods = ["apple", "bread", "cheese", "pasta"]
+    sents = []
+    for _ in range(400):
+        group = animals if rng.random() < 0.5 else foods
+        sent = list(rng.choice(group, 4)) + [None]  # NA = sentence break
+        sents.extend(sent)
+    fr = Frame({"words": Vec(None, "string",
+                             strings=np.asarray(sents, dtype=object))})
+    w2v = H2OWord2vecEstimator(vec_size=16, min_word_freq=2, epochs=100,
+                               window_size=3, seed=3, init_learning_rate=1.0)
+    w2v.train(training_frame=fr)
+    syn = w2v.model.find_synonyms("cat", count=3)
+    assert len(syn) == 3
+    top = list(syn)[0]
+    assert top in animals  # nearest neighbor stays in-topic
+    # sentence embedding
+    emb = w2v.model.transform(fr, aggregate_method="AVERAGE")
+    assert emb.ncol == 16
+
+
+def test_glrm_low_rank_recovery_and_impute(cloud1):
+    rng = np.random.default_rng(4)
+    n, p, k = 300, 10, 3
+    U = rng.normal(size=(n, k))
+    V = rng.normal(size=(k, p))
+    A = U @ V + 0.01 * rng.normal(size=(n, p))
+    A_missing = A.copy()
+    holes = rng.random((n, p)) < 0.15
+    A_missing[holes] = np.nan
+    fr = Frame.from_numpy(A_missing, names=[f"c{i}" for i in range(p)])
+    glrm = H2OGeneralizedLowRankEstimator(k=k, gamma_x=1e-4, gamma_y=1e-4,
+                                          max_iterations=100, seed=5)
+    glrm.train(training_frame=fr)
+    rec = glrm.model.reconstruct(fr).to_numpy()
+    # imputed entries close to the true low-rank values
+    err = np.abs(rec[holes] - A[holes])
+    assert np.median(err) < 0.2
+    arch = glrm.model.archetypes()
+    assert arch.shape == (k, p)

@@ -1,0 +1,110 @@
+"""Rapids munging + checkpoint tests — `testdir_munging` analog."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+from conftest import make_classification
+
+
+def test_group_by_aggregates(cloud1):
+    fr = Frame.from_dict({
+        "g": np.asarray(["a", "b", "a", "b", "a"], dtype=object),
+        "v": [1.0, 2.0, 3.0, 4.0, np.nan],
+    })
+    out = fr.group_by("g").count().sum("v").mean("v").get_frame()
+    assert out.nrow == 2
+    d = out.as_data_frame()
+    ia = list(d["g"]).index("a")
+    ib = list(d["g"]).index("b")
+    assert d["nrow"][ia] == 3
+    assert d["sum_v"][ia] == pytest.approx(4.0)   # NAs skipped
+    assert d["mean_v"][ib] == pytest.approx(3.0)
+
+
+def test_group_by_multi_key(cloud1):
+    rng = np.random.default_rng(0)
+    n = 200
+    g1 = rng.integers(0, 3, n)
+    g2 = rng.integers(0, 2, n)
+    v = rng.random(n)
+    fr = Frame.from_dict({
+        "g1": np.asarray(["x", "y", "z"], dtype=object)[g1],
+        "g2": g2.astype(float),
+        "v": v,
+    })
+    out = fr.group_by(["g1", "g2"]).mean("v").get_frame()
+    assert out.nrow == 6
+    d = out.as_data_frame()
+    # verify one cell against numpy
+    m = (g1 == 0) & (g2 == 1)
+    expect = v[m].mean()
+    row = [i for i in range(6) if d["g1"][i] == "x" and d["g2"][i] == 1][0]
+    assert d["mean_v"][row] == pytest.approx(expect)
+
+
+def test_merge_inner_and_outer(cloud1):
+    left = Frame.from_dict({"k": [1.0, 2.0, 3.0], "a": [10.0, 20.0, 30.0]})
+    right = Frame.from_dict({"k": [2.0, 3.0, 4.0], "b": [200.0, 300.0, 400.0]})
+    inner = h2o.merge(left, right)
+    assert inner.nrow == 2
+    d = inner.as_data_frame()
+    assert set(d["k"]) == {2.0, 3.0}
+    louter = h2o.merge(left, right, all_x=True)
+    assert louter.nrow == 3
+    d = louter.as_data_frame()
+    i1 = list(d["k"]).index(1.0)
+    assert np.isnan(d["b"][i1])
+
+
+def test_quantile_and_table(cloud1):
+    fr = Frame.from_dict({"v": np.arange(101, dtype=float)})
+    q = fr.quantile(prob=[0.1, 0.5, 0.9])
+    d = q.as_data_frame()
+    assert d["vQuantiles"][1] == pytest.approx(50.0)
+    fr2 = Frame.from_dict({"c": np.asarray(["a", "b", "a"], dtype=object)})
+    t = fr2.table().as_data_frame()
+    assert list(t["Count"]) == [2.0, 1.0]
+
+
+def test_frame_arithmetic_and_masks(cloud1):
+    fr = Frame.from_dict({"a": [1.0, 2.0, 3.0], "b": [10.0, 20.0, 30.0]})
+    s = fr["a"] + fr["b"]
+    assert list(s._col0()) == [11.0, 22.0, 33.0]
+    mask = fr["a"] > 1.5
+    assert fr[mask].nrow == 2
+    # enum equality mask
+    fr2 = Frame.from_dict({"c": np.asarray(["x", "y", "x"], dtype=object)})
+    assert fr2[fr2["c"] == "x"].nrow == 2
+
+
+def test_gbm_checkpoint_continue(cloud1):
+    X, y = make_classification(1200, 6, seed=1)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(6)] + ["y"]).asfactor("y")
+    base = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=2)
+    base.train(y="y", training_frame=fr)
+    ll10 = base.logloss()
+    cont = H2OGradientBoostingEstimator(ntrees=25, max_depth=3, seed=2,
+                                        checkpoint=base)
+    cont.train(y="y", training_frame=fr)
+    assert cont.model.ntrees_built == 25
+    assert cont.logloss() < ll10  # more trees, better training fit
+    # direct 25-tree model should be in the same ballpark
+    direct = H2OGradientBoostingEstimator(ntrees=25, max_depth=3, seed=2)
+    direct.train(y="y", training_frame=fr)
+    assert abs(cont.logloss() - direct.logloss()) < 0.05
+
+
+def test_checkpoint_incompatible_depth_raises(cloud1):
+    X, y = make_classification(600, 4, seed=3)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "y"]).asfactor("y")
+    base = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=4)
+    base.train(y="y", training_frame=fr)
+    bad = H2OGradientBoostingEstimator(ntrees=10, max_depth=5, seed=4, checkpoint=base)
+    with pytest.raises(ValueError, match="checkpoint"):
+        bad.train(y="y", training_frame=fr)
