@@ -46,12 +46,6 @@ def main():
     names = [f"f{i}" for i in range(X.shape[1])] + ["label"]
     fr = Frame.from_numpy(np.column_stack([X, y]), names=names).asfactor("label")
 
-    # warmup: compile the per-tree program on a small prefix
-    warm = fr.take(np.arange(min(65536, n_rows)))
-    H2OGradientBoostingEstimator(
-        ntrees=2, max_depth=max_depth, histogram_type="UniformAdaptive", seed=1
-    ).train(y="label", training_frame=warm)
-
     gbm = H2OGradientBoostingEstimator(
         ntrees=ntrees, max_depth=max_depth, learn_rate=0.1,
         histogram_type="UniformAdaptive", seed=42,

@@ -114,6 +114,12 @@ def merge(x: Frame, y: Frame, all_x: bool = False, all_y: bool = False,
         if by_x is None or len(by_x) != len(by_y):
             raise ValueError("merge: by_x and by_y must be same-length lists")
         renames = dict(zip(by_y, by_x))
+        clash = [t for t in renames.values()
+                 if t in y.names and t not in renames]
+        if clash:
+            raise ValueError(
+                f"merge: renaming by_y→by_x would overwrite right-frame column(s) {clash}"
+            )
         y = Frame({renames.get(n, n): v for n, v in zip(y.names, y.vecs())})
     return _m(x, y, by=by_x, all_x=all_x, all_y=all_y)
 

@@ -221,6 +221,24 @@ def merge(left: Frame, right: Frame, by: Optional[Sequence[str]] = None,
 
     out: Dict[str, Vec] = {}
     for n in left.names:
+        if n in by:
+            # key columns: take from whichever side matched (right-outer rows
+            # must keep their join key — h2o.merge/R merge semantics)
+            lv = left.vec(n).take(np.maximum(li, 0))
+            if (li < 0).any():
+                rv = right.vec(n).take(np.maximum(ri, 0))
+                if lv.type == "enum":
+                    ldom = np.asarray((left.vec(n).domain or []) + [None], dtype=object)
+                    rdom = np.asarray((right.vec(n).domain or []) + [None], dtype=object)
+                    lbl = np.where(li < 0, rdom[np.asarray(rv.data)],
+                                   ldom[np.asarray(lv.data)])
+                    out[n] = Vec.from_numpy(lbl.astype(object))
+                else:
+                    merged = np.where(li < 0, rv.numeric_np(), lv.numeric_np())
+                    out[n] = Vec(merged.astype(np.float32), lv.type)
+            else:
+                out[n] = lv
+            continue
         v = left.vec(n).take(np.maximum(li, 0))
         out[n] = _mask_vec(v, li < 0)
     for n in right.names:

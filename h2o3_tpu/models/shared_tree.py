@@ -17,8 +17,28 @@ replacing the MRTask RPC-tree reduce of `ScoreBuildHistogram2.java`.
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Dict, List, Optional, Sequence
+
+_PROFILE = bool(os.environ.get("H2O3_PROFILE"))
+
+
+class _Phase:
+    """Env-gated phase timer (H2O3_PROFILE=1) — the `water.util.Timer`
+    per-stage logging analog for the training driver."""
+
+    def __init__(self):
+        self.t = time.time()
+
+    def mark(self, name, sync=None):
+        if not _PROFILE:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        now = time.time()
+        print(f"[h2o3-profile] {name}: {now - self.t:.3f}s", flush=True)
+        self.t = now
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +66,41 @@ def _predict_forest_codes_jit(forest, codes, max_depth: int):
     """Σ over a stacked forest of per-row leaf values on binned codes."""
     per_tree = jax.vmap(lambda t: treelib.predict_codes(t, codes, max_depth))(forest)
     return per_tree.sum(axis=0)
+
+
+def probs_from_margins(mode, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:
+    """margins → predictions, shared by train-time scoring and model.predict
+    (single source of truth for the per-mode link mapping)."""
+    if mode == "drf":
+        # DRF: leaf values are per-leaf response means; prediction is the
+        # forest average (hex/tree/drf/DRFModel.score0 vote averaging)
+        m = m / max(ntrees, 1)
+        if problem == "binomial":
+            p1 = np.clip(m[:, 0], 0.0, 1.0)
+            return np.column_stack([1 - p1, p1])
+        if problem == "multinomial":
+            p = np.clip(m, 0.0, None)
+            s = p.sum(axis=1, keepdims=True)
+            return np.where(s > 0, p / np.maximum(s, 1e-12), 1.0 / p.shape[1])
+        return m[:, :1]
+    if problem == "binomial":
+        p1 = 1 / (1 + np.exp(-m[:, 0]))
+        return np.column_stack([1 - p1, p1])
+    if problem == "multinomial":
+        e = np.exp(m - m.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+    mm = m[:, 0]
+    if dist in ("poisson", "gamma", "tweedie"):
+        return np.exp(mm)[:, None]
+    return mm[:, None]
+
+
+def _metrics_for(problem, yvec, probs):
+    if problem == "binomial":
+        return ModelMetricsBinomial.make(np.asarray(yvec.data), probs[:, 1])
+    if problem == "multinomial":
+        return ModelMetricsMultinomial.make(np.asarray(yvec.data), probs)
+    return ModelMetricsRegression.make(yvec.numeric_np(), probs[:, 0])
 
 
 def frame_to_matrix(frame: Frame, x: Sequence[str], expected_domains=None):
@@ -115,28 +170,8 @@ class SharedTreeModel(H2OModel):
         m = self._margins(X)
         if offset is not None and self.mode != "drf":
             m = m + offset[:, None]
-        if self.mode == "drf":
-            # DRF: leaf values are per-leaf response means; prediction is the
-            # forest average (hex/tree/drf/DRFModel.score0 vote averaging)
-            m = m / max(self.ntrees_built, 1)
-            if self.problem == "binomial":
-                p1 = np.clip(m[:, 0], 0.0, 1.0)
-                return np.column_stack([1 - p1, p1])
-            if self.problem == "multinomial":
-                p = np.clip(m, 0.0, None)
-                s = p.sum(axis=1, keepdims=True)
-                return np.where(s > 0, p / np.maximum(s, 1e-12), 1.0 / p.shape[1])
-            return m[:, :1]
-        if self.problem == "binomial":
-            p1 = 1 / (1 + np.exp(-m[:, 0]))
-            return np.column_stack([1 - p1, p1])
-        if self.problem == "multinomial":
-            e = np.exp(m - m.max(axis=1, keepdims=True))
-            return e / e.sum(axis=1, keepdims=True)
-        mm = m[:, 0]
-        if self.distribution in ("poisson", "gamma", "tweedie"):
-            return np.exp(mm)[:, None]
-        return mm[:, None]
+        return probs_from_margins(self.mode, self.problem, self.distribution,
+                                  m, self.ntrees_built)
 
     def _offset_of(self, frame: Frame) -> Optional[np.ndarray]:
         oc = self.parms._parms.get("offset_column") if hasattr(self.parms, "_parms") else None
@@ -188,9 +223,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
             reg_lambda=float(p.get("reg_lambda"))
             if p.get("reg_lambda") is not None
             else (0.0 if self._mode == "drf" else 1.0),
+            reg_alpha=float(p.get("reg_alpha") or 0.0) if "reg_alpha" in p else 0.0,
         )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
+        _ph = _Phase()
         tp = self._tree_params()
         seed = self._parms["_actual_seed"]
         yvec = train.vec(y)
@@ -207,6 +244,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # clamp nbins to max categorical cardinality like nbins_cats
         max_card = int(max([len(d) for d, c in zip(doms, is_cat) if c and d], default=0))
         nbins = max(tp["nbins"] + 1, min(max_card + 1, 1 << 10))
+        _ph.mark("frame_to_matrix")
         bm = build_bins(
             X, nbins=nbins, histogram_type=tp["histogram_type"], names=list(x),
             is_categorical=is_cat, domains=doms, seed=seed,
@@ -257,6 +295,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 return np.concatenate([a, np.full(pad, fill, a.dtype)])
             return np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
 
+        _ph.mark("build_bins")
         codes_d = jnp.asarray(padr(bm.codes))
         y_d = jnp.asarray(padr(yk))
         w_d = jnp.asarray(padr(w))
@@ -315,13 +354,16 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         treelib.Tree(*[np.asarray(getattr(stacked, fld)[t])
                                        for fld in treelib.Tree._fields])
                     )
-                if self._mode != "drf":
-                    vsum = _predict_forest_codes_jit(
-                        jax.tree.map(jnp.asarray, stacked), codes_d, tp["max_depth"]
-                    )
-                    margins = margins.at[:, k].add(vsum)
+                vsum = _predict_forest_codes_jit(
+                    jax.tree.map(jnp.asarray, stacked), codes_d, tp["max_depth"]
+                )
+                margins = margins.at[:, k].add(vsum)
             if offset is not None:
                 margins = margins + jnp.asarray(padr(offset))[:, None]
+            if ndev > 1:
+                codes_d = jax.device_put(codes_d, cloud.row_sharding())
+                edges_d = jax.device_put(edges_d, cloud.replicated())
+                margins = jax.device_put(margins, cloud.row_sharding())
 
         # validation margins tracked incrementally per tree (the Score pass of
         # SharedTree.Driver on the validation frame) — early stopping uses the
@@ -344,7 +386,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             margins_v = jnp.broadcast_to(
                 jnp.asarray(np.asarray(f0).reshape(-1))[None, :], (valid.nrow, K)
             ).astype(jnp.float32)
-            if n_prior and self._mode != "drf":
+            if n_prior:
                 for k in range(K):
                     vsum = _predict_forest_codes_jit(
                         jax.tree.map(jnp.asarray, prior_stacked[k]), codes_v,
@@ -356,6 +398,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 margins_v = margins_v + jnp.asarray(off_v)[:, None]
             valid_state = [codes_v, ykv, margins_v]
 
+        _ph.mark("device_put", sync=codes_d)
         key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
         mtries = tp["mtries"]
         if self._mode == "drf":
@@ -411,13 +454,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
         annealing = tp["learn_rate_annealing"]
 
-        def _one_tree(margins, key, m, g_ext=None, h_ext=None):
-            """Build the K trees of boosting iteration m (traced int)."""
+        def _one_tree(margins, codes_a, y_a, w_a, edges_a, key, m,
+                      g_ext=None, h_ext=None):
+            """Build the K trees of boosting iteration m (traced int). All
+            data arrives as ARGUMENTS — a closure-captured device array would
+            be embedded in the HLO as a literal, defeating the persistent
+            compilation cache (new data ⇒ recompile) and bloating programs."""
             krow, kcol, ktree = jax.random.split(jax.random.fold_in(key, 0), 3)
             row_mask = (
                 jax.random.uniform(krow, (npad,)) < tp["sample_rate"]
             ).astype(jnp.float32)
-            wt = w_d_ref[0] * row_mask
+            wt = w_a * row_mask
             if colp < 1.0:
                 fm = (jax.random.uniform(kcol, (F,)) < colp).astype(jnp.float32)
                 fm = fm.at[0].set(jnp.maximum(fm[0], 1 - fm.sum().clip(0, 1)))
@@ -430,14 +477,15 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 if g_ext is not None:
                     g, h = g_ext, h_ext
                 else:
-                    g, h = _grads(margins, y_d_ref[0], k)
+                    g, h = _grads(margins, y_a, k)
                 tr, leaf_idx, gains = self._build_one(
-                    codes_ref[0], g, h, wt, fm, edges_ref[0], tp, nbins, mtries,
+                    codes_a, g, h, wt, fm, edges_a, tp, nbins, mtries,
                     ktree, cloud
                 )
                 tr = tr._replace(value=tr.value * scale)
-                if self._mode != "drf":
-                    margins = margins.at[:, k].add(tr.value[leaf_idx])
+                # margins track Σ tree outputs for ALL modes: GBM boosting
+                # margins, or DRF leaf-mean sums (÷ntrees at scoring time)
+                margins = margins.at[:, k].add(tr.value[leaf_idx])
                 trs.append(tr)
                 gains_acc = gains_acc + gains
             stacked = treelib.Tree(
@@ -445,29 +493,66 @@ class H2OSharedTreeEstimator(H2OEstimator):
             )
             return margins, stacked, gains_acc
 
-        # closure refs so the scan body captures device arrays as constants
-        codes_ref, y_d_ref, w_d_ref, edges_ref = [codes_d], [y_d], [w_d], [edges_d]
-
-        @functools.partial(jax.jit, static_argnames=("nsteps",), donate_argnums=(0,))
-        def _train_chunk(margins, key, m0, nsteps: int):
-            def body(carry, m):
-                margins = carry
-                margins, stacked, gains = _one_tree(
-                    margins, jax.random.fold_in(key, m), m
-                )
-                return margins, (stacked, gains)
-
-            margins, (trees_stack, gains) = jax.lax.scan(
-                body, margins, m0 + jnp.arange(nsteps)
+        def _pack(stacked):
+            """Tree fields → one f32 array (…, T, 5): a single D2H transfer
+            moves a whole chunk of trees (each sync transfer through a
+            remote-TPU tunnel pays seconds of fixed latency)."""
+            return jnp.stack(
+                [stacked.feat.astype(jnp.float32),
+                 stacked.bin.astype(jnp.float32),
+                 stacked.thr,
+                 stacked.is_split.astype(jnp.float32),
+                 stacked.value],
+                axis=-1,
             )
-            return margins, trees_stack, gains.sum(axis=0)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _tree_jit(margins, codes_a, y_a, w_a, edges_a, key, m):
+            margins, stacked, gains = _one_tree(
+                margins, codes_a, y_a, w_a, edges_a,
+                jax.random.fold_in(key, m), m
+            )
+            return margins, _pack(stacked), gains
+
+        def _train_chunk(margins, key, m0, nsteps: int):
+            """nsteps async per-tree dispatches (NOT lax.scan: a scan body
+            defeats XLA's onehot→reduction fusion and materializes the
+            (rows × nodes·bins) one-hot in HBM, ~300× slower; sequential
+            cached-jit enqueues pipeline on device with ~µs host overhead)."""
+            packed_list, gains_list = [], []
+            for i in range(nsteps):
+                margins, packed, gains = _tree_jit(
+                    margins, codes_d, y_d, w_d, edges_d, key, np.int32(m0 + i)
+                )
+                packed_list.append(packed)
+                gains_list.append(gains)
+            return margins, jnp.stack(packed_list), sum(gains_list)
 
         _single_jit = jax.jit(
-            lambda margins, key, m, g_ext, h_ext: _one_tree(
-                margins, jax.random.fold_in(key, m), m, g_ext, h_ext
-            ),
+            lambda margins, codes_a, y_a, w_a, edges_a, key, m, g_ext, h_ext: (
+                lambda r: (r[0], _pack(r[1]), r[2])
+            )(_one_tree(margins, codes_a, y_a, w_a, edges_a,
+                        jax.random.fold_in(key, m), m, g_ext, h_ext)),
             donate_argnums=(0,),
         )
+
+        def _unpack_host(packed_np):
+            """(nsteps, K, T, 5) f32 host array → per-(step, class) Trees."""
+            return treelib.Tree(
+                packed_np[..., 0].astype(np.int32),
+                packed_np[..., 1].astype(np.int32),
+                packed_np[..., 2],
+                packed_np[..., 3] > 0.5,
+                packed_np[..., 4],
+            )
+
+        def _stacked_from_packed_dev(packed, k):
+            """Device (nsteps, K, T, 5) → stacked Tree for class k (device)."""
+            sl = packed[:, k]
+            return treelib.Tree(
+                sl[..., 0].astype(jnp.int32), sl[..., 1].astype(jnp.int32),
+                sl[..., 2], sl[..., 3] > 0.5, sl[..., 4],
+            )
 
         # chunking: one device dispatch per `chunk` trees (remote dispatch
         # latency amortization); scoring/stopping checks at chunk boundaries
@@ -485,37 +570,36 @@ class H2OSharedTreeEstimator(H2OEstimator):
             chunk = min(25, max(ntrees_target, 1))
 
         m = 0
+        packed_chunks: List = []   # device-resident (nsteps, K, T, 5) arrays
+        gains_chunks: List = []    # device-resident (F,) arrays
         while m < ntrees_target:
             nsteps = min(chunk, ntrees_target - m)
             if custom_obj is not None:
                 g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
-                margins, stacked, gains = _single_jit(
-                    margins, key, jnp.int32(m), g_ext, h_ext
+                margins, packed, gains = _single_jit(
+                    margins, codes_d, y_d, w_d, edges_d, key, jnp.int32(m),
+                    g_ext, h_ext
                 )
-                stacked = jax.tree.map(lambda a: a[None], stacked)
+                packed = packed[None]
+                nsteps = 1
             else:
-                margins, stacked, gains = _train_chunk(
-                    margins, key, jnp.int32(m), nsteps=nsteps
+                margins, packed, gains = _train_chunk(
+                    margins, key, m, nsteps=nsteps
                 )
-            stacked_host = jax.tree.map(np.asarray, stacked)  # (nsteps, K, T)
-            for t in range(stacked_host.feat.shape[0]):
+            # everything stays on device; the single bulk D2H happens after
+            # the loop (sync transfers through the tunnel cost ~seconds each)
+            packed_chunks.append(packed)
+            gains_chunks.append(gains)
+            if valid_state is not None:
                 for k in range(K):
-                    tr_k = treelib.Tree(*[a[t, k] for a in stacked_host])
-                    trees[k].append(tr_k)
-            if valid_state is not None and self._mode != "drf":
-                # batch-update validation margins with the whole chunk
-                chunk_forest = treelib.Tree(
-                    *[jnp.asarray(a.reshape((-1,) + a.shape[2:]))
-                      for a in stacked_host]
-                )  # (nsteps*K, T) — K-major within each step
-                for k in range(K):
-                    sel = treelib.Tree(*[a[k::K] for a in chunk_forest])
                     vsum = _predict_forest_codes_jit(
-                        sel, valid_state[0], tp["max_depth"]
+                        _stacked_from_packed_dev(packed, k),
+                        valid_state[0], tp["max_depth"],
                     )
                     valid_state[2] = valid_state[2].at[:, k].add(vsum)
-            gain_total += np.asarray(gains, np.float64)
-            m += stacked_host.feat.shape[0] if custom_obj is not None else nsteps
+            if _PROFILE:
+                _ph.mark(f"chunk_{m}_{nsteps}trees", sync=margins)
+            m += nsteps
             built = m
 
             do_score = (
@@ -524,12 +608,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 or (stopper is not None and not score_interval)
             )
             if do_score:
-                ev = self._score_event(problem, dist, margins, y_d, w_d, n, built)
+                ev = self._score_event(problem, dist, margins, y_d, w_d, n, built + n_prior)
                 if valid_state is not None:
                     vev = self._score_event(
                         problem, dist, valid_state[2],
                         jnp.asarray(valid_state[1]), None, valid_state[1].shape[0],
-                        built,
+                        built + n_prior,
                     )
                     ev.update({f"validation_{k2}": v for k2, v in vev.items()
                                if k2 not in ("number_of_trees", "timestamp")})
@@ -554,6 +638,20 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if self.job:
                 self.job.update(built / max(ntrees_target, 1))
 
+        # ---- ONE bulk D2H of the whole new forest + gains ----------------
+        if packed_chunks:
+            _ph.mark("train_loop_dispatch")
+            all_packed = np.asarray(jnp.concatenate(packed_chunks, axis=0))
+            _ph.mark("forest_D2H")
+            gain_total += np.asarray(sum(gains_chunks), np.float64)
+            _ph.mark("gains_D2H")
+        else:
+            all_packed = np.zeros((0, K, treelib.heap_size(tp["max_depth"]), 5),
+                                  np.float32)
+        for t in range(all_packed.shape[0]):
+            for k in range(K):
+                trees[k].append(_unpack_host(all_packed[t, k]))
+
         forest = [treelib.stack_trees([t for t in trees[k]]) for k in range(K)]
         model = SharedTreeModel(
             self, x, y, bm, problem, nclass, domain, dist,
@@ -569,16 +667,34 @@ class H2OSharedTreeEstimator(H2OEstimator):
                  float(gain_total[i] / gain_total.sum()))
                 for i in order
             ]
-        model.training_metrics = model._make_metrics(train)
+        # training metrics straight from the final margins (already on device)
+        # instead of a fresh forest re-predict — saves transfers + a compile
+        _ph.mark("forest_unpack")
+        margins_np = np.asarray(margins[:n]).astype(np.float64)
+        _ph.mark("margins_D2H")
+        probs_tr = self._probs_from_margins(problem, dist, margins_np,
+                                            model.ntrees_built)
+        model.training_metrics = _metrics_for(problem, train.vec(y), probs_tr)
+        _ph.mark("training_metrics")
         if valid is not None:
-            model.validation_metrics = model._make_metrics(valid)
+            if valid_state is not None and self._mode != "drf":
+                mv = np.asarray(valid_state[2]).astype(np.float64)
+                probs_v = self._probs_from_margins(problem, dist, mv,
+                                                   model.ntrees_built)
+                model.validation_metrics = _metrics_for(problem, valid.vec(y), probs_v)
+            else:
+                model.validation_metrics = model._make_metrics(valid)
         return model
+
+    def _probs_from_margins(self, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:
+        return probs_from_margins(self._mode, problem, dist, m, ntrees)
 
     def _build_one(self, codes, g, h, w, fm, edges, tp, nbins, mtries, key, cloud):
         kwargs = dict(
             max_depth=tp["max_depth"], nbins=nbins, min_rows=tp["min_rows"],
             min_split_improvement=tp["min_split_improvement"],
-            reg_lambda=tp["reg_lambda"], mtries=mtries,
+            reg_lambda=tp["reg_lambda"], reg_alpha=tp.get("reg_alpha", 0.0),
+            mtries=mtries,
         )
         if cloud.size > 1:
             from jax import shard_map
@@ -610,20 +726,19 @@ class H2OSharedTreeEstimator(H2OEstimator):
     def _score_event(self, problem, dist, margins, y_d, w_d, n, ntrees) -> Dict:
         m = np.asarray(margins)[:n].astype(np.float64)
         y = np.asarray(y_d)[:n].astype(np.float64)
+        probs = self._probs_from_margins(problem, dist, m, ntrees)
         ev: Dict = {"number_of_trees": ntrees, "timestamp": time.time()}
         if problem == "binomial":
-            p = 1 / (1 + np.exp(-m[:, 0]))
-            p = np.clip(p, 1e-15, 1 - 1e-15)
+            p = np.clip(probs[:, 1], 1e-15, 1 - 1e-15)
             ev["logloss"] = float(-np.mean(np.log(np.where(y[:, 0] > 0.5, p, 1 - p))))
             ev["auc"] = float("nan")  # full AUC computed at final scoring
             ev["training_deviance"] = ev["logloss"]
         elif problem == "multinomial":
-            e = np.exp(m - m.max(axis=1, keepdims=True))
-            p = np.clip(e / e.sum(axis=1, keepdims=True), 1e-15, 1)
+            p = np.clip(probs, 1e-15, 1)
             ev["logloss"] = float(-np.mean(np.log(p[y.astype(bool)])))
             ev["training_deviance"] = ev["logloss"]
         else:
-            mu = np.asarray(dist_mod.link_inv(dist, m[:, 0]))
+            mu = probs[:, 0]
             ev["deviance"] = float(np.mean((mu - y[:, 0]) ** 2))
             ev["rmse"] = float(np.sqrt(ev["deviance"]))
             ev["training_deviance"] = ev["deviance"]

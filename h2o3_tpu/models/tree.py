@@ -52,7 +52,7 @@ def heap_size(depth: int) -> int:
     jax.jit,
     static_argnames=(
         "max_depth", "nbins", "min_rows", "min_split_improvement",
-        "reg_lambda", "hist_method", "axis_name", "mtries",
+        "reg_lambda", "reg_alpha", "hist_method", "axis_name", "mtries",
     ),
 )
 def build_tree(
@@ -67,6 +67,7 @@ def build_tree(
     min_rows: float = 10.0,
     min_split_improvement: float = 0.0,
     reg_lambda: float = 1.0,
+    reg_alpha: float = 0.0,
     hist_method: str = "auto",
     axis_name: Optional[str] = None,
     mtries: int = 0,
@@ -92,18 +93,37 @@ def build_tree(
     if key is None:
         key = jax.random.PRNGKey(0)
 
+    hist_prev = None
     for d in range(max_depth):
         L = 2 ** d
         base = L - 1                        # heap offset of this level
-        hist = build_histograms(
-            codes, idx, g, h, w, L, nbins, method=hist_method, axis_name=axis_name
-        )  # (L, F, B, 3)
+        if d == 0:
+            hist = build_histograms(
+                codes, idx, g, h, w, L, nbins, method=hist_method, axis_name=axis_name
+            )  # (L, F, B, 3)
+        else:
+            # sibling subtraction (the gpu_hist/LightGBM trick): build only
+            # LEFT children histograms; right = parent − left. Halves the
+            # histogram work at every level.
+            is_left = (idx % 2 == 0)
+            hist_left = build_histograms(
+                codes, idx // 2, g, h, w * is_left.astype(w.dtype),
+                L // 2, nbins, method=hist_method, axis_name=axis_name,
+            )  # (L/2, F, B, 3) indexed by parent
+            hist_right = hist_prev - hist_left
+            hist = jnp.stack([hist_left, hist_right], axis=1).reshape(
+                L, *hist_left.shape[1:]
+            )
+        hist_prev = hist
 
         wsum = hist[..., 0].sum(axis=2)[:, 0]   # (L,) totals (same for all F)
         gsum = hist[..., 1].sum(axis=2)[:, 0]
         hsum = hist[..., 2].sum(axis=2)[:, 0]
+        # Newton leaf value with elastic-net regularization (xgboost's
+        # CalcWeight: soft-threshold G by alpha, shrink by lambda)
+        gthr = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - reg_alpha, 0.0)
         value_a = value_a.at[base : base + L].set(
-            (-gsum / (hsum + reg_lambda + 1e-12)).astype(jnp.float32)
+            (-gthr / (hsum + reg_lambda + 1e-12)).astype(jnp.float32)
         )
 
         # split search: cumulative over bins → gain per (L, F, B)
@@ -169,8 +189,9 @@ def build_tree(
     tot = jax.ops.segment_sum(vals, idx, num_segments=Lf)       # (Lf, 3)
     if axis_name is not None:
         tot = jax.lax.psum(tot, axis_name)
+    gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
     value_a = value_a.at[basef:].set(
-        (-tot[:, 1] / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
+        (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
     )
     return Tree(feat_a, bin_a, thr_a, split_a, value_a), idx + basef, gain_per_feature
 

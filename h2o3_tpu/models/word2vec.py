@@ -111,13 +111,20 @@ class H2OWord2vecEstimator(H2OEstimator):
     @staticmethod
     def from_external(frame: Frame) -> Word2VecModel:
         """Import pre-trained embeddings (h2o.word2vec pre_trained path):
-        first column words, rest the vector."""
+        first column words, rest the vector. Word labels are decoded PER ROW
+        (an enum column's domain is sorted, not row-ordered — rows must pair
+        with their own matrix row)."""
         words = frame.vecs()[0]
-        vocab = [str(w) for w in (words.to_numpy() if words.type == "string"
-                                  else words.domain)]
+        if words.type == "string":
+            labels = [str(w) for w in words.to_numpy()]
+        elif words.type == "enum":
+            dom = np.asarray(words.domain + [None], dtype=object)
+            labels = [str(w) for w in dom[np.asarray(words.data)]]
+        else:
+            labels = [str(w) for w in words.numeric_np()]
         mat = np.column_stack([v.numeric_np() for v in frame.vecs()[1:]])
         est = H2OWord2vecEstimator()
-        return Word2VecModel(est, vocab, mat)
+        return Word2VecModel(est, labels, mat)
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> Word2VecModel:
         p = self._parms

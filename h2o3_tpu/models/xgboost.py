@@ -11,8 +11,9 @@ in HBM, and the `gpu_hist` CUDA updater's job is done by the same
 `ops/histogram.py` kernels GBM uses (`tpu_hist`); Rabit allreduce ≡ the
 `lax.psum` the tree builder already does under shard_map. This class maps
 XGBoost parameter names onto the shared-tree driver and adds:
-* XGBoost-exact leaf regularization (reg_alpha L1 soft-threshold is applied
-  via reg_lambda in the Newton step; alpha handled in `_tree_params`),
+* XGBoost-exact leaf regularization: reg_lambda shrinks the Newton step and
+  reg_alpha soft-thresholds G (xgboost CalcWeight), both applied inside
+  `tree.build_tree`,
 * `rank:ndcg` lambdarank objective with query groups — pairwise ΔNDCG
   weighted gradients (the xgboost `rank:ndcg` objective).
 """
@@ -93,6 +94,7 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
             histogram_type="QuantilesGlobal",  # xgboost hist = sketch quantiles
             mtries=0,
             reg_lambda=float(p.get("reg_lambda", 1.0)),
+            reg_alpha=float(p.get("reg_alpha", 0.0)),
         )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:

@@ -111,3 +111,15 @@ def test_automl_leaderboard(cloud1):
     assert pred.nrow == fr.nrow
     algos = {r["algo"] for r in lb.rows}
     assert "stackedensemble" in algos
+
+
+def test_xgboost_reg_alpha_shrinks_leaves(cloud1):
+    fr = _cls_frame(1000, 5, seed=12)
+    plain = H2OXGBoostEstimator(ntrees=5, max_depth=3, eta=0.3, seed=13)
+    plain.train(y="y", training_frame=fr)
+    strong = H2OXGBoostEstimator(ntrees=5, max_depth=3, eta=0.3, seed=13,
+                                 reg_alpha=50.0)
+    strong.train(y="y", training_frame=fr)
+    v0 = float(np.abs(np.asarray(plain.model.forest[0].value)).sum())
+    v1 = float(np.abs(np.asarray(strong.model.forest[0].value)).sum())
+    assert v1 < v0  # L1 soft-threshold shrinks leaf outputs

@@ -108,3 +108,13 @@ def test_checkpoint_incompatible_depth_raises(cloud1):
     bad = H2OGradientBoostingEstimator(ntrees=10, max_depth=5, seed=4, checkpoint=base)
     with pytest.raises(ValueError, match="checkpoint"):
         bad.train(y="y", training_frame=fr)
+
+
+def test_merge_right_outer_keeps_keys(cloud1):
+    left = Frame.from_dict({"k": [1.0, 2.0], "a": [10.0, 20.0]})
+    right = Frame.from_dict({"k": [2.0, 4.0], "b": [200.0, 400.0]})
+    router = h2o.merge(left, right, all_y=True)
+    d = router.as_data_frame()
+    assert 4.0 in list(d["k"])  # unmatched right row keeps its join key
+    i4 = list(d["k"]).index(4.0)
+    assert np.isnan(d["a"][i4]) and d["b"][i4] == 400.0
