@@ -227,11 +227,23 @@ def merge(left: Frame, right: Frame, by: Optional[Sequence[str]] = None,
             lv = left.vec(n).take(np.maximum(li, 0))
             if (li < 0).any():
                 rv = right.vec(n).take(np.maximum(ri, 0))
-                if lv.type == "enum":
-                    ldom = np.asarray((left.vec(n).domain or []) + [None], dtype=object)
-                    rdom = np.asarray((right.vec(n).domain or []) + [None], dtype=object)
-                    lbl = np.where(li < 0, rdom[np.asarray(rv.data)],
-                                   ldom[np.asarray(lv.data)])
+
+                def _values(v: Vec) -> np.ndarray:
+                    # enum → labels, numeric → numbers; per-side so a type
+                    # mismatch between sides can't index labels with floats
+                    if v.type == "enum":
+                        dom = np.asarray((v.domain or []) + [None], dtype=object)
+                        return dom[np.asarray(v.data, np.int64)]
+                    return v.numeric_np().astype(object)
+
+                if lv.type == "enum" or rv.type == "enum":
+                    lvals, rvals = _values(lv), _values(rv)
+                    if lv.type != rv.type:  # mixed enum/numeric keys: stringify
+                        def _s(a):
+                            return np.asarray(
+                                [None if x is None else str(x) for x in a], object)
+                        lvals, rvals = _s(lvals), _s(rvals)
+                    lbl = np.where(li < 0, rvals, lvals)
                     out[n] = Vec.from_numpy(lbl.astype(object))
                 else:
                     merged = np.where(li < 0, rv.numeric_np(), lv.numeric_np())

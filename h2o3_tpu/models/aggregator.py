@@ -1,0 +1,152 @@
+"""H2OAggregatorEstimator — exemplar-based dataset aggregation.
+
+Reference parity: `h2o-algos/src/main/java/hex/aggregator/Aggregator.java`:
+single-pass radius-based exemplar selection (a row joins the nearest exemplar
+within `radius`, else becomes a new exemplar with count 1), with the radius
+rescaled between passes until the exemplar count lands within
+`rel_tol_num_exemplars` of `target_num_exemplars`. Output is the aggregated
+frame: one row per exemplar plus a `counts` column. Estimator surface
+`h2o-py/h2o/estimators/aggregator.py`.
+
+TPU note: the distance of a block of rows against the current exemplar set is
+one (block × p) @ (p × E) matmul on the MXU; only rows that fail the radius
+test fall back to the (rare) sequential exemplar-append path on host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from .metrics import ModelMetricsBase
+from .model_base import DataInfo, H2OEstimator, H2OModel
+
+_BLOCK = 4096
+
+
+def _assign_block(block: jnp.ndarray, ex: jnp.ndarray, r2: float):
+    """Nearest exemplar id + squared distance for a block of rows (device)."""
+    d2 = (
+        jnp.sum(block * block, axis=1, keepdims=True)
+        - 2.0 * block @ ex.T
+        + jnp.sum(ex * ex, axis=1)[None, :]
+    )
+    j = jnp.argmin(d2, axis=1)
+    return j, jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0]
+
+
+def _aggregate(X: np.ndarray, radius2: float):
+    """One pass: returns (exemplar_row_indices, member_counts)."""
+    n = X.shape[0]
+    ex_idx = [0]
+    counts = [0]
+    ex_mat = X[:1]
+    assign_j = jax.jit(_assign_block)
+    i = 1
+    counts[0] = 1
+    while i < n:
+        block = X[i : i + _BLOCK]
+        j, d2 = assign_j(jnp.asarray(block), jnp.asarray(ex_mat), radius2)
+        j = np.asarray(j)
+        d2 = np.asarray(d2)
+        ok = d2 <= radius2
+        # rows within radius of an existing exemplar: bulk-assign
+        for jj in j[ok]:
+            counts[jj] += 1
+        # the rest are processed in order — each may become a new exemplar
+        # that absorbs later rows of the same block, so recompute locally
+        rest = block[~ok]
+        rest_rows = np.nonzero(~ok)[0]
+        for ridx, row in zip(rest_rows, rest):
+            d2r = np.sum((ex_mat - row) ** 2, axis=1)
+            jj = int(np.argmin(d2r))
+            if d2r[jj] <= radius2:
+                counts[jj] += 1
+            else:
+                ex_idx.append(i + int(ridx))
+                counts.append(1)
+                ex_mat = np.vstack([ex_mat, row[None, :]])
+        i += _BLOCK
+    return np.asarray(ex_idx), np.asarray(counts, np.float64)
+
+
+class AggregatorModel(H2OModel):
+    algo = "aggregator"
+
+    def __init__(self, params, x, dinfo, aggregated, exemplar_idx, counts):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = None
+        self.dinfo = dinfo
+        self._aggregated = aggregated
+        self.exemplar_idx = exemplar_idx
+        self.counts = counts
+
+    @property
+    def aggregated_frame(self) -> Frame:
+        return self._aggregated
+
+    def predict(self, test_data: Frame) -> Frame:
+        raise ValueError("aggregator does not support predict(); use aggregated_frame")
+
+    def _make_metrics(self, frame: Frame):
+        return self.training_metrics
+
+
+class H2OAggregatorEstimator(H2OEstimator):
+    algo = "aggregator"
+    supervised = False
+    _param_defaults = dict(
+        target_num_exemplars=5000,
+        rel_tol_num_exemplars=0.5,
+        transform="NORMALIZE",
+        num_iteration_without_new_exemplar=500,
+        save_mapping_frame=False,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> AggregatorModel:
+        p = self._parms
+        transform = p.get("transform", "NORMALIZE")
+        dinfo = DataInfo(train, x, standardize=transform != "NONE",
+                         use_all_factor_levels=True)
+        X = dinfo.fit_transform(train).astype(np.float32)
+        n, pdim = X.shape
+        target = int(p.get("target_num_exemplars", 5000))
+        tol = float(p.get("rel_tol_num_exemplars", 0.5))
+
+        # radius search: bisection on log-radius until exemplar count is
+        # within rel tolerance of target (Aggregator's radius rescale loop)
+        r2 = float(pdim) * 0.1
+        lo, hi = None, None
+        best = None
+        for _ in range(20):
+            idx, counts = _aggregate(X, r2)
+            e = len(idx)
+            best = (idx, counts)
+            if e > target * (1 + tol):      # too many exemplars → grow radius
+                lo = r2
+                r2 = r2 * 4 if hi is None else (r2 + hi) / 2 if hi else r2 * 4
+            elif target >= n or e >= min(target * (1 - tol), n):
+                break
+            else:                            # too few → shrink radius
+                hi = r2
+                r2 = r2 / 4 if lo is None else (r2 + lo) / 2
+        idx, counts = best
+
+        cols = {}
+        for name in train.names:
+            v = train.vec(name)
+            taken = v.take(np.asarray(idx))
+            cols[name] = taken
+        agg = Frame(cols)
+        agg["counts"] = counts
+        model = AggregatorModel(self, x, dinfo, agg, idx, counts)
+        model.training_metrics = ModelMetricsBase(nobs=n)
+        return model
+
+
+Aggregator = H2OAggregatorEstimator

@@ -1,0 +1,120 @@
+"""H2OIsotonicRegressionEstimator — weighted isotonic (monotone) regression.
+
+Reference parity: `h2o-algos/src/main/java/hex/isotonic/IsotonicRegression.java`
++ `hex/isotonic/PoolAdjacentViolatorsDriver.java`: sort by the single feature,
+run weighted pool-adjacent-violators, keep the (x, y) knots; scoring clips or
+NAs out-of-bounds inputs per `out_of_bounds`. Estimator surface
+`h2o-py/h2o/estimators/isotonic_regression.py`.
+
+TPU note: PAV is an inherently sequential merge of adjacent pools, done once
+on host over the (small) sorted aggregate; scoring is a vectorized
+`jnp.interp`-style lookup, trivially row-sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..frame.frame import Frame
+from .metrics import ModelMetricsRegression
+from .model_base import H2OEstimator, H2OModel
+
+
+def pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
+    """Weighted pool-adjacent-violators on (x, y, w) sorted by x.
+
+    Returns the isotonic knot arrays (thresholds_x, thresholds_y) — one knot
+    per final pool, as the reference's PoolAdjacentViolatorsDriver produces.
+    """
+    order = np.argsort(x, kind="mergesort")
+    x, y, w = x[order], y[order], w[order]
+    # collapse duplicate x to weighted means first (reference aggregates ties)
+    ux, start = np.unique(x, return_index=True)
+    end = np.append(start[1:], len(x))
+    wy = np.array([np.sum(y[s:e] * w[s:e]) for s, e in zip(start, end)])
+    ws = np.array([np.sum(w[s:e]) for s, e in zip(start, end)])
+    my = wy / np.maximum(ws, 1e-300)
+
+    # stack-based PAV: each pool = (sum_wy, sum_w, first_idx)
+    vals = np.empty(len(ux))
+    wts = np.empty(len(ux))
+    first = np.empty(len(ux), np.int64)
+    top = 0
+    for i in range(len(ux)):
+        vals[top], wts[top], first[top] = my[i] * ws[i], ws[i], i
+        top += 1
+        while top > 1 and vals[top - 2] / wts[top - 2] >= vals[top - 1] / wts[top - 1]:
+            vals[top - 2] += vals[top - 1]
+            wts[top - 2] += wts[top - 1]
+            top -= 1
+    means = vals[:top] / wts[:top]
+    # knots at the first x of each pool plus the trailing x, so interpolation
+    # reproduces the step/linear fit on pool boundaries
+    tx, ty = [], []
+    for k in range(top):
+        lo = first[k]
+        hi = (first[k + 1] - 1) if k + 1 < top else len(ux) - 1
+        tx.append(ux[lo])
+        ty.append(means[k])
+        if hi > lo:
+            tx.append(ux[hi])
+            ty.append(means[k])
+    return np.asarray(tx, np.float64), np.asarray(ty, np.float64)
+
+
+class IsotonicRegressionModel(H2OModel):
+    algo = "isotonicregression"
+
+    def __init__(self, params, x, y, tx, ty, out_of_bounds):
+        super().__init__(params)
+        self.x = x
+        self.y = y
+        self.thresholds_x = tx
+        self.thresholds_y = ty
+        self.out_of_bounds = out_of_bounds
+
+    def _score(self, col: np.ndarray) -> np.ndarray:
+        tx, ty = self.thresholds_x, self.thresholds_y
+        p = np.interp(col, tx, ty)
+        if self.out_of_bounds.lower() == "na":
+            p = np.where((col < tx[0]) | (col > tx[-1]), np.nan, p)
+        p = np.where(np.isnan(col), np.nan, p)
+        return p
+
+    def predict(self, test_data: Frame) -> Frame:
+        p = self._score(test_data.vec(self.x).numeric_np())
+        return Frame.from_dict({"predict": p})
+
+    def _make_metrics(self, frame: Frame):
+        p = self._score(frame.vec(self.x).numeric_np())
+        yv = frame.vec(self.y).numeric_np()
+        ok = ~np.isnan(p) & ~np.isnan(yv)
+        return ModelMetricsRegression.make(yv[ok], p[ok])
+
+
+class H2OIsotonicRegressionEstimator(H2OEstimator):
+    algo = "isotonicregression"
+    _param_defaults = dict(out_of_bounds="NA", custom_metric_func=None)
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]):
+        if len(x) != 1:
+            raise ValueError("isotonicregression expects exactly one feature column")
+        xn = x[0]
+        col = train.vec(xn).numeric_np()
+        yv = train.vec(y).numeric_np()
+        wcol = self._parms.get("weights_column")
+        w = train.vec(wcol).numeric_np() if wcol else np.ones_like(yv)
+        ok = ~np.isnan(col) & ~np.isnan(yv)
+        tx, ty = pav(col[ok], yv[ok], w[ok])
+        model = IsotonicRegressionModel(
+            self, xn, y, tx, ty, str(self._parms.get("out_of_bounds", "NA"))
+        )
+        model.training_metrics = model._make_metrics(train)
+        if valid is not None:
+            model.validation_metrics = model._make_metrics(valid)
+        return model
+
+    def _cv_predict(self, model: IsotonicRegressionModel, frame: Frame) -> np.ndarray:
+        return model._score(frame.vec(model.x).numeric_np())

@@ -192,12 +192,7 @@ class SharedTreeModel(H2OModel):
 
     def _make_metrics(self, frame: Frame):
         out = self._score_probs(self._matrix(frame), self._offset_of(frame))
-        yv = frame.vec(self.y)
-        if self.problem == "binomial":
-            return ModelMetricsBinomial.make(np.asarray(yv.data), out[:, 1])
-        if self.problem == "multinomial":
-            return ModelMetricsMultinomial.make(np.asarray(yv.data), out)
-        return ModelMetricsRegression.make(yv.numeric_np(), out[:, 0])
+        return _metrics_for(self.problem, frame.vec(self.y), out)
 
 
 class H2OSharedTreeEstimator(H2OEstimator):
@@ -260,6 +255,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if self._parms.get("offset_column")
             else None
         )
+        if offset is not None and self._mode == "drf":
+            # reference parity: DRF.init rejects offsets ("Offsets are not yet
+            # supported for DRF") — and scoring here never applies them
+            raise ValueError("offset_column is not supported for DRF")
 
         if problem == "regression":
             yk = yvec.numeric_np().astype(np.float32)[:, None]
@@ -572,6 +571,18 @@ class H2OSharedTreeEstimator(H2OEstimator):
         m = 0
         packed_chunks: List = []   # device-resident (nsteps, K, T, 5) arrays
         gains_chunks: List = []    # device-resident (F,) arrays
+        packed_host: List = []     # flushed-to-host chunks (OOM guard)
+        dev_bytes = 0
+        # deep forests (heap 2^(d+1) nodes × 5 fields × K) can exceed HBM if
+        # the whole run stays device-resident — flush to host past this budget
+        _PACK_BUDGET = 512 << 20
+
+        def _flush_packed():
+            nonlocal dev_bytes
+            for pk in packed_chunks:
+                packed_host.append(np.asarray(pk))
+            packed_chunks.clear()
+            dev_bytes = 0
         while m < ntrees_target:
             nsteps = min(chunk, ntrees_target - m)
             if custom_obj is not None:
@@ -586,10 +597,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 margins, packed, gains = _train_chunk(
                     margins, key, m, nsteps=nsteps
                 )
-            # everything stays on device; the single bulk D2H happens after
-            # the loop (sync transfers through the tunnel cost ~seconds each)
+            # chunks stay on device until the post-loop bulk D2H (sync
+            # transfers through the tunnel cost ~seconds each), unless the
+            # accumulated forest would blow the HBM budget
             packed_chunks.append(packed)
             gains_chunks.append(gains)
+            dev_bytes += int(np.prod(packed.shape)) * 4
+            if dev_bytes > _PACK_BUDGET:
+                _flush_packed()
             if valid_state is not None:
                 for k in range(K):
                     vsum = _predict_forest_codes_jit(
@@ -639,9 +654,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 self.job.update(built / max(ntrees_target, 1))
 
         # ---- ONE bulk D2H of the whole new forest + gains ----------------
-        if packed_chunks:
+        if packed_chunks or packed_host:
             _ph.mark("train_loop_dispatch")
-            all_packed = np.asarray(jnp.concatenate(packed_chunks, axis=0))
+            _flush_packed()
+            all_packed = (packed_host[0] if len(packed_host) == 1
+                          else np.concatenate(packed_host, axis=0))
             _ph.mark("forest_D2H")
             gain_total += np.asarray(sum(gains_chunks), np.float64)
             _ph.mark("gains_D2H")

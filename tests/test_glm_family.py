@@ -1,0 +1,113 @@
+"""GLM-family extensions: CoxPH, GAM, ANOVAGLM, ModelSelection.
+
+Mirrors reference pyunits `pyunit_coxph_*`, `pyunit_gam_*`,
+`pyunit_anovaglm_*`, `pyunit_modelselection_*` (tolerance asserts vs known
+generating processes)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.anovaglm import H2OANOVAGLMEstimator
+from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+from h2o3_tpu.models.modelselection import H2OModelSelectionEstimator
+
+
+def _surv_data(n=600, beta=(0.8, -0.5), seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, len(beta)))
+    lam = np.exp(X @ np.asarray(beta))
+    t = rng.exponential(1.0 / lam)
+    c = rng.exponential(2.0 / lam.mean(), n)  # independent censoring
+    time = np.minimum(t, c)
+    event = (t <= c).astype(np.float64)
+    return Frame.from_dict({"x1": X[:, 0], "x2": X[:, 1], "time": time, "event": event})
+
+
+def test_coxph_recovers_coefficients(cloud1):
+    fr = _surv_data()
+    cox = H2OCoxProportionalHazardsEstimator(stop_column="time", ties="efron")
+    cox.train(x=["x1", "x2"], y="event", training_frame=fr)
+    m = cox.model
+    coef = m.coef()
+    assert coef["x1"] == pytest.approx(0.8, abs=0.2)
+    assert coef["x2"] == pytest.approx(-0.5, abs=0.2)
+    # likelihood improved over null, concordance well above chance
+    assert m.loglik > m.loglik_null
+    assert m.concordance > 0.6
+    tab = m.coefficients_table()
+    assert all(r["se_coef"] > 0 for r in tab)
+    # breslow close to efron on modest ties
+    cox2 = H2OCoxProportionalHazardsEstimator(stop_column="time", ties="breslow")
+    cox2.train(x=["x1", "x2"], y="event", training_frame=fr)
+    assert cox2.model.coef()["x1"] == pytest.approx(coef["x1"], abs=0.05)
+
+
+def test_gam_beats_glm_on_nonlinear(cloud1):
+    rng = np.random.default_rng(3)
+    x = rng.uniform(-3, 3, 800)
+    z = rng.normal(size=800)
+    y = np.sin(x) * 2 + 0.5 * z + rng.normal(0, 0.1, 800)
+    fr = Frame.from_dict({"x": x, "z": z, "y": y})
+    gam = H2OGeneralizedAdditiveEstimator(gam_columns=["x"], num_knots=[10], family="gaussian")
+    gam.train(x=["x", "z"], y="y", training_frame=fr)
+    glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0)
+    glm.train(x=["x", "z"], y="y", training_frame=fr)
+    assert gam.model.training_metrics.rmse < 0.5 * glm.model.training_metrics.rmse
+    assert gam.model.training_metrics.rmse < 0.2
+    p = gam.predict(fr).vec("predict").numeric_np()
+    assert np.corrcoef(p, y)[0, 1] > 0.98
+
+
+def test_gam_binomial(cloud1):
+    rng = np.random.default_rng(4)
+    x = rng.uniform(-3, 3, 600)
+    eta = np.sin(x) * 3
+    y = (rng.uniform(size=600) < 1 / (1 + np.exp(-eta))).astype(int)
+    fr = Frame.from_dict({"x": x, "y": np.asarray(["n", "p"], dtype=object)[y]},
+                         column_types={"y": "enum"})
+    gam = H2OGeneralizedAdditiveEstimator(gam_columns=["x"], num_knots=[8], family="binomial")
+    gam.train(x=["x"], y="y", training_frame=fr)
+    assert gam.model.training_metrics.auc > 0.8
+
+
+def test_anovaglm_identifies_active_term(cloud1):
+    rng = np.random.default_rng(5)
+    a = rng.normal(size=500)
+    b = rng.normal(size=500)
+    y = 2.0 * a + rng.normal(0, 0.5, 500)  # only a matters; no interaction
+    fr = Frame.from_dict({"a": a, "b": b, "y": y})
+    an = H2OANOVAGLMEstimator(family="gaussian", highest_interaction_term=2)
+    an.train(x=["a", "b"], y="y", training_frame=fr)
+    res = an.model.result()
+    mv = res.vec("model")
+    terms = [mv.domain[c] for c in np.asarray(mv.data)]
+    pvals = dict(zip(terms, res.vec("p_value").numeric_np()))
+    assert pvals["a"] < 0.01
+    assert pvals["b"] > 0.05
+    assert pvals["a:b"] > 0.01
+
+
+def test_modelselection_modes(cloud1):
+    rng = np.random.default_rng(6)
+    n = 400
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    x3 = rng.normal(size=n)  # noise
+    y = 3 * x1 + 1.5 * x2 + rng.normal(0, 0.3, n)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "x3": x3, "y": y})
+    for mode in ("maxr", "allsubsets", "backward"):
+        ms = H2OModelSelectionEstimator(mode=mode, max_predictor_number=3,
+                                        family="gaussian")
+        ms.train(x=["x1", "x2", "x3"], y="y", training_frame=fr)
+        preds = ms.model.get_best_model_predictors()
+        # size-1 best is x1, size-2 best is {x1, x2}
+        assert preds[0] == ["x1"]
+        assert set(preds[1]) == {"x1", "x2"}
+        r2s = ms.model.get_best_r2_values()
+        assert r2s[1] > r2s[0]
+        assert r2s[1] > 0.95
+    coefs = ms.model.coef(predictor_size=2)
+    assert coefs["x1"] == pytest.approx(3.0, abs=0.1)

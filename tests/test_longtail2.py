@@ -1,0 +1,86 @@
+"""Long-tail algos batch 2: isotonic regression, SVD, aggregator.
+
+Mirrors the reference pyunits: `pyunit_isotonic_regression.py`,
+`pyunit_svd_*`, `pyunit_aggregator_*` (tolerance asserts vs known values).
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
+from h2o3_tpu.models.isotonic import H2OIsotonicRegressionEstimator, pav
+from h2o3_tpu.models.svd import H2OSingularValueDecompositionEstimator
+
+
+def test_pav_monotone_and_pooling():
+    x = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    y = np.array([1.0, 3.0, 2.0, 4.0, 5.0])
+    tx, ty = pav(x, y, np.ones_like(x))
+    # fitted values must be monotone non-decreasing
+    assert (np.diff(ty) >= -1e-12).all()
+    # violator pair (3,2) pools to 2.5
+    fit = np.interp(x, tx, ty)
+    np.testing.assert_allclose(fit, [1.0, 2.5, 2.5, 4.0, 5.0])
+
+
+def test_isotonic_estimator_fit_predict(cloud1):
+    rng = np.random.default_rng(7)
+    x = rng.uniform(0, 10, 400)
+    y = np.sqrt(x) + rng.normal(0, 0.1, 400)
+    fr = Frame.from_dict({"x": x, "y": y})
+    iso = H2OIsotonicRegressionEstimator(out_of_bounds="clip")
+    iso.train(x=["x"], y="y", training_frame=fr)
+    assert iso.model.training_metrics.rmse < 0.15
+    # out-of-bounds clip: prediction at x=100 equals fit at max knot
+    test = Frame.from_dict({"x": np.array([-5.0, 100.0])})
+    p = iso.predict(test).vec("predict").numeric_np()
+    assert p[0] == pytest.approx(iso.model.thresholds_y[0])
+    assert p[1] == pytest.approx(iso.model.thresholds_y[-1])
+    # NA mode
+    iso2 = H2OIsotonicRegressionEstimator(out_of_bounds="NA")
+    iso2.train(x=["x"], y="y", training_frame=fr)
+    p2 = iso2.predict(test).vec("predict").numeric_np()
+    assert np.isnan(p2).all()
+
+
+def test_svd_gram_matches_numpy(cloud1):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 6)).astype(np.float64)
+    fr = Frame.from_numpy(X, names=[f"c{i}" for i in range(6)])
+    svd = H2OSingularValueDecompositionEstimator(nv=3, transform="NONE", keep_u=True)
+    svd.train(x=fr.names, training_frame=fr)
+    m = svd.model
+    _, s_ref, _ = np.linalg.svd(X, full_matrices=False)
+    np.testing.assert_allclose(m.d, s_ref[:3], rtol=1e-3)
+    # u d v' reconstructs the dominant subspace: check column orthonormality
+    np.testing.assert_allclose(m.v.T @ m.v, np.eye(3), atol=1e-5)
+    u = m.u
+    np.testing.assert_allclose((u.T @ u), np.eye(3), atol=1e-2)
+    # projection of training data reproduces u
+    proj = svd.predict(fr)
+    np.testing.assert_allclose(proj.vec("u1").numeric_np(), u[:, 0], atol=1e-4)
+
+
+def test_svd_power_matches_gram(cloud1):
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(150, 5))
+    fr = Frame.from_numpy(X, names=[f"c{i}" for i in range(5)])
+    g = H2OSingularValueDecompositionEstimator(nv=2, svd_method="GramSVD")
+    g.train(x=fr.names, training_frame=fr)
+    pw = H2OSingularValueDecompositionEstimator(nv=2, svd_method="Power", seed=3)
+    pw.train(x=fr.names, training_frame=fr)
+    np.testing.assert_allclose(pw.model.d, g.model.d, rtol=1e-3)
+
+
+def test_aggregator_reduces_rows(cloud1):
+    rng = np.random.default_rng(2)
+    # 3 well-separated gaussian blobs, 900 rows
+    X = np.concatenate([rng.normal(c, 0.05, size=(300, 2)) for c in (0.0, 5.0, 10.0)])
+    fr = Frame.from_numpy(X, names=["a", "b"])
+    agg = H2OAggregatorEstimator(target_num_exemplars=10, rel_tol_num_exemplars=0.9)
+    agg.train(x=["a", "b"], training_frame=fr)
+    out = agg.model.aggregated_frame
+    assert 1 <= out.nrow < 900
+    # counts conserve the row total
+    assert out.vec("counts").numeric_np().sum() == 900
