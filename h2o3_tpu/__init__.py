@@ -29,6 +29,7 @@ if not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
 from .frame.frame import Frame
 from .frame.frame import Frame as H2OFrame
 from .frame.parse import import_file as _import_file
+from .frame.text import grep, tf_idf, tokenize  # noqa: F401  (h2o.tf_idf surface)
 from .parallel import mesh as _mesh
 
 __version__ = "0.1.0"

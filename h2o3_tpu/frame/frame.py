@@ -229,6 +229,10 @@ class Frame:
         return _quantile(self, prob or [0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9, 0.99],
                          combine_method)
 
+    def tokenize(self, split: str = " ") -> "Frame":
+        from .text import tokenize as _tok
+        return _tok(self, split)
+
     def table(self) -> "Frame":
         from .rapids import table as _table
 

@@ -1,0 +1,118 @@
+"""Text utilities — tokenize, TF-IDF, Grep.
+
+Reference parity:
+* `water/rapids/ast/prims/string/AstTokenize.java` — `frame.tokenize(split)`:
+  splits every string column row-wise into tokens, stacked into ONE string
+  column with a trailing NA after each original row (the sentence separator
+  format `hex/word2vec/Word2Vec` consumes).
+* `h2o-algos/src/main/java/hex/tfidf/` (TfIdfPreprocessor, DocumentFrequency-
+  Task, TermFrequencyTask) exposed as `h2o.tf_idf()` — returns a frame
+  [document_id, token, TF, IDF, TF-IDF].
+* `h2o-algos/src/main/java/hex/grep/Grep.java` — regex match over a text
+  column; returns matching rows.
+
+Host-side string work (like the reference: tokenization runs on the JVM heap,
+not the accelerator); the numeric TF/IDF aggregation is numpy segment math.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from .frame import Frame
+from .vec import Vec
+
+
+def _string_rows(v: Vec) -> List[Optional[str]]:
+    if v.type == "string":
+        return [None if s is None else str(s) for s in v.to_numpy()]
+    if v.type == "enum":
+        dom = np.asarray((v.domain or []) + [None], dtype=object)
+        return [None if s is None else str(s) for s in dom[np.asarray(v.data)]]
+    raise ValueError("expected a string/enum column")
+
+
+def tokenize(frame: Frame, split: str = " ") -> Frame:
+    """`H2OFrame.tokenize` — one output string column; each input row's
+    tokens are followed by a NA row (sentence boundary)."""
+    pat = re.compile(split)
+    cols = [v for v in frame.vecs() if v.type in ("string", "enum")]
+    if not cols:
+        raise ValueError("tokenize: no string columns in frame")
+    out: List[Optional[str]] = []
+    rows = [_string_rows(v) for v in cols]
+    for i in range(frame.nrow):
+        for r in rows:
+            s = r[i]
+            if s is None:
+                continue
+            out.extend(t for t in pat.split(s) if t)
+        out.append(None)
+    return Frame({"C1": Vec(None, "string", strings=np.asarray(out, dtype=object))})
+
+
+def tf_idf(frame: Frame, document_id_col=0, text_col=1, preprocess: bool = True,
+           case_sensitive: bool = True) -> Frame:
+    """`h2o.tf_idf` — per-(document, token): TF = term count in doc,
+    IDF = log((1+N)/(1+DF)), TF-IDF = TF·IDF."""
+    names = frame.names
+    did_col = names[document_id_col] if isinstance(document_id_col, int) else document_id_col
+    txt_col = names[text_col] if isinstance(text_col, int) else text_col
+    doc_ids = frame.vec(did_col).numeric_np().astype(np.int64)
+    if preprocess:
+        texts = _string_rows(frame.vec(txt_col))
+        pairs = []
+        for d, s in zip(doc_ids, texts):
+            if s is None:
+                continue
+            for t in s.split():
+                pairs.append((d, t if case_sensitive else t.lower()))
+    else:
+        toks = _string_rows(frame.vec(txt_col))
+        pairs = [(d, t if case_sensitive else t.lower())
+                 for d, t in zip(doc_ids, toks) if t is not None]
+    if not pairs:
+        raise ValueError("tf_idf: no tokens")
+    docs = np.asarray([p[0] for p in pairs])
+    words = np.asarray([p[1] for p in pairs], dtype=object)
+
+    tf = {}
+    for d, w in zip(docs, words):
+        tf[(d, w)] = tf.get((d, w), 0) + 1
+    n_docs = len(np.unique(docs))
+    df = {}
+    for (d, w) in tf:
+        df[w] = df.get(w, 0) + 1
+    keys = sorted(tf.keys(), key=lambda k: (k[0], str(k[1])))
+    out_doc = np.asarray([k[0] for k in keys], np.float64)
+    out_tok = np.asarray([k[1] for k in keys], dtype=object)
+    out_tf = np.asarray([tf[k] for k in keys], np.float64)
+    out_idf = np.asarray([np.log((1.0 + n_docs) / (1.0 + df[k[1]])) for k in keys])
+    return Frame({
+        did_col: Vec.from_numpy(out_doc),
+        "token": Vec(None, "string", strings=out_tok),
+        "TF": Vec.from_numpy(out_tf),
+        "IDF": Vec.from_numpy(out_idf),
+        "TF_IDF": Vec.from_numpy(out_tf * out_idf),
+    })
+
+
+def grep(frame: Frame, regex: str, invert: bool = False) -> Frame:
+    """`hex.grep.Grep` — rows of the (single string column) frame matching
+    the regex; returns [row_idx, match] like the reference's match offsets."""
+    pat = re.compile(regex)
+    v = frame.vecs()[0]
+    rows = _string_rows(v)
+    idx, matches = [], []
+    for i, s in enumerate(rows):
+        hit = bool(s is not None and pat.search(s))
+        if hit != invert:
+            idx.append(i)
+            matches.append(s)
+    return Frame({
+        "row": Vec.from_numpy(np.asarray(idx, np.float64)),
+        "match": Vec(None, "string", strings=np.asarray(matches, dtype=object)),
+    })

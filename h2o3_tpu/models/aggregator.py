@@ -28,29 +28,35 @@ from .model_base import DataInfo, H2OEstimator, H2OModel
 _BLOCK = 4096
 
 
-def _assign_block(block: jnp.ndarray, ex: jnp.ndarray, r2: float):
-    """Nearest exemplar id + squared distance for a block of rows (device)."""
+@jax.jit
+def _assign_block(block: jnp.ndarray, ex: jnp.ndarray, n_ex: jnp.ndarray):
+    """Nearest (live) exemplar id + squared distance for a block of rows.
+    `ex` is a fixed-capacity buffer; rows ≥ n_ex are masked out, so the
+    compiled shape only changes when capacity doubles."""
     d2 = (
         jnp.sum(block * block, axis=1, keepdims=True)
         - 2.0 * block @ ex.T
         + jnp.sum(ex * ex, axis=1)[None, :]
     )
+    d2 = jnp.where(jnp.arange(ex.shape[0])[None, :] < n_ex, d2, jnp.inf)
     j = jnp.argmin(d2, axis=1)
     return j, jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0]
 
 
 def _aggregate(X: np.ndarray, radius2: float):
     """One pass: returns (exemplar_row_indices, member_counts)."""
-    n = X.shape[0]
+    n, pdim = X.shape
+    cap = 256
+    ex_buf = np.zeros((cap, pdim), np.float32)
+    ex_buf[0] = X[0]
+    n_ex = 1
     ex_idx = [0]
-    counts = [0]
-    ex_mat = X[:1]
-    assign_j = jax.jit(_assign_block)
+    counts = [1]
     i = 1
-    counts[0] = 1
     while i < n:
         block = X[i : i + _BLOCK]
-        j, d2 = assign_j(jnp.asarray(block), jnp.asarray(ex_mat), radius2)
+        j, d2 = _assign_block(jnp.asarray(block), jnp.asarray(ex_buf),
+                              jnp.int32(n_ex))
         j = np.asarray(j)
         d2 = np.asarray(d2)
         ok = d2 <= radius2
@@ -59,17 +65,23 @@ def _aggregate(X: np.ndarray, radius2: float):
             counts[jj] += 1
         # the rest are processed in order — each may become a new exemplar
         # that absorbs later rows of the same block, so recompute locally
-        rest = block[~ok]
         rest_rows = np.nonzero(~ok)[0]
-        for ridx, row in zip(rest_rows, rest):
-            d2r = np.sum((ex_mat - row) ** 2, axis=1)
+        for ridx in rest_rows:
+            row = block[ridx]
+            d2r = np.sum((ex_buf[:n_ex] - row) ** 2, axis=1)
             jj = int(np.argmin(d2r))
             if d2r[jj] <= radius2:
                 counts[jj] += 1
             else:
+                if n_ex == cap:  # grow capacity (power-of-two → few recompiles)
+                    cap *= 2
+                    nb = np.zeros((cap, pdim), np.float32)
+                    nb[:n_ex] = ex_buf
+                    ex_buf = nb
+                ex_buf[n_ex] = row
+                n_ex += 1
                 ex_idx.append(i + int(ridx))
                 counts.append(1)
-                ex_mat = np.vstack([ex_mat, row[None, :]])
         i += _BLOCK
     return np.asarray(ex_idx), np.asarray(counts, np.float64)
 
@@ -118,24 +130,29 @@ class H2OAggregatorEstimator(H2OEstimator):
         target = int(p.get("target_num_exemplars", 5000))
         tol = float(p.get("rel_tol_num_exemplars", 0.5))
 
-        # radius search: bisection on log-radius until exemplar count is
-        # within rel tolerance of target (Aggregator's radius rescale loop)
-        r2 = float(pdim) * 0.1
-        lo, hi = None, None
-        best = None
-        for _ in range(20):
-            idx, counts = _aggregate(X, r2)
-            e = len(idx)
-            best = (idx, counts)
-            if e > target * (1 + tol):      # too many exemplars → grow radius
-                lo = r2
-                r2 = r2 * 4 if hi is None else (r2 + hi) / 2 if hi else r2 * 4
-            elif target >= n or e >= min(target * (1 - tol), n):
-                break
-            else:                            # too few → shrink radius
-                hi = r2
-                r2 = r2 / 4 if lo is None else (r2 + lo) / 2
-        idx, counts = best
+        if target >= n:
+            # fewer rows than requested exemplars: every row is an exemplar
+            # (radius 0 — the reference's degenerate small-data case)
+            idx, counts = np.arange(n), np.ones(n, np.float64)
+        else:
+            # radius search: bisection on log-radius until exemplar count is
+            # within rel tolerance of target (Aggregator's radius rescale loop)
+            r2 = float(pdim) * 0.1
+            lo, hi = None, None
+            best = None
+            for _ in range(20):
+                idx, counts = _aggregate(X, r2)
+                e = len(idx)
+                best = (idx, counts)
+                if e > target * (1 + tol):   # too many exemplars → grow radius
+                    lo = r2
+                    r2 = r2 * 4 if hi is None else (r2 + hi) / 2
+                elif e >= target * (1 - tol):
+                    break
+                else:                        # too few → shrink radius
+                    hi = r2
+                    r2 = r2 / 4 if lo is None else (r2 + lo) / 2
+            idx, counts = best
 
         cols = {}
         for name in train.names:

@@ -37,13 +37,16 @@ def _cox_sums(X, eta, w):
     return rs0, rs1, rs2
 
 
-def _partial_ll(X, eta, w, event, last_in_tie, tie_first, tie_size, ties):
+def _partial_ll(X, eta, w, event, last_in_tie, tie_first, tie_size, ties,
+                start_sorted=None, start_perm=None, times=None):
     """Partial log-likelihood + gradient + (negative) Hessian.
 
     Rows are pre-sorted by descending stop time; `last_in_tie[i]` is the last
     row index (inclusive) sharing row i's stop time, so risk-set sums are the
-    cumulative sums evaluated there.
-    """
+    cumulative sums evaluated there. With a start column (counting-process
+    data), rows whose (start, stop] interval does not cover the event time are
+    removed by subtracting start-sorted cumulative sums:
+    Σ_{start_j ≥ t} (entered strictly before t ⇒ at risk)."""
     rs0, rs1, rs2 = _cox_sums(X, eta, w)
     rs0 = np.asarray(rs0, np.float64)
     rs1 = np.asarray(rs1, np.float64)
@@ -52,6 +55,13 @@ def _partial_ll(X, eta, w, event, last_in_tie, tie_first, tie_size, ties):
     etan = np.asarray(eta, np.float64)
     wn = np.asarray(w, np.float64)
     r = wn * np.exp(etan)
+
+    if start_perm is not None:
+        # cumulative sums in descending-start order (device cumsum again)
+        cs0, cs1, cs2 = _cox_sums(X[start_perm], eta[start_perm], w[start_perm])
+        cs0 = np.asarray(cs0, np.float64)
+        cs1 = np.asarray(cs1, np.float64)
+        cs2 = np.asarray(cs2, np.float64)
 
     ev = event.astype(bool)
     p = Xn.shape[1]
@@ -66,6 +76,15 @@ def _partial_ll(X, eta, w, event, last_in_tie, tie_first, tie_size, ties):
             continue
         li = last_in_tie[g0]
         s0, s1, s2 = rs0[li], rs1[li], rs2[li]
+        if start_perm is not None:
+            # remove subjects not yet entered at this event time t:
+            # start_sorted is descending; k = #{j : start_j >= t}
+            t = times[g0]
+            k = int(np.searchsorted(-start_sorted, -t, side="right"))
+            if k > 0:
+                s0 = s0 - cs0[k - 1]
+                s1 = s1 - cs1[k - 1]
+                s2 = s2 - cs2[k - 1]
         sw = wn[erows].sum()
         ll += (wn[erows] * etan[erows]).sum()
         grad += (wn[erows, None] * Xn[erows]).sum(axis=0)
@@ -163,7 +182,11 @@ class H2OCoxProportionalHazardsEstimator(H2OEstimator):
         if stop_col is None:
             raise ValueError("coxph requires stop_column")
         ties = str(p.get("ties", "efron")).lower()
-        x = [c for c in x if c not in (stop_col, p.get("start_column"))]
+        start_col = p.get("start_column")
+        strat_cols = p.get("stratify_by") or []
+        if isinstance(strat_cols, str):
+            strat_cols = [strat_cols]
+        x = [c for c in x if c not in (stop_col, start_col) and c not in strat_cols]
         dinfo = DataInfo(train, x, standardize=False,
                          use_all_factor_levels=bool(p.get("use_all_factor_levels", False)))
         X = dinfo.fit_transform(train).astype(np.float64)
@@ -171,40 +194,87 @@ class H2OCoxProportionalHazardsEstimator(H2OEstimator):
         xbar = X.mean(axis=0)
         Xc = X - xbar
         t = train.vec(stop_col).numeric_np()
+        t0 = train.vec(start_col).numeric_np() if start_col else None
         yv = train.vec(y)
         event = (np.asarray(yv.data, np.float64) if yv.type == "enum"
                  else yv.numeric_np()).astype(np.float64)
         wcol = p.get("weights_column")
         w = train.vec(wcol).numeric_np() if wcol else np.ones(len(t))
+        n = len(t)
 
-        # sort by descending stop time so risk sets are prefix sums
-        order = np.argsort(-t, kind="mergesort")
-        Xs, ts, es, ws = Xc[order], t[order], event[order], w[order]
-        n = len(ts)
-        # tie-group bookkeeping on the sorted times
-        tie_first = np.zeros(n, np.int64)
-        tie_size = np.zeros(n, np.int64)
-        last_in_tie = np.zeros(n, np.int64)
-        i = 0
-        while i < n:
-            j = i
-            while j + 1 < n and ts[j + 1] == ts[i]:
-                j += 1
-            tie_first[i : j + 1] = i
-            tie_size[i] = j - i + 1
-            last_in_tie[i : j + 1] = j
-            i = j + 1
+        # strata = distinct combinations of the stratify_by columns; the
+        # partial likelihood is computed per-stratum and summed (CoxPH strata)
+        if strat_cols:
+            keys = np.zeros(n, np.int64)
+            for c in strat_cols:
+                v = train.vec(c)
+                codes = (np.asarray(v.data, np.int64) if v.type == "enum"
+                         else v.numeric_np().astype(np.int64))
+                keys = keys * (codes.max() + 2) + codes
+            _, strata = np.unique(keys, return_inverse=True)
+        else:
+            strata = np.zeros(n, np.int64)
 
-        pdim = Xs.shape[1]
+        # per-stratum sorted structures (built once)
+        groups = []
+        for s in np.unique(strata):
+            rows = np.nonzero(strata == s)[0]
+            ts_raw = t[rows]
+            order = np.argsort(-ts_raw, kind="mergesort")
+            rows = rows[order]
+            ts = t[rows]
+            m = len(rows)
+            tie_first = np.zeros(m, np.int64)
+            tie_size = np.zeros(m, np.int64)
+            last_in_tie = np.zeros(m, np.int64)
+            i = 0
+            while i < m:
+                j = i
+                while j + 1 < m and ts[j + 1] == ts[i]:
+                    j += 1
+                tie_first[i : j + 1] = i
+                tie_size[i] = j - i + 1
+                last_in_tie[i : j + 1] = j
+                i = j + 1
+            g = dict(
+                rows=rows,
+                Xj=jnp.asarray(Xc[rows], jnp.float32),
+                Xs=Xc[rows],
+                wj=jnp.asarray(w[rows], jnp.float32),
+                es=event[rows],
+                tie_first=tie_first, tie_size=tie_size, last_in_tie=last_in_tie,
+                start_sorted=None, start_perm=None, times=None,
+            )
+            if t0 is not None:
+                sp = np.argsort(-t0[rows], kind="mergesort")
+                g["start_perm"] = jnp.asarray(sp, jnp.int32)
+                g["start_sorted"] = t0[rows][sp]
+                g["times"] = ts
+            groups.append(g)
+
+        pdim = Xc.shape[1]
+
+        def accumulate(beta):
+            ll, grad, hess = 0.0, np.zeros(pdim), np.zeros((pdim, pdim))
+            for g in groups:
+                eta = jnp.asarray(g["Xs"] @ beta, jnp.float32)
+                l, gr, he = _partial_ll(
+                    g["Xj"], eta, g["wj"], g["es"], g["last_in_tie"],
+                    g["tie_first"], g["tie_size"], ties,
+                    start_sorted=g["start_sorted"], start_perm=g["start_perm"],
+                    times=g["times"],
+                )
+                ll += l
+                grad += gr
+                hess += he
+            return ll, grad, hess
+
         beta = np.full(pdim, float(p.get("init", 0.0)))
-        Xj = jnp.asarray(Xs, jnp.float32)
-        wj = jnp.asarray(ws, jnp.float32)
         ll = ll_null = None
+        if not beta.any():
+            ll_null = accumulate(beta)[0]
         for it in range(int(p.get("max_iterations", 20))):
-            eta = jnp.asarray(Xs @ beta, jnp.float32)
-            ll, grad, hess = _partial_ll(Xj, eta, wj, es, last_in_tie, tie_first, tie_size, ties)
-            if ll_null is None and it == 0 and not beta.any():
-                ll_null = ll
+            ll, grad, hess = accumulate(beta)
             try:
                 step = np.linalg.solve(hess + 1e-9 * np.eye(pdim), grad)
             except np.linalg.LinAlgError:
@@ -213,10 +283,8 @@ class H2OCoxProportionalHazardsEstimator(H2OEstimator):
             if np.max(np.abs(step)) < 1e-8:
                 break
         if ll_null is None:
-            z = jnp.zeros(n, jnp.float32)
-            ll_null, _, _ = _partial_ll(Xj, z, wj, es, last_in_tie, tie_first, tie_size, ties)
-        eta = jnp.asarray(Xs @ beta, jnp.float32)
-        ll, grad, hess = _partial_ll(Xj, eta, wj, es, last_in_tie, tie_first, tie_size, ties)
+            ll_null = accumulate(np.zeros(pdim))[0]
+        ll, grad, hess = accumulate(beta)
         try:
             se = np.sqrt(np.maximum(np.diag(np.linalg.inv(hess + 1e-9 * np.eye(pdim))), 0))
         except np.linalg.LinAlgError:

@@ -147,9 +147,23 @@ class H2OGeneralizedAdditiveEstimator(H2OEstimator):
         else:
             dinfo.fit_transform(train)
 
-        nk = p.get("num_knots")
-        nks = list(nk) if nk else [10] * len(gam_cols)
-        scales = list(p.get("scale") or [1.0] * len(gam_cols))
+        def _per_col(val, default, name):
+            if val is None:
+                return [default] * len(gam_cols)
+            if np.isscalar(val):
+                return [val] * len(gam_cols)
+            val = list(val)
+            if len(val) == 1:
+                return val * len(gam_cols)
+            if len(val) != len(gam_cols):
+                raise ValueError(
+                    f"gam: {name} has {len(val)} entries for "
+                    f"{len(gam_cols)} gam_columns"
+                )
+            return val
+
+        nks = _per_col(p.get("num_knots"), 10, "num_knots")
+        scales = _per_col(p.get("scale"), 1.0, "scale")
         gam_spec = []
         pen_blocks = []  # (offset, S·scale)
         off = parts[0].shape[1] if parts else 0

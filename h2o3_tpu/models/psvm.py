@@ -1,0 +1,172 @@
+"""H2OSupportVectorMachineEstimator — binary SVM (PSVM).
+
+Reference parity: `h2o-algos/src/main/java/hex/psvm/PSVM.java` (primal SVM
+on an Incomplete-Cholesky kernel approximation + interior point, per the
+PSVM paper; `kernel_type=gaussian`, `hyper_param` = C, ±1 response,
+`rank_ratio` controls the low-rank factor size). Estimator surface
+`h2o-py/h2o/estimators/psvm.py` (predict → label, no probabilities;
+`decision_function`).
+
+TPU redesign: the ICF low-rank kernel factor is replaced by random Fourier
+features — z(x) = √(2/D)·cos(Wx+b) with W~N(0, 2γI) approximates the same
+gaussian kernel as a dense (n×D) feature matrix, and the primal squared-hinge
+objective is minimized with full-batch Adam: every step is two MXU matmuls,
+no interior-point iterations, trivially row-sharded with psum'd gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame.frame import Frame
+from .metrics import ModelMetricsBinomial
+from .model_base import DataInfo, H2OEstimator, H2OModel
+
+
+class PSVMModel(H2OModel):
+    algo = "psvm"
+
+    def __init__(self, params, x, y, dinfo, W, b, beta, bias, domain, kernel,
+                 svs_count):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = y
+        self.dinfo = dinfo
+        self.W = W          # (p, D) fourier projection (None for linear kernel)
+        self.b = b          # (D,)
+        self.beta = beta    # (D,) or (p,) weights
+        self.bias = bias
+        self.domain = domain
+        self.kernel = kernel
+        self.svs_count = svs_count  # rows inside the margin (support vectors)
+
+    def _features(self, X: np.ndarray) -> np.ndarray:
+        if self.kernel == "linear" or self.W is None:
+            return X
+        D = self.W.shape[1]
+        return np.sqrt(2.0 / D) * np.cos(X @ self.W + self.b)
+
+    def decision_function(self, frame: Frame) -> np.ndarray:
+        X = self.dinfo.transform(frame)
+        return self._features(X) @ self.beta + self.bias
+
+    def predict(self, test_data: Frame) -> Frame:
+        f = self.decision_function(test_data)
+        lab = (f > 0).astype(int)
+        return Frame.from_dict(
+            {"predict": np.asarray(self.domain, dtype=object)[lab],
+             "decision_function": f},
+            column_types={"predict": "enum"},
+        )
+
+    def _make_metrics(self, frame: Frame):
+        f = self.decision_function(frame)
+        yv = frame.vec(self.y)
+        # decision values as ranking scores: AUC is well-defined without probs
+        score = 1.0 / (1.0 + np.exp(-np.clip(f, -30, 30)))
+        return ModelMetricsBinomial.make(np.asarray(yv.data), score)
+
+
+class H2OSupportVectorMachineEstimator(H2OEstimator):
+    algo = "psvm"
+    _param_defaults = dict(
+        hyper_param=1.0,
+        kernel_type="gaussian",
+        gamma=-1.0,
+        rank_ratio=-1.0,
+        positive_weight=1.0,
+        negative_weight=1.0,
+        disable_training_metrics=False,
+        sv_threshold=1e-4,
+        fact_threshold=1e-5,
+        max_iterations=200,
+        feasible_threshold=1e-3,
+        surrogate_gap_threshold=1e-3,
+        mu_factor=10.0,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> PSVMModel:
+        import optax
+
+        p = self._parms
+        yvec = train.vec(y)
+        if yvec.type != "enum" or yvec.nlevels != 2:
+            raise ValueError("psvm requires a binary categorical response")
+        domain = yvec.domain
+        ypm = np.asarray(yvec.data, np.float32) * 2.0 - 1.0  # ±1
+
+        dinfo = DataInfo(train, x, standardize=True)
+        X = dinfo.fit_transform(train)
+        n, pdim = X.shape
+        kernel = str(p.get("kernel_type", "gaussian")).lower()
+        gamma = float(p.get("gamma", -1.0))
+        if gamma <= 0:
+            gamma = 1.0 / max(pdim, 1)
+        C = float(p.get("hyper_param", 1.0))
+        wpos = float(p.get("positive_weight", 1.0))
+        wneg = float(p.get("negative_weight", 1.0))
+        seed = int(self._parms.get("_actual_seed", 1234))
+        rng = np.random.default_rng(seed)
+
+        if kernel == "linear":
+            W = None
+            b = None
+            Z = X
+        else:
+            # rank_ratio sets the ICF rank in the reference; here it sets the
+            # fourier feature count (default √n·8 capped to [64, 1024])
+            rr = float(p.get("rank_ratio", -1.0))
+            D = int(rr * n) if rr > 0 else int(min(max(8 * np.sqrt(n), 64), 1024))
+            W = rng.normal(scale=np.sqrt(2 * gamma), size=(pdim, D)).astype(np.float32)
+            b = rng.uniform(0, 2 * np.pi, D).astype(np.float32)
+            Z = np.sqrt(2.0 / D) * np.cos(X @ W + b)
+
+        Zd = jnp.asarray(Z, jnp.float32)
+        yd = jnp.asarray(ypm)
+        cw = jnp.asarray(np.where(ypm > 0, wpos, wneg).astype(np.float32))
+
+        def loss(params):
+            beta, bias = params
+            f = Zd @ beta + bias
+            margin = jnp.maximum(0.0, 1.0 - yd * f)
+            return 0.5 * jnp.sum(beta * beta) + C * jnp.sum(cw * margin * margin)
+
+        beta0 = (jnp.zeros(Zd.shape[1], jnp.float32), jnp.asarray(0.0, jnp.float32))
+        opt = optax.adam(0.05)
+        state = opt.init(beta0)
+
+        @jax.jit
+        def step(params, state):
+            v, g = jax.value_and_grad(loss)(params)
+            updates, state = opt.update(g, state)
+            return optax.apply_updates(params, updates), state, v
+
+        params = beta0
+        prev = np.inf
+        for it in range(max(int(p.get("max_iterations", 200)), 50) * 5):
+            params, state, v = step(params, state)
+            v = float(v)
+            if abs(prev - v) < 1e-7 * max(abs(v), 1.0):
+                break
+            prev = v
+        beta, bias = np.asarray(params[0], np.float64), float(params[1])
+
+        f = Z @ beta + bias
+        svs = int((ypm * f < 1.0 + float(p.get("sv_threshold", 1e-4))).sum())
+        model = PSVMModel(self, x, y, dinfo, W, b, beta, bias, domain, kernel, svs)
+        if not p.get("disable_training_metrics"):
+            model.training_metrics = model._make_metrics(train)
+            if valid is not None:
+                model.validation_metrics = model._make_metrics(valid)
+        return model
+
+    def _cv_predict(self, model: PSVMModel, frame: Frame) -> np.ndarray:
+        f = model.decision_function(frame)
+        return 1.0 / (1.0 + np.exp(-np.clip(f, -30, 30)))
+
+
+PSVM = H2OSupportVectorMachineEstimator

@@ -1,0 +1,273 @@
+"""H2ORuleFitEstimator — interpretable rules + linear terms (Friedman RuleFit).
+
+Reference parity: `h2o-algos/src/main/java/hex/rulefit/RuleFit.java` +
+`hex/rulefit/RuleExtractor.java`: train tree ensembles at depths
+`min_rule_length`..`max_rule_length` (`algorithm` ∈ {AUTO, DRF, GBM}),
+extract every leaf's root→leaf condition conjunction as a binary rule
+feature, optionally append winsorized linear terms (`model_type`), then fit
+a sparse LASSO GLM over rules+linear and report the surviving rules in
+`rule_importance()`. Estimator surface `h2o-py/h2o/estimators/rulefit.py`.
+
+The rule ensembles ride the same tpu_hist heap-tree engine as GBM/DRF; rule
+evaluation over rows is an elementwise compare+AND, and the LASSO is the
+GLM lambda-search path (one Gram einsum per IRLS step).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..frame.frame import Frame
+from .drf import H2ORandomForestEstimator
+from .gbm import H2OGradientBoostingEstimator
+from .glm import H2OGeneralizedLinearEstimator
+from .model_base import H2OEstimator, H2OModel, response_info
+
+
+class Rule:
+    """Conjunction of (feature_name, thr, is_right) conditions."""
+
+    __slots__ = ("conds", "support", "coef")
+
+    def __init__(self, conds: Tuple):
+        self.conds = conds
+        self.support = 0.0
+        self.coef = 0.0
+
+    def key(self):
+        return self.conds
+
+    def describe(self) -> str:
+        parts = []
+        for fname, thr, right in self.conds:
+            parts.append(f"({fname} > {thr:.6g} or NA)" if right
+                         else f"({fname} <= {thr:.6g})")
+        return " & ".join(parts)
+
+    def evaluate(self, X: np.ndarray, col_of: dict) -> np.ndarray:
+        m = np.ones(X.shape[0], bool)
+        for fname, thr, right in self.conds:
+            col = X[:, col_of[fname]]
+            if right:
+                m &= np.isnan(col) | (col > thr)
+            else:
+                m &= ~np.isnan(col) & (col <= thr)
+        return m.astype(np.float64)
+
+
+def _extract_rules(model, x: List[str], max_len: int) -> List[Rule]:
+    """Walk each stacked heap tree; every effective leaf (non-split node whose
+    ancestors all split) yields one rule — RuleExtractor semantics."""
+    rules = []
+    for stacked in model.forest:
+        nt = stacked.feat.shape[0]
+        feat = np.asarray(stacked.feat)
+        thr = np.asarray(stacked.thr)
+        issp = np.asarray(stacked.is_split)
+        T = feat.shape[1]
+        for t in range(nt):
+            stack = [(0, ())]
+            while stack:
+                node, conds = stack.pop()
+                if node < T and issp[t, node] and len(conds) < max_len:
+                    fname = x[int(feat[t, node])]
+                    tv = float(thr[t, node])
+                    stack.append((2 * node + 1, conds + ((fname, tv, False),)))
+                    stack.append((2 * node + 2, conds + ((fname, tv, True),)))
+                elif conds:
+                    rules.append(Rule(conds))
+    return rules
+
+
+class RuleFitModel(H2OModel):
+    algo = "rulefit"
+
+    def __init__(self, params, x, y, rules, lin_cols, lin_stats, glm, domain,
+                 problem):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = y
+        self.rules = rules           # kept rules (nonzero coef)
+        self.lin_cols = lin_cols     # linear-term column names
+        self.lin_stats = lin_stats   # col -> (q05, q95, std)
+        self._glm = glm              # fitted sparse GLM over [rules | linear]
+        self.domain = domain
+        self.problem = problem
+        self._col_of = {n: i for i, n in enumerate(self.x)}
+
+    def _matrix(self, frame: Frame) -> np.ndarray:
+        cols = [frame.vec(n).numeric_np() for n in self.x]
+        return np.column_stack(cols) if cols else np.zeros((frame.nrow, 0))
+
+    def _features(self, frame: Frame) -> Frame:
+        X = self._matrix(frame)
+        d = {}
+        for i, r in enumerate(self.rules):
+            d[f"rule_{i}"] = r.evaluate(X, self._col_of)
+        for c in self.lin_cols:
+            lo, hi, sd = self.lin_stats[c]
+            col = np.clip(np.nan_to_num(frame.vec(c).numeric_np()), lo, hi)
+            d[f"linear.{c}"] = 0.4 * col / max(sd, 1e-12)
+        return Frame.from_dict(d)
+
+    def rule_importance(self) -> Frame:
+        imp = [(f"rule_{i}", r.coef, r.describe(), r.support)
+               for i, r in enumerate(self.rules) if abs(r.coef) > 1e-10]
+        coefs = self._glm.coef()
+        for c in self.lin_cols:
+            v = coefs.get(f"linear.{c}", 0.0)
+            if v:
+                imp.append((f"linear.{c}", v, f"linear({c})", float("nan")))
+        imp.sort(key=lambda t: -abs(t[1]))
+        return Frame.from_dict({
+            "variable": np.asarray([i[0] for i in imp], dtype=object),
+            "coefficient": np.asarray([i[1] for i in imp], np.float64),
+            "rule": np.asarray([i[2] for i in imp], dtype=object),
+            "support": np.asarray([i[3] for i in imp], np.float64),
+        })
+
+    def predict(self, test_data: Frame) -> Frame:
+        return self._glm.predict(self._features(test_data))
+
+    def _make_metrics(self, frame: Frame):
+        fr = self._features(frame)
+        yv = frame.vec(self.y)
+        fr[self.y] = np.asarray(yv.data) if yv.type == "enum" else yv.numeric_np()
+        if yv.type == "enum":
+            fr = fr.asfactor(self.y)
+        return self._glm.model._make_metrics(fr)
+
+
+class H2ORuleFitEstimator(H2OEstimator):
+    algo = "rulefit"
+    _param_defaults = dict(
+        algorithm="AUTO",          # AUTO→DRF
+        min_rule_length=3,
+        max_rule_length=3,
+        max_num_rules=-1,
+        model_type="rules_and_linear",
+        rule_generation_ntrees=50,
+        distribution="AUTO",
+        remove_duplicates=True,
+        lambda_=None,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> RuleFitModel:
+        p = self._parms
+        yvec = train.vec(y)
+        problem, nclass, domain = response_info(yvec)
+        if problem == "multinomial":
+            raise ValueError("rulefit supports binomial/regression responses")
+        # numeric-only features for rule conditions (categoricals enter trees
+        # as codes in frame_to_matrix; condition thresholds stay on codes)
+        model_type = str(p.get("model_type", "rules_and_linear"))
+        want_rules = "rules" in model_type
+        want_linear = "linear" in model_type
+
+        lo_d = int(p.get("min_rule_length", 3))
+        hi_d = int(p.get("max_rule_length", 3))
+        depths = list(range(min(lo_d, hi_d), max(lo_d, hi_d) + 1))
+        ntrees_total = int(p.get("rule_generation_ntrees", 50))
+        per_depth = max(1, ntrees_total // max(len(depths), 1))
+        algo = str(p.get("algorithm", "AUTO")).upper()
+        TreeEst = H2OGradientBoostingEstimator if algo == "GBM" else H2ORandomForestEstimator
+        seed = int(self._parms.get("_actual_seed", 1234))
+
+        rules: List[Rule] = []
+        if want_rules:
+            for d in depths:
+                est = TreeEst(ntrees=per_depth, max_depth=d, seed=seed + d)
+                est.train(x=x, y=y, training_frame=train)
+                rules += _extract_rules(est.model, x, d)
+
+        col_of = {n: i for i, n in enumerate(x)}
+        X = np.column_stack([train.vec(n).numeric_np() for n in x])
+        # dedupe + drop degenerate (all-0/all-1) rules
+        seen = {}
+        kept: List[Rule] = []
+        feats = {}
+        for r in rules:
+            k = r.key()
+            if k in seen:
+                continue
+            seen[k] = True
+            v = r.evaluate(X, col_of)
+            s = v.mean()
+            if s <= 0.0 or s >= 1.0:
+                continue
+            r.support = float(s)
+            feats[f"rule_{len(kept)}"] = v
+            kept.append(r)
+
+        lin_cols, lin_stats = [], {}
+        if want_linear:
+            for c in x:
+                v = train.vec(c)
+                if v.type == "enum":
+                    continue
+                col = v.numeric_np()
+                ok = col[~np.isnan(col)]
+                if ok.size == 0 or ok.min() == ok.max():
+                    continue
+                lo, hi = np.quantile(ok, [0.025, 0.975])
+                sd = float(np.std(np.clip(ok, lo, hi)))
+                lin_cols.append(c)
+                lin_stats[c] = (float(lo), float(hi), sd)
+                feats[f"linear.{c}"] = 0.4 * np.clip(np.nan_to_num(col), lo, hi) / max(sd, 1e-12)
+
+        if not feats:
+            raise ValueError("rulefit: no usable rule/linear features")
+        fr = Frame.from_dict(feats)
+        fr[y] = np.asarray(yvec.data) if yvec.type == "enum" else yvec.numeric_np()
+        if yvec.type == "enum":
+            fr = fr.asfactor(y)
+
+        family = "binomial" if problem == "binomial" else "gaussian"
+        glm = H2OGeneralizedLinearEstimator(
+            family=family, alpha=1.0, lambda_search=True, standardize=False,
+        )
+        glm.train(x=list(feats.keys()), y=y, training_frame=fr)
+
+        # honour max_num_rules by walking the lambda path to the largest
+        # lambda whose active set fits (RuleFit's rule-count control)
+        max_rules = int(p.get("max_num_rules", -1))
+        gm = glm.model
+        feat_names = list(feats.keys())
+        is_rule = np.asarray([nm.startswith("rule_") for nm in feat_names])
+        if max_rules > 0 and gm.full_path is not None:
+            # last path entry whose ACTIVE RULE set fits = best (smallest)
+            # eligible lambda (path is ordered lambda_max → lambda_min);
+            # linear terms don't count against the rule budget
+            chosen = None
+            for lam, beta in gm.full_path:
+                nnz_rules = int((np.abs(beta[:-1])[is_rule] > 1e-10).sum())
+                if nnz_rules <= max_rules:
+                    chosen = (lam, beta)
+            if chosen is not None:
+                gm.beta = chosen[1]
+                gm.lambda_best = chosen[0]
+                # metrics must describe the beta predict() will use
+                gm.training_metrics = gm._make_metrics(fr)
+
+        coefs = gm.coef()
+        for i, r in enumerate(kept):
+            r.coef = float(coefs.get(f"rule_{i}", 0.0))
+        survivors = [r for r in kept if abs(r.coef) > 1e-10]
+        # re-index survivor features and refit-free: keep the glm but rebuild
+        # the model's rule list aligned to the original feature names
+        model = RuleFitModel(self, x, y, kept, lin_cols, lin_stats, glm,
+                             domain, problem)
+        # expose only surviving rules in importance; evaluation keeps all
+        model._survivors = survivors
+        model.training_metrics = gm.training_metrics
+        if valid is not None:
+            model.validation_metrics = model._make_metrics(valid)
+        return model
+
+    def _cv_predict(self, model: RuleFitModel, frame: Frame) -> np.ndarray:
+        return model._glm._cv_predict(model._glm.model, model._features(frame))
+
+
+RuleFit = H2ORuleFitEstimator

@@ -656,7 +656,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # ---- ONE bulk D2H of the whole new forest + gains ----------------
         if packed_chunks or packed_host:
             _ph.mark("train_loop_dispatch")
-            _flush_packed()
+            # remaining device chunks: single device-side concat + ONE D2H
+            # (per-chunk sync transfers only happen on over-budget flushes)
+            if packed_chunks:
+                rest = (packed_chunks[0] if len(packed_chunks) == 1
+                        else jnp.concatenate(packed_chunks, axis=0))
+                packed_host.append(np.asarray(rest))
+                packed_chunks.clear()
             all_packed = (packed_host[0] if len(packed_host) == 1
                           else np.concatenate(packed_host, axis=0))
             _ph.mark("forest_D2H")

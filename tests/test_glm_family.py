@@ -45,6 +45,33 @@ def test_coxph_recovers_coefficients(cloud1):
     assert cox2.model.coef()["x1"] == pytest.approx(coef["x1"], abs=0.05)
 
 
+def test_coxph_start_column_and_strata(cloud1):
+    fr = _surv_data(seed=8)
+    # start=0 for everyone ⇒ identical fit to no start_column
+    z = np.zeros(fr.nrow)
+    fr["start"] = z
+    base = H2OCoxProportionalHazardsEstimator(stop_column="time")
+    base.train(x=["x1", "x2"], y="event", training_frame=fr)
+    cp = H2OCoxProportionalHazardsEstimator(stop_column="time", start_column="start")
+    cp.train(x=["x1", "x2"], y="event", training_frame=fr)
+    assert cp.model.coef()["x1"] == pytest.approx(base.model.coef()["x1"], abs=1e-5)
+    # late entry removes early-time rows from risk sets → coefficients move
+    rng = np.random.default_rng(9)
+    fr["start"] = np.minimum(rng.uniform(0, 0.05, fr.nrow),
+                             fr.vec("time").numeric_np() * 0.5)
+    cp2 = H2OCoxProportionalHazardsEstimator(stop_column="time", start_column="start")
+    cp2.train(x=["x1", "x2"], y="event", training_frame=fr)
+    assert np.isfinite(cp2.model.coef()["x1"])
+    # strata: stratified fit still recovers the signs/magnitudes
+    g = (rng.uniform(size=fr.nrow) > 0.5).astype(int)
+    fr["grp"] = np.asarray(["a", "b"], dtype=object)[g]
+    fr = fr.asfactor("grp")
+    cs = H2OCoxProportionalHazardsEstimator(stop_column="time", stratify_by=["grp"])
+    cs.train(x=["x1", "x2", "grp"], y="event", training_frame=fr)
+    assert cs.model.coef()["x1"] == pytest.approx(0.8, abs=0.25)
+    assert "grp" not in "".join(cs.model.coef().keys())
+
+
 def test_gam_beats_glm_on_nonlinear(cloud1):
     rng = np.random.default_rng(3)
     x = rng.uniform(-3, 3, 800)

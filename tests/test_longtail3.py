@@ -1,0 +1,135 @@
+"""Long-tail algos batch 3: RuleFit, PSVM, UpliftDRF, ExtendedIsolationForest.
+
+Mirrors reference pyunits `pyunit_rulefit_*`, `pyunit_psvm_*`,
+`pyunit_uplift_*`, `pyunit_extended_isolation_forest_*`."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.extended_isolation_forest import (
+    H2OExtendedIsolationForestEstimator,
+)
+from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
+from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
+from h2o3_tpu.models.uplift import H2OUpliftRandomForestEstimator, auuc
+
+
+def _binary_frame(n=800, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = ((X[:, 0] > 0.3) & (X[:, 1] < 0.5) | (X[:, 2] > 1.0)).astype(int)
+    d = {f"x{i}": X[:, i] for i in range(4)}
+    d["y"] = np.asarray(["no", "yes"], dtype=object)[y]
+    return Frame.from_dict(d, column_types={"y": "enum"})
+
+
+def test_rulefit_rules_and_predict(cloud1):
+    fr = _binary_frame()
+    rf = H2ORuleFitEstimator(max_num_rules=20, min_rule_length=2,
+                             max_rule_length=3, rule_generation_ntrees=20, seed=7)
+    rf.train(x=["x0", "x1", "x2", "x3"], y="y", training_frame=fr)
+    assert rf.model.training_metrics.auc > 0.8
+    imp = rf.model.rule_importance()
+    assert 0 < imp.nrow <= 25  # rules + linear terms, sparse
+    # rule strings mention real feature names
+    rv = imp.vec("rule")
+    rules_txt = [rv.domain[c] for c in np.asarray(rv.data)]
+    assert any("x0" in r or "x2" in r for r in rules_txt)
+    p = rf.predict(fr)
+    assert "predict" in p.names
+
+
+def test_rulefit_regression(cloud1):
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 3))
+    y = np.where(X[:, 0] > 0, 2.0, -1.0) + 0.1 * rng.normal(size=500)
+    d = {f"x{i}": X[:, i] for i in range(3)}
+    d["y"] = y
+    fr = Frame.from_dict(d)
+    rf = H2ORuleFitEstimator(model_type="rules", min_rule_length=1,
+                             max_rule_length=2, rule_generation_ntrees=10, seed=3)
+    rf.train(x=["x0", "x1", "x2"], y="y", training_frame=fr)
+    assert rf.model.training_metrics.rmse < 0.6
+
+
+def test_psvm_separable(cloud1):
+    rng = np.random.default_rng(2)
+    n = 400
+    X = rng.normal(size=(n, 2))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    fr = Frame.from_dict(
+        {"a": X[:, 0], "b": X[:, 1],
+         "y": np.asarray(["n", "p"], dtype=object)[y]},
+        column_types={"y": "enum"})
+    svm = H2OSupportVectorMachineEstimator(hyper_param=1.0, kernel_type="gaussian",
+                                           seed=5)
+    svm.train(x=["a", "b"], y="y", training_frame=fr)
+    assert svm.model.training_metrics.auc > 0.95
+    assert svm.model.svs_count > 0
+    pred = svm.predict(fr)
+    assert set(pred.names) >= {"predict", "decision_function"}
+    # nonlinear ring data needs the gaussian kernel
+    r = np.sqrt((X**2).sum(axis=1))
+    y2 = (r > 1.1).astype(int)
+    fr2 = Frame.from_dict(
+        {"a": X[:, 0], "b": X[:, 1],
+         "y": np.asarray(["in", "out"], dtype=object)[y2]},
+        column_types={"y": "enum"})
+    svm2 = H2OSupportVectorMachineEstimator(kernel_type="gaussian", gamma=1.0, seed=5)
+    svm2.train(x=["a", "b"], y="y", training_frame=fr2)
+    assert svm2.model.training_metrics.auc > 0.9
+
+
+def test_uplift_drf(cloud1):
+    rng = np.random.default_rng(3)
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    treat = rng.integers(0, 2, n)
+    # uplift only where x0>0: treated respond more
+    base = (X[:, 1] > 0.5).astype(float) * 0.2
+    lift = np.where(X[:, 0] > 0, 0.4, 0.0) * treat
+    y = (rng.uniform(size=n) < base + lift + 0.1).astype(int)
+    fr = Frame.from_dict({
+        "x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+        "treatment": np.asarray(["control", "treatment"], dtype=object)[treat],
+        "y": np.asarray(["0", "1"], dtype=object)[y],
+    }, column_types={"treatment": "enum", "y": "enum"})
+    up = H2OUpliftRandomForestEstimator(
+        treatment_column="treatment", uplift_metric="KL", ntrees=20,
+        max_depth=4, seed=11)
+    up.train(x=["x0", "x1", "x2"], y="y", training_frame=fr)
+    u = up.predict(fr).vec("uplift_predict").numeric_np()
+    # predicted uplift should be higher where true uplift exists
+    assert u[X[:, 0] > 0].mean() > u[X[:, 0] <= 0].mean() + 0.1
+    m = up.model.training_metrics
+    assert np.isfinite(m.auuc)
+    # qini auuc of the model ranking beats a random ranking
+    rand_auuc, _ = auuc(y.astype(float), treat.astype(float),
+                        rng.uniform(size=n))
+    assert m.auuc > rand_auuc
+
+
+def test_extended_isolation_forest(cloud1):
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(500, 3))
+    X[:5] += 8.0  # planted anomalies
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    eif = H2OExtendedIsolationForestEstimator(ntrees=50, sample_size=128,
+                                              extension_level=2, seed=9)
+    eif.train(x=["a", "b", "c"], training_frame=fr)
+    out = eif.predict(fr)
+    s = out.vec("anomaly_score").numeric_np()
+    assert out.vec("mean_length").numeric_np().min() >= 0
+    # planted anomalies rank in the top scores
+    top = np.argsort(-s)[:10]
+    assert len(set(top) & set(range(5))) >= 4
+    assert s.min() >= 0 and s.max() <= 1
+
+
+def test_eif_extension_level_validation(cloud1):
+    fr = Frame.from_numpy(np.random.default_rng(0).normal(size=(50, 2)),
+                          names=["a", "b"])
+    with pytest.raises(ValueError):
+        H2OExtendedIsolationForestEstimator(extension_level=5).train(
+            x=["a", "b"], training_frame=fr)

@@ -1,0 +1,55 @@
+"""Text utils: tokenize / tf_idf / grep (reference: AstTokenize, hex/tfidf,
+hex/grep)."""
+
+import numpy as np
+
+import h2o3_tpu as h2o
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+
+
+def _string_frame(rows):
+    return Frame({"text": Vec(None, "string",
+                              strings=np.asarray(rows, dtype=object))})
+
+
+def test_tokenize_sentence_separators(cloud1):
+    fr = _string_frame(["hello world", "foo bar baz", None])
+    tok = fr.tokenize(split=" ")
+    vals = list(tok.vec("C1").to_numpy())
+    # tokens in order, None after each input row
+    assert vals == ["hello", "world", None, "foo", "bar", "baz", None, None]
+
+
+def test_tokenize_regex_split(cloud1):
+    fr = _string_frame(["a,b,,c"])
+    tok = fr.tokenize(split=",")
+    vals = [v for v in tok.vec("C1").to_numpy() if v is not None]
+    assert vals == ["a", "b", "c"]
+
+
+def test_tf_idf(cloud1):
+    fr = Frame({
+        "doc": Vec.from_numpy(np.asarray([0, 1, 2], np.float64)),
+        "text": Vec(None, "string", strings=np.asarray(
+            ["cat dog cat", "dog fish", "cat"], dtype=object)),
+    })
+    out = h2o.tf_idf(fr, 0, 1)
+    toks = list(out.vec("token").to_numpy())
+    tf = out.vec("TF").numeric_np()
+    tfidf = out.vec("TF_IDF").numeric_np()
+    i = [j for j, (d, t) in enumerate(zip(out.vec("doc").numeric_np(), toks))
+         if d == 0 and t == "cat"][0]
+    assert tf[i] == 2.0
+    # 'cat' appears in 2 of 3 docs; 'fish' in 1 → fish has larger idf
+    idf = dict(zip(toks, out.vec("IDF").numeric_np()))
+    assert idf["fish"] > idf["cat"]
+    assert np.allclose(tfidf, tf * out.vec("IDF").numeric_np())
+
+
+def test_grep(cloud1):
+    fr = _string_frame(["error: disk full", "ok", "error: timeout", None])
+    hits = h2o.grep(fr, r"error:")
+    assert list(hits.vec("row").numeric_np()) == [0.0, 2.0]
+    inv = h2o.grep(fr, r"error:", invert=True)
+    assert list(inv.vec("row").numeric_np()) == [1.0, 3.0]
