@@ -34,8 +34,8 @@ from .parallel import mesh as _mesh
 
 __version__ = "0.1.0"
 
-_frames = {}  # the user-visible corner of the DKV (water/DKV.java)
-_models = {}
+from .runtime.dkv import DKV as _DKV  # the keyed store (water/DKV.java)
+from .runtime.log import Log as _Log
 
 
 def init(url=None, ip=None, port=None, nthreads=-1, max_mem_size=None,
@@ -63,8 +63,7 @@ def connect(**kw):
 
 def shutdown(prompt=False):
     _mesh.reset()
-    _frames.clear()
-    _models.clear()
+    _DKV.clear()
 
 
 def import_file(path: str, destination_frame=None, header=0, sep=None,
@@ -78,7 +77,8 @@ def import_file(path: str, destination_frame=None, header=0, sep=None,
     )
     if destination_frame:
         fr.key = destination_frame
-    _frames[fr.key] = fr
+    _DKV.put(fr.key, fr)
+    _Log.info(f"imported {path} -> {fr.key} ({fr.nrow}x{fr.ncol})")
     return fr
 
 
@@ -92,17 +92,22 @@ def H2OFrame_from_python(data, column_types=None) -> Frame:
 
 
 def get_frame(key: str) -> Frame:
-    return _frames[key]
+    fr = _DKV.get(key)
+    if not isinstance(fr, Frame):
+        raise KeyError(key)
+    return fr
 
 
 def remove(obj) -> None:
-    key = obj if isinstance(obj, str) else getattr(obj, "key", None)
-    _frames.pop(key, None)
-    _models.pop(key, None)
+    if isinstance(obj, str):
+        key = obj
+    else:  # frames carry .key; models are keyed by model_id
+        key = getattr(obj, "key", None) or getattr(obj, "model_id", None)
+    _DKV.remove(key)
 
 
 def ls():
-    return list(_frames) + list(_models)
+    return _DKV.keys()
 
 
 def merge(x: Frame, y: Frame, all_x: bool = False, all_y: bool = False,

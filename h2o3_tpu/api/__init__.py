@@ -1,0 +1,11 @@
+"""REST API layer — the versioned `/3` endpoint surface.
+
+Reference parity: `h2o-core/src/main/java/water/api/` (`RequestServer.java`
+route table, `Handler.java`, `schemas3/**`) served by the pluggable Jetty
+stack (`h2o-webserver-iface/`, `h2o-jetty-9/`). Here the clients are
+in-process Python by default (zero-copy, no REST hop); this HTTP facade
+exists for remote clients, Flow-style tooling, and parity with the
+reference's wire surface.
+"""
+
+from .server import H2OApiServer, start_server  # noqa: F401

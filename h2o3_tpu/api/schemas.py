@@ -1,0 +1,57 @@
+"""Schema registry — parameter metadata for every model builder.
+
+Reference parity: `water/api/Schema.java` + `water/api/schemas3/*.java` and
+the `/3/Metadata/schemas` endpoint that `h2o-bindings/bin/gen_python.py`
+consumes to generate the client estimators. Here the single source of truth
+is each estimator's `_param_defaults` (no codegen — SURVEY.md §2.6), and this
+module renders the same metadata shape over REST.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+
+def _algo_registry() -> Dict[str, Type]:
+    from .. import estimators as est
+
+    reg = {}
+    for name in est.__all__:
+        cls = getattr(est, name)
+        reg[cls.algo] = cls
+    return reg
+
+
+_registry_cache: Optional[Dict[str, Type]] = None
+
+
+def algo_registry() -> Dict[str, Type]:
+    global _registry_cache
+    if _registry_cache is None:
+        _registry_cache = _algo_registry()
+    return _registry_cache
+
+
+def _field_schema(name: str, default) -> Dict:
+    t = type(default).__name__ if default is not None else "any"
+    return dict(name=name, type=t, default_value=default, required=False)
+
+
+def schema_for(algo: str) -> Dict:
+    cls = algo_registry().get(algo)
+    if cls is None:
+        raise KeyError(algo)
+    fields = [
+        _field_schema(k, v)
+        for k, v in {**cls._common_defaults, **cls._param_defaults}.items()
+    ]
+    return dict(
+        algo=algo,
+        name=f"{cls.__name__}V3",
+        supervised=cls.supervised,
+        parameters=fields,
+    )
+
+
+def all_schemas() -> List[Dict]:
+    return [schema_for(a) for a in sorted(algo_registry())]

@@ -1,0 +1,391 @@
+"""RequestServer — the versioned JSON-over-HTTP route table.
+
+Reference parity: `h2o-core/src/main/java/water/api/RequestServer.java`
+(route registration, versioned paths), `ModelBuilderHandler.java` (train via
+`POST /3/ModelBuilders/{algo}`), `FramesHandler`/`ModelsHandler`/
+`JobsHandler`/`PredictionsHandler`/`LogsHandler`/`ProfilerHandler`, plus
+`/99/Rapids` (`water/rapids/Rapids.java`). Jetty is replaced by the stdlib
+ThreadingHTTPServer — the webserver-iface indirection exists so the server
+can be swapped, same as `h2o-webserver-iface/`.
+
+Training runs on a worker thread under a `Job` so `/3/Jobs/{id}` polling
+behaves like the reference's async job keys.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.rapids_expr import RapidsSession
+from ..models.model_base import H2OModel, Job
+from ..runtime.dkv import DKV
+from ..runtime.log import Log
+from ..runtime.timeline import Timeline
+from . import schemas
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        v = float(o)
+        return v if np.isfinite(v) else None
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, float) and not np.isfinite(o):
+        return None
+    return str(o)
+
+
+def _frame_summary(fr: Frame, rows: int = 10) -> Dict:
+    cols = []
+    for n in fr.names:
+        v = fr.vec(n)
+        c = dict(label=n, type=v.type)
+        if v.type in ("real", "int", "time"):
+            c.update(mean=v.mean(), min=v.min(), max=v.max(), nacnt=v.nacnt())
+        elif v.type == "enum":
+            c.update(domain=v.domain, nacnt=v.nacnt())
+        head = v.to_numpy()[:rows]
+        c["data"] = [None if (isinstance(x, float) and np.isnan(x)) else x
+                     for x in head.tolist()]
+        cols.append(c)
+    return dict(frame_id=dict(name=fr.key), rows=fr.nrow,
+                num_columns=fr.ncol, columns=cols)
+
+
+def _model_json(m: H2OModel) -> Dict:
+    out = dict(
+        model_id=dict(name=m.model_id),
+        algo=m.algo,
+        parameters=[dict(name=k, actual_value=v)
+                    for k, v in m.parms.actual_params.items()
+                    if not k.startswith("_")],
+        output=dict(
+            training_metrics=m.training_metrics._ser() if m.training_metrics else None,
+            validation_metrics=m.validation_metrics._ser() if m.validation_metrics else None,
+            cross_validation_metrics=(m.cross_validation_metrics._ser()
+                                      if m.cross_validation_metrics else None),
+            scoring_history=m.scoring_history,
+            variable_importances=m.varimp_table,
+            run_time=m.run_time,
+        ),
+    )
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "h2o3tpu"
+    protocol_version = "HTTP/1.1"
+
+    # route table (method, regex) → handler name — RequestServer.register
+    ROUTES = [
+        ("GET", r"^/3/Cloud/?$", "cloud"),
+        ("GET", r"^/3/About$", "about"),
+        ("POST", r"^/3/ImportFiles$", "import_files"),
+        ("POST", r"^/3/ParseSetup$", "parse_setup"),
+        ("POST", r"^/3/Parse$", "parse"),
+        ("GET", r"^/3/Frames$", "frames_list"),
+        ("GET", r"^/3/Frames/([^/]+)/summary$", "frame_summary"),
+        ("GET", r"^/3/Frames/([^/]+)$", "frame_get"),
+        ("DELETE", r"^/3/Frames/([^/]+)$", "frame_delete"),
+        ("POST", r"^/3/ModelBuilders/([^/]+)$", "train"),
+        ("GET", r"^/3/ModelBuilders/([^/]+)$", "builder_schema"),
+        ("GET", r"^/3/Models$", "models_list"),
+        ("GET", r"^/3/Models/([^/]+)$", "model_get"),
+        ("DELETE", r"^/3/Models/([^/]+)$", "model_delete"),
+        ("POST", r"^/3/Predictions/models/([^/]+)/frames/([^/]+)$", "predict"),
+        ("GET", r"^/3/Jobs$", "jobs_list"),
+        ("GET", r"^/3/Jobs/([^/]+)$", "job_get"),
+        ("POST", r"^/99/Rapids$", "rapids"),
+        ("GET", r"^/3/Logs(?:/download)?$", "logs"),
+        ("GET", r"^/3/Timeline$", "timeline"),
+        ("GET", r"^/3/Profiler$", "profiler"),
+        ("GET", r"^/3/Metadata/schemas$", "metadata_schemas"),
+    ]
+
+    def log_message(self, fmt, *args):  # route access logs into our Log
+        Log.debug("REST " + fmt % args)
+
+    # -- plumbing ------------------------------------------------------------
+    def _send(self, obj, status: int = 200):
+        body = json.dumps(obj, default=_json_default).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _params(self) -> Dict[str, str]:
+        q = urllib.parse.urlparse(self.path).query
+        out = {k: v[0] for k, v in urllib.parse.parse_qs(q).items()}
+        ln = int(self.headers.get("Content-Length") or 0)
+        if ln:
+            raw = self.rfile.read(ln).decode()
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                out.update(json.loads(raw))
+            else:
+                out.update({k: v[0] for k, v in urllib.parse.parse_qs(raw).items()})
+        return out
+
+    def _dispatch(self, method: str):
+        path = urllib.parse.urlparse(self.path).path
+        for m, pat, name in self.ROUTES:
+            if m != method:
+                continue
+            g = re.match(pat, path)
+            if g:
+                try:
+                    Timeline.record("rest", f"{method} {path}")
+                    getattr(self, "h_" + name)(*[urllib.parse.unquote(x) for x in g.groups()])
+                except KeyError as e:
+                    self._send(dict(__meta=dict(schema_type="H2OError"),
+                                    msg=f"not found: {e}"), 404)
+                except Exception as e:  # H2OErrorV3
+                    Log.err(f"REST {path}: {e}")
+                    self._send(dict(__meta=dict(schema_type="H2OError"),
+                                    msg=str(e), exception_type=type(e).__name__), 400)
+                return
+        self._send(dict(msg=f"no route for {method} {path}"), 404)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # -- handlers ------------------------------------------------------------
+    def h_cloud(self):
+        import h2o3_tpu
+        from ..parallel import mesh
+
+        try:
+            c = mesh.cloud()
+            size, healthy = c.size, True
+        except Exception:
+            size, healthy = 0, False
+        self._send(dict(version=h2o3_tpu.__version__, cloud_name="h2o3_tpu",
+                        cloud_size=size, cloud_healthy=healthy,
+                        consensus=True, locked=True))
+
+    def h_about(self):
+        import h2o3_tpu
+
+        self._send(dict(entries=[dict(name="Build project version",
+                                      value=h2o3_tpu.__version__)]))
+
+    def h_import_files(self):
+        import h2o3_tpu as h2o
+
+        p = self._params()
+        fr = h2o.import_file(p["path"])
+        DKV.put(fr.key, fr)
+        self._send(dict(destination_frames=[fr.key], fails=[], dels=[]))
+
+    def h_parse_setup(self):
+        p = self._params()
+        paths = p.get("source_frames") or [p.get("path")]
+        if isinstance(paths, str):
+            paths = json.loads(paths) if paths.startswith("[") else [paths]
+        from ..frame.parse import import_file
+
+        fr = import_file(paths[0].strip('"'))
+        self._send(dict(
+            source_frames=paths,
+            number_columns=fr.ncol,
+            column_names=fr.names,
+            column_types=[fr.vec(n).type for n in fr.names],
+            separator=44,
+        ))
+
+    def h_parse(self):
+        import h2o3_tpu as h2o
+
+        p = self._params()
+        paths = p.get("source_frames")
+        if isinstance(paths, str):
+            paths = json.loads(paths) if paths.startswith("[") else [paths]
+        fr = h2o.import_file(paths[0].strip('"'))
+        dest = p.get("destination_frame")
+        if dest:
+            fr.key = dest
+        DKV.put(fr.key, fr)
+        self._send(dict(job=dict(status="DONE", dest=dict(name=fr.key)),
+                        destination_frame=dict(name=fr.key)))
+
+    def h_frames_list(self):
+        frames = [DKV.get(k) for k in DKV.keys(Frame)]
+        self._send(dict(frames=[dict(frame_id=dict(name=f.key), rows=f.nrow,
+                                     columns=f.ncol) for f in frames]))
+
+    def h_frame_get(self, key):
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise KeyError(key)
+        self._send(dict(frames=[_frame_summary(fr)]))
+
+    h_frame_summary = h_frame_get
+
+    def h_frame_delete(self, key):
+        DKV.remove(key)
+        self._send(dict())
+
+    def h_builder_schema(self, algo):
+        self._send(schemas.schema_for(algo))
+
+    def h_train(self, algo):
+        reg = schemas.algo_registry()
+        if algo not in reg:
+            raise KeyError(algo)
+        p = self._params()
+        train_key = p.pop("training_frame", None)
+        valid_key = p.pop("validation_frame", None)
+        y = p.pop("response_column", p.pop("y", None))
+        x = p.pop("x", None)
+        ignored = p.pop("ignored_columns", None)
+        train = DKV.get(train_key) if train_key else None
+        if train is None:
+            raise ValueError(f"training_frame {train_key!r} not in DKV")
+        valid = DKV.get(valid_key) if valid_key else None
+        if isinstance(x, str):
+            x = json.loads(x)
+        if isinstance(ignored, str):
+            ignored = json.loads(ignored)
+        cls = reg[algo]
+        known = {**cls._common_defaults, **cls._param_defaults}
+        kwargs = {}
+        for k, v in p.items():
+            if k in known:
+                if isinstance(v, str):
+                    try:
+                        v = json.loads(v)
+                    except (ValueError, TypeError):
+                        pass
+                kwargs[k] = v
+        if ignored:
+            kwargs["ignored_columns"] = ignored
+        est = cls(**kwargs)
+        job = Job(dest=f"{algo}_rest", description=f"{algo} train").start()
+        DKV.put(job.dest, job)
+
+        def run():
+            try:
+                est.train(x=x, y=y, training_frame=train, validation_frame=valid)
+                m = est.model
+                DKV.put(m.model_id, m)
+                job.dest = m.model_id
+                job.done()
+            except Exception as e:
+                Log.err(f"train {algo}: {e}")
+                job.status = "FAILED"
+                job.warnings.append(str(e))
+
+        threading.Thread(target=run, daemon=True).start()
+        self._send(dict(job=dict(key=dict(name=job.dest), status=job.status)))
+
+    def h_models_list(self):
+        models = [DKV.get(k) for k in DKV.keys(H2OModel)]
+        self._send(dict(models=[_model_json(m) for m in models]))
+
+    def h_model_get(self, key):
+        m = DKV.get(key)
+        if not isinstance(m, H2OModel):
+            raise KeyError(key)
+        self._send(dict(models=[_model_json(m)]))
+
+    def h_model_delete(self, key):
+        DKV.remove(key)
+        self._send(dict())
+
+    def h_predict(self, model_key, frame_key):
+        m = DKV.get(model_key)
+        fr = DKV.get(frame_key)
+        if not isinstance(m, H2OModel):
+            raise KeyError(model_key)
+        if not isinstance(fr, Frame):
+            raise KeyError(frame_key)
+        pred = m.predict(fr)
+        pred.key = f"prediction_{model_key}_{frame_key}"
+        DKV.put(pred.key, pred)
+        self._send(dict(predictions_frame=dict(name=pred.key)))
+
+    def h_jobs_list(self):
+        jobs = [DKV.get(k) for k in DKV.keys(Job)]
+        self._send(dict(jobs=[dict(key=dict(name=j.dest), status=j.status,
+                                   progress=j.progress) for j in jobs]))
+
+    def h_job_get(self, key):
+        j = DKV.get(key)
+        if not isinstance(j, Job):
+            raise KeyError(key)
+        self._send(dict(jobs=[dict(key=dict(name=j.dest), status=j.status,
+                                   progress=j.progress,
+                                   warnings=j.warnings)]))
+
+    def h_rapids(self):
+        p = self._params()
+        sess = RapidsSession(DKV)
+        res = sess.execute(p["ast"])
+        if isinstance(res, Frame):
+            if not getattr(res, "key", None):
+                res.key = f"rapids_{id(res)}"
+            DKV.put(res.key, res)
+            self._send(dict(key=dict(name=res.key),
+                            **_frame_summary(res)))
+        elif isinstance(res, (int, float)):
+            self._send(dict(scalar=res))
+        else:
+            self._send(dict(string=str(res) if res is not None else None))
+
+    def h_logs(self):
+        self._send(dict(logs=Log.get_logs()))
+
+    def h_timeline(self):
+        self._send(dict(events=Timeline.snapshot()))
+
+    def h_profiler(self):
+        from ..runtime import profiler
+
+        self._send(dict(nodes=[dict(node="local",
+                                    entries=profiler.profile(nsamples=2,
+                                                             interval=0.01))]))
+
+    def h_metadata_schemas(self):
+        self._send(dict(schemas=schemas.all_schemas()))
+
+
+class H2OApiServer:
+    """webserver-iface: owns the listening socket + handler thread."""
+
+    def __init__(self, port: int = 54321, host: str = "127.0.0.1"):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "H2OApiServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="h2o3tpu-rest")
+        self._thread.start()
+        Log.info(f"REST server on http://{self.host}:{self.port}/3/")
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_server(port: int = 0, host: str = "127.0.0.1") -> H2OApiServer:
+    return H2OApiServer(port=port, host=host).start()

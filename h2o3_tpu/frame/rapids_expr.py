@@ -1,0 +1,207 @@
+"""Rapids expression interpreter — the Lisp strings `/99/Rapids` accepts.
+
+Reference parity: `h2o-core/src/main/java/water/rapids/Rapids.java` (the
+recursive-descent sexpr parser) + `water/rapids/ast/prims/**` (the prim
+table). The h2o-py client compiles every Frame operation into one of these
+strings; this module implements the subset the Python surface emits most:
+arithmetic/comparison binops, slicing (`cols`/`rows`), `cbind`/`rbind`,
+reducers (`mean`/`sum`/`sd`/`min`/`max`), `quantile`, `table`, `merge`,
+`asfactor`/`as.numeric`, `ifelse`, `unique`, `assign`/`tmp` naming.
+
+Number/string/list literals follow the reference grammar: `[1 2 3]` numeric
+list, `["a" "b"]` string list, `(op arg …)` application, bare tokens are
+DKV keys or prim names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from . import rapids as rapids_ops
+from .frame import Frame
+from .vec import Vec
+
+
+# -- tokenizer / parser ------------------------------------------------------
+def _tokenize(s: str) -> List[str]:
+    out, i, n = [], 0, len(s)
+    while i < n:
+        c = s[i]
+        if c.isspace():
+            i += 1
+        elif c in "()[]":
+            out.append(c)
+            i += 1
+        elif c in "\"'":
+            j = i + 1
+            while j < n and s[j] != c:
+                j += 2 if s[j] == "\\" else 1
+            out.append(s[i : j + 1])
+            i = j + 1
+        else:
+            j = i
+            while j < n and not s[j].isspace() and s[j] not in "()[]":
+                j += 1
+            out.append(s[i:j])
+            i = j
+    return out
+
+
+def _parse(tokens: List[str], pos: int = 0):
+    t = tokens[pos]
+    if t == "(":
+        items = []
+        pos += 1
+        while tokens[pos] != ")":
+            node, pos = _parse(tokens, pos)
+            items.append(node)
+        return ("call", items), pos + 1
+    if t == "[":
+        items = []
+        pos += 1
+        while tokens[pos] != "]":
+            node, pos = _parse(tokens, pos)
+            items.append(node)
+        return ("list", items), pos + 1
+    if t and t[0] in "\"'":
+        return ("str", t[1:-1]), pos + 1
+    try:
+        return ("num", float(t)), pos + 1
+    except ValueError:
+        return ("sym", t), pos + 1
+
+
+class RapidsSession:
+    """`water.rapids.Session` — holds temp frames across expressions."""
+
+    def __init__(self, dkv=None):
+        if dkv is None:
+            from ..runtime.dkv import DKV as dkv
+        self.dkv = dkv
+
+    # -- evaluation ----------------------------------------------------------
+    def execute(self, expr: str):
+        ast, pos = _parse(_tokenize(expr))
+        return self._eval(ast)
+
+    def _eval(self, node) -> Any:
+        kind, val = node
+        if kind == "num":
+            return val
+        if kind == "str":
+            return val
+        if kind == "list":
+            return [self._eval(v) for v in val]
+        if kind == "sym":
+            obj = self.dkv.get(val)
+            if obj is not None:
+                return obj
+            return val  # prim name or bare symbol
+        # call
+        op = val[0][1] if val[0][0] == "sym" else self._eval(val[0])
+        args = [self._eval(a) for a in val[1:]]
+        return self._apply(op, args)
+
+    # -- prims ---------------------------------------------------------------
+    def _apply(self, op: str, a: List[Any]):
+        import operator
+
+        binops = {
+            "+": operator.add, "-": operator.sub, "*": operator.mul,
+            "/": operator.truediv, ">": operator.gt, "<": operator.lt,
+            ">=": operator.ge, "<=": operator.le, "==": operator.eq,
+            "!=": operator.ne,
+        }
+        if op in binops:
+            x, y = a
+            if isinstance(x, Frame) or isinstance(y, Frame):
+                return binops[op](x, y) if isinstance(x, Frame) else binops[op](y, x)
+            return binops[op](x, y)
+        if op in ("assign", "tmp="):
+            key, value = a
+            if isinstance(value, Frame):
+                value.key = str(key)
+            self.dkv.put(str(key), value)
+            return value
+        if op == "rm":
+            self.dkv.remove(str(a[0]))
+            return None
+        if op == "cols":
+            fr, sel = a
+            names = (
+                [fr.names[int(i)] for i in sel]
+                if all(isinstance(i, float) for i in sel)
+                else [str(s) for s in sel]
+            ) if isinstance(sel, list) else (
+                [fr.names[int(sel)]] if isinstance(sel, float) else [str(sel)]
+            )
+            return fr[names]
+        if op == "rows":
+            fr, sel = a
+            if isinstance(sel, Frame):  # boolean mask frame
+                mask = sel._col0().astype(bool)
+                return fr.take(np.nonzero(mask)[0])
+            idx = np.asarray([int(i) for i in (sel if isinstance(sel, list) else [sel])])
+            return fr.take(idx)
+        if op == "cbind":
+            out = a[0]
+            for fr in a[1:]:
+                out = out.cbind(fr)
+            return out
+        if op == "rbind":
+            out = a[0]
+            for fr in a[1:]:
+                out = out.rbind(fr)
+            return out
+        if op in ("mean", "sum", "sd", "min", "max", "median"):
+            fr = a[0]
+            col = fr._col0() if isinstance(fr, Frame) else np.asarray(fr)
+            fn = {"mean": np.nanmean, "sum": np.nansum, "sd": lambda c: np.nanstd(c, ddof=1),
+                  "min": np.nanmin, "max": np.nanmax, "median": np.nanmedian}[op]
+            return float(fn(col))
+        if op == "quantile":
+            fr, probs = a[0], a[1]
+            return rapids_ops.quantile(fr, [float(p) for p in probs])
+        if op == "table":
+            return rapids_ops.table(a[0])
+        if op == "merge":
+            left, right = a[0], a[1]
+            all_x = bool(a[2]) if len(a) > 2 else False
+            all_y = bool(a[3]) if len(a) > 3 else False
+            return rapids_ops.merge(left, right, all_x=all_x, all_y=all_y)
+        if op == "as.factor":
+            return a[0].asfactor()
+        if op == "as.numeric":
+            fr = a[0]
+            v = fr.vecs()[0]
+            return Frame({fr.names[0]: Vec(v.numeric_np(), "real")})
+        if op == "unique":
+            fr = a[0]
+            v = fr.vecs()[0]
+            if v.type == "enum":
+                vals = sorted(set(np.asarray(v.data)[np.asarray(v.data) >= 0]))
+                dom = v.domain
+                return Frame.from_dict(
+                    {fr.names[0]: np.asarray([dom[i] for i in vals], dtype=object)},
+                    column_types={fr.names[0]: "enum"})
+            u = np.unique(v.numeric_np())
+            return Frame.from_dict({fr.names[0]: u[~np.isnan(u)]})
+        if op == "ifelse":
+            cond, yes, no = a
+            c = cond._col0().astype(bool) if isinstance(cond, Frame) else np.asarray(cond, bool)
+            yv = yes._col0() if isinstance(yes, Frame) else yes
+            nv = no._col0() if isinstance(no, Frame) else no
+            return Frame.from_dict({"ifelse": np.where(c, yv, nv)})
+        if op == "nrow":
+            return float(a[0].nrow)
+        if op == "ncol":
+            return float(a[0].ncol)
+        if op == "colnames=":
+            fr, _idx, names = a
+            new = [str(n) for n in names]
+            return Frame(dict(zip(new, fr.vecs())))
+        if op == "tokenize":
+            return a[0].tokenize(str(a[1]))
+        raise ValueError(f"Rapids: unknown op {op!r}")

@@ -138,6 +138,8 @@ class SharedTreeModel(H2OModel):
 
     def __init__(self, params, x, y, bm: BinnedMatrix, problem, nclass, domain,
                  distribution, f0, forest, max_depth, mode="gbm"):
+        # report the concrete builder's algo (gbm/drf/...), not the shared base
+        self.algo = getattr(params, "algo", self.algo)
         super().__init__(params)
         self.x = list(x)
         self.y = y

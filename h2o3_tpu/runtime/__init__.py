@@ -1,0 +1,11 @@
+"""Runtime services — logging, timeline, profiler, persistence, DKV.
+
+The host-side control plane of the platform (SURVEY.md §5): the data plane is
+compiled XLA programs; these modules are the observability and bookkeeping
+that `water/util/Log.java`, `water/TimeLine.java`, `water/api/ProfilerHandler`,
+`water/persist/Persist.java` and `water/DKV.java` provide in the reference.
+"""
+
+from .dkv import DKV  # noqa: F401
+from .log import Log  # noqa: F401
+from .timeline import Timeline  # noqa: F401

@@ -1,0 +1,46 @@
+"""DKV — the keyed object store behind `h2o.ls`/frames/models.
+
+Reference parity: `h2o-core/src/main/java/water/DKV.java` — a distributed
+`Key→Value` hash with home-node placement. In the TPU rebuild there is one
+controller process per job (JAX single-controller model); the *data* lives in
+HBM as sharded arrays, so the KV store only holds host-side handles (Frame
+and Model objects) — a plain dict with a lock, not a distributed hash. The
+key namespace and lifecycle (`put/get/remove`, leak checks in tests) match.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class DKV:
+    _store: Dict[str, object] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def put(cls, key: str, value) -> None:
+        with cls._lock:
+            cls._store[key] = value
+
+    @classmethod
+    def get(cls, key: str):
+        with cls._lock:
+            return cls._store.get(key)
+
+    @classmethod
+    def remove(cls, key: str) -> None:
+        with cls._lock:
+            cls._store.pop(key, None)
+
+    @classmethod
+    def keys(cls, kind: Optional[type] = None) -> List[str]:
+        with cls._lock:
+            if kind is None:
+                return list(cls._store)
+            return [k for k, v in cls._store.items() if isinstance(v, kind)]
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._store.clear()

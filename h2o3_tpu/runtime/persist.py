@@ -1,0 +1,75 @@
+"""Persist SPI — pluggable storage backends for import/export.
+
+Reference parity: `h2o-core/src/main/java/water/persist/Persist.java` with
+`PersistNFS`/`PersistFS` in-tree and `h2o-persist-{s3,hdfs,gcs,http}`
+extension modules. Scheme-dispatched; local file is fully supported, cloud
+schemes are registered stubs that raise with the reference's module name so
+the surface (and error text) matches even in this network-less build.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable, Dict, List
+
+
+class Persist:
+    """file:// + bare paths — PersistNFS/PersistFS."""
+
+    scheme = "file"
+
+    def open(self, uri: str, mode: str = "rb"):
+        return open(self._strip(uri), mode)
+
+    def exists(self, uri: str) -> bool:
+        return os.path.exists(self._strip(uri))
+
+    def list(self, uri: str) -> List[str]:
+        p = self._strip(uri)
+        if os.path.isdir(p):
+            return sorted(os.path.join(p, f) for f in os.listdir(p))
+        return sorted(_glob.glob(p))
+
+    def size(self, uri: str) -> int:
+        return os.path.getsize(self._strip(uri))
+
+    @staticmethod
+    def _strip(uri: str) -> str:
+        return uri[len("file://"):] if uri.startswith("file://") else uri
+
+
+class _StubPersist(Persist):
+    def __init__(self, scheme: str, module: str):
+        self.scheme = scheme
+        self._module = module
+
+    def open(self, uri: str, mode: str = "rb"):
+        raise NotImplementedError(
+            f"{self.scheme}:// requires the {self._module} persistence "
+            f"backend (not available in this build)"
+        )
+
+    exists = list = size = open  # type: ignore[assignment]
+
+
+_REGISTRY: Dict[str, Persist] = {
+    "file": Persist(),
+    "s3": _StubPersist("s3", "h2o-persist-s3"),
+    "s3a": _StubPersist("s3a", "h2o-persist-s3"),
+    "hdfs": _StubPersist("hdfs", "h2o-persist-hdfs"),
+    "gs": _StubPersist("gs", "h2o-persist-gcs"),
+    "http": _StubPersist("http", "h2o-persist-http"),
+    "https": _StubPersist("https", "h2o-persist-http"),
+}
+
+
+def register(scheme: str, backend: Persist) -> None:
+    _REGISTRY[scheme] = backend
+
+
+def for_uri(uri: str) -> Persist:
+    scheme = uri.split("://", 1)[0] if "://" in uri else "file"
+    if scheme not in _REGISTRY:
+        raise ValueError(f"no persistence backend for scheme {scheme!r}")
+    return _REGISTRY[scheme]

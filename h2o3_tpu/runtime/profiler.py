@@ -1,0 +1,52 @@
+"""Profiler — stack sampling + XLA trace capture.
+
+Reference parity: `/3/Profiler` (`water/api/ProfilerHandler.java` +
+`water/util/JProfile.java`) collects stack-trace samples from every node —
+here `stack_samples()` snapshots all Python threads of this process (one
+process per TPU host). `trace()` wraps `jax.profiler` (perfetto/tensorboard
+capture) — strictly stronger than the reference's sampler for device time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import traceback
+from collections import Counter
+from typing import Dict, List
+
+
+def stack_samples(depth: int = 20) -> List[Dict]:
+    """One stack snapshot per live thread (the JProfile node sample)."""
+    out = []
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        stack = traceback.format_stack(frame)[-depth:]
+        out.append(dict(thread=names.get(tid, str(tid)), stack=stack))
+    return out
+
+
+def profile(nsamples: int = 10, interval: float = 0.02, depth: int = 10) -> List[Dict]:
+    """Repeated sampling aggregated by stack — the /3/Profiler table."""
+    import time
+
+    counts: Counter = Counter()
+    for _ in range(nsamples):
+        for s in stack_samples(depth):
+            counts["".join(s["stack"])] += 1
+        time.sleep(interval)
+    return [dict(stack=k, count=v) for k, v in counts.most_common()]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """`with profiler.trace('/tmp/tb'):` — device + host trace via
+    jax.profiler (viewable in tensorboard/perfetto)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,38 @@
+"""Timeline — lock-free-ish event ring for distributed debugging.
+
+Reference parity: `h2o-core/src/main/java/water/TimeLine.java` — a ring of
+64-byte records (timestamp, peer, task) for every packet send/recv, dumped
+cluster-wide via `/3/Timeline` (`water/util/TimelineSnapshot.java` merges the
+per-node rings). Here the interesting events are compiles, device transfers,
+collective launches and training milestones; one ring per process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List
+
+
+class Timeline:
+    _ring: deque = deque(maxlen=4096)
+    _lock = threading.Lock()
+
+    @classmethod
+    def record(cls, kind: str, detail: str = "", **extra):
+        ev = dict(ts=time.time(), kind=kind, detail=detail)
+        if extra:
+            ev.update(extra)
+        with cls._lock:
+            cls._ring.append(ev)
+
+    @classmethod
+    def snapshot(cls, n: int = 1000) -> List[Dict]:
+        with cls._lock:
+            return list(cls._ring)[-n:]
+
+    @classmethod
+    def clear(cls):
+        with cls._lock:
+            cls._ring.clear()

@@ -1,0 +1,74 @@
+"""Runtime aux subsystems: Log, Timeline, DKV, Persist, profiler
+(reference: water/util/Log, water/TimeLine, water/DKV, water/persist,
+water/api/ProfilerHandler)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.runtime import profiler
+from h2o3_tpu.runtime.dkv import DKV
+from h2o3_tpu.runtime.log import Log
+from h2o3_tpu.runtime.persist import for_uri
+from h2o3_tpu.runtime.timeline import Timeline
+
+
+def test_log_ring_and_levels(tmp_path):
+    Log.clear()
+    Log.set_log_dir(str(tmp_path))
+    Log.info("hello world")
+    Log.warn("watch out")
+    Log.debug("dropped at INFO level")
+    lines = Log.get_logs()
+    assert any("hello world" in l and "INFO" in l for l in lines)
+    assert any("watch out" in l and "WARN" in l for l in lines)
+    assert not any("dropped at INFO" in l for l in lines)
+    # file sink received the same lines
+    files = list(tmp_path.glob("h2o3tpu_*.log"))
+    assert files and "hello world" in files[0].read_text()
+    Log.set_log_dir(None)
+    with pytest.raises(ValueError):
+        Log.set_level("NOPE")
+
+
+def test_timeline_ring():
+    Timeline.clear()
+    for i in range(5):
+        Timeline.record("compile", f"program_{i}", dur=i)
+    evs = Timeline.snapshot()
+    assert len(evs) == 5
+    assert evs[-1]["detail"] == "program_4"
+    assert evs[0]["ts"] <= evs[-1]["ts"]
+
+
+def test_dkv_lifecycle():
+    DKV.put("k1", Frame.from_dict({"a": np.arange(3.0)}))
+    assert isinstance(DKV.get("k1"), Frame)
+    assert "k1" in DKV.keys(Frame)
+    DKV.remove("k1")
+    assert DKV.get("k1") is None
+
+
+def test_persist_spi(tmp_path):
+    f = tmp_path / "x.csv"
+    f.write_text("a,b\n1,2\n")
+    p = for_uri(str(f))
+    assert p.exists(str(f))
+    assert p.size(str(f)) > 0
+    with p.open(f"file://{f}") as fh:
+        assert fh.read().startswith(b"a,b")
+    # glob listing
+    assert p.list(str(tmp_path / "*.csv")) == [str(f)]
+    # cloud schemes are present but stubbed
+    s3 = for_uri("s3://bucket/key")
+    with pytest.raises(NotImplementedError):
+        s3.open("s3://bucket/key")
+    with pytest.raises(ValueError):
+        for_uri("weird://x")
+
+
+def test_profiler_samples():
+    samples = profiler.stack_samples()
+    assert any("MainThread" in s["thread"] for s in samples)
+    prof = profiler.profile(nsamples=2, interval=0.0)
+    assert prof and all(p["count"] >= 1 for p in prof)
