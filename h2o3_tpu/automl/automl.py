@@ -163,6 +163,62 @@ class H2OAutoML:
             hidden=[32, 32, 32], epochs=10, mini_batch_size=128)
         return steps
 
+    def _build_model(self, name, cls, parms, x, y, training_frame) -> bool:
+        """Build one leaderboard model (shared by default steps and grids)."""
+        parms = dict(parms)
+        parms["seed"] = self.seed
+        parms["nfolds"] = self.nfolds
+        parms["keep_cross_validation_predictions"] = True
+        if self.max_runtime_secs_per_model:
+            parms["max_runtime_secs"] = self.max_runtime_secs_per_model
+        try:
+            est = cls(**parms)
+            est.train(x=x, y=y, training_frame=training_frame)
+            est._automl_name = name
+            self._models.append(est)
+            self.leaderboard.add(est)
+            self.event_log.log("model", f"built {name} ({est.model_id})")
+            return True
+        except Exception as e:
+            self.event_log.log("error", f"{name} failed: {e}")
+            return False
+
+    def _run_random_grids(self, x, y, training_frame, budget_left):
+        import itertools
+
+        from ..models.deeplearning import H2ODeepLearningEstimator
+        from ..models.gbm import H2OGradientBoostingEstimator
+        from ..models.xgboost import H2OXGBoostEstimator
+
+        rng = np.random.default_rng(self.seed)
+        grids = [
+            ("GBM", H2OGradientBoostingEstimator, dict(
+                max_depth=[3, 5, 7, 9], learn_rate=[0.05, 0.1, 0.2],
+                sample_rate=[0.6, 0.8, 1.0], col_sample_rate=[0.4, 0.7, 1.0],
+                ntrees=[60])),
+            ("XGBOOST", H2OXGBoostEstimator, dict(
+                max_depth=[5, 10, 15], learn_rate=[0.05, 0.1, 0.3],
+                sample_rate=[0.6, 0.8, 1.0], reg_lambda=[0.1, 1.0, 10.0],
+                ntrees=[50])),
+            ("DEEPLEARNING", H2ODeepLearningEstimator, dict(
+                hidden=[[32], [64, 64], [128, 128]],
+                epochs=[10], mini_batch_size=[128])),
+        ]
+        for gi, (algo, cls, hp) in enumerate(grids):
+            if not self._allowed(algo):
+                continue
+            keys = list(hp)
+            combos = [dict(zip(keys, v))
+                      for v in itertools.product(*(hp[k] for k in keys))]
+            rng.shuffle(combos)
+            for ci, parms in enumerate(combos[:3]):  # budget-bounded sample
+                if not budget_left():
+                    return
+                if self.max_models and len(self._models) >= self.max_models:
+                    return
+                self._build_model(f"{algo}_grid_1_model_{ci + 1}", cls, parms,
+                                  x, y, training_frame)
+
     def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
               validation_frame=None, leaderboard_frame=None, blending_frame=None,
               **kw):
@@ -187,21 +243,12 @@ class H2OAutoML:
                 break
             if self.max_models and len(self._models) >= self.max_models:
                 break
-            parms = dict(step["parms"])
-            parms["seed"] = self.seed
-            parms["nfolds"] = self.nfolds
-            parms["keep_cross_validation_predictions"] = True
-            if self.max_runtime_secs_per_model:
-                parms["max_runtime_secs"] = self.max_runtime_secs_per_model
-            try:
-                est = step["cls"](**parms)
-                est.train(x=x, y=y, training_frame=training_frame)
-                est._automl_name = step["name"]
-                self._models.append(est)
-                self.leaderboard.add(est)
-                self.event_log.log("model", f"built {step['name']} ({est.model_id})")
-            except Exception as e:
-                self.event_log.log("error", f"{step['name']} failed: {e}")
+            self._build_model(step["name"], step["cls"], step["parms"],
+                              x, y, training_frame)
+
+        # random grids (modeling.*Steps grids: XGBoost/GBM/DL RandomDiscrete
+        # exploration after the defaults, while budget remains)
+        self._run_random_grids(x, y, training_frame, budget_left)
 
         # StackedEnsembles (SE BestOfFamily + AllModels)
         if self._allowed("STACKEDENSEMBLE") and len(self._models) >= 2 and budget_left():

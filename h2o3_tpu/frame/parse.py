@@ -162,8 +162,106 @@ def parse_svmlight(path: str) -> Frame:
     return Frame(vecs, key=os.path.basename(path))
 
 
+def parse_arff(path: str) -> Frame:
+    """ARFF ingest (`water/parser/ARFFParser.java`): @attribute declarations
+    drive the column types (numeric/real/integer → numeric, {a,b,c} → enum,
+    string/date → string); @data rows parse as CSV. Sparse `{i v, …}` data
+    rows are expanded dense."""
+    names: List[str] = []
+    types: List[str] = []
+    domains: List[Optional[List[str]]] = []
+    data_lines: List[str] = []
+    in_data = False
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln or ln.startswith("%"):
+                continue
+            low = ln.lower()
+            if in_data:
+                data_lines.append(ln)
+            elif low.startswith("@attribute"):
+                rest = ln[len("@attribute"):].strip()
+                if rest.startswith(("'", '"')):
+                    q = rest[0]
+                    end = rest.index(q, 1)
+                    name, typ = rest[1:end], rest[end + 1:].strip()
+                else:
+                    parts = rest.split(None, 1)
+                    name, typ = parts[0], (parts[1] if len(parts) > 1 else "numeric")
+                names.append(name)
+                tl = typ.strip()
+                if tl.startswith("{"):
+                    dom = [t.strip().strip("'\"") for t in tl.strip("{}").split(",")]
+                    types.append("enum")
+                    domains.append(dom)
+                elif tl.lower() in ("numeric", "real", "integer"):
+                    types.append("numeric")
+                    domains.append(None)
+                else:  # string / date / relational
+                    types.append("string")
+                    domains.append(None)
+            elif low.startswith("@data"):
+                in_data = True
+    def _arff_split(ln: str) -> List[str]:
+        """Comma split honouring ARFF's single- OR double-quoted values."""
+        out, cur, q = [], [], None
+        for ch in ln:
+            if q:
+                if ch == q:
+                    q = None
+                else:
+                    cur.append(ch)
+            elif ch in "'\"":
+                q = ch
+            elif ch == ",":
+                out.append("".join(cur).strip())
+                cur = []
+            else:
+                cur.append(ch)
+        out.append("".join(cur).strip())
+        return out
+
+    ncol = len(names)
+    # ARFF spec: omitted sparse entries are value 0 — numeric 0, or the
+    # FIRST nominal value for enum columns
+    defaults = [
+        (domains[i][0] if types[i] == "enum" and domains[i] else "0")
+        for i in range(ncol)
+    ]
+    cols: List[list] = [[] for _ in range(ncol)]
+    for ln in data_lines:
+        if ln.startswith("{"):  # sparse row: {idx val, idx val}
+            vals = list(defaults)
+            for pair in ln.strip("{}").split(","):
+                pair = pair.strip()
+                if not pair:
+                    continue
+                i, v = pair.split(None, 1)
+                vals[int(i)] = v.strip().strip("'\"")
+        else:
+            vals = _arff_split(ln)
+        for c in range(ncol):
+            cols[c].append(vals[c] if c < len(vals) else "")
+    vecs = {}
+    for i, name in enumerate(names):
+        col = np.asarray(cols[i], dtype=object)
+        if types[i] == "numeric":
+            vecs[name] = _column_to_vec(col, "numeric")
+        elif types[i] == "enum":
+            dom = domains[i]
+            lookup = {d: j for j, d in enumerate(dom)}
+            codes = np.asarray([lookup.get(str(v), -1) for v in col], np.int32)
+            vecs[name] = Vec(codes, "enum", domain=dom)
+        else:
+            vecs[name] = Vec(None, "string", strings=col)
+    return Frame(vecs, key=os.path.basename(path))
+
+
 def import_file(path: str, **kw) -> Frame:
     """`h2o.import_file` — dispatch by extension (`ParseDataset.parse`)."""
     if path.endswith((".svm", ".svmlight")):
         return parse_svmlight(path)
+    if path.endswith(".arff"):
+        return parse_arff(path)
     return parse_csv(path, **kw)

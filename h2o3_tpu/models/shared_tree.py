@@ -321,7 +321,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # checkpoint= continue-training: restore the prior forest and fast-
         # forward margins (SharedTree checkpoint restart — `_parms.checkpoint`
         # compat checks + tree restore in hex/tree/SharedTree.java)
-        prior_trees: List[List] = [[] for _ in range(K)]
         prior_stacked: List = []
         n_prior = 0
         ckpt = self._parms.get("checkpoint")
@@ -348,15 +347,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
             margins = jnp.broadcast_to(jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
             prior_stacked = list(pm.forest)
             for k in range(K):
-                stacked = pm.forest[k]
-                nt = stacked.feat.shape[0]
-                for t in range(nt):
-                    prior_trees[k].append(
-                        treelib.Tree(*[np.asarray(getattr(stacked, fld)[t])
-                                       for fld in treelib.Tree._fields])
-                    )
                 vsum = _predict_forest_codes_jit(
-                    jax.tree.map(jnp.asarray, stacked), codes_d, tp["max_depth"]
+                    jax.tree.map(jnp.asarray, pm.forest[k]), codes_d, tp["max_depth"]
                 )
                 margins = margins.at[:, k].add(vsum)
             if offset is not None:
@@ -410,7 +402,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
         else:
             mtries = 0
 
-        trees: List[List] = [list(prior_trees[k]) for k in range(K)]
         ntrees_target = max(int(tp["ntrees"]) - n_prior, 0)
         gain_total = np.zeros(F, np.float64)
         stopper = (
@@ -536,16 +527,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         jax.random.fold_in(key, m), m, g_ext, h_ext)),
             donate_argnums=(0,),
         )
-
-        def _unpack_host(packed_np):
-            """(nsteps, K, T, 5) f32 host array → per-(step, class) Trees."""
-            return treelib.Tree(
-                packed_np[..., 0].astype(np.int32),
-                packed_np[..., 1].astype(np.int32),
-                packed_np[..., 2],
-                packed_np[..., 3] > 0.5,
-                packed_np[..., 4],
-            )
 
         def _stacked_from_packed_dev(packed, k):
             """Device (nsteps, K, T, 5) → stacked Tree for class k (device)."""
@@ -673,11 +654,25 @@ class H2OSharedTreeEstimator(H2OEstimator):
         else:
             all_packed = np.zeros((0, K, treelib.heap_size(tp["max_depth"]), 5),
                                   np.float32)
-        for t in range(all_packed.shape[0]):
-            for k in range(K):
-                trees[k].append(_unpack_host(all_packed[t, k]))
-
-        forest = [treelib.stack_trees([t for t in trees[k]]) for k in range(K)]
+        # stacked forests sliced straight off the bulk array — no per-tree
+        # host Trees, no 5×ntrees tiny H2D transfers (stack_trees on device)
+        forest = []
+        for k in range(K):
+            new = treelib.Tree(
+                np.ascontiguousarray(all_packed[:, k, :, 0]).astype(np.int32),
+                np.ascontiguousarray(all_packed[:, k, :, 1]).astype(np.int32),
+                np.ascontiguousarray(all_packed[:, k, :, 2]),
+                all_packed[:, k, :, 3] > 0.5,
+                np.ascontiguousarray(all_packed[:, k, :, 4]),
+            )
+            if prior_stacked:
+                prior = prior_stacked[k]
+                new = treelib.Tree(*[
+                    np.concatenate([np.asarray(getattr(prior, f)),
+                                    getattr(new, f)], axis=0)
+                    for f in treelib.Tree._fields
+                ])
+            forest.append(new)
         model = SharedTreeModel(
             self, x, y, bm, problem, nclass, domain, dist,
             np.asarray(f0) if K > 1 else float(f0[0]),

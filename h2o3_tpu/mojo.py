@@ -73,7 +73,26 @@ def _model_payload(model) -> Dict[str, Any]:
             arrays["stds"] = model.dinfo.stds
         meta["dinfo"] = _dinfo_meta(model.dinfo)
     else:
-        raise TypeError(f"cannot export model of type {type(model).__name__}")
+        from .models.kmeans import KMeansModel
+        from .models.pca import PCAModel
+
+        if isinstance(model, KMeansModel):
+            meta.update(kind="kmeans", k=model.k)
+            arrays["centers_std"] = np.asarray(model.centers_std)
+            if model.dinfo.means is not None:
+                arrays["means"] = model.dinfo.means
+                arrays["stds"] = model.dinfo.stds
+            meta["dinfo"] = _dinfo_meta(model.dinfo)
+        elif isinstance(model, PCAModel):
+            meta.update(kind="pca", k=model.k)
+            arrays["eigenvectors"] = np.asarray(model.eigenvectors)
+            arrays["eigenvalues"] = np.asarray(model.eigenvalues)
+            if model.dinfo.means is not None:
+                arrays["means"] = model.dinfo.means
+                arrays["stds"] = model.dinfo.stds
+            meta["dinfo"] = _dinfo_meta(model.dinfo)
+        else:
+            raise TypeError(f"cannot export model of type {type(model).__name__}")
     return {"meta": meta, "arrays": arrays}
 
 
@@ -241,7 +260,7 @@ class MojoScorer:
             eta = Xi @ beta.T
             fam = meta["family"]
             if fam in ("binomial", "quasibinomial", "fractionalbinomial"):
-                p1 = 1 / (1 + np.exp(-eta))
+                p1 = 1 / (1 + np.exp(-np.clip(eta, -500, 500)))
                 dom = meta["domain"]
                 return Frame.from_dict({
                     "predict": np.asarray(dom, dtype=object)[(p1 > 0.5).astype(int)],
@@ -258,6 +277,17 @@ class MojoScorer:
             if fam in ("poisson", "gamma", "tweedie"):
                 eta = np.exp(eta)
             return Frame.from_dict({"predict": eta})
+        if kind == "kmeans":
+            X = self._expand_dinfo(data)
+            c = self.arrays["centers_std"]
+            d2 = (np.sum(X * X, axis=1, keepdims=True) - 2.0 * X @ c.T
+                  + np.sum(c * c, axis=1)[None, :])
+            return Frame.from_dict({"predict": d2.argmin(axis=1).astype(np.float64)})
+        if kind == "pca":
+            X = self._expand_dinfo(data)
+            scores = X @ self.arrays["eigenvectors"]
+            return Frame.from_dict(
+                {f"PC{i+1}": scores[:, i] for i in range(self.meta["k"])})
         if kind == "deeplearning":
             X = self._expand_dinfo(data)
             h = X

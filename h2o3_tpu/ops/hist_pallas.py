@@ -34,6 +34,95 @@ except ImportError:  # pragma: no cover
     _HAVE_PLTPU = False
 
 DEFAULT_ROW_CHUNK = 2048
+FACTORED_ROW_CHUNK = 8192
+
+
+_FB = 8  # features per block (TPU sublane granule)
+
+
+def _hist_kernel_factored(codes_ref, node_ref, vals_ref, out_ref, w_ref,
+                          *, L: int, B: int):
+    """Factored VMEM kernel: grid (row_chunks, F/8), feature-blocks innermost.
+
+    Per chunk (at fb==0) the (3L, R) node-weighted value matrix is built once
+    in scratch; each step builds only (R, B) bin one-hots in VMEM for its 8
+    features and runs 8 MXU matmuls, accumulating into the output block. HBM
+    traffic is codes-in (bf16) + the small output blocks — the (R, L·B)
+    one-hot never exists anywhere."""
+    step = pl.program_id(0)
+    fb = pl.program_id(1)
+
+    @pl.when(fb == 0)
+    def _weighted():
+        # w[c·L+l, r] = vals[c, r] · [node[r] == l]
+        l_idx = jax.lax.broadcasted_iota(jnp.int32, (3 * L, 1), 0) % L
+        node = node_ref[...]                      # (1, R) i32
+        mask = (node == l_idx).astype(jnp.float32)  # (3L, R)
+        vals = vals_ref[...]                      # (3, R) f32
+        vals3 = jnp.concatenate(
+            [jnp.broadcast_to(vals[c][None, :], (L, vals.shape[1]))
+             for c in range(3)], axis=0)          # (3L, R)
+        w_ref[...] = vals3 * mask
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    R = w_ref.shape[1]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, R), 0).astype(jnp.float32)
+    wmat = w_ref[...].astype(jnp.bfloat16)
+    for fl in range(_FB):  # unrolled: 8 features per block
+        code_f = codes_ref[fl, :]                 # (R,) f32
+        bin_oh_t = (code_f[None, :] == iota_b).astype(jnp.bfloat16)  # (B, R)
+        # contract along rows: (3L,R)·(B,R) → (3L,B), RHS-transposed matmul
+        out_ref[fl] += jax.lax.dot_general(
+            wmat, bin_oh_t,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "nbins", "row_chunk"))
+def build_histograms_pallas_factored(
+    codes_t_bf: jax.Array,   # (F, N) float32 — PRE-TRANSPOSED feature-major
+    node_id: jax.Array,      # (N,) int32
+    vals: jax.Array,         # (3, N) f32, weight-masked
+    n_nodes: int,
+    nbins: int,
+    row_chunk: int = FACTORED_ROW_CHUNK,
+) -> jax.Array:
+    """(n_nodes, F, nbins, 3) histogram; the TPU fast path for L·R fitting
+    VMEM (the scratch is (3L, R) f32)."""
+    if not _HAVE_PLTPU:
+        raise RuntimeError("pallas TPU backend unavailable")
+    F, N = codes_t_bf.shape
+    L, B = n_nodes, nbins
+    R = row_chunk
+    npad = ((N + R - 1) // R) * R
+    pad = npad - N
+    Fpad = ((F + _FB - 1) // _FB) * _FB
+    if pad or Fpad != F:
+        # pad codes with an out-of-range bin so padded rows match no bin
+        codes_t_bf = jnp.pad(codes_t_bf, ((0, Fpad - F), (0, pad)),
+                             constant_values=-1.0)
+        node_id = jnp.pad(node_id.astype(jnp.int32), (0, pad))
+        vals = jnp.pad(vals, ((0, 0), (0, pad)))
+    node2 = node_id.astype(jnp.int32)[None, :]
+    grid = (npad // R, Fpad // _FB)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_factored, L=L, B=B),
+        out_shape=jax.ShapeDtypeStruct((Fpad, 3 * L, B), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_FB, R), lambda i, f: (f, i)),  # codes_t chunk
+            pl.BlockSpec((1, R), lambda i, f: (0, i)),    # node chunk
+            pl.BlockSpec((3, R), lambda i, f: (0, i)),    # vals chunk
+        ],
+        out_specs=pl.BlockSpec((_FB, 3 * L, B), lambda i, f: (f, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((3 * L, R), jnp.float32)],
+    )(codes_t_bf, node2, vals)
+    # (Fpad, 3L, B) → (L, F, B, 3)
+    return out[:F].reshape(F, 3, L, B).transpose(2, 0, 3, 1)
 
 
 def _hist_kernel(codes_ref, cid_base_ref, vals_ref, out_ref, *, F: int, LB: int):

@@ -35,21 +35,43 @@ import numpy as np
 
 def _hist_onehot(codes, node_id, vals, n_nodes: int, nbins: int):
     """MXU path. codes (N,F) int, node_id (N,) int, vals (3,N) f32.
-    Returns (n_nodes, F, nbins, 3)."""
+    Returns (n_nodes, F, nbins, 3).
+
+    Factored one-hot: the (node × channel)-weighted matrix (3L, N) is built
+    ONCE per level and shared by every feature; each scan step only builds
+    the (N, B) bin one-hot and runs one (3L,N)@(N,B) MXU matmul. This does
+    N·B comparisons per feature instead of N·L·B — the VPU (comparison) work
+    no longer scales with the node count."""
     N, F = codes.shape
-    LB = n_nodes * nbins
-    base = node_id.astype(jnp.int32) * nbins  # (N,)
-    iota = jnp.arange(LB, dtype=jnp.int32)
+    if 3 * n_nodes * N * 2 > (256 << 20):
+        # deep levels: the shared (3L, N) weighted matrix would not fit —
+        # fall back to the fused (node,bin) one-hot inside the scan
+        LB = n_nodes * nbins
+        base = node_id.astype(jnp.int32) * nbins
+        iota = jnp.arange(LB, dtype=jnp.int32)
+
+        def one_feature_fused(carry, code_f):
+            cid = base + code_f.astype(jnp.int32)
+            onehot = (cid[:, None] == iota[None, :]).astype(jnp.bfloat16)
+            hist_f = jnp.dot(vals.astype(jnp.bfloat16), onehot,
+                             preferred_element_type=jnp.float32)  # (3, LB)
+            return carry, hist_f
+
+        _, hists = jax.lax.scan(one_feature_fused, None, codes.T)
+        return hists.reshape(F, 3, n_nodes, nbins).transpose(2, 0, 3, 1)
+
+    node_oh = (node_id[:, None].astype(jnp.int32)
+               == jnp.arange(n_nodes, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
+    weighted = vals.astype(jnp.bfloat16)[:, :, None] * node_oh[None, :, :]  # (3,N,L)
+    weighted = weighted.transpose(0, 2, 1).reshape(3 * n_nodes, N)          # (3L,N)
+    iota_b = jnp.arange(nbins, dtype=jnp.int32)
 
     def one_feature(carry, code_f):
-        cid = base + code_f.astype(jnp.int32)            # (N,)
-        onehot = (cid[:, None] == iota[None, :]).astype(jnp.bfloat16)  # (N, LB)
-        hist_f = jnp.dot(
-            vals.astype(jnp.bfloat16), onehot, preferred_element_type=jnp.float32
-        )  # (3, LB)
+        bin_oh = (code_f[:, None].astype(jnp.int32) == iota_b[None, :]).astype(jnp.bfloat16)
+        hist_f = jnp.dot(weighted, bin_oh, preferred_element_type=jnp.float32)  # (3L,B)
         return carry, hist_f
 
-    _, hists = jax.lax.scan(one_feature, None, codes.T)   # (F, 3, LB)
+    _, hists = jax.lax.scan(one_feature, None, codes.T)   # (F, 3L, B)
     return hists.reshape(F, 3, n_nodes, nbins).transpose(2, 0, 3, 1)
 
 
@@ -96,6 +118,16 @@ def build_histograms(
         from . import hist_pallas
 
         hist = hist_pallas.build_histograms_pallas(codes, node_id, vals, n_nodes, nbins)
+    elif method == "pallas_factored":
+        from . import hist_pallas
+
+        # VMEM-guard: scratch is (3L, R) f32 — fall back past ~64 nodes
+        if n_nodes > 64:
+            hist = _hist_onehot(codes, node_id, vals, n_nodes, nbins)
+        else:
+            hist = hist_pallas.build_histograms_pallas_factored(
+                codes.T.astype(jnp.float32), node_id, vals, n_nodes, nbins
+            )
     else:
         raise ValueError(f"unknown histogram method {method!r}")
     if axis_name is not None:

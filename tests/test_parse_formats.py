@@ -1,0 +1,139 @@
+"""Parser formats beyond CSV: ARFF + SVMLight + MOJO kmeans/pca round-trips
+(reference: water/parser/ARFFParser.java, SVMLightParser.java,
+hex/genmodel algos)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.frame.parse import parse_arff, parse_svmlight
+
+
+def test_arff_dense(tmp_path, cloud1):
+    p = tmp_path / "iris.arff"
+    p.write_text("""% comment
+@relation iris
+@attribute sepal_len numeric
+@attribute 'class' {setosa, versicolor}
+@attribute note string
+@data
+5.1, setosa, 'first row'
+4.9, versicolor, "second"
+6.0, setosa, third
+""")
+    fr = parse_arff(str(p))
+    assert fr.names == ["sepal_len", "class", "note"]
+    np.testing.assert_allclose(fr.vec("sepal_len").numeric_np(),
+                               [5.1, 4.9, 6.0], rtol=1e-6)
+    v = fr.vec("class")
+    assert v.type == "enum" and v.domain == ["setosa", "versicolor"]
+    assert np.asarray(v.data).tolist() == [0, 1, 0]
+    assert fr.vec("note").type == "string"
+    # dispatch through import_file
+    fr2 = h2o.import_file(str(p))
+    assert fr2.nrow == 3
+
+
+def test_arff_sparse_rows(tmp_path, cloud1):
+    p = tmp_path / "s.arff"
+    p.write_text("""@relation s
+@attribute a numeric
+@attribute b numeric
+@attribute c numeric
+@data
+{0 1.5, 2 3}
+{1 2.0}
+""")
+    fr = parse_arff(str(p))
+    assert fr.vec("a").numeric_np().tolist() == [1.5, 0.0]
+    assert fr.vec("b").numeric_np().tolist() == [0.0, 2.0]
+    assert fr.vec("c").numeric_np().tolist() == [3.0, 0.0]
+
+
+def test_arff_sparse_nominal_default_and_quotes(tmp_path, cloud1):
+    p = tmp_path / "sn.arff"
+    p.write_text("""@relation sn
+@attribute num numeric
+@attribute cls {setosa, versicolor}
+@data
+{0 1.5}
+{1 'versicolor'}
+""")
+    fr = parse_arff(str(p))
+    v = fr.vec("cls")
+    # omitted sparse nominal = FIRST domain value (ARFF spec), quoted matches
+    assert np.asarray(v.data).tolist() == [0, 1]
+    assert fr.vec("num").numeric_np().tolist() == [1.5, 0.0]
+
+
+def test_arff_quoted_comma_value(tmp_path, cloud1):
+    p = tmp_path / "qc.arff"
+    p.write_text("""@relation qc
+@attribute a numeric
+@attribute s string
+@attribute b numeric
+@data
+5.1, 'big, green', 3.0
+1.0, "x", 2.0
+""")
+    fr = parse_arff(str(p))
+    assert list(fr.vec("s").to_numpy()) == ["big, green", "x"]
+    assert fr.vec("b").numeric_np().tolist() == [3.0, 2.0]
+
+
+def test_svmlight(tmp_path, cloud1):
+    p = tmp_path / "d.svm"
+    p.write_text("1 1:0.5 3:2.0 # comment\n-1 2:1.0\n")
+    fr = parse_svmlight(str(p))
+    assert fr.vec("C1").numeric_np().tolist() == [1.0, -1.0]
+    assert fr.vec("C2").numeric_np().tolist() == [0.5, 0.0]
+    assert fr.vec("C4").numeric_np().tolist() == [2.0, 0.0]
+
+
+def test_mojo_kmeans_pca_roundtrip(tmp_path, cloud1):
+    from h2o3_tpu.estimators import (
+        H2OKMeansEstimator,
+        H2OPrincipalComponentAnalysisEstimator,
+    )
+    from h2o3_tpu.frame.frame import Frame
+
+    rng = np.random.default_rng(0)
+    X = np.concatenate([rng.normal(0, 0.3, (100, 3)), rng.normal(4, 0.3, (100, 3))])
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    km = H2OKMeansEstimator(k=2, seed=1)
+    km.train(x=["a", "b", "c"], training_frame=fr)
+    path = h2o.save_model(km, str(tmp_path))
+    scorer = h2o.load_model(path)
+    p_live = km.predict(fr).vec("predict").numeric_np()
+    p_mojo = scorer.predict(fr).vec("predict").numeric_np()
+    np.testing.assert_array_equal(p_live, p_mojo)
+
+    pca = H2OPrincipalComponentAnalysisEstimator(k=2, transform="STANDARDIZE")
+    pca.train(x=["a", "b", "c"], training_frame=fr)
+    path = h2o.save_model(pca, str(tmp_path))
+    scorer = h2o.load_model(path)
+    np.testing.assert_allclose(
+        pca.predict(fr).vec("PC1").numeric_np(),
+        scorer.predict(fr).vec("PC1").numeric_np(), rtol=1e-5)
+
+
+def test_pallas_factored_histogram_matches():
+    """TPU-only: the VMEM factored kernel matches the XLA one-hot path."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        import pytest
+        pytest.skip("pallas TPU kernel requires a TPU backend")
+    import jax.numpy as jnp
+    from h2o3_tpu.ops.histogram import build_histograms
+
+    rng = np.random.default_rng(0)
+    N, F, L, B = 10000, 5, 8, 16
+    codes = jnp.asarray(rng.integers(0, B, (N, F), dtype=np.int8))
+    idx = jnp.asarray(rng.integers(0, L, N, dtype=np.int32))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.ones(N, jnp.float32)
+    w = jnp.ones(N, jnp.float32)
+    a = build_histograms(codes, idx, g, h, w, L, B, method="onehot")
+    b = build_histograms(codes, idx, g, h, w, L, B, method="pallas_factored")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
