@@ -157,3 +157,23 @@ def download_mojo(model, path: str = ".", **kw) -> str:
 
 def import_mojo(path: str):
     return load_model(path)
+
+
+def load_grid(grid_file_path: str, grid_id: Optional[str] = None):
+    """`h2o.load_grid` — re-import a checkpointed grid from its
+    recovery_dir (hex/grid recovery)."""
+    import glob as _glob
+
+    from .models.grid import H2OGridSearch
+
+    if grid_id is None:
+        hits = sorted(_glob.glob(_os.path.join(grid_file_path, "*.grid.json")))
+        if not hits:
+            raise FileNotFoundError(f"no grid state under {grid_file_path}")
+        if len(hits) > 1:
+            ids = [_os.path.basename(h)[: -len(".grid.json")] for h in hits]
+            raise ValueError(
+                f"multiple grids under {grid_file_path}: {ids}; pass grid_id"
+            )
+        grid_id = _os.path.basename(hits[0])[: -len(".grid.json")]
+    return H2OGridSearch.load(grid_file_path, grid_id)

@@ -238,6 +238,211 @@ class Frame:
 
         return _table(self)
 
+    # -- wider H2OFrame munging surface (AstImpute/AstScale/AstSort/prims) ---
+    def impute(self, column=None, method: str = "mean",
+               combine_method: str = "interpolate", by=None) -> "Frame":
+        """In-place NA imputation (h2o.impute / AstImpute): mean/median/mode
+        for numerics (mode = most frequent value), mode for categoricals;
+        `by` imputes within groups of the given column(s)."""
+        if method not in ("mean", "median", "mode"):
+            raise ValueError(f"impute: unsupported method {method!r}")
+        names = ([column] if isinstance(column, str)
+                 else list(column) if column else self.names)
+        if by is not None:
+            by = [by] if isinstance(by, str) else list(by)
+            keys = np.zeros(self.nrow, np.int64)
+            for b in by:
+                codes = np.nan_to_num(self._vecs[b].numeric_np(), nan=-1).astype(np.int64)
+                keys = keys * (codes.max() + 2) + codes
+            _, groups = np.unique(keys, return_inverse=True)
+        else:
+            groups = np.zeros(self.nrow, np.int64)
+
+        def fill_value(vals):
+            if method == "median":
+                return np.nanmedian(vals)
+            if method == "mode":
+                fin = vals[~np.isnan(vals)]
+                u, c = np.unique(fin, return_counts=True)
+                return u[c.argmax()]
+            return np.nanmean(vals)
+
+        for n in names:
+            if by and n in by:
+                continue
+            v = self._vecs[n]
+            if v.type == "enum":
+                codes = np.asarray(v.data).copy()
+                for g in np.unique(groups):
+                    m = groups == g
+                    ok = codes[m] >= 0
+                    if (~ok).any() and ok.any():
+                        mode = np.bincount(codes[m][ok]).argmax()
+                        sub = codes[m]
+                        sub[~ok] = mode
+                        codes[m] = sub
+                self._vecs[n] = Vec(codes.astype(np.int32), "enum", domain=v.domain)
+            elif v.type != "string":
+                col = v.numeric_np()
+                for g in np.unique(groups):
+                    m = groups == g
+                    na = np.isnan(col[m])
+                    if na.any() and not na.all():
+                        sub = col[m]
+                        sub[na] = fill_value(sub)
+                        col[m] = sub
+                self._vecs[n] = Vec(col.astype(np.float32), v.type)
+        return self
+
+    def scale(self, center=True, scale=True) -> "Frame":
+        """Standardize numeric columns (H2OFrame.scale)."""
+        out = {}
+        for n, v in self._vecs.items():
+            if v.type in ("real", "int"):
+                col = v.numeric_np()
+                mu = np.nanmean(col) if center else 0.0
+                sd = np.nanstd(col, ddof=1) if scale else 1.0
+                out[n] = Vec(((col - mu) / (sd if sd > 1e-300 else 1.0)
+                              ).astype(np.float32), "real")
+            else:
+                out[n] = v
+        return Frame(out)
+
+    def sort(self, by, ascending=True) -> "Frame":
+        """Row sort by column(s) (H2OFrame.sort / AstSort radix sort)."""
+        by = [by] if isinstance(by, (str, int)) else list(by)
+        by = [self.names[b] if isinstance(b, int) else b for b in by]
+        asc = ([ascending] * len(by) if isinstance(ascending, bool)
+               else list(ascending))
+        idx = np.arange(self.nrow)
+        for b, a in zip(reversed(by), reversed(asc)):  # stable multi-key
+            col = self._vecs[b].numeric_np()[idx]
+            order = np.argsort(col if a else -col, kind="mergesort")
+            idx = idx[order]
+        return self.take(idx)
+
+    def na_omit(self) -> "Frame":
+        """Drop rows with any NA (H2OFrame.na_omit)."""
+        mask = np.zeros(self.nrow, bool)
+        for v in self._vecs.values():
+            mask |= v.isna_np()
+        return self.take(np.nonzero(~mask)[0])
+
+    def unique(self) -> "Frame":
+        v = self.vecs()[0]
+        n = self.names[0]
+        if v.type == "enum":
+            codes = np.asarray(v.data)
+            present = sorted(set(codes[codes >= 0]))
+            return Frame.from_dict(
+                {n: np.asarray([v.domain[i] for i in present], dtype=object)},
+                column_types={n: "enum"})
+        u = np.unique(v.numeric_np())
+        return Frame.from_dict({n: u[~np.isnan(u)]})
+
+    def head(self, rows: int = 10) -> "Frame":
+        return self.take(np.arange(min(rows, self.nrow)))
+
+    def tail(self, rows: int = 10) -> "Frame":
+        return self.take(np.arange(max(self.nrow - rows, 0), self.nrow))
+
+    def cor(self, na_rm: bool = True) -> np.ndarray:
+        """Pearson correlation matrix of the numeric columns (h2o.cor)."""
+        cols = [v.numeric_np() for v in self._vecs.values()
+                if v.type in ("real", "int")]
+        X = np.column_stack(cols)
+        if na_rm:
+            X = X[~np.isnan(X).any(axis=1)]
+        return np.corrcoef(X, rowvar=False)
+
+    def cut(self, breaks, labels=None, include_lowest: bool = False,
+            right: bool = True) -> "Frame":
+        """Numeric → categorical binning (H2OFrame.cut / AstCut)."""
+        col = self._col0()
+        br = np.asarray(breaks, np.float64)
+        codes = np.digitize(col, br, right=right) - 1
+        oob = (codes < 0) | (codes >= len(br) - 1) | np.isnan(col)
+        if include_lowest:
+            codes = np.where(col == br[0], 0, codes)
+            oob &= ~(col == br[0])
+        dom = (list(labels) if labels is not None else
+               [f"({br[i]:g},{br[i+1]:g}]" for i in range(len(br) - 1)])
+        codes = np.where(oob, -1, codes).astype(np.int32)
+        return Frame({self.names[0]: Vec(codes, "enum", domain=dom)})
+
+    # string ops (water/rapids/ast/prims/string/*) — enum/string columns
+    def _map_strings(self, fn) -> "Frame":
+        out = {}
+        for n, v in self._vecs.items():
+            if v.type == "string":
+                s = np.asarray([None if x is None else fn(str(x))
+                                for x in v.to_numpy()], dtype=object)
+                out[n] = Vec(None, "string", strings=s)
+            elif v.type == "enum":
+                out[n] = Vec(np.asarray(v.data), "enum",
+                             domain=[fn(str(d)) for d in (v.domain or [])])
+            else:
+                out[n] = v
+        return Frame(out)
+
+    def sub(self, pattern: str, replacement: str, ignore_case=False) -> "Frame":
+        import re
+        fl = re.IGNORECASE if ignore_case else 0
+        return self._map_strings(lambda s: re.sub(pattern, replacement, s, count=1, flags=fl))
+
+    def gsub(self, pattern: str, replacement: str, ignore_case=False) -> "Frame":
+        import re
+        fl = re.IGNORECASE if ignore_case else 0
+        return self._map_strings(lambda s: re.sub(pattern, replacement, s, flags=fl))
+
+    def trim(self) -> "Frame":
+        return self._map_strings(str.strip)
+
+    def tolower(self) -> "Frame":
+        return self._map_strings(str.lower)
+
+    def toupper(self) -> "Frame":
+        return self._map_strings(str.upper)
+
+    def substring(self, start_index: int, end_index: Optional[int] = None) -> "Frame":
+        return self._map_strings(lambda s: s[start_index:end_index])
+
+    def nchar(self) -> "Frame":
+        v = self.vecs()[0]
+        if v.type == "enum":
+            lens = np.asarray([len(d) for d in (v.domain or [])] + [0], np.float64)
+            codes = np.asarray(v.data)
+            out = np.where(codes >= 0, lens[np.maximum(codes, 0)], np.nan)
+        else:
+            out = np.asarray([np.nan if s is None else len(str(s))
+                              for s in v.to_numpy()], np.float64)
+        return Frame.from_dict({self.names[0]: out})
+
+    def strsplit(self, pattern: str) -> "Frame":
+        """Split the (single) string column; output columns C1..Ck."""
+        import re
+        v = self.vecs()[0]
+        rows = [([] if s is None else re.split(pattern, str(s)))
+                for s in (v.to_numpy() if v.type == "string"
+                          else [None if c < 0 else v.domain[c]
+                                for c in np.asarray(self.vecs()[0].data)])]
+        k = max((len(r) for r in rows), default=0)
+        cols = {}
+        for j in range(k):
+            cols[f"C{j+1}"] = np.asarray(
+                [r[j] if j < len(r) else None for r in rows], dtype=object)
+        return Frame({n: Vec(None, "string", strings=c) for n, c in cols.items()})
+
+    def countmatches(self, pattern) -> "Frame":
+        pats = [pattern] if isinstance(pattern, str) else list(pattern)
+        v = self.vecs()[0]
+        strs = (v.to_numpy() if v.type == "string"
+                else [None if c < 0 else v.domain[c] for c in np.asarray(v.data)])
+        out = np.asarray(
+            [np.nan if s is None else float(sum(str(s).count(p) for p in pats))
+             for s in strs], np.float64)
+        return Frame.from_dict({self.names[0]: out})
+
     # -- elementwise arithmetic/comparison (lazy-ExprNode surface, eager) ----
     def _col0(self) -> np.ndarray:
         return self.vecs()[0].numeric_np()

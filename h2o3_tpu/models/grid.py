@@ -30,6 +30,7 @@ class H2OGridSearch:
         hyper_params: Dict[str, Sequence[Any]],
         grid_id: Optional[str] = None,
         search_criteria: Optional[Dict[str, Any]] = None,
+        recovery_dir: Optional[str] = None,
     ):
         # `model` may be an estimator class or a template instance (h2o-py
         # accepts both)
@@ -44,8 +45,53 @@ class H2OGridSearch:
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         self.grid_id = grid_id or f"grid_{int(time.time())}"
         self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
+        self.recovery_dir = recovery_dir
         self.models: List = []
         self.failed: List[Dict] = []
+        self._done_combos: List[Dict] = []  # restored on recovery
+
+    # -- grid auto-recovery (hex/grid/GridSearch recovery + RecoveryHandler) -
+    def _state_path(self):
+        import os
+
+        return os.path.join(self.recovery_dir, f"{self.grid_id}.grid.json")
+
+    def _save_state(self):
+        import json
+        import os
+
+        os.makedirs(self.recovery_dir, exist_ok=True)
+        state = dict(
+            grid_id=self.grid_id,
+            model_module=self.model_class.__module__,
+            model_class=self.model_class.__name__,
+            base_parms={k: v for k, v in self.base_parms.items()
+                        if isinstance(v, (int, float, str, bool, list, type(None)))},
+            hyper_params=self.hyper_params,
+            search_criteria=self.search_criteria,
+            done_combos=self._done_combos,
+        )
+        with open(self._state_path(), "w") as f:
+            json.dump(state, f)
+
+    @staticmethod
+    def load(recovery_dir: str, grid_id: str) -> "H2OGridSearch":
+        """Re-import a checkpointed grid; train() resumes the remaining
+        combos (h2o.load_grid / grid recovery_dir semantics)."""
+        import importlib
+        import json
+        import os
+
+        with open(os.path.join(recovery_dir, f"{grid_id}.grid.json")) as f:
+            state = json.load(f)
+        cls = getattr(importlib.import_module(state["model_module"]),
+                      state["model_class"])
+        g = H2OGridSearch(cls, state["hyper_params"], grid_id=state["grid_id"],
+                          search_criteria=state["search_criteria"],
+                          recovery_dir=recovery_dir)
+        g.base_parms = state["base_parms"]
+        g._done_combos = state["done_combos"]
+        return g
 
     def _combos(self) -> List[Dict[str, Any]]:
         keys = list(self.hyper_params)
@@ -69,6 +115,8 @@ class H2OGridSearch:
         for combo in self._combos():
             if budget and time.time() - t0 > budget:
                 break
+            if combo in self._done_combos:  # recovered: skip finished combos
+                continue
             parms = dict(self.base_parms)
             parms.update(combo)
             parms.pop("model_id", None)
@@ -79,6 +127,20 @@ class H2OGridSearch:
                 self.models.append(est)
             except Exception as e:  # failed combos are recorded, walk continues
                 self.failed.append({"params": combo, "error": str(e)})
+                continue
+            if self.recovery_dir:
+                # checkpoint OUTSIDE the train try: an I/O failure must not
+                # mark the built model failed, and a combo only counts as
+                # done once its artifact actually exists on disk (else a
+                # resumed grid would skip it with nothing to restore)
+                try:
+                    from ..mojo import save_model
+
+                    save_model(est, self.recovery_dir)
+                    self._done_combos.append(combo)
+                    self._save_state()
+                except (TypeError, OSError):
+                    pass
         return self
 
     # -- h2o-py surface ------------------------------------------------------

@@ -353,6 +353,15 @@ class H2OEstimator:
         model.run_time = time.time() - t0
         self.job.done()
         self._model = model
+        ckpt_dir = self._parms.get("export_checkpoints_dir")
+        if ckpt_dir:
+            # auto-export the finished model (Model export_checkpoints_dir)
+            try:
+                from ..mojo import save_model
+
+                save_model(model, ckpt_dir)
+            except TypeError:
+                pass  # artifact format doesn't cover this algo yet
         return self
 
     # -- n-fold CV (ModelBuilder.computeCrossValidation) --------------------

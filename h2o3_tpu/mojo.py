@@ -142,6 +142,19 @@ class MojoScorer:
         self.algo = meta["algo"]
         self.x = meta["x"]
         self.y = meta["y"]
+        self._native_forests: Dict[int, tuple] = {}  # k → converted arrays
+
+    def _native_forest(self, k: int):
+        """Contiguous ctypes-ready forest arrays, converted once per class
+        (the serving hot path must not re-copy the model every call)."""
+        if k not in self._native_forests:
+            self._native_forests[k] = (
+                np.ascontiguousarray(self.arrays[f"forest{k}_feat"], np.int32),
+                np.ascontiguousarray(self.arrays[f"forest{k}_thr"], np.float32),
+                np.ascontiguousarray(self.arrays[f"forest{k}_is_split"]).astype(np.uint8),
+                np.ascontiguousarray(self.arrays[f"forest{k}_value"], np.float32),
+            )
+        return self._native_forests[k]
 
     # -- shared helpers -----------------------------------------------------
     def _matrix(self, data) -> np.ndarray:
@@ -157,26 +170,28 @@ class MojoScorer:
         return np.asarray(data, np.float64)
 
     def _tree_scores(self, X: np.ndarray) -> np.ndarray:
+        from .native import loader as native_loader
+
         meta = self.meta
         D = meta["max_depth"]
         outs = []
         for k in range(meta["n_forests"]):
-            feat = self.arrays[f"forest{k}_feat"]
-            thr = self.arrays[f"forest{k}_thr"]
-            split = self.arrays[f"forest{k}_is_split"]
-            value = self.arrays[f"forest{k}_value"]
-            ntrees = feat.shape[0]
-            total = np.zeros(X.shape[0])
-            for t in range(ntrees):
-                node = np.zeros(X.shape[0], np.int64)
-                for _ in range(D):
-                    f = feat[t][node]
-                    s = split[t][node]
-                    xv = X[np.arange(X.shape[0]), f]
-                    right = np.isnan(xv) | (xv > thr[t][node])
-                    child = 2 * node + 1 + (right & s).astype(np.int64)
-                    node = np.where(s, child, node)
-                total += value[t][node]
+            feat, thr, split, value = self._native_forest(k)
+            # native C++ traversal (mojo_scorer.cpp) — numpy fallback below
+            total = native_loader.score_forest(feat, thr, split, value, D, X)
+            if total is None:
+                ntrees = feat.shape[0]
+                total = np.zeros(X.shape[0])
+                for t in range(ntrees):
+                    node = np.zeros(X.shape[0], np.int64)
+                    for _ in range(D):
+                        f = feat[t][node]
+                        s = split[t][node]
+                        xv = X[np.arange(X.shape[0]), f]
+                        right = np.isnan(xv) | (xv > thr[t][node])
+                        child = 2 * node + 1 + (right & s).astype(np.int64)
+                        node = np.where(s, child, node)
+                    total += value[t][node]
             f0 = meta["f0"]
             f0k = f0[k] if isinstance(f0, list) else f0
             outs.append(total + (f0k if meta["mode"] != "drf" else 0.0))

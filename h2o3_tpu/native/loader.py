@@ -28,16 +28,23 @@ def _lib():
     _TRIED = True
     here = os.path.dirname(__file__)
     so = os.path.join(here, "libh2o3native.so")
-    if not os.path.exists(so):
-        # build on first use — the .so is not shipped (platform-specific)
+    srcs = [os.path.join(here, f) for f in os.listdir(here) if f.endswith(".cpp")]
+    stale = os.path.exists(so) and any(
+        os.path.getmtime(s) > os.path.getmtime(so) for s in srcs
+    )
+    if not os.path.exists(so) or stale:
+        # (re)build on first use — the .so is not shipped (platform-specific)
+        # and a stale lib (older than its sources) would miss newer symbols
         import subprocess
 
         try:
             subprocess.run(
-                ["make", "-C", here], capture_output=True, timeout=120, check=True
+                ["make", "-B", "-C", here] if stale else ["make", "-C", here],
+                capture_output=True, timeout=120, check=True,
             )
         except (OSError, subprocess.SubprocessError):
-            return None
+            if not os.path.exists(so):
+                return None
     if os.path.exists(so):
         try:
             _LIB = ctypes.CDLL(so)
@@ -48,6 +55,45 @@ def _lib():
 
 def available() -> bool:
     return _lib() is not None
+
+
+def score_forest(feat: np.ndarray, thr: np.ndarray, split: np.ndarray,
+                 value: np.ndarray, max_depth: int, X: np.ndarray
+                 ) -> Optional[np.ndarray]:
+    """Native heap-forest traversal (mojo_scorer.cpp). Arrays are the
+    (ntrees, T) stacked fields of one class's forest; X row-major (n, F)
+    float64. Returns summed leaf values (n,) or None without the lib."""
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        fn = lib.h2o3_score_forest
+    except AttributeError:
+        return None
+    feat = np.ascontiguousarray(feat, np.int32)
+    thr = np.ascontiguousarray(thr, np.float32)
+    split = np.ascontiguousarray(split).astype(np.uint8)
+    value = np.ascontiguousarray(value, np.float32)
+    X = np.ascontiguousarray(X, np.float64)
+    ntrees, T = feat.shape
+    n, F = X.shape
+    out = np.empty(n, np.float64)
+    fn.restype = None
+    fn.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_longlong, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    fn(feat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       thr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       split.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+       value.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       ntrees, T, max_depth,
+       X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, X.shape[1],
+       out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out
 
 
 def tokenize_csv(path: str, sep: str, header: bool, ncol: int) -> Optional[List[np.ndarray]]:

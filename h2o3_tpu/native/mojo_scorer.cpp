@@ -1,0 +1,43 @@
+// Native MOJO scorer — standalone forest traversal.
+//
+// Reference parity: `h2o-genmodel/src/main/java/hex/genmodel/algos/tree/`
+// (`SharedTreeMojoModel.scoreTree` — the dependency-free tree walk behind
+// `EasyPredictModelWrapper`). The artifact layout here is the flat heap
+// forest of models/tree.py: per tree, arrays feat/thr/is_split/value of
+// length 2^(D+1)-1; traversal sends NaN and x > thr right, matching
+// predict_raw (NA-bin-is-last training semantics).
+//
+// Exposed via ctypes (native/loader.py):
+//   h2o3_score_forest(feat, thr, split, value, ntrees, T, max_depth,
+//                     X, n, F, out)
+//     X row-major (n, F) doubles; out (n,) receives the summed leaf values.
+// OpenMP-parallel over rows.
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" void h2o3_score_forest(
+    const int32_t* feat, const float* thr, const uint8_t* split,
+    const float* value, int ntrees, int T, int max_depth,
+    const double* X, long long n, int F, double* out) {
+#pragma omp parallel for schedule(static)
+  for (long long i = 0; i < n; ++i) {
+    const double* xi = X + i * (long long)F;
+    double acc = 0.0;
+    for (int t = 0; t < ntrees; ++t) {
+      const long long off = (long long)t * T;
+      const int32_t* tf = feat + off;
+      const float* tt = thr + off;
+      const uint8_t* ts = split + off;
+      int node = 0;
+      for (int d = 0; d < max_depth; ++d) {
+        if (!ts[node]) break;
+        double x = xi[tf[node]];
+        bool right = std::isnan(x) || x > (double)tt[node];
+        node = 2 * node + 1 + (right ? 1 : 0);
+      }
+      acc += (double)value[off + node];
+    }
+    out[i] = acc;
+  }
+}

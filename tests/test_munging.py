@@ -1,0 +1,133 @@
+"""H2OFrame munging surface: impute/scale/sort/cut/string ops
+(reference: water/rapids/ast/prims — AstImpute, AstScale, AstSort, AstCut,
+string/*)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.frame.vec import Vec
+
+
+def _sf(rows):
+    return Frame({"s": Vec(None, "string",
+                           strings=np.asarray(rows, dtype=object))})
+
+
+def test_impute_mean_median_mode(cloud1):
+    fr = Frame.from_dict({
+        "a": np.asarray([1.0, np.nan, 3.0]),
+        "b": np.asarray(["x", "y", "x"], dtype=object),
+    }, column_types={"b": "enum"})
+    codes = np.asarray(fr.vec("b").data).copy()
+    codes[1] = -1
+    fr._vecs["b"] = Vec(codes, "enum", domain=fr.vec("b").domain)
+    fr.impute()
+    assert fr.vec("a").numeric_np()[1] == pytest.approx(2.0)
+    assert np.asarray(fr.vec("b").data).tolist() == [0, 0, 0]  # mode = 'x'
+    fr2 = Frame.from_dict({"a": np.asarray([1.0, np.nan, 2.0, 10.0])})
+    fr2.impute(method="median")
+    assert fr2.vec("a").numeric_np()[1] == pytest.approx(2.0)
+
+
+def test_scale_sort_na_omit_unique_head_tail(cloud1):
+    fr = Frame.from_dict({"a": np.asarray([3.0, 1.0, 2.0, np.nan]),
+                          "b": np.asarray([1.0, 2.0, 2.0, 4.0])})
+    s = fr.scale()
+    col = s.vec("a").numeric_np()
+    assert abs(np.nanmean(col)) < 1e-6
+    srt = fr.sort("a")
+    assert srt.vec("a").numeric_np()[0] == 1.0
+    srt2 = fr.sort(["b", "a"], ascending=[False, True])
+    assert srt2.vec("b").numeric_np()[0] == 4.0
+    no_na = fr.na_omit()
+    assert no_na.nrow == 3
+    u = fr[["b"]].unique()
+    assert sorted(u.vec("b").numeric_np().tolist()) == [1.0, 2.0, 4.0]
+    assert fr.head(2).nrow == 2 and fr.tail(1).vec("b").numeric_np()[0] == 4.0
+
+
+def test_cor(cloud1):
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=200)
+    fr = Frame.from_dict({"a": a, "b": 2 * a + rng.normal(0, 0.01, 200)})
+    c = fr.cor()
+    assert c[0, 1] > 0.99
+
+
+def test_cut(cloud1):
+    fr = Frame.from_dict({"a": np.asarray([0.5, 1.5, 2.5, 5.0])})
+    out = fr.cut([0, 1, 2, 3])
+    v = out.vec("a")
+    assert v.type == "enum"
+    assert np.asarray(v.data).tolist() == [0, 1, 2, -1]  # 5.0 out of range
+
+
+def test_string_ops(cloud1):
+    fr = _sf([" Hello World ", "foo,bar", None])
+    assert list(fr.trim().vec("s").to_numpy())[0] == "Hello World"
+    assert list(fr.tolower().vec("s").to_numpy())[0] == " hello world "
+    assert list(fr.gsub("o", "0").vec("s").to_numpy())[0] == " Hell0 W0rld "
+    assert list(fr.sub("o", "0").vec("s").to_numpy())[0] == " Hell0 World "
+    assert list(fr.substring(1, 6).vec("s").to_numpy())[0] == "Hello"
+    nc = fr.nchar().vec("s").numeric_np()
+    assert nc[0] == 13.0 and np.isnan(nc[2])
+    cm = fr.countmatches("o").vec("s").numeric_np()
+    assert cm[0] == 2.0 and cm[1] == 2.0
+    sp = _sf(["a,b", "c"]).strsplit(",")
+    assert list(sp.vec("C1").to_numpy()) == ["a", "c"]
+    assert list(sp.vec("C2").to_numpy()) == ["b", None]
+    # enum columns map through their domain
+    ef = Frame.from_dict({"e": np.asarray(["Cat", "Dog"], dtype=object)},
+                         column_types={"e": "enum"})
+    assert ef.toupper().vec("e").domain == ["CAT", "DOG"]
+
+
+def test_export_checkpoints_dir(tmp_path, cloud1):
+    import os
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(0)
+    fr = Frame.from_dict({"a": rng.normal(size=200),
+                          "y": rng.normal(size=200)})
+    g = H2OGradientBoostingEstimator(ntrees=3, max_depth=2,
+                                     export_checkpoints_dir=str(tmp_path))
+    g.train(x=["a"], y="y", training_frame=fr)
+    assert any(f.endswith(".h2o3") for f in os.listdir(tmp_path))
+
+
+def test_grid_recovery_resume(tmp_path, cloud1):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    rng = np.random.default_rng(1)
+    fr = Frame.from_dict({"a": rng.normal(size=300),
+                          "y": rng.normal(size=300)})
+    hp = {"max_depth": [2, 3], "learn_rate": [0.1, 0.3]}
+    g = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=3), hp,
+                      grid_id="g1", recovery_dir=str(tmp_path))
+    g.train(x=["a"], y="y", training_frame=fr)
+    assert len(g.models) == 4
+    # resume: all 4 combos already done -> no retraining
+    g2 = H2OGridSearch.load(str(tmp_path), "g1")
+    assert len(g2._done_combos) == 4
+    g2.train(x=["a"], y="y", training_frame=fr)
+    assert len(g2.models) == 0  # nothing left to do
+    # partial recovery: drop two combos from the state, resume builds them
+    g2._done_combos = g2._done_combos[:2]
+    g2.train(x=["a"], y="y", training_frame=fr)
+    assert len(g2.models) == 2
+
+
+def test_impute_by_group_and_mode(cloud1):
+    fr = Frame.from_dict({
+        "g": np.asarray([0.0, 0.0, 1.0, 1.0]),
+        "a": np.asarray([1.0, np.nan, 10.0, np.nan]),
+    })
+    fr.impute("a", method="mean", by="g")
+    assert fr.vec("a").numeric_np().tolist() == [1.0, 1.0, 10.0, 10.0]
+    fr2 = Frame.from_dict({"a": np.asarray([5.0, 5.0, 7.0, np.nan])})
+    fr2.impute("a", method="mode")
+    assert fr2.vec("a").numeric_np()[3] == 5.0
+    with pytest.raises(ValueError):
+        fr2.impute("a", method="bogus")

@@ -137,3 +137,35 @@ def test_pallas_factored_histogram_matches():
     a = build_histograms(codes, idx, g, h, w, L, B, method="onehot")
     b = build_histograms(codes, idx, g, h, w, L, B, method="pallas_factored")
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_native_forest_scorer_parity(cloud1):
+    """mojo_scorer.cpp traversal == the numpy fallback, NaNs included."""
+    from h2o3_tpu.native import loader
+
+    if not loader.available():
+        pytest.skip("native lib not built")
+    rng = np.random.default_rng(0)
+    ntrees, D = 10, 4
+    T = 2 ** (D + 1) - 1
+    feat = rng.integers(0, 3, (ntrees, T)).astype(np.int32)
+    thr = rng.normal(size=(ntrees, T)).astype(np.float32)
+    split = np.zeros((ntrees, T), bool)
+    split[:, : 2**D - 1] = rng.random((ntrees, 2**D - 1)) < 0.8
+    value = rng.normal(size=(ntrees, T)).astype(np.float32)
+    X = rng.normal(size=(500, 3))
+    X[rng.random(X.shape) < 0.1] = np.nan
+    out = loader.score_forest(feat, thr, split, value, D, X)
+    if out is None:
+        pytest.skip("native lib lacks h2o3_score_forest (stale build)")
+    total = np.zeros(X.shape[0])
+    for t in range(ntrees):
+        node = np.zeros(X.shape[0], np.int64)
+        for _ in range(D):
+            f = feat[t][node]
+            s = split[t][node]
+            xv = X[np.arange(X.shape[0]), f]
+            right = np.isnan(xv) | (xv > thr[t][node])
+            node = np.where(s, 2 * node + 1 + (right & s).astype(np.int64), node)
+        total += value[t][node]
+    np.testing.assert_allclose(out, total, atol=1e-6)
