@@ -250,13 +250,19 @@ class Frame:
                  else list(column) if column else self.names)
         if by is not None:
             by = [by] if isinstance(by, str) else list(by)
-            keys = np.zeros(self.nrow, np.int64)
-            for b in by:
-                codes = np.nan_to_num(self._vecs[b].numeric_np(), nan=-1).astype(np.int64)
-                keys = keys * (codes.max() + 2) + codes
-            _, groups = np.unique(keys, return_inverse=True)
+            # exact composite keys via row-wise unique — safe for negative /
+            # fractional / NA group values
+            mat = np.column_stack([self._vecs[b].numeric_np() for b in by])
+            _, groups = np.unique(np.nan_to_num(mat, nan=np.inf), axis=0,
+                                  return_inverse=True)
+            groups = groups.reshape(-1)
         else:
             groups = np.zeros(self.nrow, np.int64)
+        # sorted segmentation: one argsort, then per-group contiguous slices
+        order = np.argsort(groups, kind="stable")
+        sorted_groups = groups[order]
+        starts = np.searchsorted(sorted_groups, np.arange(sorted_groups[-1] + 1 if len(sorted_groups) else 0))
+        bounds = list(starts) + [len(order)]
 
         def fill_value(vals):
             if method == "median":
@@ -273,24 +279,23 @@ class Frame:
             v = self._vecs[n]
             if v.type == "enum":
                 codes = np.asarray(v.data).copy()
-                for g in np.unique(groups):
-                    m = groups == g
-                    ok = codes[m] >= 0
+                for gi in range(len(bounds) - 1):
+                    rows = order[bounds[gi]:bounds[gi + 1]]
+                    sub = codes[rows]
+                    ok = sub >= 0
                     if (~ok).any() and ok.any():
-                        mode = np.bincount(codes[m][ok]).argmax()
-                        sub = codes[m]
-                        sub[~ok] = mode
-                        codes[m] = sub
+                        sub[~ok] = np.bincount(sub[ok]).argmax()
+                        codes[rows] = sub
                 self._vecs[n] = Vec(codes.astype(np.int32), "enum", domain=v.domain)
             elif v.type != "string":
-                col = v.numeric_np()
-                for g in np.unique(groups):
-                    m = groups == g
-                    na = np.isnan(col[m])
+                col = v.numeric_np().copy()  # never mutate a shared Vec buffer
+                for gi in range(len(bounds) - 1):
+                    rows = order[bounds[gi]:bounds[gi + 1]]
+                    sub = col[rows]
+                    na = np.isnan(sub)
                     if na.any() and not na.all():
-                        sub = col[m]
                         sub[na] = fill_value(sub)
-                        col[m] = sub
+                        col[rows] = sub
                 self._vecs[n] = Vec(col.astype(np.float32), v.type)
         return self
 

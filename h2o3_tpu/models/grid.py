@@ -23,6 +23,37 @@ import numpy as np
 from ..frame.frame import Frame
 
 
+class _RecoveredModel:
+    """Checkpointed grid model restored from its artifact: scores via the
+    MOJO scorer; metric accessors replay the persisted values so a resumed
+    grid's leaderboard includes pre-crash models."""
+
+    def __init__(self, combo, path, metrics):
+        import os
+
+        self._grid_combo = combo
+        self._path = path
+        self._metrics = metrics
+        self._scorer = None
+        self.model_id = os.path.basename(path)[: -len(".h2o3")]
+        self._parms = dict(combo)
+
+    def predict(self, frame):
+        if self._scorer is None:
+            from ..mojo import load_model
+
+            self._scorer = load_model(self._path)
+        return self._scorer.predict(frame)
+
+    def __getattr__(self, name):
+        metrics = object.__getattribute__(self, "_metrics")
+        if name in ("auc", "rmse", "mse", "logloss", "mae", "r2",
+                    "mean_per_class_error", "pr_auc", "accuracy"):
+            val = metrics.get(name, float("nan"))
+            return lambda *a, **kw: val
+        raise AttributeError(name)
+
+
 class H2OGridSearch:
     def __init__(
         self,
@@ -76,8 +107,10 @@ class H2OGridSearch:
 
     @staticmethod
     def load(recovery_dir: str, grid_id: str) -> "H2OGridSearch":
-        """Re-import a checkpointed grid; train() resumes the remaining
-        combos (h2o.load_grid / grid recovery_dir semantics)."""
+        """Re-import a checkpointed grid; already-built models are restored
+        from their artifacts (so the leaderboard stays complete) and
+        train() resumes only the remaining combos (h2o.load_grid / grid
+        recovery_dir semantics)."""
         import importlib
         import json
         import os
@@ -91,6 +124,11 @@ class H2OGridSearch:
                           recovery_dir=recovery_dir)
         g.base_parms = state["base_parms"]
         g._done_combos = state["done_combos"]
+        for d in g._done_combos:
+            path = os.path.join(recovery_dir, d["file"])
+            if os.path.exists(path):
+                g.models.append(_RecoveredModel(d["params"], path,
+                                                d.get("metrics", {})))
         return g
 
     def _combos(self) -> List[Dict[str, Any]]:
@@ -115,8 +153,8 @@ class H2OGridSearch:
         for combo in self._combos():
             if budget and time.time() - t0 > budget:
                 break
-            if combo in self._done_combos:  # recovered: skip finished combos
-                continue
+            if any(d["params"] == combo for d in self._done_combos):
+                continue  # recovered: finished combos already have artifacts
             parms = dict(self.base_parms)
             parms.update(combo)
             parms.pop("model_id", None)
@@ -132,12 +170,23 @@ class H2OGridSearch:
                 # checkpoint OUTSIDE the train try: an I/O failure must not
                 # mark the built model failed, and a combo only counts as
                 # done once its artifact actually exists on disk (else a
-                # resumed grid would skip it with nothing to restore)
+                # resumed grid would skip it with nothing to restore).
+                # Filenames are combo-indexed (NOT model_id, which restarts
+                # per process and would clobber earlier runs' artifacts).
                 try:
                     from ..mojo import save_model
 
-                    save_model(est, self.recovery_dir)
-                    self._done_combos.append(combo)
+                    fname = f"{self.grid_id}_combo{len(self._done_combos)}.h2o3"
+                    save_model(est, self.recovery_dir, filename=fname)
+                    m = est.model
+                    metrics = dict(m.training_metrics._ser()
+                                   if m.training_metrics else {})
+                    if m.cross_validation_metrics is not None:
+                        metrics.update(m.cross_validation_metrics._ser())
+                    metrics = {k: v for k, v in metrics.items()
+                               if isinstance(v, (int, float, str))}
+                    self._done_combos.append(
+                        dict(params=combo, file=fname, metrics=metrics))
                     self._save_state()
                 except (TypeError, OSError):
                     pass

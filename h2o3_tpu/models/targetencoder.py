@@ -1,0 +1,186 @@
+"""H2OTargetEncoderEstimator — categorical target encoding.
+
+Reference parity: `h2o-algos/src/main/java/ai/h2o/targetencoding/
+TargetEncoder.java` (+ `TargetEncoderModel.java`): per-level target means
+with `data_leakage_handling` ∈ {None, KFold, LeaveOneOut}, PAVLOU-style
+blending toward the prior — lambda = 1/(1+exp(-(n-k)/f)) with
+`inflection_point` k and `smoothing` f — and optional uniform `noise`.
+`transform()` appends `<col>_te` columns. Estimator surface
+`h2o-py/h2o/estimators/targetencoder.py`.
+
+The fit is one segment-mean per encoded column (a psum-able reduction over
+row shards); transforms are table lookups.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..frame.frame import Frame
+from ..frame.vec import Vec
+from .metrics import ModelMetricsBase
+from .model_base import H2OEstimator, H2OModel
+
+
+def _blend(level_sum, level_cnt, prior, k, f):
+    with np.errstate(over="ignore"):
+        lam = 1.0 / (1.0 + np.exp(-(level_cnt - k) / max(f, 1e-12)))
+    mean = level_sum / np.maximum(level_cnt, 1e-12)
+    return lam * mean + (1 - lam) * prior
+
+
+class TargetEncoderModel(H2OModel):
+    algo = "targetencoder"
+
+    def __init__(self, params, x, y, encodings, prior, blending, k, f, noise,
+                 leakage, fold_assign, seed):
+        super().__init__(params)
+        self.x = list(x)
+        self.y = y
+        self.encodings = encodings    # col → (domain, sums, counts)
+        self.prior = prior
+        self.blending = blending
+        self.k = k
+        self.f = f
+        self.noise = noise
+        self.leakage = leakage
+        self._fold_assign = fold_assign  # training-time fold ids (KFold)
+        self.seed = seed
+
+    def _encode_col(self, v: Vec, col: str, sums, cnts, dom,
+                    y_arr: Optional[np.ndarray], as_training: bool) -> np.ndarray:
+        codes = np.asarray(v.data) if v.type == "enum" else v.numeric_np().astype(np.int64)
+        if v.type == "enum" and v.domain != dom and v.domain:
+            lookup = {d: i for i, d in enumerate(dom)}
+            remap = np.asarray([lookup.get(d, -1) for d in v.domain], np.int64)
+            codes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+        n = len(codes)
+        out = np.full(n, self.prior)
+        ok = (codes >= 0) & (codes < len(sums))
+        ci = np.maximum(codes, 0)
+        if as_training and self.leakage == "LeaveOneOut" and y_arr is not None:
+            s = sums[ci] - y_arr
+            c = np.maximum(cnts[ci] - 1, 0)
+        else:
+            s = sums[ci]
+            c = cnts[ci]
+        if self.blending:
+            enc = _blend(s, c, self.prior, self.k, self.f)
+        else:
+            enc = np.where(c > 0, s / np.maximum(c, 1e-12), self.prior)
+        out[ok] = enc[ok]
+        return out
+
+    def transform(self, frame: Frame, as_training: bool = False,
+                  noise: Optional[float] = None) -> Frame:
+        """Append `<col>_te` columns (TargetEncoderModel.transformAsTrainingFrame
+        / transform)."""
+        out = {n: v for n, v in zip(frame.names, frame.vecs())}
+        yv = frame.vec(self.y) if (self.y in frame.names) else None
+        y_arr = None
+        if yv is not None:
+            y_arr = (np.asarray(yv.data, np.float64) if yv.type == "enum"
+                     else yv.numeric_np())
+        rng = np.random.default_rng(self.seed)
+        nz = self.noise if noise is None else noise
+        for col, (dom, sums, cnts, fold_tables) in self.encodings.items():
+            if col not in frame.names:
+                continue
+            v = frame.vec(col)
+            if (as_training and self.leakage == "KFold"
+                    and self._fold_assign is not None
+                    and len(self._fold_assign) == frame.nrow):
+                enc = np.full(frame.nrow, self.prior)
+                codes = np.asarray(v.data)
+                for fid, (fs, fc) in fold_tables.items():
+                    m = self._fold_assign == fid
+                    enc[m] = self._encode_col(
+                        Vec(codes[m], "enum", domain=v.domain), col, fs, fc,
+                        dom, None, False)
+            else:
+                enc = self._encode_col(v, col, sums, cnts, dom, y_arr, as_training)
+            if as_training and nz:
+                enc = enc + rng.uniform(-nz, nz, len(enc))
+            out[f"{col}_te"] = Vec(enc.astype(np.float32), "real")
+        return Frame(out)
+
+    def predict(self, test_data: Frame) -> Frame:
+        return self.transform(test_data)
+
+    def _make_metrics(self, frame: Frame):
+        return self.training_metrics
+
+
+class H2OTargetEncoderEstimator(H2OEstimator):
+    algo = "targetencoder"
+    _param_defaults = dict(
+        columns=None,
+        data_leakage_handling="None",
+        blending=False,
+        inflection_point=10.0,
+        smoothing=20.0,
+        noise=0.01,
+    )
+
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> TargetEncoderModel:
+        p = self._parms
+        cols: List[str] = list(p.get("columns") or
+                               [c for c in x if train.vec(c).type == "enum"])
+        yvec = train.vec(y)
+        y_arr = (np.asarray(yvec.data, np.float64) if yvec.type == "enum"
+                 else yvec.numeric_np())
+        prior = float(np.nanmean(y_arr))
+        leakage = str(p.get("data_leakage_handling", "None"))
+        seed = int(self._parms.get("_actual_seed", 1234))
+
+        fold_assign = None
+        if leakage == "KFold":
+            fc = p.get("fold_column")
+            if fc:
+                fold_assign = train.vec(fc).numeric_np().astype(np.int64)
+            else:
+                rng = np.random.default_rng(seed)
+                fold_assign = rng.integers(0, 5, train.nrow)
+
+        encodings: Dict[str, tuple] = {}
+        for col in cols:
+            v = train.vec(col)
+            if v.type != "enum":
+                continue
+            codes = np.asarray(v.data)
+            K = max(v.nlevels, 1)
+            ok = codes >= 0
+            sums = np.zeros(K)
+            cnts = np.zeros(K)
+            np.add.at(sums, codes[ok], y_arr[ok])
+            np.add.at(cnts, codes[ok], 1.0)
+            fold_tables = {}
+            if fold_assign is not None:
+                # out-of-fold tables: global minus the fold's own rows
+                for fid in np.unique(fold_assign):
+                    m = ok & (fold_assign == fid)
+                    fs = sums.copy()
+                    fc_ = cnts.copy()
+                    np.add.at(fs, codes[m], -y_arr[m])
+                    np.add.at(fc_, codes[m], -1.0)
+                    fold_tables[fid] = (fs, fc_)
+            encodings[col] = (v.domain, sums, cnts, fold_tables)
+
+        model = TargetEncoderModel(
+            self, cols, y, encodings, prior,
+            bool(p.get("blending", False)),
+            float(p.get("inflection_point", 10.0)),
+            float(p.get("smoothing", 20.0)),
+            float(p.get("noise", 0.01)),
+            leakage, fold_assign, seed,
+        )
+        model.training_metrics = ModelMetricsBase(nobs=train.nrow)
+        return model
+
+    def transform(self, frame: Frame, **kw) -> Frame:
+        return self.model.transform(frame, **kw)
+
+
+TargetEncoder = H2OTargetEncoderEstimator

@@ -108,15 +108,22 @@ def test_grid_recovery_resume(tmp_path, cloud1):
                       grid_id="g1", recovery_dir=str(tmp_path))
     g.train(x=["a"], y="y", training_frame=fr)
     assert len(g.models) == 4
-    # resume: all 4 combos already done -> no retraining
+    # resume: all 4 combos already done -> models restored, no retraining
     g2 = H2OGridSearch.load(str(tmp_path), "g1")
     assert len(g2._done_combos) == 4
+    assert len(g2.models) == 4  # leaderboard complete from artifacts
+    # recovered models score and expose persisted metrics
+    p = g2.models[0].predict(fr)
+    assert p.nrow == fr.nrow
+    assert np.isfinite(g2.models[0].rmse())
+    n_before = len(g2.models)
     g2.train(x=["a"], y="y", training_frame=fr)
-    assert len(g2.models) == 0  # nothing left to do
+    assert len(g2.models) == n_before  # nothing left to do
     # partial recovery: drop two combos from the state, resume builds them
     g2._done_combos = g2._done_combos[:2]
+    g2.models = g2.models[:2]
     g2.train(x=["a"], y="y", training_frame=fr)
-    assert len(g2.models) == 2
+    assert len(g2.models) == 4
 
 
 def test_impute_by_group_and_mode(cloud1):
@@ -131,3 +138,43 @@ def test_impute_by_group_and_mode(cloud1):
     assert fr2.vec("a").numeric_np()[3] == 5.0
     with pytest.raises(ValueError):
         fr2.impute("a", method="bogus")
+
+
+def test_target_encoder(cloud1):
+    from h2o3_tpu.models.targetencoder import H2OTargetEncoderEstimator
+
+    rng = np.random.default_rng(0)
+    n = 1000
+    lv = rng.integers(0, 3, n)
+    y = (rng.uniform(size=n) < [0.2, 0.5, 0.8][0] * 0 + np.asarray([0.2, 0.5, 0.8])[lv]).astype(int)
+    fr = Frame.from_dict({
+        "c": np.asarray(["a", "b", "d"], dtype=object)[lv],
+        "y": np.asarray(["no", "yes"], dtype=object)[y],
+    }, column_types={"c": "enum", "y": "enum"})
+    te = H2OTargetEncoderEstimator(columns=["c"], noise=0.0)
+    te.train(x=["c"], y="y", training_frame=fr)
+    out = te.transform(fr)
+    enc = out.vec("c_te").numeric_np()
+    # per-level encodings approximate the level response rates
+    for code, rate in [(0, 0.2), (1, 0.5), (2, 0.8)]:
+        got = enc[lv == code][0]
+        assert abs(got - rate) < 0.08
+    # blending pulls rare levels toward the prior
+    te2 = H2OTargetEncoderEstimator(columns=["c"], blending=True,
+                                    inflection_point=10000, smoothing=20, noise=0.0)
+    te2.train(x=["c"], y="y", training_frame=fr)
+    enc2 = te2.transform(fr).vec("c_te").numeric_np()
+    prior = te2.model.prior
+    assert np.all(np.abs(enc2 - prior) < np.abs(enc - prior) + 1e-12)
+    # LOO excludes the row's own target
+    te3 = H2OTargetEncoderEstimator(columns=["c"],
+                                    data_leakage_handling="LeaveOneOut", noise=0.0)
+    te3.train(x=["c"], y="y", training_frame=fr)
+    loo = te3.transform(fr, as_training=True).vec("c_te").numeric_np()
+    assert not np.allclose(loo, enc)
+    # KFold: out-of-fold encodings differ across folds
+    te4 = H2OTargetEncoderEstimator(columns=["c"],
+                                    data_leakage_handling="KFold", noise=0.0)
+    te4.train(x=["c"], y="y", training_frame=fr)
+    kf = te4.transform(fr, as_training=True).vec("c_te").numeric_np()
+    assert len(np.unique(np.round(kf[lv == 0], 6))) > 1
