@@ -100,6 +100,15 @@ def build_bins(
                 lo, hi = float(fin.min()), float(fin.max())
                 if histogram_type == "UniformAdaptive":
                     e = np.linspace(lo, hi, nvalue + 1)[1:-1]
+                    # arithmetic quantize == searchsorted(e, col, 'left') for
+                    # uniform edges, ~30x cheaper than the binary search
+                    step = (hi - lo) / nvalue if hi > lo else 1.0
+                    c = np.ceil(np.nan_to_num((col - lo) / step, nan=0.0)
+                                ).astype(np.int64) - 1
+                    c = np.where(na, 0, np.clip(c, 0, nvalue - 1))
+                    codes[:, j] = np.where(na, nvalue, c).astype(dtype)
+                    edges.append(np.asarray(e, dtype=np.float64))
+                    continue
                 elif histogram_type == "QuantilesGlobal":
                     qs = np.linspace(0, 1, nvalue + 1)[1:-1]
                     e = np.unique(np.quantile(fin, qs))

@@ -375,6 +375,69 @@ class Frame:
         codes = np.where(oob, -1, codes).astype(np.int32)
         return Frame({self.names[0]: Vec(codes, "enum", domain=dom)})
 
+    # time ops (water/rapids/ast/prims/time/*) — epoch-millis "time" columns
+    def _dt64(self):
+        """(datetime64[ms] values, na_mask) of the first column."""
+        col = self._col0()
+        return col.astype("datetime64[ms]"), np.isnan(col)
+
+    def _time_part(self, fn) -> "Frame":
+        dt, na = self._dt64()
+        vals = fn(dt).astype(np.float64)
+        return Frame.from_dict({self.names[0]: np.where(na, np.nan, vals)})
+
+    def year(self) -> "Frame":
+        return self._time_part(lambda d: 1970 + d.astype("datetime64[Y]").astype(np.int64))
+
+    def month(self) -> "Frame":
+        return self._time_part(
+            lambda d: (d.astype("datetime64[M]")
+                       - d.astype("datetime64[Y]")).astype(np.int64) + 1)
+
+    def day(self) -> "Frame":
+        return self._time_part(
+            lambda d: (d.astype("datetime64[D]")
+                       - d.astype("datetime64[M]")).astype(np.int64) + 1)
+
+    def hour(self) -> "Frame":
+        return self._time_part(
+            lambda d: (d - d.astype("datetime64[D]")).astype("timedelta64[h]").astype(np.int64))
+
+    def minute(self) -> "Frame":
+        return self._time_part(
+            lambda d: ((d - d.astype("datetime64[h]"))
+                       .astype("timedelta64[m]").astype(np.int64)))
+
+    def second(self) -> "Frame":
+        return self._time_part(
+            lambda d: ((d - d.astype("datetime64[m]"))
+                       .astype("timedelta64[s]").astype(np.int64)))
+
+    def dayOfWeek(self) -> "Frame":
+        # epoch day 0 = Thursday; Monday = 0 (h2o's Mon-first ordering)
+        return self._time_part(
+            lambda d: (d.astype("datetime64[D]").astype(np.int64) + 3) % 7)
+
+    day_of_week = dayOfWeek
+
+    def hist(self, breaks=20, plot: bool = False) -> "Frame":
+        """Histogram table: breaks/counts/mids (H2OFrame.hist, AstHist)."""
+        col = self._col0()
+        fin = col[~np.isnan(col)]
+        if fin.size == 0:
+            return Frame.from_dict({"breaks": np.zeros(0), "counts": np.zeros(0),
+                                    "mids": np.zeros(0)})
+        if isinstance(breaks, int):
+            edges = np.linspace(fin.min(), fin.max(), breaks + 1)
+        else:
+            edges = np.asarray(breaks, np.float64)
+        counts, edges = np.histogram(fin, bins=edges)
+        return Frame.from_dict({
+            "breaks": edges[1:],
+            "counts": counts.astype(np.float64),
+            "mids": (edges[:-1] + edges[1:]) / 2.0,
+        })
+
     # string ops (water/rapids/ast/prims/string/*) — enum/string columns
     def _map_strings(self, fn) -> "Frame":
         out = {}

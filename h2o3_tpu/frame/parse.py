@@ -116,10 +116,13 @@ def _tokenize_numpy(path: str, sep: str, header: bool, ncol: int) -> List[np.nda
 
 def _column_to_vec(col: np.ndarray, hint: Optional[str]) -> Vec:
     if hint in ("real", "int", "numeric", "float"):
+        from .vec import _maybe_f32
+
         vals = np.asarray(
-            [np.nan if str(v).strip() in _NA_TOKENS else float(v) for v in col], dtype=np.float32
+            [np.nan if str(v).strip() in _NA_TOKENS else float(v) for v in col],
+            dtype=np.float64,
         )
-        return Vec(vals, "real")
+        return Vec(_maybe_f32(vals), "real")
     if hint in ("enum", "factor", "categorical"):
         return Vec.from_numpy(col.astype(object), "enum")
     if hint == "string":

@@ -67,9 +67,10 @@ class Vec:
             try:
                 as_num = np.asarray(
                     [np.nan if v in ("", "NA", "na", "nan", None) else float(v) for v in col],
-                    dtype=np.float32,
+                    dtype=np.float64,
                 )
-                return Vec(as_num, "real" if not _all_int(as_num) else "int")
+                return Vec(_maybe_f32(as_num),
+                           "real" if not _all_int(as_num) else "int")
             except (TypeError, ValueError):
                 pass
             if type_hint == "string":
@@ -91,7 +92,7 @@ class Vec:
             ]
             return Vec(full, "enum", domain=labels)
         t = "int" if col.dtype.kind in "iub" or _all_int(col) else "real"
-        return Vec(col.astype(np.float32), t)
+        return Vec(_maybe_f32(col.astype(np.float64)), t)
 
     # -- properties ---------------------------------------------------------
     def __len__(self) -> int:
@@ -142,6 +143,14 @@ class Vec:
 
     def __repr__(self):
         return f"Vec(type={self.type}, len={len(self)}, domain={self.nlevels or None})"
+
+
+def _maybe_f32(col: np.ndarray) -> np.ndarray:
+    """Downcast f64 → f32 unless magnitudes exceed f32's exact-integer
+    range — epoch-ms timestamps ("time" columns) would lose minutes."""
+    fin = col[np.isfinite(col)]
+    big = float(np.abs(fin).max()) if fin.size else 0.0
+    return col if big > (1 << 24) else col.astype(np.float32)
 
 
 def _all_int(a: np.ndarray) -> bool:

@@ -33,6 +33,41 @@ def _avg_path_length(n: float) -> float:
     return 2.0 * h - 2.0 * (n - 1) / n
 
 
+def forest_path_lengths(trees, X: np.ndarray, max_depth: int) -> np.ndarray:
+    """Mean path length per row over the forest (shared by the live model and
+    the MOJO scorer). `trees` = iterable of (feat, thr, is_split, leaf_n)."""
+    n = X.shape[0]
+    total = np.zeros(n)
+    ntrees = 0
+    for feat, thr, split, leaf_n in trees:
+        ntrees += 1
+        node = np.zeros(n, np.int64)
+        depth = np.zeros(n)
+        for _ in range(max_depth):
+            s = split[node]
+            xv = X[np.arange(n), feat[node]]
+            right = np.isnan(xv) | (xv > thr[node])
+            child = 2 * node + 1 + (right & s).astype(np.int64)
+            depth = depth + s.astype(np.float64)
+            node = np.where(s, child, node)
+        # add c(leaf size): unresolved subtree correction
+        ln = leaf_n[node]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            corr = np.where(
+                ln > 1,
+                2.0 * (np.log(np.maximum(ln - 1, 1)) + 0.5772156649)
+                - 2.0 * (ln - 1) / np.maximum(ln, 1),
+                0.0,
+            )
+        total += depth + corr
+    return total / max(ntrees, 1)
+
+
+def anomaly_scores(path_lengths: np.ndarray, sample_size: int) -> np.ndarray:
+    c = _avg_path_length(sample_size)
+    return np.power(2.0, -path_lengths / max(c, 1e-12))
+
+
 class IsolationForestModel(H2OModel):
     algo = "isolationforest"
 
@@ -45,28 +80,12 @@ class IsolationForestModel(H2OModel):
         self.max_depth = max_depth
 
     def _path_lengths(self, X: np.ndarray) -> np.ndarray:
-        n = X.shape[0]
-        D = self.max_depth
-        total = np.zeros(n)
-        for feat, thr, split, leaf_n in self.trees:
-            node = np.zeros(n, np.int64)
-            depth = np.zeros(n)
-            for _ in range(D):
-                s = split[node]
-                xv = X[np.arange(n), feat[node]]
-                right = np.isnan(xv) | (xv > thr[node])
-                child = 2 * node + 1 + (right & s).astype(np.int64)
-                depth = depth + s.astype(np.float64)
-                node = np.where(s, child, node)
-            # add c(leaf size): unresolved subtree correction
-            total += depth + np.asarray([_avg_path_length(m) for m in leaf_n[node]])
-        return total / max(len(self.trees), 1)
+        return forest_path_lengths(self.trees, X, self.max_depth)
 
     def predict(self, test_data: Frame) -> Frame:
         X, _, _ = frame_to_matrix(test_data, self.x)
         pl = self._path_lengths(X)
-        c = _avg_path_length(self.sample_size)
-        score = np.power(2.0, -pl / max(c, 1e-12))
+        score = anomaly_scores(pl, self.sample_size)
         return Frame.from_dict({"predict": score, "mean_length": pl})
 
     def _make_metrics(self, frame: Frame):

@@ -45,14 +45,12 @@ def auc_exact(y: np.ndarray, p: np.ndarray) -> float:
     order = np.argsort(p, kind="mergesort")
     ranks = np.empty_like(order, dtype=np.float64)
     ranks[order] = np.arange(1, len(p) + 1)
-    # average ranks over ties
+    # average ranks over ties (vectorized run-length expansion)
     ps = np.asarray(p)[order]
     uniq, start = np.unique(ps, return_index=True)
     end = np.append(start[1:], len(ps))
     avg = (start + 1 + end) / 2.0
-    tie_rank = np.empty(len(ps))
-    for s, e, a in zip(start, end, avg):
-        tie_rank[s:e] = a
+    tie_rank = np.repeat(avg, end - start)
     r = np.empty_like(tie_rank)
     r[order] = tie_rank
     npos = y.sum()

@@ -73,10 +73,18 @@ def _model_payload(model) -> Dict[str, Any]:
             arrays["stds"] = model.dinfo.stds
         meta["dinfo"] = _dinfo_meta(model.dinfo)
     else:
+        from .models.isolation_forest import IsolationForestModel
         from .models.kmeans import KMeansModel
         from .models.pca import PCAModel
 
-        if isinstance(model, KMeansModel):
+        if isinstance(model, IsolationForestModel):
+            meta.update(kind="isoforest", sample_size=model.sample_size,
+                        max_depth=model.max_depth, ntrees=len(model.trees))
+            arrays["if_feat"] = np.stack([t[0] for t in model.trees]).astype(np.int32)
+            arrays["if_thr"] = np.stack([t[1] for t in model.trees]).astype(np.float32)
+            arrays["if_split"] = np.stack([t[2] for t in model.trees])
+            arrays["if_leafn"] = np.stack([t[3] for t in model.trees]).astype(np.float64)
+        elif isinstance(model, KMeansModel):
             meta.update(kind="kmeans", k=model.k)
             arrays["centers_std"] = np.asarray(model.centers_std)
             if model.dinfo.means is not None:
@@ -292,6 +300,15 @@ class MojoScorer:
             if fam in ("poisson", "gamma", "tweedie"):
                 eta = np.exp(eta)
             return Frame.from_dict({"predict": eta})
+        if kind == "isoforest":
+            from .models.isolation_forest import anomaly_scores, forest_path_lengths
+
+            X = self._matrix(data)
+            trees = zip(self.arrays["if_feat"], self.arrays["if_thr"],
+                        self.arrays["if_split"], self.arrays["if_leafn"])
+            pl = forest_path_lengths(trees, X, self.meta["max_depth"])
+            score = anomaly_scores(pl, self.meta["sample_size"])
+            return Frame.from_dict({"predict": score, "mean_length": pl})
         if kind == "kmeans":
             X = self._expand_dinfo(data)
             c = self.arrays["centers_std"]

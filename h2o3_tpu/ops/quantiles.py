@@ -1,0 +1,80 @@
+"""Distributed quantiles — the multi-host edge-finding primitive.
+
+Reference parity: `h2o-algos/src/main/java/hex/quantile/Quantile.java` —
+the exact distributed quantile MRTask that feeds `QuantilesGlobal`
+histograms and quantile loss: per-node value histograms are tree-reduced,
+the target bin located from merged counts, then refined by re-histogramming
+inside that bin. On TPU the same two ideas become one compiled program:
+
+* per-shard fixed-width histogram over the global [min, max] range —
+  `lax.psum` merges shards (the MRTask.reduce step);
+* iterative refinement re-histograms inside the bracketing bin, so k
+  rounds give (nbins)^k effective resolution without sorting or gathering
+  row data across hosts.
+
+Runs under `shard_map` with rows sharded over the ``hosts`` axis; on one
+device it degenerates to plain histogramming (axis_name=None).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("probs", "nbins", "iters", "axis_name")
+)
+def distributed_quantiles(
+    x: jax.Array,            # (N,) shard-local values (NaN = NA, ignored)
+    w: jax.Array,            # (N,) shard-local weights (0 masks rows/padding)
+    probs: tuple,            # quantile probabilities, static
+    nbins: int = 1024,
+    iters: int = 3,
+    axis_name: Optional[str] = None,
+):
+    """Weighted quantiles of the global (cross-shard) distribution.
+
+    Returns (len(probs),) values. Accuracy: range/(nbins^iters) per
+    quantile — 1024^3 buckets covers float32 exactly for practical data.
+    """
+    valid = ~jnp.isnan(x) & (w > 0)
+    xz = jnp.where(valid, x, 0.0)
+    big = jnp.float32(3.4e38)
+
+    def allred(v, op):
+        return jax.lax.psum(v, axis_name) if (axis_name and op == "sum") else (
+            jax.lax.pmin(v, axis_name) if (axis_name and op == "min") else (
+                jax.lax.pmax(v, axis_name) if (axis_name and op == "max") else v))
+
+    gmin = allred(jnp.min(jnp.where(valid, x, big)), "min")
+    gmax = allred(jnp.max(jnp.where(valid, x, -big)), "max")
+    wtot = allred(jnp.sum(jnp.where(valid, w, 0.0)), "sum")
+
+    def hist(lo, hi):
+        """Weighted histogram of values in [lo, hi) + weight below lo."""
+        span = jnp.maximum(hi - lo, 1e-300)
+        b = jnp.clip(((xz - lo) / span * nbins).astype(jnp.int32), 0, nbins - 1)
+        inside = valid & (xz >= lo) & (xz <= hi)
+        h = jax.ops.segment_sum(jnp.where(inside, w, 0.0), b, num_segments=nbins)
+        below = jnp.sum(jnp.where(valid & (xz < lo), w, 0.0))
+        return allred(h, "sum"), allred(below, "sum")
+
+    out = []
+    for p in probs:
+        target = jnp.asarray(p, jnp.float32) * wtot
+        lo, hi = gmin, gmax
+        for _ in range(iters):
+            h, below = hist(lo, hi)
+            cum = jnp.cumsum(h) + below
+            # first bin where cumulative weight reaches the target
+            k = jnp.argmax(cum >= target)
+            span = jnp.maximum(hi - lo, 1e-300) / nbins
+            new_lo = lo + k.astype(jnp.float32) * span
+            hi = new_lo + span
+            lo = new_lo
+        out.append((lo + hi) * 0.5)
+    return jnp.stack(out)

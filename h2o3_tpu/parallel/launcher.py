@@ -1,0 +1,86 @@
+"""Multi-host launcher — cluster bring-up for TPU pods.
+
+Reference parity: `h2o-hadoop-common`'s `h2odriver` (launches the JVM cloud
+on YARN) and the `h2o-k8s` helm/stateful-set launcher, plus `H2O.main`'s
+clouding handshake (SURVEY.md §3.1). The TPU equivalent is one Python
+process per TPU host joined through the JAX coordination service:
+`jax.distributed.initialize` replaces Paxos/flatfile discovery — the
+coordinator address is the flatfile, `process_id` the node index, and the
+"cloud locks" when every process has connected.
+
+Usage (one command per host, e.g. via `gcloud compute tpus tpu-vm ssh
+--worker=all`):
+
+    python -m h2o3_tpu.parallel.launcher \
+        --coordinator ${HOST0_IP}:8476 --nprocs 8 --rank ${WORKER_ID} \
+        train_script.py [script args...]
+
+or programmatically: `launcher.initialize_multihost(...)` then `h2o.init()`.
+On a TPU VM the rank/nprocs/coordinator can usually be omitted — JAX infers
+them from the TPU metadata (the auto path below).
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+from typing import Optional
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join (or form) the multi-host cloud. Returns cloud facts.
+
+    With no arguments, uses JAX's auto-detection (TPU pod metadata) — the
+    analog of multicast discovery; with explicit arguments it behaves like
+    flatfile clouding.
+    """
+    import jax
+
+    if coordinator_address or num_processes or process_id is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    else:
+        try:
+            jax.distributed.initialize()
+        except (ValueError, RuntimeError):
+            pass  # single-process (no pod metadata): 1-node cloud
+    return dict(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_devices=len(jax.local_devices()),
+        global_devices=len(jax.devices()),
+    )
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="h2o3_tpu multi-host launcher (h2odriver equivalent)")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (the 'flatfile' head)")
+    ap.add_argument("--nprocs", type=int, default=None)
+    ap.add_argument("--rank", type=int, default=None)
+    ap.add_argument("script", help="training script to run after clouding")
+    ap.add_argument("script_args", nargs="*")
+    args = ap.parse_args(argv)
+
+    facts = initialize_multihost(args.coordinator, args.nprocs, args.rank)
+    from ..runtime.log import Log
+
+    Log.info(f"cloud up: process {facts['process_index']}/{facts['process_count']}"
+             f" with {facts['local_devices']} local device(s)")
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

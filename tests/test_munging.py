@@ -178,3 +178,19 @@ def test_target_encoder(cloud1):
     te4.train(x=["c"], y="y", training_frame=fr)
     kf = te4.transform(fr, as_training=True).vec("c_te").numeric_np()
     assert len(np.unique(np.round(kf[lv == 0], 6))) > 1
+
+
+def test_time_ops_and_hist(cloud1):
+    # 2020-03-15 13:45:30 UTC = 1584279930000 ms
+    ms = 1584279930000.0
+    fr = Frame.from_dict({"t": np.asarray([ms, np.nan])})
+    assert fr.year().vec("t").numeric_np()[0] == 2020
+    assert fr.month().vec("t").numeric_np()[0] == 3
+    assert fr.day().vec("t").numeric_np()[0] == 15
+    assert fr.hour().vec("t").numeric_np()[0] == 13
+    assert fr.minute().vec("t").numeric_np()[0] == 45
+    assert fr.second().vec("t").numeric_np()[0] == 30
+    assert fr.dayOfWeek().vec("t").numeric_np()[0] == 6  # Sunday, Mon=0
+    assert np.isnan(fr.year().vec("t").numeric_np()[1])
+    h = Frame.from_dict({"a": np.r_[np.zeros(10), np.ones(30)]}).hist(breaks=2)
+    assert h.vec("counts").numeric_np().tolist() == [10.0, 30.0]

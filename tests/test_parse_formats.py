@@ -169,3 +169,28 @@ def test_native_forest_scorer_parity(cloud1):
             node = np.where(s, 2 * node + 1 + (right & s).astype(np.int64), node)
         total += value[t][node]
     np.testing.assert_allclose(out, total, atol=1e-6)
+
+
+def test_mojo_isolation_forest_roundtrip(tmp_path, cloud1):
+    from h2o3_tpu.estimators import H2OIsolationForestEstimator
+    from h2o3_tpu.frame.frame import Frame
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 4))
+    X[:3] += 6.0
+    fr = Frame.from_numpy(X, names=["a", "b", "c", "d"])
+    iso = H2OIsolationForestEstimator(ntrees=20, sample_size=64, seed=4)
+    iso.train(x=["a", "b", "c", "d"], training_frame=fr)
+    path = h2o.save_model(iso, str(tmp_path))
+    sc = h2o.load_model(path)
+    p_live = iso.predict(fr).vec("predict").numeric_np()
+    p_mojo = sc.predict(fr).vec("predict").numeric_np()
+    np.testing.assert_allclose(p_live, p_mojo, atol=1e-6)
+
+
+def test_multihost_launcher_single_process(cloud1):
+    from h2o3_tpu.parallel.launcher import initialize_multihost
+
+    facts = initialize_multihost()
+    assert facts["process_count"] >= 1
+    assert facts["global_devices"] >= facts["local_devices"] >= 1
