@@ -204,4 +204,69 @@ class RapidsSession:
             return Frame(dict(zip(new, fr.vecs())))
         if op == "tokenize":
             return a[0].tokenize(str(a[1]))
+        def _truthy(v, default=True):
+            """Rapids booleans arrive as TRUE/FALSE symbols or 0/1 numbers."""
+            if v is None:
+                return default
+            if isinstance(v, str):
+                return v.upper() in ("TRUE", "T", "1")
+            if isinstance(v, (int, float)):
+                return bool(v)
+            raise ValueError(f"Rapids: expected a boolean, got {v!r}")
+
+        if op == "sort":
+            fr, sel = a[0], a[1]
+            cols = [int(i) for i in (sel if isinstance(sel, list) else [sel])]
+            asc = True
+            if len(a) > 2:  # ascending flags per key column
+                flags = a[2] if isinstance(a[2], list) else [a[2]]
+                asc = [_truthy(f) for f in flags]
+                if len(asc) == 1:
+                    asc = asc[0]
+            return fr.sort([fr.names[i] for i in cols], ascending=asc)
+        if op == "h2o.impute":
+            fr = a[0]
+            col = int(a[1]) if len(a) > 1 else None
+            method = str(a[2]).lower() if len(a) > 2 else "mean"
+            by = None
+            if len(a) > 4 and isinstance(a[4], list) and a[4]:
+                by = [fr.names[int(i)] for i in a[4]]
+            return fr.impute(fr.names[col] if col is not None and col >= 0 else None,
+                             method=method, by=by)
+        if op == "scale":
+            # per-column numeric center/scale lists are a reference feature
+            # this subset doesn't implement — reject rather than silently
+            # substituting computed statistics
+            for v in a[1:3]:
+                if isinstance(v, list):
+                    raise ValueError("Rapids scale: per-column center/scale "
+                                     "lists not supported")
+            center = _truthy(a[1] if len(a) > 1 else None)
+            sc = _truthy(a[2] if len(a) > 2 else None)
+            return a[0].scale(center=center, scale=sc)
+        if op == "hist":
+            return a[0].hist(int(a[1]) if len(a) > 1 else 20)
+        if op == "cut":
+            return a[0].cut([float(b) for b in a[1]])
+        if op in ("year", "month", "day", "hour", "minute", "second",
+                  "dayOfWeek"):
+            return getattr(a[0], op)()
+        if op in ("trim", "tolower", "toupper", "na.omit"):
+            meth = {"na.omit": "na_omit"}.get(op, op)
+            return getattr(a[0], meth)()
+        if op in ("replacefirst", "replaceall"):
+            fn = "sub" if op == "replacefirst" else "gsub"
+            return getattr(a[0], fn)(str(a[1]), str(a[2]))
+        if op == "strsplit":
+            return a[0].strsplit(str(a[1]))
+        if op == "countmatches":
+            return a[0].countmatches(a[1] if isinstance(a[1], list) else str(a[1]))
+        if op == "is.na":
+            v = a[0]
+            if isinstance(v, (int, float)):
+                return Frame.from_dict({"isNA": np.asarray(
+                    [float(v != v)])})  # NaN-aware scalar
+            return Frame.from_dict(
+                {n: c.isna_np().astype(np.float64)
+                 for n, c in zip(v.names, v.vecs())})
         raise ValueError(f"Rapids: unknown op {op!r}")

@@ -99,6 +99,43 @@ class ModelMetricsRegression(ModelMetricsBase):
         )
 
 
+def gains_lift_table(y: np.ndarray, p: np.ndarray, groups: int = 16):
+    """Quantile gains/lift table — `hex/GainsLift.java` (16 groups default):
+    per group cumulative capture rate, lift, response rate."""
+    y = np.asarray(y, np.float64)
+    order = np.argsort(-np.asarray(p), kind="mergesort")
+    ys = y[order]
+    ps = np.asarray(p)[order]
+    n = len(ys)
+    total_pos = max(ys.sum(), 1e-12)
+    bounds = np.unique((np.arange(1, groups + 1) * n) // groups)
+    bounds = bounds[bounds > 0]  # n < groups would emit an empty first group
+    rows = []
+    prev = 0
+    cum_pos = 0.0
+    overall_rate = total_pos / n
+    for b in bounds:
+        grp = ys[prev:b]
+        s = grp.sum()
+        cum_pos += s
+        rate = s / max(len(grp), 1)
+        rows.append(dict(
+            group=len(rows) + 1,
+            cumulative_data_fraction=b / n,
+            lower_threshold=float(ps[b - 1]),
+            lift=float(rate / overall_rate),
+            cumulative_lift=float((cum_pos / b) / overall_rate),
+            response_rate=float(rate),
+            cumulative_response_rate=float(cum_pos / b),
+            capture_rate=float(s / total_pos),
+            cumulative_capture_rate=float(cum_pos / total_pos),
+            gain=100.0 * (rate / overall_rate - 1),
+            cumulative_gain=100.0 * ((cum_pos / b) / overall_rate - 1),
+        ))
+        prev = b
+    return rows
+
+
 @dataclass
 class ModelMetricsBinomial(ModelMetricsBase):
     auc: float = float("nan")
@@ -110,6 +147,15 @@ class ModelMetricsBinomial(ModelMetricsBase):
     accuracy: float = float("nan")
     confusion_matrix: Optional[np.ndarray] = None
     threshold: float = 0.5
+    gains_lift_table: Optional[List[Dict]] = None
+    _roc: Optional[tuple] = None
+
+    def gains_lift(self):
+        return self.gains_lift_table
+
+    def roc(self):
+        """(fpr, tpr) arrays over the binned threshold sweep (AUC2)."""
+        return self._roc
 
     @staticmethod
     def make(y: np.ndarray, p: np.ndarray) -> "ModelMetricsBinomial":
@@ -140,6 +186,8 @@ class ModelMetricsBinomial(ModelMetricsBase):
             auc=auc, pr_auc=pr_auc, logloss=logloss, gini=2 * auc - 1,
             mean_per_class_error=(err0 + err1) / 2, f1=float(f1s[bi]),
             accuracy=float((yhat == y).mean()), confusion_matrix=cm, threshold=thr,
+            gains_lift_table=gains_lift_table(y, p),
+            _roc=(fpr, tpr),
         )
 
 

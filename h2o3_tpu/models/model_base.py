@@ -226,6 +226,14 @@ class H2OModel:
     def varimp(self, use_pandas=False):
         return self.varimp_table
 
+    def gains_lift(self, valid=False, xval=False):
+        m = self._m(valid, xval)
+        return m.gains_lift() if hasattr(m, "gains_lift") else None
+
+    def roc(self, valid=False, xval=False):
+        m = self._m(valid, xval)
+        return m.roc() if hasattr(m, "roc") else None
+
     def predict(self, test_data: Frame) -> Frame:
         raise NotImplementedError
 

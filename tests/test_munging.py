@@ -194,3 +194,24 @@ def test_time_ops_and_hist(cloud1):
     assert np.isnan(fr.year().vec("t").numeric_np()[1])
     h = Frame.from_dict({"a": np.r_[np.zeros(10), np.ones(30)]}).hist(breaks=2)
     assert h.vec("counts").numeric_np().tolist() == [10.0, 30.0]
+
+
+def test_gains_lift_and_roc(cloud1):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(2)
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0).astype(int)
+    fr = Frame.from_dict({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "y": np.asarray(["n", "p"], dtype=object)[y]}, column_types={"y": "enum"})
+    m = H2OGradientBoostingEstimator(ntrees=10, max_depth=3)
+    m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    gl = m.model.gains_lift()
+    assert gl and len(gl) >= 10
+    # top group captures far above average; cumulative capture ends at 1
+    assert gl[0]["lift"] > 1.5
+    assert gl[-1]["cumulative_capture_rate"] == pytest.approx(1.0)
+    fpr, tpr = m.model.roc()
+    assert len(fpr) == len(tpr) and (np.diff(fpr) <= 1e-12).all()  # desc sweep

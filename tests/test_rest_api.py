@@ -157,3 +157,25 @@ def test_error_handling(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(srv, "/3/ModelBuilders/nosuchalgo", training_frame="x")
     assert e.value.code == 404
+
+
+def test_rapids_extended_prims(server):
+    srv, csv = server
+    r = _post(srv, "/3/ImportFiles", path=csv)
+    key = r["destination_frames"][0]
+    # sort by column 0 ascending → first value is the min
+    out = _post(srv, "/99/Rapids", ast=f"(assign srt (sort {key} [0]))")
+    mn = _post(srv, "/99/Rapids", ast=f"(min (cols {key} [0]))")["scalar"]
+    first = out["columns"][0]["data"][0]
+    assert abs(first - mn) < 1e-6
+    # scale → mean 0
+    _post(srv, "/99/Rapids", ast=f"(assign sc (scale (cols {key} [0]) 1 1))")
+    m = _post(srv, "/99/Rapids", ast="(mean sc)")["scalar"]
+    assert abs(m) < 1e-6
+    # hist returns a table frame
+    h = _post(srv, "/99/Rapids", ast=f"(hist (cols {key} [0]) 5)")
+    names = [c["label"] for c in h["columns"]]
+    assert set(names) == {"breaks", "counts", "mids"}
+    # is.na
+    na = _post(srv, "/99/Rapids", ast=f"(sum (is.na (cols {key} [0])))")
+    assert na["scalar"] == 0.0
