@@ -42,8 +42,9 @@ class Leaderboard:
         self.decreasing = decreasing
         self.rows: List[Dict] = []
 
-    def add(self, est):
-        m = est.model._m(xval=True)
+    def add(self, est, lb_frame=None):
+        m = (est.model.model_performance(lb_frame) if lb_frame is not None
+             else est.model._m(xval=True))
         row = {
             "model_id": est.model_id,
             "algo": est.algo,
@@ -97,6 +98,7 @@ class H2OAutoML:
         **kw,
     ):
         self.max_models = max_models
+        self._lb_frame = None
         self.max_runtime_secs = max_runtime_secs
         self.max_runtime_secs_per_model = max_runtime_secs_per_model
         self.seed = seed if seed is not None else 1234
@@ -176,7 +178,7 @@ class H2OAutoML:
             est.train(x=x, y=y, training_frame=training_frame)
             est._automl_name = name
             self._models.append(est)
-            self.leaderboard.add(est)
+            self.leaderboard.add(est, self._lb_frame)
             self.event_log.log("model", f"built {name} ({est.model_id})")
             return True
         except Exception as e:
@@ -223,6 +225,7 @@ class H2OAutoML:
               validation_frame=None, leaderboard_frame=None, blending_frame=None,
               **kw):
         assert training_frame is not None and y is not None
+        self._lb_frame = leaderboard_frame
         t0 = time.time()
         problem, nclass, domain = response_info(training_frame.vec(y))
         sort_metric = self.sort_metric
@@ -267,7 +270,7 @@ class H2OAutoML:
                     se._automl_name = name
                     # SE has no CV — rank by training metrics as proxy
                     se.model.cross_validation_metrics = se.model.training_metrics
-                    self.leaderboard.add(se)
+                    self.leaderboard.add(se, self._lb_frame)
                     self.event_log.log("model", f"built {name}")
                 except Exception as e:
                     self.event_log.log("error", f"{name} failed: {e}")

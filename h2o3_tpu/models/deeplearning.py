@@ -118,14 +118,32 @@ class DeepLearningModel(H2OModel):
     def _score(self, frame: Frame) -> np.ndarray:
         X = jnp.asarray(self.dinfo.transform(frame))
         out = _forward(self.net_params, X, self.activation, None, 0.0, None, False)
+        if self.problem == "autoencoder":
+            return np.asarray(out, np.float64)  # reconstruction
         if self.problem in ("binomial", "multinomial"):
             return np.asarray(jax.nn.softmax(out, axis=1), np.float64)
         if self.distribution in ("poisson", "gamma", "tweedie"):
             return np.asarray(jnp.exp(out[:, 0]), np.float64)[:, None]
         return np.asarray(out[:, :1], np.float64)
 
+    def anomaly(self, frame: Frame) -> Frame:
+        """Per-row reconstruction MSE (`h2o.anomaly` on an autoencoder)."""
+        if self.problem != "autoencoder":
+            raise ValueError("anomaly() requires autoencoder=True")
+        X = self.dinfo.transform(frame)  # one expansion, reused for both
+        rec = np.asarray(_forward(self.net_params, jnp.asarray(X),
+                                  self.activation, None, 0.0, None, False),
+                         np.float64)
+        return Frame.from_dict(
+            {"Reconstruction.MSE": np.mean((rec - X) ** 2, axis=1)})
+
     def predict(self, test_data: Frame) -> Frame:
         out = self._score(test_data)
+        if self.problem == "autoencoder":
+            # reconstructed inputs in the expanded coefficient space
+            return Frame.from_dict(
+                {f"reconstr_{n}": out[:, i]
+                 for i, n in enumerate(self.dinfo.coef_names)})
         if self.problem in ("binomial", "multinomial"):
             lab = out.argmax(axis=1)
             d = {"predict": np.asarray(self.domain, dtype=object)[lab]}
@@ -136,6 +154,13 @@ class DeepLearningModel(H2OModel):
 
     def _make_metrics(self, frame: Frame):
         out = self._score(frame)
+        if self.problem == "autoencoder":
+            X = self.dinfo.transform(frame)
+            mse = float(np.mean((out - X) ** 2))
+            m = ModelMetricsRegression(mse=mse, rmse=float(np.sqrt(mse)),
+                                       nobs=frame.nrow,
+                                       description="autoencoder reconstruction")
+            return m
         yv = frame.vec(self.y)
         if self.problem == "binomial":
             return ModelMetricsBinomial.make(np.asarray(yv.data), out[:, 1])
@@ -184,18 +209,27 @@ class H2ODeepLearningEstimator(H2OEstimator):
         variable_importances=True,
         export_weights_and_biases=False,
         elastic_averaging=False,
+        autoencoder=False,
     )
+
+    def _is_supervised(self) -> bool:  # autoencoder trains without a response
+        return not self._parms.get("autoencoder", False)
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> DeepLearningModel:
         p = self._parms
         seed = p["_actual_seed"]
-        yvec = train.vec(y)
-        problem, nclass, domain = response_info(yvec)
-        dist = p.get("distribution", "AUTO")
-        if dist == "AUTO":
-            dist = {"binomial": "bernoulli", "multinomial": "multinomial"}.get(
-                problem, "gaussian"
-            )
+        autoenc = bool(p.get("autoencoder", False))
+        if autoenc:
+            problem, nclass, domain = "autoencoder", 0, None
+            dist = "gaussian"
+        else:
+            yvec = train.vec(y)
+            problem, nclass, domain = response_info(yvec)
+            dist = p.get("distribution", "AUTO")
+            if dist == "AUTO":
+                dist = {"binomial": "bernoulli", "multinomial": "multinomial"}.get(
+                    problem, "gaussian"
+                )
         dinfo = DataInfo(
             train, x,
             standardize=bool(p.get("standardize", True)),
@@ -207,10 +241,15 @@ class H2ODeepLearningEstimator(H2OEstimator):
         activation = p.get("activation", "Rectifier")
         if activation not in ACTIVATIONS:
             raise ValueError(f"activation {activation!r} not in {ACTIVATIONS}")
-        K = nclass if problem in ("binomial", "multinomial") else 1
+        if autoenc:
+            K = nfeat  # reconstruct the (expanded, standardized) inputs
+        else:
+            K = nclass if problem in ("binomial", "multinomial") else 1
         sizes = [nfeat] + hidden + [K]
 
-        if problem in ("binomial", "multinomial"):
+        if autoenc:
+            yarr = np.zeros(n, np.float32)  # unused placeholder
+        elif problem in ("binomial", "multinomial"):
             yarr = np.asarray(yvec.data, np.int32)
         else:
             yarr = yvec.numeric_np().astype(np.float32)
@@ -248,7 +287,9 @@ class H2ODeepLearningEstimator(H2OEstimator):
 
         def loss_fn(params, xb, yb, wb, key):
             out = _forward(params, xb, activation, hidden_dropout, input_dropout, key, True)
-            if problem in ("binomial", "multinomial"):
+            if autoenc:
+                nll = jnp.mean((out - xb) ** 2, axis=1)
+            elif problem in ("binomial", "multinomial"):
                 logp = jax.nn.log_softmax(out, axis=1)
                 nll = -jnp.take_along_axis(logp, yb[:, None].astype(jnp.int32), axis=1)[:, 0]
             elif dist == "poisson":
@@ -346,7 +387,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
                     "epochs": seen / n, "iterations": it,
                     "samples": seen, "timestamp": time.time(),
                 }
-                if problem == "regression":
+                if problem in ("regression", "autoencoder"):
                     ev["deviance"] = sm.mse
                     metric_val = sm.mse
                 else:

@@ -254,7 +254,7 @@ class H2OEstimator:
     the reference's schema validation does."""
 
     algo = "base"
-    supervised = True
+    supervised = True  # class-level default; see _is_supervised()
     _param_defaults: Dict[str, Any] = {}
     _common_defaults: Dict[str, Any] = dict(
         model_id=None,
@@ -302,6 +302,11 @@ class H2OEstimator:
         else:
             object.__setattr__(self, name, value)
 
+    def _is_supervised(self) -> bool:
+        """Instance-level supervision check — overridable where a parameter
+        flips it (e.g. DeepLearning autoencoder=True)."""
+        return type(self).supervised
+
     @property
     def actual_params(self) -> Dict[str, Any]:
         return dict(self._parms)
@@ -317,7 +322,7 @@ class H2OEstimator:
     ) -> "H2OEstimator":
         if training_frame is None:
             raise ValueError("training_frame is required")
-        if self.supervised and y is None:
+        if self._is_supervised() and y is None:
             raise ValueError(f"{self.algo}: response column y is required")
         ignored = set(self._parms.get("ignored_columns") or [])
         if x is None:
@@ -334,7 +339,7 @@ class H2OEstimator:
         if self._parms.get("ignore_const_cols", True):
             x = [n for n in x if not _is_const(training_frame.vec(n))]
 
-        if self.supervised and y is not None:
+        if self._is_supervised() and y is not None:
             # rows with a missing response are dropped before training —
             # ModelBuilder.init response filtering (hex/ModelBuilder.java)
             na = training_frame.vec(y).isna_np()
@@ -356,7 +361,7 @@ class H2OEstimator:
 
         nfolds = int(self._parms.get("nfolds") or 0)
         model = self._fit(x, y, training_frame, validation_frame)
-        if nfolds >= 2 and self.supervised:
+        if nfolds >= 2 and self._is_supervised():
             self._run_cv(model, x, y, training_frame, nfolds)
         model.run_time = time.time() - t0
         self.job.done()

@@ -172,8 +172,20 @@ class SharedTreeModel(H2OModel):
         m = self._margins(X)
         if offset is not None and self.mode != "drf":
             m = m + offset[:, None]
-        return probs_from_margins(self.mode, self.problem, self.distribution,
-                                  m, self.ntrees_built)
+        out = probs_from_margins(self.mode, self.problem, self.distribution,
+                                 m, self.ntrees_built)
+        dists = getattr(self, "balance_dists", None)
+        if dists is not None and self.problem in ("binomial", "multinomial"):
+            # hex.Model correctProbabilities: rescale balanced-trained
+            # probabilities back to the prior class distribution
+            prior, modeld = dists
+            if self.problem == "binomial" and len(prior) == 2:
+                ratio = np.asarray(prior) / np.maximum(np.asarray(modeld), 1e-12)
+                out = out * ratio[None, :]
+            else:
+                out = out * (np.asarray(prior) / np.maximum(np.asarray(modeld), 1e-12))[None, :]
+            out = out / np.maximum(out.sum(axis=1, keepdims=True), 1e-12)
+        return out
 
     def _offset_of(self, frame: Frame) -> Optional[np.ndarray]:
         oc = self.parms._parms.get("offset_column") if hasattr(self.parms, "_parms") else None
@@ -252,6 +264,27 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if self._parms.get("weights_column")
             else np.ones(n)
         ).astype(np.float32)
+        balance_dists = None  # (prior_dist, model_dist) for score correction
+        if (self._parms.get("balance_classes")
+                and problem in ("binomial", "multinomial")):
+            # class balancing as per-class row weights — expectation-equal to
+            # the reference's minority oversampling (ModelBuilder
+            # balance_classes / class_sampling_factors); scoring applies the
+            # priorClassDist/modelClassDist probability correction below
+            codes_y = np.asarray(yvec.data)
+            counts = np.bincount(codes_y, minlength=nclass).astype(np.float64)
+            csf = self._parms.get("class_sampling_factors")
+            if csf is not None:
+                factors = np.asarray(csf, np.float64)
+            else:
+                factors = n / (len(counts) * np.maximum(counts, 1.0))
+            cap = float(self._parms.get("max_after_balance_size", 5.0))
+            factors = np.minimum(factors, cap * n / np.maximum(counts, 1.0))
+            w = (w * factors[codes_y]).astype(np.float32)
+            prior_dist = counts / counts.sum()
+            model_w = counts * factors
+            balance_dists = (prior_dist, model_w / model_w.sum())
+
         offset = (
             train.vec(self._parms["offset_column"]).numeric_np().astype(np.float32)
             if self._parms.get("offset_column")
@@ -678,6 +711,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             np.asarray(f0) if K > 1 else float(f0[0]),
             forest, tp["max_depth"], mode=self._mode,
         )
+        model.balance_dists = balance_dists
         model.scoring_history = history
         if gain_total.sum() > 0:
             order = np.argsort(-gain_total)

@@ -79,3 +79,24 @@ def test_dl_multichip_dp(cloud8):
                                   mini_batch_size=256)
     dl.train(y="y", training_frame=fr)
     assert dl.auc() > 0.75
+
+
+def test_dl_autoencoder_anomaly(cloud1):
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 6)).astype(np.float32)
+    X[:5] += 8.0  # planted anomalies
+    fr = Frame.from_numpy(X, names=[f"c{i}" for i in range(6)])
+    ae = H2ODeepLearningEstimator(autoencoder=True, hidden=[3], epochs=30,
+                                  mini_batch_size=64, seed=1)
+    ae.train(x=fr.names, training_frame=fr)  # no y
+    assert ae.model.training_metrics.mse < 1.5
+    an = ae.model.anomaly(fr).vec("Reconstruction.MSE").numeric_np()
+    # the planted outliers reconstruct worst
+    top = np.argsort(-an)[:8]
+    assert len(set(top) & set(range(5))) >= 4
+    rec = ae.predict(fr)
+    assert rec.ncol == 6 and rec.names[0].startswith("reconstr_")

@@ -165,3 +165,27 @@ def test_gbm_multichip_shard_map(cloud8):
     gbm.train(y="y", training_frame=fr)
     auc8 = gbm.auc()
     assert auc8 > 0.85
+
+
+def test_balance_classes_weights_minority(cloud1):
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(5)
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    # rare positive class (5%) driven by x0
+    y = ((X[:, 0] > 1.6) | (rng.uniform(size=n) < 0.01)).astype(int)
+    fr = Frame.from_dict({
+        "a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+        "y": np.asarray(["n", "p"], dtype=object)[y]}, column_types={"y": "enum"})
+    m = H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                     balance_classes=True, seed=1)
+    m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    # the priorClassDist correction keeps scored probabilities calibrated to
+    # the ORIGINAL prior despite balanced training (hex.Model semantics)
+    pm = m.predict(fr).vec("p").numeric_np().mean()
+    prior = y.mean()
+    assert abs(pm - prior) < 0.1
+    assert m.auc() > 0.8
