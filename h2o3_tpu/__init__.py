@@ -130,6 +130,116 @@ def merge(x: Frame, y: Frame, all_x: bool = False, all_y: bool = False,
     return _m(x, y, by=by_x, all_x=all_x, all_y=all_y)
 
 
+def export_file(frame: Frame, path: str, force: bool = False, sep: str = ",",
+                header: bool = True, quote_header: bool = False) -> str:
+    """`h2o.export_file` — write a Frame as CSV (water/api frames export)."""
+    import csv as _csv
+
+    if _os.path.exists(path) and not force:
+        raise FileExistsError(f"{path} exists; pass force=True")
+    cols = frame.as_data_frame()
+    names = frame.names
+    with open(path, "w", newline="") as f:
+        wr = _csv.writer(f, delimiter=sep,
+                         quoting=_csv.QUOTE_ALL if quote_header else _csv.QUOTE_MINIMAL)
+        if header:
+            wr.writerow(names)
+        mats = [cols[n] for n in names]
+        for i in range(frame.nrow):
+            wr.writerow([
+                "" if v is None or (isinstance(v, float) and np.isnan(v)) else v
+                for v in (m[i] for m in mats)
+            ])
+    return path
+
+
+def get_model(model_id: str):
+    """`h2o.get_model` — fetch a trained model from the DKV by id."""
+    m = _DKV.get(model_id)
+    if m is None:
+        raise KeyError(model_id)
+    return m
+
+
+def frames():
+    return [k for k in _DKV.keys(Frame)]
+
+
+def deep_copy(frame: Frame, dest: str) -> Frame:
+    """`h2o.deep_copy` — independent copy of a frame's columns."""
+    from .frame.vec import Vec
+
+    out = {}
+    for n, v in zip(frame.names, frame.vecs()):
+        if v.type == "string":
+            out[n] = Vec(None, "string", strings=np.asarray(v.to_numpy()).copy())
+        else:
+            out[n] = Vec(np.asarray(v.data).copy(), v.type, domain=v.domain)
+    fr = Frame(out, key=dest)
+    _DKV.put(dest, fr)
+    return fr
+
+
+def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
+                 real_fraction: Optional[float] = None,
+                 categorical_fraction: Optional[float] = None,
+                 integer_fraction: Optional[float] = None,
+                 binary_fraction: Optional[float] = None,
+                 factors: int = 5, real_range: float = 100.0,
+                 integer_range: int = 100, missing_fraction: float = 0.0,
+                 has_response: bool = False, response_factors: int = 2,
+                 seed: Optional[int] = None, frame_id: Optional[str] = None,
+                 **kw) -> Frame:
+    """`h2o.create_frame` — random synthetic frame (water/api CreateFrame),
+    the generator many reference pyunits build fixtures with."""
+    rng = np.random.default_rng(seed if seed is not None else 42)
+    rf = 0.5 if real_fraction is None else real_fraction
+    cf = 0.2 if categorical_fraction is None else categorical_fraction
+    intf = 0.3 if integer_fraction is None else integer_fraction
+    bf = 0.0 if binary_fraction is None else binary_fraction
+    tot = max(rf + cf + intf + bf, 1e-12)
+    # largest-remainder apportionment: exactly `cols` columns, and every
+    # kind with a nonzero fraction keeps at least its floor share
+    fracs = [("real", rf / tot), ("enum", cf / tot),
+             ("int", intf / tot), ("bin", bf / tot)]
+    floors = {k: int(np.floor(cols * f)) for k, f in fracs}
+    rem = cols - sum(floors.values())
+    by_rem = sorted(fracs, key=lambda kf: -(cols * kf[1] - floors[kf[0]]))
+    for k, f in by_rem[:rem]:
+        floors[k] += 1
+    kinds = [k for k, _ in fracs for _ in range(floors[k])]
+    d = {}
+    types = {}
+    for i, kind in enumerate(kinds):
+        name = f"C{i+1}"
+        if kind == "real":
+            col = rng.uniform(-real_range, real_range, rows)
+        elif kind == "int":
+            col = rng.integers(-integer_range, integer_range + 1, rows).astype(np.float64)
+        elif kind == "bin":
+            col = rng.integers(0, 2, rows).astype(np.float64)
+        else:
+            col = np.asarray([f"c{j}" for j in range(factors)], dtype=object)[
+                rng.integers(0, factors, rows)]
+            types[name] = "enum"
+        if missing_fraction > 0 and kind != "enum":
+            col = np.where(rng.uniform(size=rows) < missing_fraction, np.nan, col)
+        d[name] = col
+    if has_response:
+        if response_factors > 1:
+            d["response"] = np.asarray(
+                [f"r{j}" for j in range(response_factors)], dtype=object)[
+                rng.integers(0, response_factors, rows)]
+            types["response"] = "enum"
+        else:
+            d["response"] = rng.normal(size=rows)
+    fr = Frame.from_dict(d, column_types=types or None)
+    if frame_id:
+        fr.key = frame_id
+    _DKV.put(fr.key, fr)
+    return fr
+
+
 def no_progress():
     pass
 

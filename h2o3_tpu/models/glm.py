@@ -157,6 +157,40 @@ class GLMModel(H2OModel):
             }
         return dict(zip(self._names(), np.asarray(self.beta)))
 
+    def coef_with_p_values(self):
+        """Coefficient table with std errors / z / p-values on the DATA scale
+        (matches coef()) — requires compute_p_values=True and lambda=0
+        (GLMModel p-value output)."""
+        if self.stderr is None:
+            raise ValueError(
+                "p-values unavailable: train with compute_p_values=True "
+                "and lambda_=0")
+        b = np.asarray(self.beta, np.float64)
+        pdim = len(b) - 1
+        cov = getattr(self, "covmat", None)
+        if self.dinfo.standardize and self.dinfo.means is not None and cov is not None:
+            # affine destandardization T: slope_j /= σ_j, intercept absorbs
+            # −Σ β_j μ_j/σ_j; covariance transforms as T Cov Tᵀ
+            T = np.zeros((pdim + 1, pdim + 1))
+            T[np.arange(pdim), np.arange(pdim)] = 1.0 / self.dinfo.stds
+            T[pdim, :pdim] = -self.dinfo.means / self.dinfo.stds
+            T[pdim, pdim] = 1.0
+            b = T @ b
+            se = np.sqrt(np.maximum(np.diag(T @ cov @ T.T), 0.0))
+        else:
+            b = self._destandardize(b)
+            se = np.asarray(self.stderr, np.float64)
+        z = b / np.maximum(se, 1e-300)
+        # two-sided normal p-value (the reference uses z-tests for binomial)
+        from math import erfc, sqrt
+
+        pv = [erfc(abs(zz) / sqrt(2.0)) for zz in z]
+        return [
+            dict(names=n, coefficients=float(bb), std_error=float(s),
+                 z_value=float(zz), p_value=float(p))
+            for n, bb, s, zz, p in zip(self._names(), b, se, z, pv)
+        ]
+
     def _destandardize(self, b):
         b = np.asarray(b, np.float64)
         if not self.dinfo.standardize or self.dinfo.means is None:
@@ -287,6 +321,7 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
 
         full_path = None
         stderr = None
+        cov = None
         if family == "multinomial":
             beta = self._fit_multinomial(Xd, yarr, wd, nclass, alpha, lam or 0.0, max_iter)
             lam_best = lam or 0.0
@@ -302,12 +337,31 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             if p.get("compute_p_values") and (lam_best == 0):
                 gram, _ = _gram_step(Xd, yd, wd, jnp.asarray(beta), family, tweedie_p)
                 try:
-                    stderr = np.sqrt(np.diag(np.linalg.inv(np.asarray(gram, np.float64))))
+                    cov = np.linalg.inv(np.asarray(gram, np.float64))
+                    # dispersion: Pearson X²/(n−p) for the families whose
+                    # variance is estimated (gaussian/gamma/tweedie); fixed
+                    # at 1 for binomial/poisson (GLM dispersion_estimated)
+                    if family in ("gaussian", "gamma", "tweedie"):
+                        eta = np.asarray(Xd @ jnp.asarray(beta, jnp.float32), np.float64)
+                        mu = np.asarray(_linkinv(family, jnp.asarray(eta)), np.float64)
+                        yv_ = np.asarray(yd, np.float64)
+                        wv_ = np.asarray(wd, np.float64)
+                        vfun = {"gaussian": np.ones_like(mu),
+                                "gamma": np.maximum(mu, 1e-12) ** 2,
+                                "tweedie": np.maximum(mu, 1e-12) ** tweedie_p}[family]
+                        dof = max(float(wv_.sum()) - Xd.shape[1], 1.0)
+                        dispersion = float(np.sum(wv_ * (yv_ - mu) ** 2 / vfun) / dof)
+                    else:
+                        dispersion = 1.0
+                    cov = cov * dispersion
+                    stderr = np.sqrt(np.maximum(np.diag(cov), 0.0))
                 except np.linalg.LinAlgError:
+                    cov = None
                     stderr = None
 
         model = GLMModel(self, x, y, dinfo, family, beta, domain,
                          lambda_best=lam_best, stderr=stderr, full_path=full_path)
+        model.covmat = cov  # (p+1)² dispersion-scaled covariance (p-values)
         model.training_metrics = model._make_metrics(train)
         if valid is not None:
             model.validation_metrics = model._make_metrics(valid)

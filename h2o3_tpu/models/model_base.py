@@ -366,6 +366,9 @@ class H2OEstimator:
         model.run_time = time.time() - t0
         self.job.done()
         self._model = model
+        from ..runtime.dkv import DKV
+
+        DKV.put(model.model_id, model)  # h2o.get_model / h2o.models surface
         ckpt_dir = self._parms.get("export_checkpoints_dir")
         if ckpt_dir:
             # auto-export the finished model (Model export_checkpoints_dir)

@@ -91,3 +91,36 @@ def test_asfactor():
     fr2 = fr.asfactor("y")
     assert fr2.vec("y").type == "enum"
     assert fr2.vec("y").nlevels == 2
+
+
+def test_h2o_module_functions(tmp_path, cloud1):
+    import os
+    import h2o3_tpu as h2o
+    from h2o3_tpu.frame.frame import Frame
+
+    fr = h2o.create_frame(rows=100, cols=6, categorical_fraction=0.3,
+                          real_fraction=0.4, integer_fraction=0.3,
+                          factors=4, missing_fraction=0.1, seed=7,
+                          has_response=True)
+    assert fr.nrow == 100 and fr.ncol == 7
+    assert any(v.type == "enum" for v in fr.vecs())
+    assert any(v.nacnt() > 0 for v in fr.vecs())
+    # export → reimport round trip
+    p = str(tmp_path / "out.csv")
+    h2o.export_file(fr[["C1", "C2"]], p)
+    back = h2o.import_file(p)
+    assert back.nrow == 100 and back.ncol == 2
+    import pytest
+    with pytest.raises(FileExistsError):
+        h2o.export_file(fr[["C1"]], p)
+    # deep copy is independent
+    cp = h2o.deep_copy(fr, "the_copy")
+    assert "the_copy" in h2o.frames()
+    # get_model after a train
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    import numpy as np
+    tr = Frame.from_dict({"a": np.arange(50.0), "y": np.arange(50.0) * 2})
+    m = H2OGradientBoostingEstimator(ntrees=2, max_depth=2)
+    m.train(x=["a"], y="y", training_frame=tr)
+    assert h2o.get_model(m.model_id) is m.model
+    assert m.model_id in h2o.ls()

@@ -138,3 +138,26 @@ def test_modelselection_modes(cloud1):
         assert r2s[1] > 0.95
     coefs = ms.model.coef(predictor_size=2)
     assert coefs["x1"] == pytest.approx(3.0, abs=0.1)
+
+
+def test_glm_p_values(cloud1):
+    rng = np.random.default_rng(11)
+    n = 2000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)  # noise predictor
+    sigma = 3.0
+    y = 2.0 * x1 + rng.normal(0, sigma, n)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y})
+    # default standardize=True: table must still report data-scale values
+    g = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0,
+                                      compute_p_values=True)
+    g.train(x=["x1", "x2"], y="y", training_frame=fr)
+    tab = g.model.coef_with_p_values()
+    row = {r["names"]: r for r in tab}
+    assert row["x1"]["p_value"] < 1e-6
+    assert row["x2"]["p_value"] > 0.001
+    # dispersion-scaled SE ≈ sigma/sqrt(n)
+    se_true = sigma / np.sqrt(n)
+    assert row["x1"]["std_error"] == pytest.approx(se_true, rel=0.3)
+    # data-scale coefficients match coef()
+    assert row["x1"]["coefficients"] == pytest.approx(g.model.coef()["x1"], abs=1e-8)
