@@ -140,10 +140,13 @@ def export_file(frame: Frame, path: str, force: bool = False, sep: str = ",",
     cols = frame.as_data_frame()
     names = frame.names
     with open(path, "w", newline="") as f:
-        wr = _csv.writer(f, delimiter=sep,
-                         quoting=_csv.QUOTE_ALL if quote_header else _csv.QUOTE_MINIMAL)
+        wr = _csv.writer(f, delimiter=sep, quoting=_csv.QUOTE_MINIMAL)
         if header:
-            wr.writerow(names)
+            if quote_header:  # reference quotes ONLY the header names
+                _csv.writer(f, delimiter=sep,
+                            quoting=_csv.QUOTE_ALL).writerow(names)
+            else:
+                wr.writerow(names)
         mats = [cols[n] for n in names]
         for i in range(frame.nrow):
             wr.writerow([
@@ -162,7 +165,7 @@ def get_model(model_id: str):
 
 
 def frames():
-    return [k for k in _DKV.keys(Frame)]
+    return _DKV.keys(Frame)
 
 
 def deep_copy(frame: Frame, dest: str) -> Frame:
@@ -189,7 +192,7 @@ def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
                  integer_range: int = 100, missing_fraction: float = 0.0,
                  has_response: bool = False, response_factors: int = 2,
                  seed: Optional[int] = None, frame_id: Optional[str] = None,
-                 **kw) -> Frame:
+                 ) -> Frame:
     """`h2o.create_frame` — random synthetic frame (water/api CreateFrame),
     the generator many reference pyunits build fixtures with."""
     rng = np.random.default_rng(seed if seed is not None else 42)
@@ -212,7 +215,9 @@ def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
     types = {}
     for i, kind in enumerate(kinds):
         name = f"C{i+1}"
-        if kind == "real":
+        if not randomize and kind != "enum":
+            col = np.zeros(rows)  # CreateFrame randomize=false: constant 0
+        elif kind == "real":
             col = rng.uniform(-real_range, real_range, rows)
         elif kind == "int":
             col = rng.integers(-integer_range, integer_range + 1, rows).astype(np.float64)
@@ -236,6 +241,41 @@ def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
     fr = Frame.from_dict(d, column_types=types or None)
     if frame_id:
         fr.key = frame_id
+    _DKV.put(fr.key, fr)
+    return fr
+
+
+def interaction(data: Frame, factors, pairwise: bool, max_factors: int,
+                min_occurrence: int, destination_frame: Optional[str] = None) -> Frame:
+    """`h2o.interaction` — interaction columns between categorical factors
+    (hex/Interaction.java): combined levels, capped at max_factors most
+    frequent (others pooled as 'other'), levels under min_occurrence dropped."""
+    from .frame.vec import Vec
+
+    facs = [data.names[f] if isinstance(f, int) else f for f in factors]
+    pairs = ([(a, b) for i, a in enumerate(facs) for b in facs[i + 1:]]
+             if pairwise else [tuple(facs)])
+    out = {}
+    for combo in pairs:
+        labels = []
+        for c in combo:
+            v = data.vec(c)
+            dom = np.asarray((v.domain or []) + [None], dtype=object)
+            labels.append(dom[np.asarray(v.data, np.int64)])
+        joined = np.asarray(
+            ["_".join("NA" if p is None else str(p) for p in row)
+             for row in zip(*labels)], dtype=object)
+        uniq, counts = np.unique(joined, return_counts=True)
+        keep = uniq[counts >= max(min_occurrence, 1)]
+        order = np.argsort(-counts[np.isin(uniq, keep)])
+        kept = list(keep[order][:max_factors])
+        lookup = {lbl: i for i, lbl in enumerate(kept)}
+        other = len(kept)
+        codes = np.asarray([lookup.get(s, other) for s in joined], np.int32)
+        dom = kept + ["other"] if (codes == other).any() else kept
+        name = "_".join(combo)
+        out[name] = Vec(codes, "enum", domain=dom)
+    fr = Frame(out, key=destination_frame)
     _DKV.put(fr.key, fr)
     return fr
 

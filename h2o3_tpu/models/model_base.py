@@ -185,7 +185,11 @@ class H2OModel:
 
     def __init__(self, params: "H2OEstimator"):
         self.parms = params
-        self.model_id = f"{self.algo}_{next(_model_counter)}"
+        # honour a user-chosen model_id (estimator parameter), else generate
+        user_id = None
+        if hasattr(params, "_parms"):
+            user_id = params._parms.get("model_id")
+        self.model_id = user_id or f"{self.algo}_{next(_model_counter)}"
         self.training_metrics: Optional[ModelMetricsBase] = None
         self.validation_metrics: Optional[ModelMetricsBase] = None
         self.cross_validation_metrics: Optional[ModelMetricsBase] = None
@@ -193,6 +197,7 @@ class H2OModel:
         self.varimp_table: Optional[List] = None
         self.run_time: float = 0.0
         self._cv_holdout_pred: Optional[np.ndarray] = None
+        self.cross_validation_models: Optional[List] = None
 
     # -- metric accessors (h2o-py ModelBase) --------------------------------
     def _m(self, valid=False, xval=False):
@@ -401,7 +406,7 @@ class H2OEstimator:
                 assign[order] = np.arange(n) % nfolds
             folds = np.arange(nfolds)
         holdout = None
-        ys, ps = [], []
+        cv_models = []
         for f in folds:
             tr = train.take(np.nonzero(assign != f)[0])
             ho = train.take(np.nonzero(assign == f)[0])
@@ -410,15 +415,18 @@ class H2OEstimator:
                 {k: v for k, v in self._parms.items() if not k.startswith("_")}
             )
             sub._parms["nfolds"] = 0
+            sub._parms["model_id"] = None  # fold models get their own ids
             sub._parms["_actual_seed"] = self._parms["_actual_seed"]
             cvm = sub._fit(x, y, tr, None)
             pred = sub._cv_predict(cvm, ho)
             if holdout is None:
                 holdout = np.zeros((n,) + pred.shape[1:], dtype=np.float64)
             holdout[assign == f] = pred
-            ys.append(ho.vec(y))
-            ps.append(pred)
+            if self._parms.get("keep_cross_validation_models", True):
+                cvm.validation_metrics = cvm._make_metrics(ho)
+                cv_models.append(cvm)
         model._cv_holdout_pred = holdout
+        model.cross_validation_models = cv_models or None
         model.cross_validation_metrics = self._metrics_from_cv(train.vec(y), assign, holdout)
 
     def _metrics_from_cv(self, yvec: Vec, assign, holdout):

@@ -45,10 +45,10 @@ def _hist_kernel_factored(codes_ref, node_ref, vals_ref, out_ref, w_ref,
     """Factored VMEM kernel: grid (row_chunks, F/8), feature-blocks innermost.
 
     Per chunk (at fb==0) the (3L, R) node-weighted value matrix is built once
-    in scratch; each step builds only (R, B) bin one-hots in VMEM for its 8
-    features and runs 8 MXU matmuls, accumulating into the output block. HBM
-    traffic is codes-in (bf16) + the small output blocks — the (R, L·B)
-    one-hot never exists anywhere."""
+    in scratch; each step builds ONE (8B, R) bin one-hot covering its whole
+    8-feature block and runs a single (3L,R)·(R,8B) MXU matmul, accumulating
+    into the (1, 3L, 8B) output block. HBM traffic is codes-in + the small
+    output blocks — the (R, L·B) one-hot never exists anywhere."""
     step = pl.program_id(0)
     fb = pl.program_id(1)
 
@@ -69,17 +69,21 @@ def _hist_kernel_factored(codes_ref, node_ref, vals_ref, out_ref, w_ref,
         out_ref[...] = jnp.zeros_like(out_ref)
 
     R = w_ref.shape[1]
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, R), 0).astype(jnp.float32)
     wmat = w_ref[...].astype(jnp.bfloat16)
-    for fl in range(_FB):  # unrolled: 8 features per block
-        code_f = codes_ref[fl, :]                 # (R,) f32
-        bin_oh_t = (code_f[None, :] == iota_b).astype(jnp.bfloat16)  # (B, R)
-        # contract along rows: (3L,R)·(B,R) → (3L,B), RHS-transposed matmul
-        out_ref[fl] += jax.lax.dot_general(
-            wmat, bin_oh_t,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    # one (8B, R) one-hot for the whole 8-feature block → ONE MXU matmul per
+    # grid step instead of 8 tiny (3L,B) ones (output 3L × 8B utilizes the
+    # systolic array far better)
+    fb_iota = jax.lax.broadcasted_iota(jnp.int32, (_FB * B, R), 0)
+    b_of = (fb_iota % B).astype(jnp.float32)
+    codes_blk = codes_ref[...]    # (8, R) f32
+    code_rows = jnp.repeat(codes_blk, B, axis=0)             # (8B, R)
+    bin_oh_t = (code_rows == b_of).astype(jnp.bfloat16)      # (8B, R)
+    h = jax.lax.dot_general(
+        wmat, bin_oh_t,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                              # (3L, 8B)
+    out_ref[0] += h
 
 
 @functools.partial(jax.jit, static_argnames=("n_nodes", "nbins", "row_chunk"))
@@ -111,18 +115,20 @@ def build_histograms_pallas_factored(
     grid = (npad // R, Fpad // _FB)
     out = pl.pallas_call(
         functools.partial(_hist_kernel_factored, L=L, B=B),
-        out_shape=jax.ShapeDtypeStruct((Fpad, 3 * L, B), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((Fpad // _FB, 3 * L, _FB * B), jnp.float32),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_FB, R), lambda i, f: (f, i)),  # codes_t chunk
             pl.BlockSpec((1, R), lambda i, f: (0, i)),    # node chunk
             pl.BlockSpec((3, R), lambda i, f: (0, i)),    # vals chunk
         ],
-        out_specs=pl.BlockSpec((_FB, 3 * L, B), lambda i, f: (f, 0, 0)),
+        out_specs=pl.BlockSpec((1, 3 * L, _FB * B), lambda i, f: (f, 0, 0)),
         scratch_shapes=[pltpu.VMEM((3 * L, R), jnp.float32)],
     )(codes_t_bf, node2, vals)
-    # (Fpad, 3L, B) → (L, F, B, 3)
-    return out[:F].reshape(F, 3, L, B).transpose(2, 0, 3, 1)
+    # (Fpad/8, 3L, 8B) → (Fpad, 3L, B) → (L, F, B, 3)
+    out = out.reshape(Fpad // _FB, 3 * L, _FB, B).transpose(0, 2, 1, 3)
+    out = out.reshape(Fpad, 3 * L, B)[:F]
+    return out.reshape(F, 3, L, B).transpose(2, 0, 3, 1)
 
 
 def _hist_kernel(codes_ref, cid_base_ref, vals_ref, out_ref, *, F: int, LB: int):

@@ -129,3 +129,42 @@ def test_mojo_roundtrip_dl(tmp_path, cloud1):
         scorer.predict(fr).vec("1").numeric_np(),
         rtol=1e-4, atol=1e-5,
     )
+
+
+def test_keep_cross_validation_models(cloud1):
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(0)
+    fr = Frame.from_dict({"a": rng.normal(size=300),
+                          "y": rng.normal(size=300)})
+    m = H2OGradientBoostingEstimator(ntrees=3, max_depth=2, nfolds=3)
+    m.train(x=["a"], y="y", training_frame=fr)
+    cvs = m.model.cross_validation_models
+    assert cvs and len(cvs) == 3
+    assert all(c.validation_metrics is not None for c in cvs)
+    m2 = H2OGradientBoostingEstimator(ntrees=3, max_depth=2, nfolds=3,
+                                      keep_cross_validation_models=False)
+    m2.train(x=["a"], y="y", training_frame=fr)
+    assert m2.model.cross_validation_models is None
+
+
+def test_h2o_interaction(cloud1):
+    import numpy as np
+    import h2o3_tpu as h2o
+    from h2o3_tpu.frame.frame import Frame
+
+    rng = np.random.default_rng(1)
+    a = np.asarray(["x", "y"], dtype=object)[rng.integers(0, 2, 200)]
+    b = np.asarray(["p", "q", "r"], dtype=object)[rng.integers(0, 3, 200)]
+    fr = Frame.from_dict({"a": a, "b": b},
+                         column_types={"a": "enum", "b": "enum"})
+    out = h2o.interaction(fr, factors=["a", "b"], pairwise=True,
+                          max_factors=100, min_occurrence=1)
+    v = out.vec("a_b")
+    assert v.type == "enum" and 4 <= v.nlevels <= 6
+    # capping pools rare combos into 'other'
+    out2 = h2o.interaction(fr, factors=["a", "b"], pairwise=True,
+                           max_factors=2, min_occurrence=1)
+    assert "other" in out2.vec("a_b").domain
