@@ -175,7 +175,9 @@ class H2OExtendedIsolationForestEstimator(H2OEstimator):
         seed = int(self._parms.get("_actual_seed", 1234))
         rng = np.random.default_rng(seed)
 
-        dirs_all, thr_all, split_all, count_all = [], [], [], []
+        # dispatch all tree builds async; ONE stacked D2H at the end (per-tree
+        # np.asarray syncs pay the remote-TPU tunnel RTT ntrees times)
+        dirs_all, thr_dev, split_dev, count_dev = [], [], [], []
         for t in range(ntrees):
             rows = rng.choice(n, size=S, replace=False)
             Xs = jnp.asarray(X[rows])
@@ -192,14 +194,14 @@ class H2OExtendedIsolationForestEstimator(H2OEstimator):
             thr, split, counts = _build_eif_tree(Xs, jnp.asarray(d),
                                                  jnp.asarray(us), depth)
             dirs_all.append(d)
-            thr_all.append(np.asarray(thr))
-            split_all.append(np.asarray(split))
-            count_all.append(np.asarray(counts))
+            thr_dev.append(thr)
+            split_dev.append(split)
+            count_dev.append(counts)
 
         model = ExtendedIsolationForestModel(
             self, x, dinfo,
-            jnp.asarray(np.stack(dirs_all)), jnp.asarray(np.stack(thr_all)),
-            jnp.asarray(np.stack(split_all)), jnp.asarray(np.stack(count_all)),
+            jnp.asarray(np.stack(dirs_all)),
+            jnp.stack(thr_dev), jnp.stack(split_dev), jnp.stack(count_dev),
             depth, S,
         )
         model.training_metrics = ModelMetricsBase(nobs=n)

@@ -264,6 +264,22 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if self._parms.get("weights_column")
             else np.ones(n)
         ).astype(np.float32)
+        mc = self._parms.get("monotone_constraints")
+        if mc:
+            # {col: ±1} → (F,) vector aligned with x (GBM monotone_constraints)
+            vec = np.zeros(len(x), np.float32)
+            for cname, d in dict(mc).items():
+                if cname not in x:
+                    raise ValueError(f"monotone_constraints: unknown column {cname!r}")
+                if train.vec(cname).type == "enum":
+                    raise ValueError(
+                        f"monotone_constraints: {cname!r} is categorical — "
+                        "constraints apply to numeric columns only")
+                vec[list(x).index(cname)] = float(d)
+            self._monotone_vec = jnp.asarray(vec)
+        else:
+            self._monotone_vec = None
+
         balance_dists = None  # (prior_dist, model_dist) for score correction
         if (self._parms.get("balance_classes")
                 and problem in ("binomial", "multinomial")):
@@ -750,6 +766,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
             reg_lambda=tp["reg_lambda"], reg_alpha=tp.get("reg_alpha", 0.0),
             mtries=mtries,
         )
+        mono = getattr(self, "_monotone_vec", None)
+        if mono is not None:
+            kwargs["monotone"] = mono
         if cloud.size > 1:
             from jax import shard_map
 

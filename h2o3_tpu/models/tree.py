@@ -72,6 +72,7 @@ def build_tree(
     axis_name: Optional[str] = None,
     mtries: int = 0,
     key: Optional[jax.Array] = None,
+    monotone: Optional[jax.Array] = None,  # (F,) ∈ {-1,0,1}
 ):
     """Build one tree; returns (Tree, final_leaf_heap_idx (N,), gain_per_feature (F,)).
 
@@ -92,6 +93,11 @@ def build_tree(
     gain_per_feature = jnp.zeros(F, jnp.float32)
     if key is None:
         key = jax.random.PRNGKey(0)
+    BIG = jnp.float32(3.4e38)
+    # per-level-node value bounds for monotone constraints (LightGBM-style
+    # mid-point bound propagation; every node's value is clamped into them)
+    lo_lvl = jnp.full(1, -BIG)
+    hi_lvl = jnp.full(1, BIG)
 
     hist_prev = None
     for d in range(max_depth):
@@ -122,9 +128,10 @@ def build_tree(
         # Newton leaf value with elastic-net regularization (xgboost's
         # CalcWeight: soft-threshold G by alpha, shrink by lambda)
         gthr = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - reg_alpha, 0.0)
-        value_a = value_a.at[base : base + L].set(
-            (-gthr / (hsum + reg_lambda + 1e-12)).astype(jnp.float32)
-        )
+        node_val = (-gthr / (hsum + reg_lambda + 1e-12)).astype(jnp.float32)
+        if monotone is not None:
+            node_val = jnp.clip(node_val, lo_lvl, hi_lvl)
+        value_a = value_a.at[base : base + L].set(node_val)
 
         # split search: cumulative over bins → gain per (L, F, B)
         cw = jnp.cumsum(hist[..., 0], axis=2)
@@ -144,6 +151,21 @@ def build_tree(
         ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)   # no split at NA bin
         ok = ok & (feat_mask[None, :, None] > 0)
         ok = ok & active[:, None, None]
+        if monotone is not None:
+            # monotone_constraints (hex/tree Constraints / LightGBM): a split
+            # on feature f with constraint c is admissible only when
+            # c·(value_right − value_left) ≥ 0, where the child values use
+            # the SAME soft-thresholded formula as materialized node values
+            # and are clamped into the node's inherited bounds. Bound
+            # propagation (below) then guarantees zero violations.
+            gthrL = jnp.sign(GL) * jnp.maximum(jnp.abs(GL) - reg_alpha, 0.0)
+            gthrR = jnp.sign(GR) * jnp.maximum(jnp.abs(GR) - reg_alpha, 0.0)
+            vL = jnp.clip(-gthrL / (HL + reg_lambda + 1e-12),
+                          lo_lvl[:, None, None], hi_lvl[:, None, None])
+            vR = jnp.clip(-gthrR / (HR + reg_lambda + 1e-12),
+                          lo_lvl[:, None, None], hi_lvl[:, None, None])
+            mc = monotone[None, :, None]
+            ok = ok & ((mc == 0) | (mc * (vR - vL) >= 0))
         if mtries > 0:
             key, sub = jax.random.split(key)
             # per-(node,feature) bernoulli keep with the same node psum'd RNG
@@ -182,6 +204,31 @@ def build_tree(
         idx = 2 * idx + go_right.astype(jnp.int32)
         active = jnp.repeat(do_split, 2)
 
+        if monotone is not None:
+            # propagate bounds to children: on a ±1-constrained split the
+            # mid-point of the chosen split's child values caps the lower-
+            # valued side and floors the higher-valued side
+            sel = (bf * nbins + bb)[:, None]
+            flat_pick = lambda A: jnp.take_along_axis(
+                A.reshape(L, F * nbins), sel, axis=1)[:, 0]
+            gthrL = jnp.sign(flat_pick(GL)) * jnp.maximum(
+                jnp.abs(flat_pick(GL)) - reg_alpha, 0.0)
+            gthrR = jnp.sign(flat_pick(GR)) * jnp.maximum(
+                jnp.abs(flat_pick(GR)) - reg_alpha, 0.0)
+            vLs = jnp.clip(-gthrL / (flat_pick(HL) + reg_lambda + 1e-12),
+                           lo_lvl, hi_lvl)
+            vRs = jnp.clip(-gthrR / (flat_pick(HR) + reg_lambda + 1e-12),
+                           lo_lvl, hi_lvl)
+            mid = 0.5 * (vLs + vRs)
+            c = monotone[bf] * do_split.astype(monotone.dtype)
+            # c=+1: left ≤ mid ≤ right; c=−1: mirrored; c=0: inherit as-is
+            hi_left = jnp.where(c > 0, jnp.minimum(hi_lvl, mid), hi_lvl)
+            lo_left = jnp.where(c < 0, jnp.maximum(lo_lvl, mid), lo_lvl)
+            hi_right = jnp.where(c < 0, jnp.minimum(hi_lvl, mid), hi_lvl)
+            lo_right = jnp.where(c > 0, jnp.maximum(lo_lvl, mid), lo_lvl)
+            lo_lvl = jnp.stack([lo_left, lo_right], axis=1).reshape(2 * L)
+            hi_lvl = jnp.stack([hi_left, hi_right], axis=1).reshape(2 * L)
+
     # final level values from exact per-cell totals
     Lf = 2 ** max_depth
     basef = Lf - 1
@@ -190,9 +237,10 @@ def build_tree(
     if axis_name is not None:
         tot = jax.lax.psum(tot, axis_name)
     gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
-    value_a = value_a.at[basef:].set(
-        (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
-    )
+    leaf_val = (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
+    if monotone is not None:
+        leaf_val = jnp.clip(leaf_val, lo_lvl, hi_lvl)
+    value_a = value_a.at[basef:].set(leaf_val)
     return Tree(feat_a, bin_a, thr_a, split_a, value_a), idx + basef, gain_per_feature
 
 

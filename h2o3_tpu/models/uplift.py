@@ -269,7 +269,9 @@ class H2OUpliftRandomForestEstimator(H2OEstimator):
         seed = int(self._parms.get("_actual_seed", 1234))
         rng = np.random.default_rng(seed)
 
-        trees: List = []
+        # all trees dispatched async; ONE stacked D2H at the end (a per-tree
+        # np.asarray sync would pay the remote-TPU tunnel RTT ntrees times)
+        trees_dev: List = []
         for t in range(ntrees):
             samp = (rng.uniform(size=n) < sample_rate).astype(np.float32)
             wt = jnp.asarray(samp * treat)
@@ -280,8 +282,9 @@ class H2OUpliftRandomForestEstimator(H2OEstimator):
                 min_rows=float(p.get("min_rows", 10.0)), metric=metric,
                 mtries=mtries, key=jax.random.PRNGKey(seed + t),
             )
-            trees.append(jax.tree.map(np.asarray, tr))
-        forest = treelib.stack_trees(trees)
+            trees_dev.append(tr)
+        stacked_dev = treelib.stack_trees(trees_dev)
+        forest = treelib.Tree(*[np.asarray(f) for f in stacked_dev])
 
         model = UpliftRandomForestModel(
             self, x, y, bm, forest, int(p.get("max_depth", 10)),

@@ -189,3 +189,39 @@ def test_balance_classes_weights_minority(cloud1):
     prior = y.mean()
     assert abs(pm - prior) < 0.1
     assert m.auc() > 0.8
+
+
+def test_monotone_constraints(cloud1):
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(7)
+    n = 1500
+    x = rng.uniform(-2, 2, n)
+    z = rng.normal(size=n)
+    # mostly increasing relationship with local noise dips
+    y = x + 0.6 * np.sin(4 * x) + 0.3 * z
+    fr = Frame.from_dict({"x": x, "z": z, "y": y})
+    m = H2OGradientBoostingEstimator(ntrees=40, max_depth=4,
+                                     monotone_constraints={"x": 1}, seed=1)
+    m.train(x=["x", "z"], y="y", training_frame=fr)
+    # predictions along x (z fixed) must be non-decreasing
+    grid = Frame.from_dict({"x": np.linspace(-2, 2, 200),
+                            "z": np.zeros(200)})
+    p = m.predict(grid).vec("predict").numeric_np()
+    # bound propagation guarantees ZERO violations (hex/tree Constraints)
+    viol = np.diff(p) < -1e-5
+    assert viol.sum() == 0, f"{viol.sum()} monotonicity violations"
+    # unconstrained model does violate (the sin dips)
+    m2 = H2OGradientBoostingEstimator(ntrees=40, max_depth=4, seed=1)
+    m2.train(x=["x", "z"], y="y", training_frame=fr)
+    p2 = m2.predict(grid).vec("predict").numeric_np()
+    assert (np.diff(p2) < -1e-4).sum() > 0
+    # categorical constraint is rejected
+    fr2 = Frame.from_dict({"c": np.asarray(["a", "b"] * 50, dtype=object),
+                           "y": rng.normal(size=100)},
+                          column_types={"c": "enum"})
+    with pytest.raises(ValueError):
+        H2OGradientBoostingEstimator(ntrees=2, monotone_constraints={"c": 1}
+                                     ).train(x=["c"], y="y", training_frame=fr2)
