@@ -40,6 +40,8 @@ class H2ORandomForestEstimator(H2OSharedTreeEstimator):
         max_after_balance_size=5.0,
         build_tree_one_node=False,
         calibrate_model=False,
+        calibration_frame=None,
+        calibration_method="AUTO",
         reg_lambda=None,
     )
 

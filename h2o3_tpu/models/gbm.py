@@ -41,6 +41,8 @@ class H2OGradientBoostingEstimator(H2OSharedTreeEstimator):
         max_abs_leafnode_pred=float("inf"),
         pred_noise_bandwidth=0.0,
         calibrate_model=False,
+        calibration_frame=None,
+        calibration_method="AUTO",
         monotone_constraints=None,
         score_tree_interval=0,
         balance_classes=False,

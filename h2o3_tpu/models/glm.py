@@ -327,8 +327,32 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             lam_best = lam or 0.0
         else:
             if lambda_search:
+                vdata = None
+                if valid is not None:
+                    Xv = dinfo.transform(valid)
+                    Xvi = np.concatenate(
+                        [Xv, np.ones((Xv.shape[0], 1), np.float32)], axis=1)
+                    yvv = valid.vec(y)
+                    if yvv.type == "enum":
+                        codes_v = np.asarray(yvv.data, np.int64)
+                        if yvv.domain != domain and yvv.domain:
+                            # remap to the TRAINING response domain
+                            lookup = {d: i for i, d in enumerate(domain or [])}
+                            remap = np.asarray(
+                                [lookup.get(d, -1) for d in yvv.domain], np.int64)
+                            codes_v = np.where(codes_v >= 0,
+                                               remap[np.maximum(codes_v, 0)], -1)
+                        yva = codes_v.astype(np.float32)
+                    else:
+                        yva = yvv.numeric_np().astype(np.float32)
+                    wv = (valid.vec(p["weights_column"]).numeric_np()
+                          if p.get("weights_column")
+                          and p["weights_column"] in valid.names
+                          else np.ones(Xv.shape[0])).astype(np.float32)
+                    vdata = (jnp.asarray(Xvi), jnp.asarray(yva), jnp.asarray(wv))
                 beta, lam_best, full_path = self._lambda_path(
-                    Xd, yd, wd, family, alpha, n, nfeat, max_iter, beta_eps, tweedie_p, p
+                    Xd, yd, wd, family, alpha, n, nfeat, max_iter, beta_eps,
+                    tweedie_p, p, vdata=vdata,
                 )
             else:
                 lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
@@ -402,9 +426,13 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 break  # gaussian ridge/OLS is exact in one step
         return beta
 
-    def _lambda_path(self, Xd, yd, wd, family, alpha, n, nfeat, max_iter, beta_eps, tweedie_p, p):
+    def _lambda_path(self, Xd, yd, wd, family, alpha, n, nfeat, max_iter,
+                     beta_eps, tweedie_p, p, vdata=None):
         """lambda_search: geometric path from lambda_max down, warm starts
-        (hex/glm/GLM.java regularization path)."""
+        (hex/glm/GLM.java regularization path). `lambda_best` is chosen by
+        VALIDATION deviance when a validation_frame was given (the reference
+        selects on held-out deviance; training deviance otherwise, which
+        favours the smallest lambda)."""
         gram0, xy0 = _gram_step(
             Xd, yd, wd, jnp.zeros(Xd.shape[1], jnp.float32), family, tweedie_p
         )
@@ -422,7 +450,10 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         for lv in lams:
             beta = self._irls_warm(Xd, yd, wd, family, float(lv), alpha,
                                    max_iter, beta_eps, tweedie_p, beta)
-            dev = self._deviance(Xd, yd, wd, family, beta)
+            if vdata is not None:
+                dev = self._deviance(vdata[0], vdata[1], vdata[2], family, beta)
+            else:
+                dev = self._deviance(Xd, yd, wd, family, beta)
             path.append((float(lv), beta.copy()))
             if dev < best[1]:
                 best = (beta.copy(), dev, float(lv))

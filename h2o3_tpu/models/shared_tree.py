@@ -200,6 +200,13 @@ class SharedTreeModel(H2OModel):
             d = {"predict": np.asarray(self.domain, dtype=object)[lab]}
             for i, cls in enumerate(self.domain):
                 d[str(cls)] = out[:, i]
+            cal = getattr(self, "calibrator", None)
+            if cal is not None and self.problem == "binomial":
+                # calibrate_model: appended cal_ columns (hex/tree
+                # CalibrationHelper — Platt scaling / isotonic)
+                p1 = cal(out[:, 1])
+                d[f"cal_{self.domain[0]}"] = 1.0 - p1
+                d[f"cal_{self.domain[1]}"] = p1
             fr = Frame.from_dict(d, column_types={"predict": "enum"})
             return fr
         return Frame.from_dict({"predict": out[:, 0]})
@@ -728,6 +735,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
             forest, tp["max_depth"], mode=self._mode,
         )
         model.balance_dists = balance_dists
+        model.calibrator = None
+        if self._parms.get("calibrate_model"):
+            if problem != "binomial":
+                raise ValueError("calibrate_model is only supported for "
+                                 "binomial models")
+            model.calibrator = self._fit_calibrator(model)
         model.scoring_history = history
         if gain_total.sum() > 0:
             order = np.argsort(-gain_total)
@@ -789,6 +802,48 @@ class H2OSharedTreeEstimator(H2OEstimator):
             )
             return fn(codes, g, h, w, fm, edges, key)
         return treelib.build_tree(codes, g, h, w, fm, edges, key=key, **kwargs)
+
+    def _fit_calibrator(self, model: SharedTreeModel):
+        """calibrate_model: fit Platt scaling (default) or isotonic
+        regression of the true labels on predicted p1 over the
+        calibration_frame (hex/tree CalibrationHelper)."""
+        calib = self._parms.get("calibration_frame")
+        if calib is None:
+            raise ValueError("calibrate_model=True requires calibration_frame")
+        # score EXACTLY as predict will (incl. offsets) so the map composes
+        p1 = model._score_probs(model._matrix(calib),
+                                model._offset_of(calib))[:, 1]
+        ycal = np.asarray(calib.vec(model.y).data, np.float64)
+        method = str(self._parms.get("calibration_method", "AUTO"))
+        if method in ("AUTO", "PlattScaling"):
+            # 1-D logistic regression y ~ a·logit(p) + b via Newton
+            z = np.log(np.clip(p1, 1e-12, 1 - 1e-12)
+                       / np.clip(1 - p1, 1e-12, 1 - 1e-12))
+            X = np.column_stack([z, np.ones_like(z)])
+            ab = np.zeros(2)
+            for _ in range(25):
+                mu = 1 / (1 + np.exp(-(X @ ab)))
+                Wd = np.clip(mu * (1 - mu), 1e-10, None)
+                grad = X.T @ (ycal - mu)
+                Hm = (X * Wd[:, None]).T @ X
+                step = np.linalg.solve(Hm + 1e-9 * np.eye(2), grad)
+                ab = ab + step
+                if np.max(np.abs(step)) < 1e-10:
+                    break
+            a, b = float(ab[0]), float(ab[1])
+
+            def platt(p):
+                zz = np.log(np.clip(p, 1e-12, 1 - 1e-12)
+                            / np.clip(1 - p, 1e-12, 1 - 1e-12))
+                return 1 / (1 + np.exp(-(a * zz + b)))
+
+            return platt
+        if method == "IsotonicRegression":
+            from .isotonic import pav
+
+            tx, ty = pav(p1, ycal, np.ones_like(ycal))
+            return lambda p: np.interp(p, tx, ty)
+        raise ValueError(f"unknown calibration_method {method!r}")
 
     def _default_stopping_metric(self, problem):
         sm = self._parms.get("stopping_metric", "AUTO")

@@ -207,18 +207,13 @@ def build_tree(
         if monotone is not None:
             # propagate bounds to children: on a ±1-constrained split the
             # mid-point of the chosen split's child values caps the lower-
-            # valued side and floors the higher-valued side
+            # valued side and floors the higher-valued side. Child values
+            # gathered from the SAME vL/vR used by the admissibility check.
             sel = (bf * nbins + bb)[:, None]
             flat_pick = lambda A: jnp.take_along_axis(
                 A.reshape(L, F * nbins), sel, axis=1)[:, 0]
-            gthrL = jnp.sign(flat_pick(GL)) * jnp.maximum(
-                jnp.abs(flat_pick(GL)) - reg_alpha, 0.0)
-            gthrR = jnp.sign(flat_pick(GR)) * jnp.maximum(
-                jnp.abs(flat_pick(GR)) - reg_alpha, 0.0)
-            vLs = jnp.clip(-gthrL / (flat_pick(HL) + reg_lambda + 1e-12),
-                           lo_lvl, hi_lvl)
-            vRs = jnp.clip(-gthrR / (flat_pick(HR) + reg_lambda + 1e-12),
-                           lo_lvl, hi_lvl)
+            vLs = flat_pick(vL)
+            vRs = flat_pick(vR)
             mid = 0.5 * (vLs + vRs)
             c = monotone[bf] * do_split.astype(monotone.dtype)
             # c=+1: left ≤ mid ≤ right; c=−1: mirrored; c=0: inherit as-is

@@ -225,3 +225,32 @@ def test_monotone_constraints(cloud1):
     with pytest.raises(ValueError):
         H2OGradientBoostingEstimator(ntrees=2, monotone_constraints={"c": 1}
                                      ).train(x=["c"], y="y", training_frame=fr2)
+
+
+def test_calibrate_model_platt_and_isotonic(cloud1):
+    rng = np.random.default_rng(31)
+    n = 3000
+    X = rng.normal(size=(n, 4))
+    p_true = 1 / (1 + np.exp(-(1.5 * X[:, 0] - 0.5)))
+    y = (rng.uniform(size=n) < p_true).astype(int)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "y"]).asfactor("y")
+    tr, cal = fr.split_frame([0.7], seed=1)
+    for method in ("PlattScaling", "IsotonicRegression"):
+        m = H2OGradientBoostingEstimator(
+            ntrees=30, max_depth=5, learn_rate=0.3, seed=1,
+            calibrate_model=True, calibration_frame=cal,
+            calibration_method=method)
+        m.train(y="y", training_frame=tr)
+        pred = m.predict(cal)
+        assert "cal_1" in pred.names and "cal_0" in pred.names
+        raw = pred.vec("1").numeric_np()
+        calp = pred.vec("cal_1").numeric_np()
+        ycal = np.asarray(cal.vec("y").data, np.float64)
+        # calibrated probabilities are no worse (usually better) in brier
+        brier_raw = np.mean((raw - ycal) ** 2)
+        brier_cal = np.mean((calp - ycal) ** 2)
+        assert brier_cal <= brier_raw + 0.01, (method, brier_raw, brier_cal)
+    with pytest.raises(ValueError):
+        H2OGradientBoostingEstimator(ntrees=2, calibrate_model=True).train(
+            y="y", training_frame=tr)

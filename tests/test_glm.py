@@ -152,3 +152,30 @@ def test_glm_multichip(cloud8):
     glm = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0)
     glm.train(y="y", training_frame=fr)
     assert glm.coef()["a"] == pytest.approx(1.0, abs=0.02)
+
+
+def test_lambda_search_validation_selection(cloud1):
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+    rng = np.random.default_rng(3)
+    n, p = 120, 40  # p-heavy: training deviance favours tiny lambda
+    X = rng.normal(size=(n, p))
+    beta = np.zeros(p)
+    beta[:3] = [2.0, -1.5, 1.0]
+    y = X @ beta + rng.normal(0, 1.0, n)
+    names = [f"x{i}" for i in range(p)]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names + ["y"])
+    Xv = rng.normal(size=(200, p))
+    yv = Xv @ beta + rng.normal(0, 1.0, 200)
+    vf = Frame.from_numpy(np.column_stack([Xv, yv]), names=names + ["y"])
+    g = H2OGeneralizedLinearEstimator(family="gaussian", alpha=1.0,
+                                      lambda_search=True)
+    g.train(x=names, y="y", training_frame=fr, validation_frame=vf)
+    gt = H2OGeneralizedLinearEstimator(family="gaussian", alpha=1.0,
+                                       lambda_search=True)
+    gt.train(x=names, y="y", training_frame=fr)
+    # validation-selected lambda regularizes more than train-selected
+    assert g.model.lambda_best >= gt.model.lambda_best
+    assert g.model.lambda_best > 0
