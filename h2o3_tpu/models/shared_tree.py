@@ -520,6 +520,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 fm = jnp.ones(F, jnp.float32)
             scale = (lr * jnp.power(annealing, m.astype(jnp.float32))).astype(jnp.float32)
             trs, gains_acc = [], jnp.zeros(F, jnp.float32)
+            oob_inc = None
             for k in range(K):
                 ktree = jax.random.fold_in(ktree, k)
                 if g_ext is not None:
@@ -534,12 +535,18 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 # margins track Σ tree outputs for ALL modes: GBM boosting
                 # margins, or DRF leaf-mean sums (÷ntrees at scoring time)
                 margins = margins.at[:, k].add(tr.value[leaf_idx])
+                if self._mode == "drf":
+                    # out-of-bag contribution (DRF OOB scoring): rows NOT
+                    # sampled into this tree accumulate its prediction
+                    col = tr.value[leaf_idx] * (1.0 - row_mask)
+                    oob_inc = col[:, None] if oob_inc is None else jnp.concatenate(
+                        [oob_inc, col[:, None]], axis=1)
                 trs.append(tr)
                 gains_acc = gains_acc + gains
             stacked = treelib.Tree(
                 *[jnp.stack([getattr(t, f) for t in trs]) for f in treelib.Tree._fields]
             )
-            return margins, stacked, gains_acc
+            return margins, stacked, gains_acc, oob_inc, (1.0 - row_mask)
 
         def _pack(stacked):
             """Tree fields → one f32 array (…, T, 5): a single D2H transfer
@@ -554,27 +561,31 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 axis=-1,
             )
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def _tree_jit(margins, codes_a, y_a, w_a, edges_a, key, m):
-            margins, stacked, gains = _one_tree(
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _tree_jit(margins, oob_sum, oob_cnt, codes_a, y_a, w_a, edges_a, key, m):
+            margins, stacked, gains, oob_inc, oob_mask = _one_tree(
                 margins, codes_a, y_a, w_a, edges_a,
                 jax.random.fold_in(key, m), m
             )
-            return margins, _pack(stacked), gains
+            if oob_inc is not None:
+                oob_sum = oob_sum + oob_inc
+                oob_cnt = oob_cnt + oob_mask
+            return margins, oob_sum, oob_cnt, _pack(stacked), gains
 
-        def _train_chunk(margins, key, m0, nsteps: int):
+        def _train_chunk(margins, oob_sum, oob_cnt, key, m0, nsteps: int):
             """nsteps async per-tree dispatches (NOT lax.scan: a scan body
             defeats XLA's onehot→reduction fusion and materializes the
             (rows × nodes·bins) one-hot in HBM, ~300× slower; sequential
             cached-jit enqueues pipeline on device with ~µs host overhead)."""
             packed_list, gains_list = [], []
             for i in range(nsteps):
-                margins, packed, gains = _tree_jit(
-                    margins, codes_d, y_d, w_d, edges_d, key, np.int32(m0 + i)
+                margins, oob_sum, oob_cnt, packed, gains = _tree_jit(
+                    margins, oob_sum, oob_cnt, codes_d, y_d, w_d, edges_d,
+                    key, np.int32(m0 + i)
                 )
                 packed_list.append(packed)
                 gains_list.append(gains)
-            return margins, jnp.stack(packed_list), sum(gains_list)
+            return margins, oob_sum, oob_cnt, jnp.stack(packed_list), sum(gains_list)
 
         _single_jit = jax.jit(
             lambda margins, codes_a, y_a, w_a, edges_a, key, m, g_ext, h_ext: (
@@ -608,6 +619,16 @@ class H2OSharedTreeEstimator(H2OEstimator):
             chunk = min(25, max(ntrees_target, 1))
 
         m = 0
+        # DRF OOB accumulators (out-of-bag prediction sums / counts per row)
+        if self._mode == "drf":
+            oob_sum = jnp.zeros((npad, K), jnp.float32)
+            oob_cnt = jnp.zeros(npad, jnp.float32)
+            if ndev > 1:
+                oob_sum = jax.device_put(oob_sum, cloud.row_sharding())
+                oob_cnt = jax.device_put(oob_cnt, cloud.row_sharding())
+        else:
+            oob_sum = jnp.zeros((1, K), jnp.float32)  # unused placeholder
+            oob_cnt = jnp.zeros(1, jnp.float32)
         packed_chunks: List = []   # device-resident (nsteps, K, T, 5) arrays
         gains_chunks: List = []    # device-resident (F,) arrays
         packed_host: List = []     # flushed-to-host chunks (OOM guard)
@@ -633,8 +654,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 packed = packed[None]
                 nsteps = 1
             else:
-                margins, packed, gains = _train_chunk(
-                    margins, key, m, nsteps=nsteps
+                margins, oob_sum, oob_cnt, packed, gains = _train_chunk(
+                    margins, oob_sum, oob_cnt, key, m, nsteps=nsteps
                 )
             # chunks stay on device until the post-loop bulk D2H (sync
             # transfers through the tunnel cost ~seconds each), unless the
@@ -662,7 +683,20 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 or (stopper is not None and not score_interval)
             )
             if do_score:
-                ev = self._score_event(problem, dist, margins, y_d, w_d, n, built + n_prior)
+                if self._mode == "drf" and tp["sample_rate"] < 1.0 and n_prior == 0:
+                    # score on OOB predictions (DRF scoring history is OOB)
+                    osum = np.asarray(oob_sum[:n], np.float64)
+                    ocnt = np.asarray(oob_cnt[:n], np.float64)
+                    have = ocnt > 0
+                    mnp = np.asarray(margins[:n], np.float64)
+                    oob_mean = np.where(have[:, None],
+                                        osum / np.maximum(ocnt[:, None], 1.0),
+                                        mnp / max(built, 1))
+                    ev = self._score_event(problem, dist,
+                                           oob_mean * max(built, 1),
+                                           y_d, w_d, n, built + n_prior)
+                else:
+                    ev = self._score_event(problem, dist, margins, y_d, w_d, n, built + n_prior)
                 if valid_state is not None:
                     vev = self._score_event(
                         problem, dist, valid_state[2],
@@ -755,8 +789,32 @@ class H2OSharedTreeEstimator(H2OEstimator):
         _ph.mark("forest_unpack")
         margins_np = np.asarray(margins[:n]).astype(np.float64)
         _ph.mark("margins_D2H")
-        probs_tr = self._probs_from_margins(problem, dist, margins_np,
-                                            model.ntrees_built)
+        if self._mode == "drf" and tp["sample_rate"] < 1.0 and n_prior > 0:
+            # checkpoint continuation: the prior forest's per-tree sample
+            # masks are gone, so OOB accounting cannot be reconstructed —
+            # metrics fall back to in-bag; make the semantics change loud
+            from ..runtime.log import Log
+
+            Log.warn("DRF checkpoint continuation: training metrics are "
+                     "in-bag (OOB state is not carried across checkpoints)")
+        if self._mode == "drf" and tp["sample_rate"] < 1.0 and n_prior == 0:
+            # DRF training metrics are OUT-OF-BAG (DRF OOB scoring): each
+            # row is scored only by trees that did not sample it; in-bag
+            # margins back-fill rows every tree happened to include
+            osum = np.asarray(oob_sum[:n], np.float64)
+            ocnt = np.asarray(oob_cnt[:n], np.float64)
+            have = ocnt > 0
+            oob_mean = np.where(
+                have[:, None], osum / np.maximum(ocnt[:, None], 1.0),
+                margins_np / max(model.ntrees_built, 1))
+            # feed as "margins × ntrees" so probs_from_margins' ÷ntrees
+            # reproduces the OOB mean
+            probs_tr = self._probs_from_margins(
+                problem, dist, oob_mean * max(model.ntrees_built, 1),
+                model.ntrees_built)
+        else:
+            probs_tr = self._probs_from_margins(problem, dist, margins_np,
+                                                model.ntrees_built)
         model.training_metrics = _metrics_for(problem, train.vec(y), probs_tr)
         _ph.mark("training_metrics")
         if valid is not None:
