@@ -280,6 +280,13 @@ def interaction(data: Frame, factors, pairwise: bool, max_factors: int,
     return fr
 
 
+def rapids(expr: str):
+    """`h2o.rapids` — evaluate a Rapids sexpr against the DKV."""
+    from .frame.rapids_expr import RapidsSession
+
+    return RapidsSession(_DKV).execute(expr)
+
+
 def no_progress():
     pass
 

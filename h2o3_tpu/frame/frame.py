@@ -211,6 +211,51 @@ class Frame:
                 out[n] = Vec.from_numpy(np.asarray(v.numeric_np()), "enum")
         return Frame(out)
 
+    # -- column/type introspection (H2OFrame surface) ------------------------
+    def levels(self):
+        """Per-column domains for enum columns (H2OFrame.levels)."""
+        return [v.domain or [] for v in self.vecs()]
+
+    def nlevels(self):
+        return [v.nlevels for v in self.vecs()]
+
+    def isfactor(self):
+        return [v.type == "enum" for v in self.vecs()]
+
+    def isnumeric(self):
+        return [v.type in ("real", "int") for v in self.vecs()]
+
+    def ischaracter(self):
+        return [v.type == "string" for v in self.vecs()]
+
+    def set_names(self, names) -> "Frame":
+        names = list(names)
+        if len(names) != self.ncol:
+            raise ValueError(f"set_names: {len(names)} names for {self.ncol} columns")
+        if len(set(names)) != len(names):
+            raise ValueError("set_names: duplicate column names")
+        self._vecs = dict(zip(names, self._vecs.values()))
+        return self
+
+    def rename(self, columns: Dict[str, str]) -> "Frame":
+        """{old: new} column rename (H2OFrame.rename)."""
+        new_names = [columns.get(n, n) for n in self._vecs]
+        if len(set(new_names)) != len(new_names):
+            raise ValueError("rename: would create duplicate column names")
+        self._vecs = dict(zip(new_names, self._vecs.values()))
+        return self
+
+    def columns_by_type(self, coltype: str = "numeric"):
+        sel = {
+            "numeric": lambda v: v.type in ("real", "int"),
+            "categorical": lambda v: v.type == "enum",
+            "string": lambda v: v.type == "string",
+            "time": lambda v: v.type == "time",
+        }.get(coltype)
+        if sel is None:
+            raise ValueError(f"columns_by_type: unknown type {coltype!r}")
+        return [float(i) for i, v in enumerate(self.vecs()) if sel(v)]
+
     # -- munging entry points (water/rapids subset, see rapids.py) -----------
     def group_by(self, by):
         from .rapids import GroupBy

@@ -502,15 +502,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
         annealing = tp["learn_rate_annealing"]
 
-        def _one_tree(margins, codes_a, y_a, w_a, edges_a, key, m,
+        def _one_tree(margins, codes_a, y_a, w_a, rate_a, edges_a, key, m,
                       g_ext=None, h_ext=None):
             """Build the K trees of boosting iteration m (traced int). All
             data arrives as ARGUMENTS — a closure-captured device array would
             be embedded in the HLO as a literal, defeating the persistent
             compilation cache (new data ⇒ recompile) and bloating programs."""
             krow, kcol, ktree = jax.random.split(jax.random.fold_in(key, 0), 3)
+            # rate_a is per-row: constant sample_rate, or per-class rates
+            # when sample_rate_per_class is set
             row_mask = (
-                jax.random.uniform(krow, (npad,)) < tp["sample_rate"]
+                jax.random.uniform(krow, (npad,)) < rate_a
             ).astype(jnp.float32)
             wt = w_a * row_mask
             if colp < 1.0:
@@ -562,9 +564,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
             )
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def _tree_jit(margins, oob_sum, oob_cnt, codes_a, y_a, w_a, edges_a, key, m):
+        def _tree_jit(margins, oob_sum, oob_cnt, codes_a, y_a, w_a, rate_a,
+                      edges_a, key, m):
             margins, stacked, gains, oob_inc, oob_mask = _one_tree(
-                margins, codes_a, y_a, w_a, edges_a,
+                margins, codes_a, y_a, w_a, rate_a, edges_a,
                 jax.random.fold_in(key, m), m
             )
             if oob_inc is not None:
@@ -580,17 +583,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
             packed_list, gains_list = [], []
             for i in range(nsteps):
                 margins, oob_sum, oob_cnt, packed, gains = _tree_jit(
-                    margins, oob_sum, oob_cnt, codes_d, y_d, w_d, edges_d,
-                    key, np.int32(m0 + i)
+                    margins, oob_sum, oob_cnt, codes_d, y_d, w_d, rate_d,
+                    edges_d, key, np.int32(m0 + i)
                 )
                 packed_list.append(packed)
                 gains_list.append(gains)
             return margins, oob_sum, oob_cnt, jnp.stack(packed_list), sum(gains_list)
 
         _single_jit = jax.jit(
-            lambda margins, codes_a, y_a, w_a, edges_a, key, m, g_ext, h_ext: (
+            lambda margins, codes_a, y_a, w_a, rate_a, edges_a, key, m, g_ext, h_ext: (
                 lambda r: (r[0], _pack(r[1]), r[2])
-            )(_one_tree(margins, codes_a, y_a, w_a, edges_a,
+            )(_one_tree(margins, codes_a, y_a, w_a, rate_a, edges_a,
                         jax.random.fold_in(key, m), m, g_ext, h_ext)),
             donate_argnums=(0,),
         )
@@ -619,6 +622,24 @@ class H2OSharedTreeEstimator(H2OEstimator):
             chunk = min(25, max(ntrees_target, 1))
 
         m = 0
+        # per-row sampling rate: constant sample_rate, or per-class rates
+        # (sample_rate_per_class, hex/tree SharedTree class sampling)
+        srpc = self._parms.get("sample_rate_per_class")
+        if srpc and problem not in ("binomial", "multinomial"):
+            raise ValueError("sample_rate_per_class requires a categorical "
+                             "response (classification only)")
+        if srpc:
+            rates_np = np.asarray(list(srpc), np.float32)
+            if len(rates_np) != nclass:
+                raise ValueError(
+                    f"sample_rate_per_class needs {nclass} entries, got {len(rates_np)}")
+            rate_rows = rates_np[np.asarray(yvec.data, np.int64)]
+            rate_d = jnp.asarray(padr(rate_rows.astype(np.float32)))
+        else:
+            rate_d = jnp.full(npad, np.float32(tp["sample_rate"]))
+        row_sampled = tp["sample_rate"] < 1.0 or bool(srpc)
+        if ndev > 1:
+            rate_d = jax.device_put(rate_d, cloud.row_sharding())
         # DRF OOB accumulators (out-of-bag prediction sums / counts per row)
         if self._mode == "drf":
             oob_sum = jnp.zeros((npad, K), jnp.float32)
@@ -648,8 +669,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if custom_obj is not None:
                 g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
                 margins, packed, gains = _single_jit(
-                    margins, codes_d, y_d, w_d, edges_d, key, jnp.int32(m),
-                    g_ext, h_ext
+                    margins, codes_d, y_d, w_d, rate_d, edges_d, key,
+                    jnp.int32(m), g_ext, h_ext
                 )
                 packed = packed[None]
                 nsteps = 1
@@ -683,7 +704,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 or (stopper is not None and not score_interval)
             )
             if do_score:
-                if self._mode == "drf" and tp["sample_rate"] < 1.0 and n_prior == 0:
+                if self._mode == "drf" and row_sampled and n_prior == 0:
                     # score on OOB predictions (DRF scoring history is OOB)
                     osum = np.asarray(oob_sum[:n], np.float64)
                     ocnt = np.asarray(oob_cnt[:n], np.float64)
@@ -789,7 +810,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         _ph.mark("forest_unpack")
         margins_np = np.asarray(margins[:n]).astype(np.float64)
         _ph.mark("margins_D2H")
-        if self._mode == "drf" and tp["sample_rate"] < 1.0 and n_prior > 0:
+        if self._mode == "drf" and row_sampled and n_prior > 0:
             # checkpoint continuation: the prior forest's per-tree sample
             # masks are gone, so OOB accounting cannot be reconstructed —
             # metrics fall back to in-bag; make the semantics change loud
@@ -797,7 +818,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
             Log.warn("DRF checkpoint continuation: training metrics are "
                      "in-bag (OOB state is not carried across checkpoints)")
-        if self._mode == "drf" and tp["sample_rate"] < 1.0 and n_prior == 0:
+        if self._mode == "drf" and row_sampled and n_prior == 0:
             # DRF training metrics are OUT-OF-BAG (DRF OOB scoring): each
             # row is scored only by trees that did not sample it; in-bag
             # margins back-fill rows every tree happened to include

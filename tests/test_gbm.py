@@ -280,3 +280,19 @@ def test_drf_oob_training_metrics(cloud1):
     true_auc = auc_exact(y.astype(float), p)
     # ~11 OOB trees per row at ntrees=30 → a noisy but unbiased-ish estimate
     assert abs(oob_auc - true_auc) < 0.12, (oob_auc, true_auc)
+
+
+def test_sample_rate_per_class(cloud1):
+    rng = np.random.default_rng(51)
+    n = 2000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 1.0).astype(int)  # ~16% minority
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "y"]).asfactor("y")
+    m = H2ORandomForestEstimator(ntrees=20, max_depth=6, seed=1,
+                                 sample_rate_per_class=[0.3, 1.0])
+    m.train(y="y", training_frame=fr)
+    assert m.auc() > 0.8
+    with pytest.raises(ValueError):
+        H2ORandomForestEstimator(ntrees=2, sample_rate_per_class=[0.5]).train(
+            y="y", training_frame=fr)

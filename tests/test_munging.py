@@ -215,3 +215,33 @@ def test_gains_lift_and_roc(cloud1):
     assert gl[-1]["cumulative_capture_rate"] == pytest.approx(1.0)
     fpr, tpr = m.model.roc()
     assert len(fpr) == len(tpr) and (np.diff(fpr) <= 1e-12).all()  # desc sweep
+
+
+def test_frame_introspection_and_rapids_fn(cloud1):
+    import h2o3_tpu as h2o
+
+    fr = Frame.from_dict({
+        "num": np.asarray([1.0, 2.0]),
+        "cat": np.asarray(["a", "b"], dtype=object),
+    }, column_types={"cat": "enum"})
+    assert fr.isfactor() == [False, True]
+    assert fr.isnumeric() == [True, False]
+    assert fr.levels() == [[], ["a", "b"]]
+    assert fr.nlevels() == [0, 2]
+    assert fr.columns_by_type("categorical") == [1.0]
+    fr.rename({"num": "n2"})
+    assert fr.names == ["n2", "cat"]
+    fr.set_names(["x", "y"])
+    assert fr.names == ["x", "y"]
+    from h2o3_tpu.runtime.dkv import DKV
+    DKV.put("rfr", fr)
+    assert h2o.rapids("(nrow rfr)") == 2
+
+
+def test_rename_set_names_collisions(cloud1):
+    fr = Frame.from_dict({"a": np.asarray([1.0]), "b": np.asarray([2.0])})
+    with pytest.raises(ValueError):
+        fr.rename({"a": "b"})
+    with pytest.raises(ValueError):
+        fr.set_names(["x", "x"])
+    assert fr.ncol == 2  # untouched after failed renames
