@@ -70,6 +70,22 @@ class Leaderboard:
             {k: v for k, v in r.items() if not k.startswith("_")} for r in self.rows
         ]
 
+    def as_frame(self):
+        """Leaderboard as a Frame (the h2o-py leaderboard frame surface)."""
+        rows = self.as_data_frame()
+        if not rows:
+            return Frame({})
+        cols = {}
+        for k in rows[0]:
+            vals = [r.get(k) for r in rows]
+            if isinstance(vals[0], str):
+                cols[k] = np.asarray(vals, dtype=object)
+            else:
+                cols[k] = np.asarray(
+                    [np.nan if v is None else float(v) for v in vals])
+        return Frame.from_dict(cols, column_types={"model_id": "enum",
+                                                   "algo": "enum"})
+
     def __len__(self):
         return len(self.rows)
 
@@ -283,6 +299,34 @@ class H2OAutoML:
     def predict(self, frame: Frame) -> Frame:
         assert self.leader is not None, "AutoML has no leader; call train() first"
         return self.leader.predict(frame)
+
+    _LEADERBOARD_METRICS = ("auc", "logloss", "mean_per_class_error",
+                            "rmse", "mse", "mae")
+
+    def get_best_model(self, algorithm: Optional[str] = None,
+                       criterion: Optional[str] = None):
+        """Best model overall or of one algorithm family
+        (H2OAutoML.get_best_model)."""
+        if self.leaderboard is None:
+            raise ValueError("AutoML has no leaderboard; call train() first")
+        rows = self.leaderboard.rows
+        if criterion:
+            if criterion not in self._LEADERBOARD_METRICS:
+                raise ValueError(
+                    f"criterion {criterion!r} not in leaderboard metrics "
+                    f"{self._LEADERBOARD_METRICS}")
+            decreasing = criterion in ("auc",)
+
+            def sk(r):  # NaN-safe total order (same shape as Leaderboard._sort)
+                v = r.get(criterion, float("nan"))
+                bad = v is None or (isinstance(v, float) and np.isnan(v))
+                return (bad, -v if (decreasing and not bad) else (v if not bad else 0))
+
+            rows = sorted(rows, key=sk)
+        for r in rows:
+            if algorithm is None or r["algo"].lower() == algorithm.lower():
+                return r["_est"]
+        return None
 
     def get_leaderboard(self, extra_columns=None):
         return self.leaderboard

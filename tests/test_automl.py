@@ -123,3 +123,24 @@ def test_xgboost_reg_alpha_shrinks_leaves(cloud1):
     v0 = float(np.abs(np.asarray(plain.model.forest[0].value)).sum())
     v1 = float(np.abs(np.asarray(strong.model.forest[0].value)).sum())
     assert v1 < v0  # L1 soft-threshold shrinks leaf outputs
+
+
+def test_leaderboard_frame_and_best_model(cloud1):
+    import numpy as np
+    from h2o3_tpu.automl.automl import H2OAutoML
+    from h2o3_tpu.frame.frame import Frame
+
+    rng = np.random.default_rng(0)
+    n = 600
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "y"]).asfactor("y")
+    aml = H2OAutoML(max_models=3, max_runtime_secs=120, nfolds=2, seed=1,
+                    include_algos=["GBM", "GLM"])
+    aml.train(y="y", training_frame=fr)
+    lb = aml.leaderboard.as_frame()
+    assert lb.nrow >= 3 and "auc" in lb.names
+    best_glm = aml.get_best_model(algorithm="glm")
+    assert best_glm is not None and best_glm.algo == "glm"
+    assert aml.get_best_model() is aml.leaderboard[0]["_est"]
