@@ -157,6 +157,20 @@ class GLMModel(H2OModel):
             }
         return dict(zip(self._names(), np.asarray(self.beta)))
 
+    def summary(self):
+        s = super().summary()
+        # intercepts excluded; a multinomial predictor counts once if active
+        # in ANY class (matches the total's per-predictor granularity)
+        if self.family == "multinomial":
+            slopes = np.abs(np.asarray(self.beta)[:, :-1]).max(axis=0)
+        else:
+            slopes = np.abs(np.asarray(self.beta)[:-1])
+        s.update(family=self.family,
+                 number_of_predictors_total=len(self.dinfo.coef_names),
+                 number_of_active_predictors=int((slopes > 1e-10).sum()),
+                 lambda_=self.lambda_best)
+        return s
+
     def coef_with_p_values(self):
         """Coefficient table with std errors / z / p-values on the DATA scale
         (matches coef()) — requires compute_p_values=True and lambda=0

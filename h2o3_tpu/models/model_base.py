@@ -231,6 +231,19 @@ class H2OModel:
     def varimp(self, use_pandas=False):
         return self.varimp_table
 
+    def summary(self):
+        """Model summary table (h2o-py ModelBase.summary) — generic form;
+        concrete models override with their architecture specifics."""
+        return dict(model_id=self.model_id, algo=self.algo,
+                    run_time_s=round(self.run_time, 3))
+
+    def show(self):
+        print(f"Model: {self.model_id} ({self.algo})")
+        for k, v in self.summary().items():
+            print(f"  {k}: {v}")
+        if self.training_metrics is not None:
+            print(f"  training: {self.training_metrics._ser()}")
+
     def gains_lift(self, valid=False, xval=False):
         m = self._m(valid, xval)
         return m.gains_lift() if hasattr(m, "gains_lift") else None

@@ -154,6 +154,23 @@ class SharedTreeModel(H2OModel):
         self.mode = mode          # 'gbm' (summed margins) | 'drf' (averaged leaves)
         self.ntrees_built = int(forest[0].feat.shape[0]) if forest else 0
 
+    def summary(self):
+        """ModelSummary of SharedTreeModel: tree count + depth/leaf stats."""
+        s = super().summary()
+        depths, leaves = [], []
+        for stacked in self.forest:
+            issp = np.asarray(stacked.is_split)
+            node_depth = np.floor(np.log2(np.arange(1, issp.shape[1] + 1)))
+            for t in range(issp.shape[0]):
+                d = node_depth[issp[t]].max() + 1 if issp[t].any() else 0
+                depths.append(int(d))
+                leaves.append(int(issp[t].sum() + 1))
+        s.update(number_of_trees=self.ntrees_built,
+                 min_depth=int(min(depths, default=0)),
+                 max_depth=int(max(depths, default=0)),
+                 mean_leaves=float(np.mean(leaves)) if leaves else 0.0)
+        return s
+
     def _matrix(self, frame: Frame) -> np.ndarray:
         X, _, _ = frame_to_matrix(frame, self.x, expected_domains=self.bm.domains)
         return X

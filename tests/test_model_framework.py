@@ -168,3 +168,25 @@ def test_h2o_interaction(cloud1):
     out2 = h2o.interaction(fr, factors=["a", "b"], pairwise=True,
                            max_factors=2, min_occurrence=1)
     assert "other" in out2.vec("a_b").domain
+
+
+def test_model_summary_show(cloud1, capsys):
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+    rng = np.random.default_rng(0)
+    fr = Frame.from_dict({"a": rng.normal(size=300), "b": rng.normal(size=300),
+                          "y": rng.normal(size=300)})
+    m = H2OGradientBoostingEstimator(ntrees=5, max_depth=3)
+    m.train(x=["a", "b"], y="y", training_frame=fr)
+    s = m.model.summary()
+    assert s["number_of_trees"] == 5 and 1 <= s["max_depth"] <= 3
+    m.model.show()
+    out = capsys.readouterr().out
+    assert "number_of_trees" in out
+    g = H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0)
+    g.train(x=["a", "b"], y="y", training_frame=fr)
+    gs = g.model.summary()
+    assert gs["family"] == "gaussian" and gs["number_of_predictors_total"] == 2
