@@ -40,9 +40,20 @@ def _json_default(o):
         return v if np.isfinite(v) else None
     if isinstance(o, np.ndarray):
         return o.tolist()
-    if isinstance(o, float) and not np.isfinite(o):
-        return None
     return str(o)
+
+
+def _sanitize(o):
+    """Replace non-finite floats with null BEFORE dumps — json.dumps never
+    calls `default` for native floats, so NaN would otherwise serialize as a
+    bare (invalid-JSON) NaN token."""
+    if isinstance(o, float):
+        return o if np.isfinite(o) else None
+    if isinstance(o, dict):
+        return {k: _sanitize(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_sanitize(v) for v in o]
+    return o
 
 
 def _frame_summary(fr: Frame, rows: int = 10) -> Dict:
@@ -103,6 +114,7 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Models/([^/]+)$", "model_get"),
         ("DELETE", r"^/3/Models/([^/]+)$", "model_delete"),
         ("POST", r"^/3/Predictions/models/([^/]+)/frames/([^/]+)$", "predict"),
+        ("POST", r"^/3/ModelMetrics/models/([^/]+)/frames/([^/]+)$", "model_metrics"),
         ("GET", r"^/3/Jobs$", "jobs_list"),
         ("GET", r"^/3/Jobs/([^/]+)$", "job_get"),
         ("POST", r"^/99/Rapids$", "rapids"),
@@ -117,7 +129,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ------------------------------------------------------------
     def _send(self, obj, status: int = 200):
-        body = json.dumps(obj, default=_json_default).encode()
+        body = json.dumps(_sanitize(obj), default=_json_default).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -277,7 +289,11 @@ class _Handler(BaseHTTPRequestHandler):
         if ignored:
             kwargs["ignored_columns"] = ignored
         est = cls(**kwargs)
-        job = Job(dest=f"{algo}_rest", description=f"{algo} train").start()
+        import uuid
+
+        job = Job(dest=f"{algo}_rest_{uuid.uuid4().hex[:8]}",
+                  description=f"{algo} train").start()
+        job.result = None  # model key once DONE (the job's `dest` is stable)
         DKV.put(job.dest, job)
 
         def run():
@@ -285,7 +301,7 @@ class _Handler(BaseHTTPRequestHandler):
                 est.train(x=x, y=y, training_frame=train, validation_frame=valid)
                 m = est.model
                 DKV.put(m.model_id, m)
-                job.dest = m.model_id
+                job.result = m.model_id
                 job.done()
             except Exception as e:
                 Log.err(f"train {algo}: {e}")
@@ -321,18 +337,33 @@ class _Handler(BaseHTTPRequestHandler):
         DKV.put(pred.key, pred)
         self._send(dict(predictions_frame=dict(name=pred.key)))
 
+    def h_model_metrics(self, model_key, frame_key):
+        m = DKV.get(model_key)
+        fr = DKV.get(frame_key)
+        if not isinstance(m, H2OModel):
+            raise KeyError(model_key)
+        if not isinstance(fr, Frame):
+            raise KeyError(frame_key)
+        mm = m.model_performance(fr)
+        self._send(dict(model_metrics=[dict(
+            model=dict(name=model_key), frame=dict(name=frame_key),
+            **(mm._ser() if mm else {}))]))
+
+    @staticmethod
+    def _job_json(j):
+        return dict(key=dict(name=j.dest), status=j.status,
+                    progress=j.progress, warnings=j.warnings,
+                    dest=dict(name=getattr(j, "result", None) or j.dest))
+
     def h_jobs_list(self):
         jobs = [DKV.get(k) for k in DKV.keys(Job)]
-        self._send(dict(jobs=[dict(key=dict(name=j.dest), status=j.status,
-                                   progress=j.progress) for j in jobs]))
+        self._send(dict(jobs=[self._job_json(j) for j in jobs]))
 
     def h_job_get(self, key):
         j = DKV.get(key)
         if not isinstance(j, Job):
             raise KeyError(key)
-        self._send(dict(jobs=[dict(key=dict(name=j.dest), status=j.status,
-                                   progress=j.progress,
-                                   warnings=j.warnings)]))
+        self._send(dict(jobs=[self._job_json(j)]))
 
     def h_rapids(self):
         p = self._params()

@@ -509,5 +509,9 @@ def response_info(yvec: Vec):
     ModelBuilder's distribution inference from response type."""
     if yvec.type == "enum":
         k = yvec.nlevels
+        if k < 2:
+            raise ValueError(
+                "categorical response has fewer than two classes "
+                "(ModelBuilder rejects constant responses)")
         return ("binomial" if k == 2 else "multinomial"), k, yvec.domain
     return "regression", 1, None

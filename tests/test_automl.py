@@ -80,8 +80,8 @@ def test_grid_search_random_discrete(cloud1):
 
 
 def test_stacked_ensemble(cloud1):
-    fr = _cls_frame(1500, 6, seed=8)
-    common = dict(nfolds=3, keep_cross_validation_predictions=True, seed=9)
+    fr = _cls_frame(900, 5, seed=8)
+    common = dict(nfolds=2, keep_cross_validation_predictions=True, seed=9)
     gbm = H2OGradientBoostingEstimator(ntrees=15, max_depth=3, **common)
     gbm.train(y="y", training_frame=fr)
     drf = H2ORandomForestEstimator(ntrees=15, max_depth=8, **common)
@@ -97,7 +97,7 @@ def test_stacked_ensemble(cloud1):
 
 
 def test_automl_leaderboard(cloud1):
-    fr = _cls_frame(900, 5, seed=10)
+    fr = _cls_frame(500, 4, seed=10)
     aml = H2OAutoML(max_models=4, max_runtime_secs=600, seed=11, nfolds=2,
                     exclude_algos=["DeepLearning"])
     aml.train(y="y", training_frame=fr)
@@ -136,11 +136,11 @@ def test_leaderboard_frame_and_best_model(cloud1):
     y = (X[:, 0] + X[:, 1] > 0).astype(int)
     fr = Frame.from_numpy(np.column_stack([X, y]),
                           names=["a", "b", "c", "d", "y"]).asfactor("y")
-    aml = H2OAutoML(max_models=3, max_runtime_secs=120, nfolds=2, seed=1,
+    aml = H2OAutoML(max_models=2, max_runtime_secs=120, nfolds=2, seed=1,
                     include_algos=["GBM", "GLM"])
     aml.train(y="y", training_frame=fr)
     lb = aml.leaderboard.as_frame()
-    assert lb.nrow >= 3 and "auc" in lb.names
+    assert lb.nrow >= 2 and "auc" in lb.names
     best_glm = aml.get_best_model(algorithm="glm")
     assert best_glm is not None and best_glm.algo == "glm"
     assert aml.get_best_model() is aml.leaderboard[0]["_est"]

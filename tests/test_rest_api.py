@@ -88,9 +88,8 @@ def test_train_poll_predict_delete(server):
         if j["status"] in ("DONE", "FAILED"):
             break
         time.sleep(0.25)
-        job_key = j["key"]["name"]
     assert j["status"] == "DONE", j
-    model_key = j["key"]["name"]
+    model_key = j["dest"]["name"]
     m = _get(srv, f"/3/Models/{model_key}")["models"][0]
     assert m["algo"] == "gbm"
     assert m["output"]["training_metrics"]["rmse"] < 0.5
@@ -179,3 +178,26 @@ def test_rapids_extended_prims(server):
     # is.na
     na = _post(srv, "/99/Rapids", ast=f"(sum (is.na (cols {key} [0])))")
     assert na["scalar"] == 0.0
+
+
+def test_model_metrics_endpoint(server):
+    srv, csv = server
+    r = _post(srv, "/3/ImportFiles", path=csv)
+    key = r["destination_frames"][0]
+    _post(srv, "/99/Rapids",
+          ast=f"(assign mmtrain (cbind (cols {key} [0 1 2])"
+              f" (as.factor (cols {key} [3]))))")
+    r = _post(srv, "/3/ModelBuilders/gbm", training_frame="mmtrain",
+              response_column="y", ntrees="5", max_depth="3")
+    jk = r["job"]["key"]["name"]
+    for _ in range(400):
+        j = _get(srv, f"/3/Jobs/{jk}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED"):
+            break
+        time.sleep(0.25)
+    assert j["status"] == "DONE", j
+    mk = j["dest"]["name"]
+    mm = _post(srv, f"/3/ModelMetrics/models/{mk}/frames/mmtrain")
+    row = mm["model_metrics"][0]
+    assert row["model"]["name"] == mk
+    assert 0.5 <= row["auc"] <= 1.0
