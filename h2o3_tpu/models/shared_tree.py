@@ -153,6 +153,7 @@ class SharedTreeModel(H2OModel):
         self.max_depth = max_depth
         self.mode = mode          # 'gbm' (summed margins) | 'drf' (averaged leaves)
         self.ntrees_built = int(forest[0].feat.shape[0]) if forest else 0
+        self.covers = None        # list over classes of (ntrees, T) — TreeSHAP
 
     def summary(self):
         """ModelSummary of SharedTreeModel: tree count + depth/leaf stats."""
@@ -231,6 +232,106 @@ class SharedTreeModel(H2OModel):
     def _make_metrics(self, frame: Frame):
         out = self._score_probs(self._matrix(frame), self._offset_of(frame))
         return _metrics_for(self.problem, frame.vec(self.y), out)
+
+    def predict_contributions(self, test_data: Frame, output_format="Original",
+                              top_n=0, bottom_n=0, compare_abs=False) -> Frame:
+        """Per-row SHAP feature contributions + BiasTerm (path-dependent
+        TreeSHAP — hex/genmodel TreeSHAP.java via Model.scoreContributions).
+        Contributions are in the margin space (log-odds for GBM binomial,
+        response for regression, probability for DRF binomial) and sum with
+        BiasTerm to the raw prediction. Binomial/regression only, as in the
+        reference."""
+        if self.problem == "multinomial":
+            raise ValueError(
+                "predict_contributions is not supported for multinomial "
+                "models (reference parity: hex/Model.scoreContributions)")
+        if output_format not in ("Original", "Compact", "original", "compact"):
+            raise ValueError("output_format must be 'Original' or 'Compact' "
+                             "(they coincide here: enums stay integer-coded, "
+                             "one column per input feature)")
+        oc = (self.parms._parms.get("offset_column")
+              if hasattr(self.parms, "_parms") else None)
+        if oc:
+            raise ValueError(
+                "predict_contributions is not supported for models trained "
+                "with an offset_column (reference parity)")
+        covers = getattr(self, "covers", None)
+        if not covers:
+            raise ValueError(
+                "this model has no recorded node covers "
+                "(trained before TreeSHAP support); retrain to enable "
+                "predict_contributions")
+        from .tree_shap import compute_contributions
+
+        X = self._matrix(test_data)
+        scale = 1.0 / max(self.ntrees_built, 1) if self.mode == "drf" else 1.0
+        stacked = self.forest[0]
+        f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[0]
+        contrib = compute_contributions(
+            stacked.feat, stacked.thr, stacked.is_split, stacked.value,
+            covers[0], X, scale, f0k)
+        names = list(self.x) + ["BiasTerm"]
+        if top_n or bottom_n:
+            # top/bottom-N pairs per row: (feature, value) columns, ranked by
+            # signed value (or |value| with compare_abs), BiasTerm excluded
+            vals = contrib[:, :-1]
+            keys = np.abs(vals) if compare_abs else vals
+            order = np.argsort(-keys, axis=1, kind="stable")
+            d = {}
+            fn_arr = np.asarray(self.x, dtype=object)
+            nf = len(self.x)
+            tn = nf if top_n < 0 else min(top_n, nf)
+            bn = nf if bottom_n < 0 else min(bottom_n, nf)
+            for i in range(tn):
+                sel = order[:, i]
+                d[f"top_feature_{i + 1}"] = fn_arr[sel]
+                d[f"top_value_{i + 1}"] = np.take_along_axis(
+                    vals, sel[:, None], axis=1)[:, 0]
+            for i in range(bn):
+                sel = order[:, nf - 1 - i]
+                d[f"bottom_feature_{i + 1}"] = fn_arr[sel]
+                d[f"bottom_value_{i + 1}"] = np.take_along_axis(
+                    vals, sel[:, None], axis=1)[:, 0]
+            d["BiasTerm"] = contrib[:, -1]
+            return Frame.from_dict(d)
+        return Frame.from_dict({n2: contrib[:, j] for j, n2 in enumerate(names)})
+
+    def predict_leaf_node_assignment(self, test_data: Frame,
+                                     type: str = "Path") -> Frame:
+        """Leaf assignment per (tree, class): decision-path strings ("LRL…")
+        or heap node ids — `Model.scoreLeafNodeAssignment`
+        (hex/tree/SharedTreeModel leaf_node_assignment)."""
+        if type not in ("Path", "Node_ID"):
+            raise ValueError("type must be 'Path' or 'Node_ID'")
+        X = self._matrix(test_data)
+        N = X.shape[0]
+        d = {}
+        ctypes_ = {}
+        for k, stacked in enumerate(self.forest):
+            feat = np.asarray(stacked.feat)
+            thr = np.asarray(stacked.thr)
+            issp = np.asarray(stacked.is_split)
+            for t in range(self.ntrees_built):
+                node = np.zeros(N, np.int64)
+                paths = (np.full(N, "", dtype=f"<U{self.max_depth}")
+                         if type == "Path" else None)
+                for _ in range(self.max_depth):
+                    s = issp[t][node]
+                    if not s.any():
+                        break
+                    xv = X[np.arange(N), feat[t][node]]
+                    right = (np.isnan(xv) | (xv > thr[t][node])) & s
+                    if paths is not None:
+                        step = np.where(s, np.where(right, "R", "L"), "")
+                        paths = np.char.add(paths, step)
+                    node = np.where(s, 2 * node + 1 + right.astype(np.int64), node)
+                col = (f"T{t + 1}.C{k + 1}")
+                if type == "Path":
+                    d[col] = paths.astype(object)
+                    ctypes_[col] = "enum"
+                else:
+                    d[col] = node.astype(np.float64)
+        return Frame.from_dict(d, column_types=ctypes_ or None)
 
 
 class H2OSharedTreeEstimator(H2OEstimator):
@@ -538,7 +639,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             else:
                 fm = jnp.ones(F, jnp.float32)
             scale = (lr * jnp.power(annealing, m.astype(jnp.float32))).astype(jnp.float32)
-            trs, gains_acc = [], jnp.zeros(F, jnp.float32)
+            trs, covs, gains_acc = [], [], jnp.zeros(F, jnp.float32)
             oob_inc = None
             for k in range(K):
                 ktree = jax.random.fold_in(ktree, k)
@@ -546,7 +647,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     g, h = g_ext, h_ext
                 else:
                     g, h = _grads(margins, y_a, k)
-                tr, leaf_idx, gains = self._build_one(
+                tr, leaf_idx, gains, cover = self._build_one(
                     codes_a, g, h, wt, fm, edges_a, tp, nbins, mtries,
                     ktree, cloud
                 )
@@ -561,36 +662,39 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     oob_inc = col[:, None] if oob_inc is None else jnp.concatenate(
                         [oob_inc, col[:, None]], axis=1)
                 trs.append(tr)
+                covs.append(cover)
                 gains_acc = gains_acc + gains
             stacked = treelib.Tree(
                 *[jnp.stack([getattr(t, f) for t in trs]) for f in treelib.Tree._fields]
             )
-            return margins, stacked, gains_acc, oob_inc, (1.0 - row_mask)
+            covers = jnp.stack(covs)                      # (K, T)
+            return margins, stacked, covers, gains_acc, oob_inc, (1.0 - row_mask)
 
-        def _pack(stacked):
-            """Tree fields → one f32 array (…, T, 5): a single D2H transfer
-            moves a whole chunk of trees (each sync transfer through a
-            remote-TPU tunnel pays seconds of fixed latency)."""
+        def _pack(stacked, covers):
+            """Tree fields + covers → one f32 array (…, T, 6): a single D2H
+            transfer moves a whole chunk of trees (each sync transfer through
+            a remote-TPU tunnel pays seconds of fixed latency)."""
             return jnp.stack(
                 [stacked.feat.astype(jnp.float32),
                  stacked.bin.astype(jnp.float32),
                  stacked.thr,
                  stacked.is_split.astype(jnp.float32),
-                 stacked.value],
+                 stacked.value,
+                 covers],
                 axis=-1,
             )
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def _tree_jit(margins, oob_sum, oob_cnt, codes_a, y_a, w_a, rate_a,
                       edges_a, key, m):
-            margins, stacked, gains, oob_inc, oob_mask = _one_tree(
+            margins, stacked, covers, gains, oob_inc, oob_mask = _one_tree(
                 margins, codes_a, y_a, w_a, rate_a, edges_a,
                 jax.random.fold_in(key, m), m
             )
             if oob_inc is not None:
                 oob_sum = oob_sum + oob_inc
                 oob_cnt = oob_cnt + oob_mask
-            return margins, oob_sum, oob_cnt, _pack(stacked), gains
+            return margins, oob_sum, oob_cnt, _pack(stacked, covers), gains
 
         def _train_chunk(margins, oob_sum, oob_cnt, key, m0, nsteps: int):
             """nsteps async per-tree dispatches (NOT lax.scan: a scan body
@@ -609,7 +713,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
         _single_jit = jax.jit(
             lambda margins, codes_a, y_a, w_a, rate_a, edges_a, key, m, g_ext, h_ext: (
-                lambda r: (r[0], _pack(r[1]), r[2])
+                lambda r: (r[0], _pack(r[1], r[2]), r[3])
             )(_one_tree(margins, codes_a, y_a, w_a, rate_a, edges_a,
                         jax.random.fold_in(key, m), m, g_ext, h_ext)),
             donate_argnums=(0,),
@@ -780,11 +884,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
             gain_total += np.asarray(sum(gains_chunks), np.float64)
             _ph.mark("gains_D2H")
         else:
-            all_packed = np.zeros((0, K, treelib.heap_size(tp["max_depth"]), 5),
+            all_packed = np.zeros((0, K, treelib.heap_size(tp["max_depth"]), 6),
                                   np.float32)
         # stacked forests sliced straight off the bulk array — no per-tree
-        # host Trees, no 5×ntrees tiny H2D transfers (stack_trees on device)
+        # host Trees, no 6×ntrees tiny H2D transfers (stack_trees on device)
         forest = []
+        covers_by_class = []
+        prior_covers = getattr(pm, "covers", None) if prior_stacked else None
         for k in range(K):
             new = treelib.Tree(
                 np.ascontiguousarray(all_packed[:, k, :, 0]).astype(np.int32),
@@ -793,6 +899,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 all_packed[:, k, :, 3] > 0.5,
                 np.ascontiguousarray(all_packed[:, k, :, 4]),
             )
+            cov_k = np.ascontiguousarray(all_packed[:, k, :, 5])
             if prior_stacked:
                 prior = prior_stacked[k]
                 new = treelib.Tree(*[
@@ -800,12 +907,22 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                     getattr(new, f)], axis=0)
                     for f in treelib.Tree._fields
                 ])
+                if prior_covers is not None and k < len(prior_covers):
+                    cov_k = np.concatenate(
+                        [np.asarray(prior_covers[k], np.float32), cov_k], axis=0)
             forest.append(new)
+            covers_by_class.append(cov_k)
+        if prior_stacked and prior_covers is None:
+            # continued from a pre-TreeSHAP checkpoint: the prior trees have
+            # no covers, so a partial covers array would misalign with the
+            # forest — disable contributions for this model instead
+            covers_by_class = None
         model = SharedTreeModel(
             self, x, y, bm, problem, nclass, domain, dist,
             np.asarray(f0) if K > 1 else float(f0[0]),
             forest, tp["max_depth"], mode=self._mode,
         )
+        model.covers = covers_by_class
         model.balance_dists = balance_dists
         model.calibrator = None
         if self._parms.get("calibrate_model"):
@@ -893,7 +1010,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 inner, mesh=cloud.mesh,
                 in_specs=(rspec, rspec, rspec, rspec, P(), P(), P()),
                 out_specs=(
-                    treelib.Tree(P(), P(), P(), P(), P()), rspec, P(),
+                    treelib.Tree(P(), P(), P(), P(), P()), rspec, P(), P(),
                 ),
             )
             return fn(codes, g, h, w, fm, edges, key)

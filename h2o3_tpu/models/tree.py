@@ -74,7 +74,9 @@ def build_tree(
     key: Optional[jax.Array] = None,
     monotone: Optional[jax.Array] = None,  # (F,) ∈ {-1,0,1}
 ):
-    """Build one tree; returns (Tree, final_leaf_heap_idx (N,), gain_per_feature (F,)).
+    """Build one tree; returns (Tree, final_leaf_heap_idx (N,),
+    gain_per_feature (F,), cover (T,) — Σ training row weights per heap node,
+    recorded for path-dependent TreeSHAP (hex/genmodel TreeSHAP node weights).
 
     mtries > 0 samples ~mtries of F features per node per level (DRF's
     per-split column sampling, `hex/tree/drf/DRF.java` _mtry) — bernoulli
@@ -87,6 +89,7 @@ def build_tree(
     thr_a = jnp.zeros(T, jnp.float32)
     split_a = jnp.zeros(T, bool)
     value_a = jnp.zeros(T, jnp.float32)
+    cover_a = jnp.zeros(T, jnp.float32)   # Σ row weights per node (TreeSHAP)
 
     idx = jnp.zeros(N, jnp.int32)          # level-local node index
     active = jnp.ones(1, bool)             # per-level-node: may still split
@@ -132,6 +135,7 @@ def build_tree(
         if monotone is not None:
             node_val = jnp.clip(node_val, lo_lvl, hi_lvl)
         value_a = value_a.at[base : base + L].set(node_val)
+        cover_a = cover_a.at[base : base + L].set(wsum.astype(jnp.float32))
 
         # split search: cumulative over bins → gain per (L, F, B)
         cw = jnp.cumsum(hist[..., 0], axis=2)
@@ -236,7 +240,13 @@ def build_tree(
     if monotone is not None:
         leaf_val = jnp.clip(leaf_val, lo_lvl, hi_lvl)
     value_a = value_a.at[basef:].set(leaf_val)
-    return Tree(feat_a, bin_a, thr_a, split_a, value_a), idx + basef, gain_per_feature
+    cover_a = cover_a.at[basef:].set(tot[:, 0].astype(jnp.float32))
+    return (
+        Tree(feat_a, bin_a, thr_a, split_a, value_a),
+        idx + basef,
+        gain_per_feature,
+        cover_a,
+    )
 
 
 def predict_codes(tree: Tree, codes: jax.Array, max_depth: int) -> jax.Array:

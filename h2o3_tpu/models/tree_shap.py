@@ -1,0 +1,221 @@
+"""Path-dependent TreeSHAP over heap forests — `predict_contributions`.
+
+Reference parity: `h2o-genmodel/src/main/java/hex/genmodel/algos/tree/
+TreeSHAP.java` (per-row recursive SHAP with the EXTEND/UNWIND path weights
+of Lundberg et al., "Consistent Individualized Feature Attribution for Tree
+Ensembles") feeding `Model.scoreContributions` (hex/Model.java, the
+`predict_contributions` REST/Python surface).
+
+The trees here are perfect-depth heaps (see `tree.py`): a node is internal
+iff ``is_split``; children of heap node i are 2i+1 / 2i+2; per-node training
+covers (Σ row weights) are recorded by ``build_tree`` exactly for this
+algorithm. Routing matches scoring: right iff ``x > thr`` or ``x`` is NaN
+(the NA-goes-right convention of the last histogram bin).
+
+The hot path is the native C++ kernel (``native/tree_shap.cpp``, OpenMP over
+rows); this module holds the numpy fallback and the brute-force Shapley
+oracle used by the tests.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from math import factorial
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# per-row recursive TreeSHAP (numpy fallback; mirrors the C++ kernel)
+# ---------------------------------------------------------------------------
+
+def _tree_shap_row(feat, thr, is_split, value, cover, x, phi, scale):
+    """Accumulate SHAP values of one tree for one row into phi (len F+1).
+
+    phi[:F] += per-feature contributions · scale; phi[F] += E[f] · scale
+    (the bias term — the cover-weighted mean leaf value).
+
+    Each recursion level owns a COPY of the path (a repeated feature unwinds
+    a middle element, so the parent's path must stay intact for the cold
+    branch — same reason the reference TreeSHAP copies path fragments).
+    Path element: [d, z, o, w] = feature, zero-fraction, one-fraction,
+    permutation weight.
+    """
+
+    def extend(m, pzf, pof, pif):
+        l = len(m)
+        m.append([pif, pzf, pof, 1.0 if l == 0 else 0.0])
+        for i in range(l - 1, -1, -1):
+            m[i + 1][3] += pof * m[i][3] * (i + 1.0) / (l + 1.0)
+            m[i][3] = pzf * m[i][3] * (l - i) / (l + 1.0)
+
+    def unwound_sum(m, i):
+        """Σ path weights with element i unwound (no mutation)."""
+        l = len(m) - 1
+        one, zero = m[i][2], m[i][1]
+        total = 0.0
+        nxt = m[l][3]
+        for j in range(l - 1, -1, -1):
+            if one != 0.0:
+                tmp = nxt * (l + 1.0) / ((j + 1.0) * one)
+                total += tmp
+                nxt = m[j][3] - tmp * zero * (l - j) / (l + 1.0)
+            else:
+                total += m[j][3] * (l + 1.0) / (zero * (l - j))
+        return total
+
+    def unwind(m, i):
+        """Remove path element i in place. The recomputed permutation
+        weights stay at their positions (only d/z/o shift down) — shifting
+        weights too corrupts the shortened path."""
+        l = len(m) - 1
+        one, zero = m[i][2], m[i][1]
+        nxt = m[l][3]
+        for j in range(l - 1, -1, -1):
+            if one != 0.0:
+                tmp = nxt * (l + 1.0) / ((j + 1.0) * one)
+                nxt = m[j][3] - tmp * zero * (l - j) / (l + 1.0)
+                m[j][3] = tmp
+            else:
+                m[j][3] = m[j][3] * (l + 1.0) / (zero * (l - j))
+        for j in range(i, l):
+            m[j][0] = m[j + 1][0]
+            m[j][1] = m[j + 1][1]
+            m[j][2] = m[j + 1][2]
+        del m[l]
+
+    def recurse(node, m, pzf, pof, pif):
+        m = [e.copy() for e in m]
+        extend(m, pzf, pof, pif)
+        if not is_split[node]:
+            v = value[node] * scale
+            for i in range(1, len(m)):
+                phi[m[i][0]] += unwound_sum(m, i) * (m[i][2] - m[i][1]) * v
+            return
+        f = feat[node]
+        xv = x[f]
+        go_right = np.isnan(xv) or xv > thr[node]
+        hot = 2 * node + 2 if go_right else 2 * node + 1
+        cold = 2 * node + 1 if go_right else 2 * node + 2
+        cn, ch, cc = cover[node], cover[hot], cover[cold]
+        iz, io = 1.0, 1.0
+        # a feature already on the path folds its fractions into this split
+        for i in range(1, len(m)):
+            if m[i][0] == f:
+                iz, io = m[i][1], m[i][2]
+                unwind(m, i)
+                break
+        denom = cn if cn > 0 else 1.0
+        recurse(hot, m, iz * ch / denom, io, f)
+        recurse(cold, m, iz * cc / denom, 0.0, f)
+
+    phi[len(x)] += _expected_value(feat, thr, is_split, value, cover, 0) * scale
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def _expected_value(feat, thr, is_split, value, cover, node):
+    if not is_split[node]:
+        return value[node]
+    l, r = 2 * node + 1, 2 * node + 2
+    cn = cover[node]
+    if cn <= 0:
+        return value[node]
+    return (
+        cover[l] / cn * _expected_value(feat, thr, is_split, value, cover, l)
+        + cover[r] / cn * _expected_value(feat, thr, is_split, value, cover, r)
+    )
+
+
+def tree_shap_numpy(forest, covers, X: np.ndarray, scale: float = 1.0) -> np.ndarray:
+    """SHAP contributions for a stacked forest.
+
+    forest: Tree of (ntrees, T) arrays; covers: (ntrees, T); X: (N, F) raw
+    features (NaN = NA). Returns (N, F+1): per-feature φ plus the bias term.
+    """
+    feat = np.asarray(forest.feat, np.int64)
+    thr = np.asarray(forest.thr, np.float64)
+    issp = np.asarray(forest.is_split, bool)
+    val = np.asarray(forest.value, np.float64)
+    cov = np.asarray(covers, np.float64)
+    N, F = X.shape
+    out = np.zeros((N, F + 1), np.float64)
+    ntrees = feat.shape[0]
+    for r in range(N):
+        phi = out[r]
+        for t in range(ntrees):
+            _tree_shap_row(feat[t], thr[t], issp[t], val[t], cov[t],
+                           X[r], phi, scale)
+    return out
+
+
+def compute_contributions(feat, thr, is_split, value, cover, X: np.ndarray,
+                          scale: float, f0: float) -> np.ndarray:
+    """Shared contributions entry: native kernel when available, numpy
+    mirror otherwise; f0·scale folded into the BiasTerm column. Used by both
+    the in-cluster model and the MOJO scorer (single source of truth)."""
+    from collections import namedtuple
+
+    from ..native import loader as native_loader
+
+    feat = np.asarray(feat)
+    cover = np.asarray(cover)
+    if cover.shape != feat.shape:
+        raise ValueError(
+            f"covers shape {cover.shape} does not match forest {feat.shape} "
+            "(model continued from a pre-TreeSHAP checkpoint?); retrain to "
+            "enable predict_contributions")
+    thr = np.asarray(thr)
+    is_split = np.asarray(is_split)
+    value = np.asarray(value)
+    contrib = native_loader.tree_shap(feat, thr, is_split, value, cover, X, scale)
+    if contrib is None:
+        Fst = namedtuple("Fst", "feat thr is_split value")
+        contrib = tree_shap_numpy(Fst(feat, thr, is_split, value), cover, X, scale)
+    contrib[:, -1] += float(f0) * scale
+    return contrib
+
+
+# ---------------------------------------------------------------------------
+# brute-force Shapley oracle (tests only) — exponential in F
+# ---------------------------------------------------------------------------
+
+def _cond_expectation(feat, thr, is_split, value, cover, x, known):
+    """EXPVALUE(x, S): walk splits on known features, average on unknown."""
+
+    def go(node):
+        if not is_split[node]:
+            return value[node]
+        f = feat[node]
+        l, r = 2 * node + 1, 2 * node + 2
+        if f in known:
+            xv = x[f]
+            return go(r) if (np.isnan(xv) or xv > thr[node]) else go(l)
+        cn = cover[node]
+        if cn <= 0:
+            return value[node]
+        return cover[l] / cn * go(l) + cover[r] / cn * go(r)
+
+    return go(0)
+
+
+def shapley_bruteforce(forest, covers, x: np.ndarray) -> np.ndarray:
+    """Exact path-dependent Shapley values for one row (tests)."""
+    feat = np.asarray(forest.feat, np.int64)
+    thr = np.asarray(forest.thr, np.float64)
+    issp = np.asarray(forest.is_split, bool)
+    val = np.asarray(forest.value, np.float64)
+    cov = np.asarray(covers, np.float64)
+    F = x.shape[0]
+    phi = np.zeros(F + 1)
+    for t in range(feat.shape[0]):
+        args = (feat[t], thr[t], issp[t], val[t], cov[t], x)
+        for i in range(F):
+            rest = [j for j in range(F) if j != i]
+            for k in range(F):
+                for S in combinations(rest, k):
+                    wgt = factorial(k) * factorial(F - k - 1) / factorial(F)
+                    with_i = _cond_expectation(*args, set(S) | {i})
+                    without = _cond_expectation(*args, set(S))
+                    phi[i] += wgt * (with_i - without)
+        phi[F] += _cond_expectation(*args, set())
+    return phi

@@ -48,6 +48,10 @@ def _model_payload(model) -> Dict[str, Any]:
         for k, stacked in enumerate(model.forest):
             for field in ("feat", "bin", "thr", "is_split", "value"):
                 arrays[f"forest{k}_{field}"] = np.asarray(getattr(stacked, field))
+            covers = getattr(model, "covers", None)
+            if covers:
+                # per-node training covers — predict_contributions (TreeSHAP)
+                arrays[f"forest{k}_cover"] = np.asarray(covers[k], np.float32)
         meta["n_forests"] = len(model.forest)
     elif isinstance(model, GLMModel):
         meta.update(
@@ -238,6 +242,35 @@ class MojoScorer:
         if di["standardize"] and "means" in self.arrays:
             X = (X - self.arrays["means"]) / self.arrays["stds"]
         return np.nan_to_num(X, nan=0.0)
+
+    def predict_contributions(self, data):
+        """Offline SHAP contributions + BiasTerm — the genmodel-side
+        `predictContributions` (hex/genmodel/algos/tree/TreeSHAP.java via
+        EasyPredictModelWrapper). Tree artifacts with recorded covers only;
+        binomial/regression, as in-cluster."""
+        from .frame.frame import Frame
+
+        meta = self.meta
+        if meta["kind"] != "tree":
+            raise ValueError("predict_contributions requires a tree artifact")
+        if meta["problem"] == "multinomial":
+            raise ValueError("predict_contributions is not supported for "
+                             "multinomial models")
+        if "forest0_cover" not in self.arrays:
+            raise ValueError("artifact has no node covers (exported before "
+                             "TreeSHAP support); re-export the model")
+        from .models.tree_shap import compute_contributions
+
+        X = self._matrix(data)
+        feat, thr, split, value = self._native_forest(0)
+        cover = np.ascontiguousarray(self.arrays["forest0_cover"], np.float32)
+        scale = 1.0 / max(meta["ntrees"], 1) if meta["mode"] == "drf" else 1.0
+        f0 = meta["f0"]
+        f0k = f0[0] if isinstance(f0, list) else f0
+        contrib = compute_contributions(feat, thr, split, value, cover, X,
+                                        scale, f0k)
+        names = list(self.x) + ["BiasTerm"]
+        return Frame.from_dict({n: contrib[:, j] for j, n in enumerate(names)})
 
     # -- prediction ---------------------------------------------------------
     def predict(self, data):

@@ -96,6 +96,49 @@ def score_forest(feat: np.ndarray, thr: np.ndarray, split: np.ndarray,
     return out
 
 
+def tree_shap(feat: np.ndarray, thr: np.ndarray, split: np.ndarray,
+              value: np.ndarray, cover: np.ndarray, X: np.ndarray,
+              scale: float = 1.0) -> Optional[np.ndarray]:
+    """Native path-dependent TreeSHAP (tree_shap.cpp). Arrays are the
+    (ntrees, T) stacked fields + covers of one class's forest; X row-major
+    (n, F) float64. Returns (n, F+1) contributions (+BiasTerm last) or None
+    without the lib."""
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        fn = lib.h2o3_tree_shap
+    except AttributeError:
+        return None
+    feat = np.ascontiguousarray(feat, np.int32)
+    thr = np.ascontiguousarray(thr, np.float32)
+    split = np.ascontiguousarray(split).astype(np.uint8)
+    value = np.ascontiguousarray(value, np.float32)
+    cover = np.ascontiguousarray(cover, np.float32)
+    X = np.ascontiguousarray(X, np.float64)
+    ntrees, T = feat.shape
+    n, F = X.shape
+    out = np.empty((n, F + 1), np.float64)
+    fn.restype = None
+    fn.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_longlong, ctypes.c_int,
+        ctypes.c_double, ctypes.POINTER(ctypes.c_double),
+    ]
+    fn(feat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+       thr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       split.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+       value.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       cover.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       ntrees, T,
+       X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, F,
+       float(scale),
+       out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out
+
+
 def tokenize_csv(path: str, sep: str, header: bool, ncol: int) -> Optional[List[np.ndarray]]:
     """Fast numeric-first CSV tokenize. Returns per-column object arrays, or
     None when the native lib is absent (callers fall back to numpy)."""

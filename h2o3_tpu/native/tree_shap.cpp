@@ -1,0 +1,158 @@
+// Native path-dependent TreeSHAP over heap forests.
+//
+// Reference parity: `h2o-genmodel/src/main/java/hex/genmodel/algos/tree/
+// TreeSHAP.java` (the EXTEND/UNWIND recursion of Lundberg et al.'s
+// "Consistent Individualized Feature Attribution for Tree Ensembles"),
+// which backs `Model.scoreContributions` / `predict_contributions`.
+//
+// Trees are the flat perfect-depth heaps of models/tree.py (node i internal
+// iff split[i]; children 2i+1/2i+2; NaN and x > thr go right). `cover` is
+// the per-node Σ of training row weights recorded by build_tree. The Python
+// mirror (and the test oracle) is models/tree_shap.py.
+//
+// Exposed via ctypes (native/loader.py):
+//   h2o3_tree_shap(feat, thr, split, value, cover, ntrees, T,
+//                  X, n, F, scale, out)
+//     X row-major (n, F) doubles; out (n, F+1) doubles — per-feature phi
+//     plus the bias term (cover-weighted forest expectation) in column F.
+// OpenMP-parallel over rows.
+
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxPath = 66;  // supports tree depth up to 64
+
+struct PathEl {
+  int d;        // feature of this path element (-1 for the root dummy)
+  double z;     // fraction of "cold" (feature-excluded) paths flowing through
+  double o;     // fraction of "hot" (feature-included) paths
+  double w;     // permutation weight
+};
+
+// Remove element i from the path in place (inverse of one EXTEND). The
+// recomputed permutation weights stay at their positions — only the
+// d/z/o fields shift down (shifting weights too corrupts the path).
+inline void unwind(PathEl* m, int& len, int i) {
+  const int l = len - 1;
+  const double one = m[i].o, zero = m[i].z;
+  double nxt = m[l].w;
+  for (int j = l - 1; j >= 0; --j) {
+    if (one != 0.0) {
+      const double tmp = nxt * (l + 1.0) / ((j + 1.0) * one);
+      nxt = m[j].w - tmp * zero * (l - j) / (l + 1.0);
+      m[j].w = tmp;
+    } else {
+      m[j].w = m[j].w * (l + 1.0) / (zero * (l - j));
+    }
+  }
+  for (int j = i; j < l; ++j) {
+    m[j].d = m[j + 1].d;
+    m[j].z = m[j + 1].z;
+    m[j].o = m[j + 1].o;
+  }
+  len = l;
+}
+
+// Σ path weights with element i unwound, without mutating the path.
+inline double unwound_sum(const PathEl* m, int len, int i) {
+  const int l = len - 1;
+  const double one = m[i].o, zero = m[i].z;
+  double total = 0.0, nxt = m[l].w;
+  for (int j = l - 1; j >= 0; --j) {
+    if (one != 0.0) {
+      const double tmp = nxt * (l + 1.0) / ((j + 1.0) * one);
+      total += tmp;
+      nxt = m[j].w - tmp * zero * (l - j) / (l + 1.0);
+    } else {
+      total += m[j].w * (l + 1.0) / (zero * (l - j));
+    }
+  }
+  return total;
+}
+
+void recurse(const int32_t* feat, const float* thr, const uint8_t* split,
+             const float* value, const float* cover, const double* x,
+             double* phi, double scale, int node, const PathEl* parent,
+             int plen, double pz, double po, int pi) {
+  // each level owns a copy: a repeated feature unwinds a middle element,
+  // and the parent's path must stay intact for the cold branch
+  PathEl m[kMaxPath];
+  for (int i = 0; i < plen; ++i) m[i] = parent[i];
+  int len = plen;
+  m[len] = {pi, pz, po, len == 0 ? 1.0 : 0.0};
+  for (int i = len - 1; i >= 0; --i) {
+    m[i + 1].w += po * m[i].w * (i + 1.0) / (len + 1.0);
+    m[i].w = pz * m[i].w * (len - i) / (len + 1.0);
+  }
+  ++len;
+
+  if (!split[node]) {
+    const double v = (double)value[node] * scale;
+    for (int i = 1; i < len; ++i)
+      phi[m[i].d] += unwound_sum(m, len, i) * (m[i].o - m[i].z) * v;
+    return;
+  }
+
+  const int f = feat[node];
+  const double xv = x[f];
+  const bool right = std::isnan(xv) || xv > (double)thr[node];
+  const int hot = 2 * node + 1 + (right ? 1 : 0);
+  const int cold = 2 * node + 1 + (right ? 0 : 1);
+  const double cn = cover[node];
+  const double denom = cn > 0.0 ? cn : 1.0;
+  double iz = 1.0, io = 1.0;
+  for (int i = 1; i < len; ++i) {
+    if (m[i].d == f) {
+      iz = m[i].z;
+      io = m[i].o;
+      unwind(m, len, i);
+      break;
+    }
+  }
+  recurse(feat, thr, split, value, cover, x, phi, scale, hot, m, len,
+          iz * cover[hot] / denom, io, f);
+  recurse(feat, thr, split, value, cover, x, phi, scale, cold, m, len,
+          iz * cover[cold] / denom, 0.0, f);
+}
+
+}  // namespace
+
+extern "C" void h2o3_tree_shap(
+    const int32_t* feat, const float* thr, const uint8_t* split,
+    const float* value, const float* cover, int ntrees, int T,
+    const double* X, long long n, int F, double scale, double* out) {
+  // per-tree expectation (bias term), computed once by an upward pass
+  std::vector<double> ev((size_t)T);
+  double bias = 0.0;
+  for (int t = 0; t < ntrees; ++t) {
+    const long long off = (long long)t * T;
+    for (int i = T - 1; i >= 0; --i) {
+      if (!split[off + i] || 2 * i + 2 >= T) {
+        ev[i] = (double)value[off + i];
+      } else {
+        const double cn = (double)cover[off + i];
+        ev[i] = cn > 0.0
+                    ? ((double)cover[off + 2 * i + 1] * ev[2 * i + 1] +
+                       (double)cover[off + 2 * i + 2] * ev[2 * i + 2]) / cn
+                    : (double)value[off + i];
+      }
+    }
+    bias += ev[0] * scale;
+  }
+
+#pragma omp parallel for schedule(static)
+  for (long long r = 0; r < n; ++r) {
+    const double* xi = X + r * (long long)F;
+    double* phi = out + r * (long long)(F + 1);
+    for (int j = 0; j <= F; ++j) phi[j] = 0.0;
+    phi[F] = bias;
+    for (int t = 0; t < ntrees; ++t) {
+      const long long off = (long long)t * T;
+      recurse(feat + off, thr + off, split + off, value + off, cover + off,
+              xi, phi, scale, 0, nullptr, 0, 1.0, 1.0, -1);
+    }
+  }
+}

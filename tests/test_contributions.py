@@ -1,0 +1,186 @@
+"""predict_contributions (TreeSHAP) + predict_leaf_node_assignment.
+
+Mirrors the reference's contribution tests (h2o-py pyunit predict_contributions
+suites; hex/genmodel/algos/tree/TreeSHAP.java): the local-accuracy contract
+(contributions + BiasTerm == raw prediction), exact agreement with a
+brute-force Shapley oracle, MOJO round-trip consistency, and the native C++
+kernel vs the numpy mirror.
+"""
+
+import sys
+from collections import namedtuple
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.estimators import (
+    H2OGradientBoostingEstimator,
+    H2ORandomForestEstimator,
+    H2OXGBoostEstimator,
+)
+from h2o3_tpu.models import tree_shap as ts
+
+Fst = namedtuple("Fst", "feat thr is_split value")
+
+
+def _binomial_frame(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    x0, x1, x2 = rng.normal(size=n), rng.normal(size=n), rng.normal(size=n)
+    logit = 1.5 * x0 - 0.8 * x1 + 0.3 * x0 * x2
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    return h2o.H2OFrame_from_python(
+        {"a": x0, "b": x1, "c": x2, "y": y.astype(str)},
+        column_types={"y": "enum"},
+    )
+
+
+def _contrib_matrix(fr):
+    return np.column_stack(
+        [np.asarray(fr.vec(n).data, np.float64) for n in fr.names]
+    )
+
+
+def test_treeshap_matches_bruteforce_random_trees():
+    rng = np.random.default_rng(3)
+    D, F = 3, 3
+    T = 2 ** (D + 1) - 1
+    for trial in range(5):
+        feat = rng.integers(0, F, T).astype(np.int64)
+        thr = rng.normal(size=T)
+        issp = np.zeros(T, bool)
+        issp[: 2 ** D - 1] = rng.random(2 ** D - 1) < 0.8
+        for i in range(1, T):
+            if not issp[(i - 1) // 2]:
+                issp[i] = False
+        value = rng.normal(size=T)
+        cov = np.zeros(T)
+        cov[2 ** D - 1:] = rng.random(2 ** D) + 0.1
+        for i in range(2 ** D - 2, -1, -1):
+            cov[i] = cov[2 * i + 1] + cov[2 * i + 2]
+        forest = Fst(feat[None], thr[None], issp[None], value[None])
+        X = rng.normal(size=(4, F))
+        X[0, 1] = np.nan
+        phi = ts.tree_shap_numpy(forest, cov[None], X)
+        for r in range(X.shape[0]):
+            bf = ts.shapley_bruteforce(forest, cov[None], X[r])
+            np.testing.assert_allclose(phi[r], bf, atol=1e-10)
+
+
+def test_gbm_contributions_local_accuracy():
+    fr = _binomial_frame()
+    gbm = H2OGradientBoostingEstimator(ntrees=15, max_depth=4, seed=7)
+    gbm.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    m = gbm.model
+    contrib = m.predict_contributions(fr)
+    assert contrib.names == ["a", "b", "c", "BiasTerm"]
+    C = _contrib_matrix(contrib)
+    margins = m._margins(m._matrix(fr))[:, 0]
+    np.testing.assert_allclose(C.sum(axis=1), margins, atol=1e-5)
+
+
+def test_native_kernel_matches_numpy():
+    from h2o3_tpu.native import loader
+
+    if not loader.available():
+        pytest.skip("native lib unavailable")
+    fr = _binomial_frame(400)
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    gbm.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    m = gbm.model
+    st = m.forest[0]
+    args = (np.asarray(st.feat), np.asarray(st.thr),
+            np.asarray(st.is_split), np.asarray(st.value))
+    cov = np.asarray(m.covers[0])
+    X = m._matrix(fr)[:64]
+    nat = loader.tree_shap(*args, cov, X)
+    ref = ts.tree_shap_numpy(Fst(*args), cov, X)
+    np.testing.assert_allclose(nat, ref, atol=1e-12)
+
+
+def test_drf_regression_contributions_sum_to_prediction():
+    rng = np.random.default_rng(1)
+    n = 1000
+    x0, x1, x2 = rng.normal(size=n), rng.normal(size=n), rng.normal(size=n)
+    fr = h2o.H2OFrame_from_python(
+        {"a": x0, "b": x1, "c": x2, "y": 2 * x0 - x1 + 0.1 * rng.normal(size=n)}
+    )
+    drf = H2ORandomForestEstimator(ntrees=8, max_depth=5, seed=2)
+    drf.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    C = _contrib_matrix(drf.model.predict_contributions(fr))
+    pred = np.asarray(drf.model.predict(fr).vec("predict").data, np.float64)
+    np.testing.assert_allclose(C.sum(axis=1), pred, atol=1e-5)
+
+
+def test_xgboost_contributions_local_accuracy():
+    fr = _binomial_frame(800, seed=5)
+    xgb = H2OXGBoostEstimator(ntrees=10, max_depth=4, seed=3)
+    xgb.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    m = xgb.model
+    C = _contrib_matrix(m.predict_contributions(fr))
+    margins = m._margins(m._matrix(fr))[:, 0]
+    np.testing.assert_allclose(C.sum(axis=1), margins, atol=1e-5)
+
+
+def test_contributions_multinomial_raises():
+    rng = np.random.default_rng(4)
+    n = 300
+    x = rng.normal(size=n)
+    y = np.digitize(x, [-0.5, 0.5]).astype(str)
+    fr = h2o.H2OFrame_from_python(
+        {"a": x, "b": rng.normal(size=n), "y": y}, column_types={"y": "enum"}
+    )
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+    gbm.train(x=["a", "b"], y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="multinomial"):
+        gbm.model.predict_contributions(fr)
+
+
+def test_contributions_top_n_pairs():
+    fr = _binomial_frame(500, seed=9)
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    gbm.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    out = gbm.model.predict_contributions(fr, top_n=2)
+    assert out.names == ["top_feature_1", "top_value_1",
+                         "top_feature_2", "top_value_2", "BiasTerm"]
+    v1 = np.asarray(out.vec("top_value_1").data, np.float64)
+    v2 = np.asarray(out.vec("top_value_2").data, np.float64)
+    assert (v1 >= v2).all()
+
+
+def test_mojo_contributions_round_trip(tmp_path):
+    fr = _binomial_frame(600, seed=11)
+    gbm = H2OGradientBoostingEstimator(ntrees=8, max_depth=4, seed=4)
+    gbm.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    in_cluster = _contrib_matrix(gbm.model.predict_contributions(fr))
+    path = h2o.save_model(gbm, str(tmp_path))
+    scorer = h2o.load_model(path)
+    offline = _contrib_matrix(scorer.predict_contributions(fr))
+    np.testing.assert_allclose(offline, in_cluster, atol=1e-6)
+
+
+def test_leaf_node_assignment_path_and_node_id():
+    fr = _binomial_frame(300, seed=13)
+    gbm = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1)
+    gbm.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    m = gbm.model
+    la = m.predict_leaf_node_assignment(fr, type="Path")
+    assert la.names == [f"T{t + 1}.C1" for t in range(4)]
+    # paths are L/R strings of length <= max_depth
+    dom = la.vec("T1.C1").domain
+    assert all(set(p) <= {"L", "R"} and len(p) <= 3 for p in dom)
+    ni = m.predict_leaf_node_assignment(fr, type="Node_ID")
+    ids = np.asarray(ni.vec("T1.C1").data, np.int64)
+    # node ids must be valid heap indices and consistent with the path depth
+    assert ids.min() >= 0 and ids.max() < 2 ** 4 - 1
+    # routing consistency: each row's leaf value summed over trees == margin
+    st = m.forest[0]
+    val = np.asarray(st.value)
+    total = np.zeros(fr.nrow)
+    for t in range(4):
+        ids_t = np.asarray(
+            ni.vec(f"T{t + 1}.C1").data, np.int64)
+        total += val[t][ids_t]
+    f0 = m.f0 if np.ndim(m.f0) == 0 else m.f0[0]
+    margins = m._margins(m._matrix(fr))[:, 0]
+    np.testing.assert_allclose(total + f0, margins, atol=1e-5)

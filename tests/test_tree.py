@@ -49,7 +49,7 @@ def test_build_tree_learns_threshold_split():
     h = np.full(N, 0.25, np.float32)
     pad_edges = np.full((1, B - 2), np.inf, np.float32)
     pad_edges[0, : len(edges)] = edges
-    tree, leaf_idx, gains = treelib.build_tree(
+    tree, leaf_idx, gains, _cov = treelib.build_tree(
         jnp.asarray(codes), jnp.asarray(g), jnp.asarray(h),
         jnp.ones(N, jnp.float32), jnp.ones(1, jnp.float32),
         jnp.asarray(pad_edges), max_depth=2, nbins=B, min_rows=10.0,
@@ -69,7 +69,7 @@ def test_build_tree_respects_min_rows():
     codes[:2, 0] = 1  # only 2 rows distinguishable
     g = np.ones(N, np.float32)
     g[:2] = -1
-    tree, _, _ = treelib.build_tree(
+    tree, _, _, _ = treelib.build_tree(
         jnp.asarray(codes), jnp.asarray(g), jnp.ones(N, jnp.float32),
         jnp.ones(N, jnp.float32), jnp.ones(1, jnp.float32),
         jnp.full((1, B - 2), jnp.inf, jnp.float32),
@@ -91,7 +91,7 @@ def test_predict_raw_matches_codes_path():
     pad_edges = np.full((Fn, B - 2), np.inf, np.float32)
     for j, e in enumerate(bm.edges):
         pad_edges[j, : len(e)] = e
-    tree, leaf_idx, _ = treelib.build_tree(
+    tree, leaf_idx, _, _ = treelib.build_tree(
         jnp.asarray(bm.codes), jnp.asarray(g), jnp.asarray(h),
         jnp.ones(N, jnp.float32), jnp.ones(Fn, jnp.float32),
         jnp.asarray(pad_edges), max_depth=4, nbins=B, min_rows=5.0,
