@@ -30,6 +30,7 @@ from .frame.frame import Frame
 from .frame.frame import Frame as H2OFrame
 from .frame.parse import import_file as _import_file
 from .frame.text import grep, tf_idf, tokenize  # noqa: F401  (h2o.tf_idf surface)
+from . import tree_api as tree  # noqa: F401  (h2o.tree.H2OTree surface)
 from .parallel import mesh as _mesh
 
 __version__ = "0.1.0"

@@ -263,6 +263,145 @@ class H2OModel:
     def _make_metrics(self, frame: Frame):
         raise NotImplementedError
 
+    # -- model understanding (h2o-py ModelBase surface) ---------------------
+    @staticmethod
+    def _response_stats(p: np.ndarray, weights: Optional[np.ndarray]):
+        """(mean, sd, sem) of one response column, optionally weighted."""
+        if weights is None:
+            mean = float(np.mean(p))
+            sd = float(np.std(p, ddof=1)) if len(p) > 1 else 0.0
+        else:
+            wsum = max(float(weights.sum()), 1e-12)
+            mean = float((p * weights).sum() / wsum)
+            sd = float(np.sqrt(((p - mean) ** 2 * weights).sum() / wsum))
+        return mean, sd, sd / max(np.sqrt(len(p)), 1.0)
+
+    def _response_column(self, pred: Frame, target: Optional[str]) -> np.ndarray:
+        """Pick the response column of a prediction frame — a chosen class
+        probability, binomial p1, or the raw (regression) prediction."""
+        if target is not None:
+            return pred.vec(str(target)).numeric_np().astype(np.float64)
+        domain = getattr(self, "domain", None)
+        if domain is not None and len(domain) == 2 and str(domain[1]) in pred.names:
+            return pred.vec(str(domain[1])).numeric_np().astype(np.float64)
+        if domain is not None and len(domain) > 2:
+            raise ValueError(
+                "multinomial models need `targets=[<class label>, ...]` "
+                "(averaging the predicted class labels is meaningless — "
+                "hex/PartialDependence requires targets too)")
+        return pred.vec("predict").numeric_np().astype(np.float64)
+
+    def partial_plot(self, data: Frame, cols=None, nbins: int = 20,
+                     plot: bool = False, include_na: bool = False,
+                     user_splits=None, targets=None, row_index=None,
+                     weight_column: Optional[str] = None, **_kw):
+        """Partial-dependence tables, one Frame per column (× target for
+        multinomial): columns [<col>, mean_response, stddev_response,
+        std_error_mean_response]. 1-D PDP over nbins grid points (numeric) or
+        the categorical levels — `h2o-py ModelBase.partial_plot` /
+        `hex/PartialDependence.java`. `row_index` gives a single-row ICE
+        curve instead of the dataset mean."""
+        if cols is None:
+            raise ValueError("cols is required")
+        if isinstance(cols, str):
+            cols = [cols]
+        if row_index is not None:
+            data = Frame({n: v.take(np.asarray([row_index]))
+                          for n, v in data._vecs.items()})
+        weights = None
+        if weight_column is not None:
+            weights = data.vec(weight_column).numeric_np().astype(np.float64)
+        tlist = list(targets) if targets else [None]
+        out = []
+        for col in cols:
+            v = data.vec(col)
+            if v.type == "enum":
+                values = list(range(len(v.domain or [])))
+                labels = list(v.domain or [])
+            else:
+                raw = v.numeric_np()
+                raw = raw[~np.isnan(raw)]
+                if user_splits and col in user_splits:
+                    values = list(user_splits[col])
+                else:
+                    lo, hi = (float(raw.min()), float(raw.max())) if len(raw) else (0.0, 1.0)
+                    values = list(np.linspace(lo, hi, nbins))
+                labels = values
+            if include_na:
+                values = values + [np.nan]
+                labels = labels + [float("nan") if v.type != "enum" else ".missing(NA)"]
+            # ONE predict per grid value; every target reads its own column
+            rows = {tgt: [] for tgt in tlist}
+            for val in values:
+                n = data.nrow
+                if v.type == "enum":
+                    is_na = isinstance(val, float) and np.isnan(val)
+                    code = -1 if is_na else int(val)
+                    const = Vec(np.full(n, code, np.int32), "enum",
+                                domain=v.domain)
+                else:
+                    const = Vec(np.full(n, val, np.float64), "real")
+                pred = self.predict(Frame({**data._vecs, col: const}))
+                for tgt in tlist:
+                    p = self._response_column(pred, tgt)
+                    rows[tgt].append(self._response_stats(p, weights))
+            for tgt in tlist:
+                d = {
+                    col: (np.asarray(labels, dtype=object) if v.type == "enum"
+                          else np.asarray(labels, np.float64)),
+                    "mean_response": np.asarray([r[0] for r in rows[tgt]]),
+                    "stddev_response": np.asarray([r[1] for r in rows[tgt]]),
+                    "std_error_mean_response": np.asarray(
+                        [r[2] for r in rows[tgt]]),
+                }
+                fr_out = Frame.from_dict(
+                    d, column_types={col: "enum"} if v.type == "enum" else None)
+                if tgt is not None:
+                    fr_out.target = tgt
+                out.append(fr_out)
+        return out
+
+    def permutation_importance(self, frame: Frame, metric: str = "AUTO",
+                               n_samples: int = -1, n_repeats: int = 1,
+                               features=None, seed: int = -1,
+                               use_pandas: bool = False) -> Frame:
+        """Permutation variable importance (`h2o-py permutation_varimp` /
+        `hex/PermutationVarImp.java`): |metric(baseline) − metric(feature
+        shuffled)|, averaged over n_repeats."""
+        problem = getattr(self, "problem", None)
+        if metric in ("AUTO", "auto", None):
+            metric = {"binomial": "auc", "multinomial": "logloss"}.get(
+                problem, "rmse")
+        metric = metric.lower()
+        rng = np.random.default_rng(None if seed in (-1, None) else seed)
+        if 0 < n_samples < frame.nrow:
+            idx = rng.choice(frame.nrow, n_samples, replace=False)
+            frame = Frame({n: v.take(idx) for n, v in frame._vecs.items()})
+        base = getattr(self._make_metrics(frame), metric)
+        feats = list(features) if features else list(self.x)
+        rel = []
+        for f in feats:
+            deltas = []
+            v = frame.vec(f)
+            for _ in range(max(n_repeats, 1)):
+                perm = rng.permutation(frame.nrow)
+                shuf = Vec(np.asarray(v.data)[perm] if v.data is not None else None,
+                           v.type, domain=v.domain)
+                m = getattr(self._make_metrics(Frame({**frame._vecs, f: shuf})),
+                            metric)
+                deltas.append(abs(base - m))
+            rel.append(float(np.mean(deltas)))
+        rel_a = np.asarray(rel, np.float64)
+        mx = rel_a.max() if rel_a.size and rel_a.max() > 0 else 1.0
+        tot = rel_a.sum() if rel_a.sum() > 0 else 1.0
+        order = np.argsort(-rel_a)
+        return Frame.from_dict({
+            "Variable": np.asarray(feats, dtype=object)[order],
+            "Relative Importance": rel_a[order],
+            "Scaled Importance": rel_a[order] / mx,
+            "Percentage": rel_a[order] / tot,
+        })
+
 
 class H2OEstimator:
     """Parameter-holder + builder — `hex.ModelBuilder` merged with the
@@ -486,6 +625,25 @@ class H2OEstimator:
 
     def varimp(self, **kw):
         return self.model.varimp(**kw)
+
+    # model-understanding passthroughs (h2o-py keeps these on the estimator)
+    def partial_plot(self, *a, **kw):
+        return self.model.partial_plot(*a, **kw)
+
+    def permutation_importance(self, *a, **kw):
+        return self.model.permutation_importance(*a, **kw)
+
+    def predict_contributions(self, *a, **kw):
+        return self.model.predict_contributions(*a, **kw)
+
+    def predict_leaf_node_assignment(self, *a, **kw):
+        return self.model.predict_leaf_node_assignment(*a, **kw)
+
+    def staged_predict_proba(self, *a, **kw):
+        return self.model.staged_predict_proba(*a, **kw)
+
+    def feature_frequencies(self, *a, **kw):
+        return self.model.feature_frequencies(*a, **kw)
 
     @property
     def scoring_history(self):

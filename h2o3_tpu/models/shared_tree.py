@@ -296,6 +296,73 @@ class SharedTreeModel(H2OModel):
             return Frame.from_dict(d)
         return Frame.from_dict({n2: contrib[:, j] for j, n2 in enumerate(names)})
 
+    def staged_predict_proba(self, test_data: Frame) -> Frame:
+        """Class-1 probability after each successive tree (binomial GBM) —
+        `h2o-py ModelBase.staged_predict_proba` (hex/tree staged scoring)."""
+        if self.problem != "binomial" or self.mode == "drf":
+            raise ValueError("staged_predict_proba supports binomial "
+                             "boosting models only (reference parity)")
+        oc = (self.parms._parms.get("offset_column")
+              if hasattr(self.parms, "_parms") else None)
+        if oc or getattr(self, "balance_dists", None) is not None:
+            raise ValueError(
+                "staged_predict_proba is not supported for models trained "
+                "with offset_column or balance_classes (staged margins "
+                "would disagree with predict())")
+        X = jnp.asarray(self._matrix(test_data), jnp.float32)
+        stacked = self.forest[0]
+        per_tree = np.asarray(jax.vmap(
+            lambda t: treelib.predict_raw(t, X, self.max_depth)
+        )(jax.tree.map(jnp.asarray, stacked)))            # (ntrees, N)
+        f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[0]
+        margins = f0k + np.cumsum(per_tree, axis=0)
+        probs = 1.0 / (1.0 + np.exp(-margins))
+        return Frame.from_dict(
+            {f"T{t + 1}": probs[t] for t in range(probs.shape[0])})
+
+    @staticmethod
+    def _route_rows(feat_t, thr_t, issp_t, X, max_depth, visit=None):
+        """Route all rows of X root→leaf through one heap tree (NaN and
+        x > thr go right — the single NA-routing rule shared by scoring,
+        leaf assignment and feature frequencies). `visit(split_mask,
+        split_feature_per_row, goes_right)` is called once per level;
+        returns the final heap node per row."""
+        N = X.shape[0]
+        node = np.zeros(N, np.int64)
+        for _ in range(max_depth):
+            s = issp_t[node]
+            if not s.any():
+                break
+            f = feat_t[node]
+            xv = X[np.arange(N), f]
+            right = (np.isnan(xv) | (xv > thr_t[node])) & s
+            if visit is not None:
+                visit(s, f, right)
+            node = np.where(s, 2 * node + 1 + right.astype(np.int64), node)
+        return node
+
+    def feature_frequencies(self, test_data: Frame) -> Frame:
+        """Per row, how many times each feature decides the row's path,
+        summed over all trees — `h2o-py ModelBase.feature_frequencies`
+        (hex/tree/SharedTreeModel feature frequencies)."""
+        X = self._matrix(test_data)
+        N = X.shape[0]
+        counts = np.zeros((N, len(self.x)), np.int64)
+
+        def visit(s, f, right):
+            np.add.at(counts, (np.nonzero(s)[0], f[s]), 1)
+
+        for stacked in self.forest:
+            feat = np.asarray(stacked.feat)
+            thr = np.asarray(stacked.thr)
+            issp = np.asarray(stacked.is_split)
+            for t in range(self.ntrees_built):
+                self._route_rows(feat[t], thr[t], issp[t], X,
+                                 self.max_depth, visit)
+        return Frame.from_dict(
+            {n2: counts[:, j].astype(np.float64)
+             for j, n2 in enumerate(self.x)})
+
     def predict_leaf_node_assignment(self, test_data: Frame,
                                      type: str = "Path") -> Frame:
         """Leaf assignment per (tree, class): decision-path strings ("LRL…")
@@ -312,22 +379,19 @@ class SharedTreeModel(H2OModel):
             thr = np.asarray(stacked.thr)
             issp = np.asarray(stacked.is_split)
             for t in range(self.ntrees_built):
-                node = np.zeros(N, np.int64)
-                paths = (np.full(N, "", dtype=f"<U{self.max_depth}")
-                         if type == "Path" else None)
-                for _ in range(self.max_depth):
-                    s = issp[t][node]
-                    if not s.any():
-                        break
-                    xv = X[np.arange(N), feat[t][node]]
-                    right = (np.isnan(xv) | (xv > thr[t][node])) & s
-                    if paths is not None:
-                        step = np.where(s, np.where(right, "R", "L"), "")
-                        paths = np.char.add(paths, step)
-                    node = np.where(s, 2 * node + 1 + right.astype(np.int64), node)
+                paths = [np.full(N, "", dtype=f"<U{self.max_depth}")]
+
+                def visit(s, right, _p=paths):
+                    step = np.where(s, np.where(right, "R", "L"), "")
+                    _p[0] = np.char.add(_p[0], step)
+
+                node = self._route_rows(
+                    feat[t], thr[t], issp[t], X, self.max_depth,
+                    (lambda s, f, right: visit(s, right))
+                    if type == "Path" else None)
                 col = (f"T{t + 1}.C{k + 1}")
                 if type == "Path":
-                    d[col] = paths.astype(object)
+                    d[col] = paths[0].astype(object)
                     ctypes_[col] = "enum"
                 else:
                     d[col] = node.astype(np.float64)

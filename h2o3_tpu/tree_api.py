@@ -1,0 +1,107 @@
+"""Tree inspection — the `h2o.tree.H2OTree` client surface.
+
+Reference parity: `h2o-py/h2o/tree/tree.py` (H2OTree fetching a single tree
+over `/3/Tree`) and `hex/schemas/TreeV3` / `hex/tree/TreeHandler.java` on the
+server side. Here the model is in-process, so the tree is read straight off
+the heap arrays of `models/tree.py`: reachable nodes are enumerated in
+breadth-first heap order; children of non-split nodes are -1 (leaf).
+
+NA routing is always "right" in this framework (the NA bin is the last
+histogram bin — see models/tree.py), so `nas` is "R" at every split.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class H2OTree:
+    """One tree of a trained GBM/DRF/XGBoost/IF model.
+
+    Arrays are aligned over the tree's REACHABLE nodes (BFS order):
+      node_ids        heap index of each node
+      left_children / right_children   positions in these arrays, -1 at leaves
+      features        split feature name (None at leaves)
+      thresholds      split threshold (NaN at leaves)
+      predictions     node value (the prediction when the node is a leaf)
+      nas             NA direction at splits ("R" here), None at leaves
+      root_node_id    heap id of the root (always 0)
+    """
+
+    def __init__(self, model, tree_number: int = 0,
+                 tree_class: Optional[str] = None):
+        m = getattr(model, "model", model)
+        forest = getattr(m, "forest", None)
+        if forest is None:
+            raise TypeError("H2OTree requires a tree-based model")
+        domain = getattr(m, "domain", None)
+        k = 0
+        if tree_class is not None:
+            if domain is None or str(tree_class) not in [str(d) for d in domain]:
+                raise ValueError(f"unknown tree_class {tree_class!r}")
+            k = [str(d) for d in domain].index(str(tree_class))
+            if len(forest) == 1 and k != len(domain) - 1:
+                # binomial: only the positive class is modelled (one forest);
+                # the reference TreeHandler rejects the other class too
+                raise ValueError(
+                    f"binomial models have trees only for class "
+                    f"{domain[-1]!r}; tree_class={tree_class!r} is not "
+                    "modelled")
+            if len(forest) == 1:
+                k = 0
+        stacked = forest[k]
+        ntrees = m.ntrees_built
+        if not (0 <= tree_number < ntrees):
+            raise ValueError(f"tree_number must be in [0, {ntrees})")
+        self.model_id = m.model_id
+        self.tree_number = tree_number
+        self.tree_class = tree_class
+        feat = np.asarray(stacked.feat)[tree_number]
+        thr = np.asarray(stacked.thr)[tree_number]
+        issp = np.asarray(stacked.is_split)[tree_number]
+        val = np.asarray(stacked.value)[tree_number]
+        names = list(m.x)
+
+        ids: List[int] = []
+        order = {}           # heap id -> position in output arrays
+        queue = [0]
+        while queue:
+            h = queue.pop(0)
+            order[h] = len(ids)
+            ids.append(h)
+            if issp[h]:
+                queue.append(2 * h + 1)
+                queue.append(2 * h + 2)
+        self.node_ids = ids
+        self.left_children = [
+            order[2 * h + 1] if issp[h] else -1 for h in ids]
+        self.right_children = [
+            order[2 * h + 2] if issp[h] else -1 for h in ids]
+        self.features = [names[feat[h]] if issp[h] else None for h in ids]
+        self.thresholds = [
+            float(thr[h]) if issp[h] else float("nan") for h in ids]
+        self.predictions = [float(val[h]) for h in ids]
+        self.nas = ["R" if issp[h] else None for h in ids]
+        self.root_node_id = 0
+        self.levels = [None] * len(ids)  # numeric splits (enums are codes)
+        self.descriptions = [
+            (f"split on {self.features[i]} <= {self.thresholds[i]:.6g} "
+             f"(NA goes right)") if self.left_children[i] >= 0
+            else f"leaf: {self.predictions[i]:.6g}"
+            for i in range(len(ids))
+        ]
+
+    def __len__(self) -> int:
+        return len(self.node_ids)
+
+    def show(self):
+        print(f"Tree {self.tree_number} of model {self.model_id} "
+              f"({len(self)} nodes)")
+        for i in range(len(self)):
+            print(f"  [{i}] {self.descriptions[i]}")
+
+    def __repr__(self):
+        return (f"<H2OTree model={self.model_id} tree={self.tree_number} "
+                f"nodes={len(self)}>")

@@ -1,0 +1,161 @@
+"""Model-understanding surface: partial dependence, permutation importance,
+staged predictions, feature frequencies, tree inspection.
+
+Mirrors the reference's h2o-py tests for `partial_plot`
+(hex/PartialDependence.java), `permutation_varimp`
+(hex/PermutationVarImp.java), `staged_predict_proba`, `feature_frequencies`
+(hex/tree/SharedTreeModel), and `h2o.tree.H2OTree` (hex/tree/TreeHandler).
+"""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.estimators import (
+    H2OGradientBoostingEstimator,
+    H2OGeneralizedLinearEstimator,
+)
+from h2o3_tpu.tree_api import H2OTree
+
+
+def _frame(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    cat = rng.choice(["x", "y", "z"], size=n)
+    shift = np.where(cat == "x", 1.0, np.where(cat == "y", 0.0, -1.0))
+    logit = 2.0 * a + shift
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    return h2o.H2OFrame_from_python(
+        {"a": a, "b": b, "cat": cat, "y": y.astype(str)},
+        column_types={"y": "enum", "cat": "enum"},
+    )
+
+
+@pytest.fixture(scope="module")
+def gbm_and_frame():
+    fr = _frame()
+    gbm = H2OGradientBoostingEstimator(ntrees=12, max_depth=4, seed=3)
+    gbm.train(x=["a", "b", "cat"], y="y", training_frame=fr)
+    return gbm, fr
+
+
+def test_partial_plot_numeric_monotone(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    (pdp,) = gbm.partial_plot(fr, cols=["a"], nbins=10)
+    assert pdp.names == ["a", "mean_response", "stddev_response",
+                         "std_error_mean_response"]
+    vals = np.asarray(pdp.vec("a").data, np.float64)
+    mr = np.asarray(pdp.vec("mean_response").data, np.float64)
+    assert len(vals) == 10
+    # y depends positively on a: pdp must rise end-to-end
+    assert mr[-1] > mr[0] + 0.1
+    assert ((mr >= 0) & (mr <= 1)).all()
+
+
+def test_partial_plot_categorical_levels(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    (pdp,) = gbm.partial_plot(fr, cols=["cat"])
+    assert pdp.vec("cat").domain == ["x", "y", "z"]
+    mr = np.asarray(pdp.vec("mean_response").data, np.float64)
+    # class-x shifts the logit up, class-z down
+    assert mr[0] > mr[2]
+
+
+def test_partial_plot_ice_row(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    (ice,) = gbm.partial_plot(fr, cols=["a"], nbins=5, row_index=3)
+    assert ice.nrow == 5
+    sd = np.asarray(ice.vec("stddev_response").data, np.float64)
+    np.testing.assert_allclose(sd, 0.0)  # single row: no spread
+
+
+def test_permutation_importance_ranks_signal_feature(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    pvi = gbm.permutation_importance(fr, metric="auc", seed=1)
+    assert pvi.names == ["Variable", "Relative Importance",
+                         "Scaled Importance", "Percentage"]
+    top = pvi.vec("Variable").domain[
+        int(np.asarray(pvi.vec("Variable").data)[0])]
+    assert top == "a"
+    pct = np.asarray(pvi.vec("Percentage").data, np.float64)
+    np.testing.assert_allclose(pct.sum(), 1.0, atol=1e-9)
+    scaled = np.asarray(pvi.vec("Scaled Importance").data, np.float64)
+    assert scaled[0] == 1.0
+
+
+def test_staged_predict_proba_converges_to_predict(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    staged = gbm.staged_predict_proba(fr)
+    assert len(staged.names) == 12
+    final = np.asarray(staged.vec("T12").data, np.float64)
+    p1 = np.asarray(gbm.predict(fr).vec("1").data, np.float64)
+    np.testing.assert_allclose(final, p1, atol=1e-5)
+
+
+def test_feature_frequencies(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    ff = gbm.feature_frequencies(fr)
+    assert ff.names == ["a", "b", "cat"]
+    counts = np.column_stack(
+        [np.asarray(ff.vec(n).data, np.float64) for n in ff.names])
+    assert (counts >= 0).all()
+    # 'a' is the dominant signal: used most on average
+    assert counts[:, 0].mean() > counts[:, 1].mean()
+
+
+def test_h2o_tree_inspection(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    t = H2OTree(gbm, tree_number=0)
+    assert t.root_node_id == 0
+    assert len(t) >= 3
+    # children indices are consistent and in range
+    for i in range(len(t)):
+        l, r = t.left_children[i], t.right_children[i]
+        assert (l == -1) == (r == -1)
+        if l >= 0:
+            assert 0 <= l < len(t) and 0 <= r < len(t)
+            assert t.features[i] in ("a", "b", "cat")
+            assert np.isfinite(t.thresholds[i])
+        else:
+            assert t.features[i] is None
+    # alias: h2o.tree.H2OTree
+    assert h2o.tree.H2OTree is H2OTree
+    with pytest.raises(ValueError):
+        H2OTree(gbm, tree_number=99)
+
+
+def test_partial_plot_multinomial_requires_targets():
+    rng = np.random.default_rng(7)
+    n = 400
+    a = rng.normal(size=n)
+    y = np.digitize(a, [-0.5, 0.5]).astype(str)
+    fr = h2o.H2OFrame_from_python(
+        {"a": a, "b": rng.normal(size=n), "y": y}, column_types={"y": "enum"})
+    gbm = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+    gbm.train(x=["a", "b"], y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="targets"):
+        gbm.partial_plot(fr, cols=["a"], nbins=5)
+    # with targets: one table per target, probabilities in [0, 1]
+    t0, t1 = gbm.partial_plot(fr, cols=["a"], nbins=5, targets=["0", "1"])
+    for t in (t0, t1):
+        mr = np.asarray(t.vec("mean_response").data, np.float64)
+        assert ((mr >= 0) & (mr <= 1)).all()
+    assert t0.target == "0" and t1.target == "1"
+
+
+def test_h2o_tree_binomial_negative_class_rejected(gbm_and_frame):
+    gbm, fr = gbm_and_frame
+    with pytest.raises(ValueError, match="not.*modelled|modelled"):
+        H2OTree(gbm, tree_number=0, tree_class="0")
+    t = H2OTree(gbm, tree_number=0, tree_class="1")
+    assert len(t) >= 3
+
+
+def test_partial_plot_works_for_glm():
+    fr = _frame(800, seed=2)
+    glm = H2OGeneralizedLinearEstimator(family="binomial")
+    glm.train(x=["a", "b", "cat"], y="y", training_frame=fr)
+    (pdp,) = glm.partial_plot(fr, cols=["a"], nbins=8)
+    mr = np.asarray(pdp.vec("mean_response").data, np.float64)
+    assert mr[-1] > mr[0]
