@@ -35,7 +35,15 @@ class _Phase:
         if not _PROFILE:
             return
         if sync is not None:
-            jax.block_until_ready(sync)
+            # fetch one element: through a remote-device tunnel,
+            # block_until_ready can return before the computation lands —
+            # a tiny D2H is the only reliable barrier
+            import numpy as _np
+
+            try:
+                _np.asarray(sync.ravel()[:1])
+            except Exception:
+                jax.block_until_ready(sync)
         now = time.time()
         print(f"[h2o3-profile] {name}: {now - self.t:.3f}s", flush=True)
         self.t = now
@@ -537,7 +545,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
         _ph.mark("build_bins")
         codes_d = jnp.asarray(padr(bm.codes))
         y_d = jnp.asarray(padr(yk))
-        w_d = jnp.asarray(padr(w))
+        if np.all(w == 1.0):
+            # trivial weights: build on device (zero-weight padded tail)
+            # instead of pushing 4·npad bytes of 1.0s through the tunnel
+            w_d = jnp.ones(npad, jnp.float32).at[n:].set(0.0) if pad else (
+                jnp.ones(npad, jnp.float32))
+        else:
+            w_d = jnp.asarray(padr(w))
         edges = np.full((F, nbins - 2), np.inf, np.float32)
         for j, e in enumerate(bm.edges):
             edges[j, : min(len(e), nbins - 2)] = e[: nbins - 2]
@@ -670,6 +684,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if "quantile_alpha" in self._parms else 0.5
         colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
         custom_obj = getattr(self, "_objective_fn", None)
+        no_row_sampling = (tp["sample_rate"] >= 1.0
+                           and not self._parms.get("sample_rate_per_class"))
 
         def _grads(margins, y_d, k):
             if self._mode == "drf":
@@ -692,11 +708,16 @@ class H2OSharedTreeEstimator(H2OEstimator):
             compilation cache (new data ⇒ recompile) and bloating programs."""
             krow, kcol, ktree = jax.random.split(jax.random.fold_in(key, 0), 3)
             # rate_a is per-row: constant sample_rate, or per-class rates
-            # when sample_rate_per_class is set
-            row_mask = (
-                jax.random.uniform(krow, (npad,)) < rate_a
-            ).astype(jnp.float32)
-            wt = w_a * row_mask
+            # when sample_rate_per_class is set. With no sampling at all the
+            # per-tree 1M-point RNG draw is skipped entirely (static flag).
+            if no_row_sampling:
+                row_mask = jnp.ones(npad, jnp.float32)
+                wt = w_a
+            else:
+                row_mask = (
+                    jax.random.uniform(krow, (npad,)) < rate_a
+                ).astype(jnp.float32)
+                wt = w_a * row_mask
             if colp < 1.0:
                 fm = (jax.random.uniform(kcol, (F,)) < colp).astype(jnp.float32)
                 fm = fm.at[0].set(jnp.maximum(fm[0], 1 - fm.sum().clip(0, 1)))
@@ -718,11 +739,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 tr = tr._replace(value=tr.value * scale)
                 # margins track Σ tree outputs for ALL modes: GBM boosting
                 # margins, or DRF leaf-mean sums (÷ntrees at scoring time)
-                margins = margins.at[:, k].add(tr.value[leaf_idx])
+                leaf_vals = treelib.value_at(tr.value, leaf_idx)
+                margins = margins.at[:, k].add(leaf_vals)
                 if self._mode == "drf":
                     # out-of-bag contribution (DRF OOB scoring): rows NOT
                     # sampled into this tree accumulate its prediction
-                    col = tr.value[leaf_idx] * (1.0 - row_mask)
+                    col = leaf_vals * (1.0 - row_mask)
                     oob_inc = col[:, None] if oob_inc is None else jnp.concatenate(
                         [oob_inc, col[:, None]], axis=1)
                 trs.append(tr)

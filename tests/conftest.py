@@ -18,6 +18,10 @@ import jax  # noqa: E402
 # this image's sitecustomize registers an `axon` TPU backend and pins
 # jax_platforms programmatically — env alone doesn't win; config does
 jax.config.update("jax_platforms", "cpu")
+# same story for the persistent compilation cache: engage it via config
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
