@@ -39,11 +39,138 @@ def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 0):
     return X, y
 
 
-def main():
+def bench_gbm():
+    """Flagship: HIGGS-like GBM (BASELINE.json config 1)."""
     n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     ntrees = int(os.environ.get("BENCH_TREES", 100))
     max_depth = int(os.environ.get("BENCH_DEPTH", 6))
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
 
+    X, y = make_higgs_like(n_rows)
+    names = [f"f{i}" for i in range(X.shape[1])] + ["label"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names).asfactor("label")
+    gbm = H2OGradientBoostingEstimator(
+        ntrees=ntrees, max_depth=max_depth, learn_rate=0.1,
+        histogram_type="UniformAdaptive", seed=42,
+    )
+    t0 = time.time()
+    gbm.train(y="label", training_frame=fr)
+    wall = time.time() - t0
+    return (f"higgs_gbm_{n_rows//1000}k_{ntrees}trees_wall_s", wall,
+            {"auc": round(float(gbm.auc()), 5)})
+
+
+def bench_glm():
+    """Airlines-like logistic GLM, IRLS (BASELINE.json config 2): mixed
+    numeric + high-cardinality categoricals, like Year/Month/Origin/Dest."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    rng = np.random.default_rng(0)
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+    dep = rng.integers(0, 2400, n_rows).astype(np.float64)
+    dist = np.abs(rng.normal(800, 500, n_rows))
+    origin = rng.integers(0, 100, n_rows)
+    dest = rng.integers(0, 100, n_rows)
+    month = rng.integers(0, 12, n_rows)
+    dow = rng.integers(0, 7, n_rows)
+    eff = (0.002 * (dep - 1200) + 0.4 * (origin % 7 == 0)
+           - 0.3 * (dest % 11 == 0) + 0.1 * (dow >= 5))
+    y = (rng.random(n_rows) < 1 / (1 + np.exp(-eff))).astype(int)
+    fr = h2o.H2OFrame_from_python(
+        {"DepTime": dep, "Distance": dist,
+         "Origin": np.char.add("O", origin.astype(str)),
+         "Dest": np.char.add("D", dest.astype(str)),
+         "Month": month.astype(str), "DayOfWeek": dow.astype(str),
+         "IsDepDelayed": np.where(y == 1, "YES", "NO")},
+        column_types={"Origin": "enum", "Dest": "enum", "Month": "enum",
+                      "DayOfWeek": "enum", "IsDepDelayed": "enum"})
+    glm = H2OGeneralizedLinearEstimator(family="binomial", solver="IRLSM",
+                                        lambda_=0.0)
+    t0 = time.time()
+    glm.train(y="IsDepDelayed", training_frame=fr)
+    wall = time.time() - t0
+    return (f"airlines_glm_{n_rows//1000}k_wall_s", wall,
+            {"auc": round(float(glm.auc()), 5)})
+
+
+def bench_dl():
+    """MNIST-like DeepLearning (BASELINE.json config 3): 784→200→200→10
+    rectifier MLP, sync-DP SGD replacing Hogwild; reports samples/sec."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 60_000))
+    epochs = float(os.environ.get("BENCH_EPOCHS", 5))
+    rng = np.random.default_rng(0)
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+
+    X = rng.random((n_rows, 784)).astype(np.float32)
+    proto = rng.normal(size=(10, 784)).astype(np.float32)
+    y = (X @ proto.T + 0.5 * rng.normal(size=(n_rows, 10))).argmax(axis=1)
+    d = {f"p{i}": X[:, i] for i in range(784)}
+    d["label"] = y.astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"label": "enum"})
+    dl = H2ODeepLearningEstimator(hidden=[200, 200], activation="Rectifier",
+                                  epochs=epochs, seed=1)
+    t0 = time.time()
+    dl.train(y="label", training_frame=fr)
+    wall = time.time() - t0
+    sps = n_rows * epochs / wall
+    return (f"mnist_dl_{n_rows//1000}k_samples_per_s", sps,
+            {"wall_s": round(wall, 3), "unit_override": "samples/s"})
+
+
+def bench_xgb_rank():
+    """MSLR-like lambdarank XGBoost (BASELINE.json config 4):
+    tree_method=tpu_hist, NDCG@10 objective over query groups."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 200_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 50))
+    rng = np.random.default_rng(0)
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+
+    nq = n_rows // 100
+    qid = np.sort(rng.integers(0, nq, n_rows))
+    X = rng.normal(size=(n_rows, 40)).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.5, size=n_rows)
+                   ) * 1.2 + 1.5, 0, 4).astype(int)
+    d = {f"f{i}": X[:, i] for i in range(40)}
+    d["qid"] = qid.astype(np.float64)
+    d["rel"] = rel.astype(np.float64)
+    fr = h2o.H2OFrame_from_python(d)
+    xgb = H2OXGBoostEstimator(ntrees=ntrees, max_depth=6, seed=1,
+                              objective="rank:ndcg", group_column="qid")
+    t0 = time.time()
+    xgb.train(x=[f"f{i}" for i in range(40)], y="rel", training_frame=fr)
+    wall = time.time() - t0
+    ndcg = xgb.ndcg(fr)
+    return (f"mslr_xgb_rank_{n_rows//1000}k_{ntrees}trees_wall_s", wall,
+            {"ndcg10": round(float(ndcg), 5)})
+
+
+def bench_automl():
+    """AutoML leaderboard (BASELINE.json config 5)."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 50_000))
+    max_models = int(os.environ.get("BENCH_MODELS", 8))
+    import h2o3_tpu as h2o
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    X, y = make_higgs_like(n_rows, n_feat=12)
+    d = {f"f{i}": X[:, i] for i in range(12)}
+    d["label"] = y.astype(int).astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"label": "enum"})
+    aml = H2OAutoML(max_models=max_models, seed=1, nfolds=3)
+    t0 = time.time()
+    aml.train(y="label", training_frame=fr)
+    wall = time.time() - t0
+    rows = aml.leaderboard.rows
+    best_auc = (round(float(rows[0].get("auc", float("nan"))), 5)
+                if rows else None)
+    return (f"automl_{n_rows//1000}k_{max_models}models_wall_s", wall,
+            {"n_models": len(rows), "best_auc": best_auc})
+
+
+def main():
     import jax
 
     # env vars alone do not engage the persistent cache under the remote-TPU
@@ -52,30 +179,18 @@ def main():
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    from h2o3_tpu.frame.frame import Frame
-    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
-
-    X, y = make_higgs_like(n_rows)
-    names = [f"f{i}" for i in range(X.shape[1])] + ["label"]
-    fr = Frame.from_numpy(np.column_stack([X, y]), names=names).asfactor("label")
-
-    gbm = H2OGradientBoostingEstimator(
-        ntrees=ntrees, max_depth=max_depth, learn_rate=0.1,
-        histogram_type="UniformAdaptive", seed=42,
-    )
-    t0 = time.time()
-    gbm.train(y="label", training_frame=fr)
-    wall = time.time() - t0
-    auc = gbm.auc()
-
+    config = os.environ.get("BENCH_CONFIG", "gbm")
+    fn = {"gbm": bench_gbm, "glm": bench_glm, "dl": bench_dl,
+          "xgb_rank": bench_xgb_rank, "automl": bench_automl}[config]
+    metric, value, extra = fn()
     result = {
-        "metric": f"higgs_gbm_{n_rows//1000}k_{ntrees}trees_wall_s",
-        "value": round(wall, 3),
-        "unit": "s",
+        "metric": metric,
+        "value": round(float(value), 3),
+        "unit": extra.pop("unit_override", "s"),
         "vs_baseline": 1.0,
-        "auc": round(float(auc), 5),
-        "backend": __import__("jax").default_backend(),
+        "backend": jax.default_backend(),
     }
+    result.update({k: v for k, v in extra.items() if v is not None})
     print(json.dumps(result))
 
 

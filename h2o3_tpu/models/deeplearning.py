@@ -115,8 +115,11 @@ class DeepLearningModel(H2OModel):
         self.activation = activation
         self.distribution = distribution
 
-    def _score(self, frame: Frame) -> np.ndarray:
-        X = jnp.asarray(self.dinfo.transform(frame))
+    def _score(self, frame: Frame, X_pre=None) -> np.ndarray:
+        """X_pre: optional pre-transformed (and possibly device-resident)
+        design matrix — the training loop passes its HBM copy so scoring
+        events skip the host re-expansion and the big re-upload."""
+        X = X_pre if X_pre is not None else jnp.asarray(self.dinfo.transform(frame))
         out = _forward(self.net_params, X, self.activation, None, 0.0, None, False)
         if self.problem == "autoencoder":
             return np.asarray(out, np.float64)  # reconstruction
@@ -152,10 +155,11 @@ class DeepLearningModel(H2OModel):
             return Frame.from_dict(d, column_types={"predict": "enum"})
         return Frame.from_dict({"predict": out[:, 0]})
 
-    def _make_metrics(self, frame: Frame):
-        out = self._score(frame)
+    def _make_metrics(self, frame: Frame, X_pre=None):
+        out = self._score(frame, X_pre=X_pre)
         if self.problem == "autoencoder":
-            X = self.dinfo.transform(frame)
+            X = (np.asarray(X_pre) if X_pre is not None
+                 else self.dinfo.transform(frame))
             mse = float(np.mean((out - X) ** 2))
             m = ModelMetricsRegression(mse=mse, rmse=float(np.sqrt(mse)),
                                        nobs=frame.nrow,
@@ -312,9 +316,10 @@ class H2ODeepLearningEstimator(H2OEstimator):
         else:
             opt_state = [(jnp.zeros_like(W), jnp.zeros_like(b)) for W, b in params]
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def train_step(params, opt_state, xb, yb, wb, key, it):
-            grads = jax.grad(loss_fn)(params, xb, yb, wb, key)
+        def _update(params, opt_state, grads, it):
+            """One optimizer update (ADADELTA per Neurons.java, or
+            momentum/annealed-rate SGD) — shared by the per-batch step and
+            the device-resident scan."""
             new_params, new_state = [], []
             if adaptive:
                 for (W, b), (Eg2W, Ed2W, Eg2b, Ed2b), (gW, gb) in zip(params, opt_state, grads):
@@ -344,6 +349,43 @@ class H2ODeepLearningEstimator(H2OEstimator):
                     new_state.append((vW2, vb2))
             return new_params, new_state
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, xb, yb, wb, key, it):
+            grads = jax.grad(loss_fn)(params, xb, yb, wb, key)
+            return _update(params, opt_state, grads, it)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1),
+                           static_argnames=("nsteps",))
+        def train_chunk(params, opt_state, X_d, y_d, w_d, key, it0, nsteps):
+            """nsteps minibatch updates as ONE device program (lax.scan):
+            the training set lives in HBM; one random permutation per chunk
+            re-batches it into (nsteps, batch, ·) slices that scan consumes
+            directly — no per-step gathers, no per-batch host→device uploads
+            (either would dominate the step time through a remote-chip
+            tunnel). Replaces the reference's per-row Hogwild loop
+            (DeepLearningTask.map) with compiled minibatch SGD; the
+            per-chunk reshuffle matches `shuffle_training_data` semantics."""
+            kperm, kdrop = jax.random.split(key)
+            need = nsteps * batch
+            perm = jax.random.permutation(kperm, n)
+            reps = -(-need // n)                       # ceil: allow short n
+            sel = jnp.tile(perm, reps)[:need]
+            xs = (X_d[sel].reshape(nsteps, batch, -1),
+                  y_d[sel].reshape((nsteps, batch) + y_d.shape[1:]),
+                  w_d[sel].reshape(nsteps, batch),
+                  jax.random.split(kdrop, nsteps))
+
+            def body(carry, xb_yb_wb_k):
+                params, opt_state, it = carry
+                xb, yb, wb, k = xb_yb_wb_k
+                grads = jax.grad(loss_fn)(params, xb, yb, wb, k)
+                params, opt_state = _update(params, opt_state, grads, it)
+                return (params, opt_state, it + 1.0), None
+
+            (params, opt_state, _), _ = jax.lax.scan(
+                body, (params, opt_state, jnp.float32(it0)), xs)
+            return params, opt_state
+
         # sync-DP: batches row-sharded over the mesh; params replicated —
         # XLA inserts the gradient psum (the Hogwild replacement)
         rs = cloud.row_sharding() if cloud.size > 1 else None
@@ -367,22 +409,46 @@ class H2ODeepLearningEstimator(H2OEstimator):
         max_runtime = float(p.get("max_runtime_secs", 0) or 0)
         model = DeepLearningModel(self, x, y, dinfo, problem, nclass, domain,
                                   params, activation, dist)
+        # single-device fast path: data device-resident, scan over steps.
+        # (Multi-device keeps the sharded per-batch step: a global batch
+        # gather across row shards would need an all-gather per step.)
+        use_scan = rs is None and not (max_runtime and max_runtime > 0)
+        if use_scan:
+            X_dev = jnp.asarray(X)
+            y_dev = jnp.asarray(yarr)
+            w_dev = jnp.asarray(w)
+            X_score = X_dev                  # scoring reuses the HBM copy
+        else:
+            # sharded / max_runtime path: no persistent unsharded device
+            # copy (it could evict params on data sized for row sharding);
+            # scoring falls back to the transient per-event transform
+            X_score = None
         while seen < total:
-            idx = rng.integers(0, n, batch)
-            xb = jnp.asarray(X[idx])
-            yb = jnp.asarray(yarr[idx])
-            wb = jnp.asarray(w[idx])
-            if rs is not None:
-                xb, yb, wb = (jax.device_put(a, rs) for a in (xb, yb, wb))
-            key, sub = jax.random.split(key)
-            params, opt_state = train_step(params, opt_state, xb, yb, wb, sub,
-                                           jnp.float32(it))
-            seen += batch
-            it += 1
+            if use_scan:
+                upto = min(next_score, total)
+                steps = max(1, -(-(upto - seen) // batch))   # ceil
+                key, sub = jax.random.split(key)
+                params, opt_state = train_chunk(
+                    params, opt_state, X_dev, y_dev, w_dev, sub,
+                    float(it), int(steps))
+                seen += steps * batch
+                it += steps
+            else:
+                idx = rng.integers(0, n, batch)
+                xb = jnp.asarray(X[idx])
+                yb = jnp.asarray(yarr[idx])
+                wb = jnp.asarray(w[idx])
+                if rs is not None:
+                    xb, yb, wb = (jax.device_put(a, rs) for a in (xb, yb, wb))
+                key, sub = jax.random.split(key)
+                params, opt_state = train_step(params, opt_state, xb, yb, wb,
+                                               sub, jnp.float32(it))
+                seen += batch
+                it += 1
             if seen >= next_score or seen >= total:
                 next_score += score_every
                 model.net_params = params
-                sm = model._make_metrics(train)
+                sm = model._make_metrics(train, X_pre=X_score)
                 ev = {
                     "epochs": seen / n, "iterations": it,
                     "samples": seen, "timestamp": time.time(),
@@ -403,7 +469,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
 
         model.net_params = params
         model.scoring_history = history
-        model.training_metrics = model._make_metrics(train)
+        model.training_metrics = model._make_metrics(train, X_pre=X_score)
         if valid is not None:
             model.validation_metrics = model._make_metrics(valid)
         return model

@@ -294,9 +294,8 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 problem, "gaussian"
             )
         dinfo = DataInfo(train, x, standardize=bool(p.get("standardize", True)))
-        X = dinfo.fit_transform(train)
-        n, nfeat = X.shape
-        Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
+        n = train.nrow
+        nfeat = len(dinfo.coef_names)
         w = (
             train.vec(p["weights_column"]).numeric_np()
             if p.get("weights_column")
@@ -321,10 +320,11 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         beta_eps = float(p.get("beta_epsilon", 1e-4))
 
         cloud = cloudlib.cloud()
-        Xd = jnp.asarray(Xi)
         yd = jnp.asarray(yarr if family != "multinomial" else yarr.astype(np.float32))
         wd = jnp.asarray(w)
         if cloud.size > 1 and n >= cloud.size:
+            X = dinfo.fit_transform(train)
+            Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
             npad = cloudlib.pad_to_multiple(n, cloud.size)
             padn = npad - n
             Xd = jnp.asarray(np.concatenate([Xi, np.zeros((padn, Xi.shape[1]), np.float32)]))
@@ -332,6 +332,10 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             wd = jnp.asarray(np.concatenate([w, np.zeros(padn, np.float32)]))
             rs = cloud.row_sharding()
             Xd, yd, wd = jax.device_put(Xd, rs), jax.device_put(yd, rs), jax.device_put(wd, rs)
+        else:
+            # compact upload + on-device one-hot expansion (the dense design
+            # matrix never crosses the host↔device link)
+            Xd = dinfo.device_design(train, fit=True, add_intercept=True)
 
         full_path = None
         stderr = None

@@ -20,6 +20,7 @@ algorithm (see `tree.py`, `glm.py`, `deeplearning.py`).
 
 from __future__ import annotations
 
+import functools
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -37,6 +38,44 @@ from .metrics import (
 )
 
 _model_counter = itertools.count()
+
+
+@functools.lru_cache(maxsize=64)
+def _device_expand_fn(sig):
+    """Jitted design-matrix expansion, cached per DataInfo signature
+    (column kinds/cardinalities, use_all, standardize, intercept) so every
+    same-shaped frame reuses one compiled program."""
+    import jax
+    import jax.numpy as jnp
+
+    spec, use_all, standardized, add_intercept = sig
+
+    def expand(nums, cats, means, stds):
+        parts = []
+        ni = ci = 0
+        for kind, K in spec:
+            if kind == "num":
+                parts.append(nums[:, ni][:, None])
+                ni += 1
+            else:
+                codes = cats[:, ci]
+                ci += 1
+                oh = (codes[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :]
+                      ).astype(jnp.float32)
+                if not use_all and K > 0:
+                    oh = oh[:, 1:]
+                parts.append(oh)
+        X = jnp.concatenate(parts, axis=1)
+        if standardized:
+            X = (X - means[None, :]) / stds[None, :]
+        # trailing NaN cleanup, mirroring fit_transform/transform
+        X = jnp.nan_to_num(X, nan=0.0)
+        if add_intercept:
+            X = jnp.concatenate(
+                [X, jnp.ones((X.shape[0], 1), jnp.float32)], axis=1)
+        return X
+
+    return jax.jit(expand)
 
 
 @dataclass
@@ -149,6 +188,96 @@ class DataInfo:
         if self.standardize and self.means is not None:
             X = (X - self.means) / self.stds
         return np.nan_to_num(X, nan=0.0).astype(np.float32)
+
+    def device_design(self, frame: Frame, fit: bool,
+                      add_intercept: bool = False):
+        """Expanded design matrix built ON DEVICE from compact columns.
+
+        Semantically identical to fit_transform/transform (same one-hot
+        layout, imputation, standardization — the stats are derived
+        analytically from the codes), but the host→device transfer is the
+        compact representation (numeric f32 + categorical int32 codes,
+        ~P_cat× smaller than the dense one-hot), and the expansion runs as
+        one compiled program. This is what makes wide-categorical GLM
+        viable through a remote-chip tunnel."""
+        import jax
+        import jax.numpy as jnp
+
+        n = frame.nrow
+        nums, cats = [], []
+        means, stds = [], []
+        pos = 0  # expanded-column position (for stored-stat lookups)
+        for kind, name, dom in self._spec:
+            v = frame.vec(name)
+            if kind == "num":
+                c = v.numeric_np()
+                if self.impute_missing:
+                    if fit:
+                        with np.errstate(all="ignore"):
+                            mv = np.nanmean(c) if np.isfinite(c).any() else 0.0
+                        self.col_means[name] = float(mv)
+                    c = np.where(np.isnan(c), self.col_means.get(name, 0.0), c)
+                if fit and self.standardize:
+                    # stats over valid rows only (nanmean/nanstd), exactly
+                    # like fit_transform — with imputation active c has no
+                    # NaNs so this is the plain mean/std. All-NaN columns
+                    # get (0, 1) so they standardize to the zeros
+                    # fit_transform's trailing nan_to_num produces.
+                    with np.errstate(all="ignore"):
+                        m = float(np.nanmean(c)) if np.isfinite(c).any() else 0.0
+                        s = float(np.nanstd(c)) if np.isfinite(c).any() else 0.0
+                    means.append([m if np.isfinite(m) else 0.0])
+                    stds.append([s if np.isfinite(s) and s >= 1e-10 else 1.0])
+                if not self.impute_missing and np.isnan(c).any():
+                    if self.standardize:
+                        # fit_transform zeroes missing AFTER scaling, so the
+                        # raw fill that standardizes to 0 is the column mean
+                        mm = (means[-1][0] if fit
+                              else float(self.means[pos])
+                              if self.means is not None else 0.0)
+                        c = np.where(np.isnan(c), mm, c)
+                    else:
+                        c = np.nan_to_num(c, nan=0.0)
+                nums.append(c.astype(np.float32))
+                pos += 1
+            else:
+                codes = np.asarray(v.data)
+                if v.domain != dom and v.domain:
+                    remap = np.asarray(
+                        [dom.index(d) if d in dom else -1 for d in v.domain],
+                        np.int64)
+                    codes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+                cats.append(codes.astype(np.int32))
+                if fit and self.standardize:
+                    K = len(dom)
+                    cnt = np.bincount(codes[codes >= 0], minlength=K)[:K]
+                    p_lvl = cnt / max(n, 1)
+                    lv = p_lvl if self.use_all else p_lvl[1:]
+                    means.append(lv.tolist())
+                    stds.append([float(s) if (s := np.sqrt(pl * (1 - pl))) >= 1e-10
+                                 else 1.0 for pl in lv])
+                pos += len(dom) if self.use_all else max(len(dom) - 1, 0)
+        if fit and self.standardize:
+            self.means = np.asarray(
+                [m for grp in means for m in grp], np.float64)
+            self.stds = np.asarray(
+                [s for grp in stds for s in grp], np.float64)
+
+        nums_a = (np.stack(nums, axis=1) if nums
+                  else np.zeros((n, 0), np.float32))
+        cats_a = (np.stack(cats, axis=1) if cats
+                  else np.zeros((n, 0), np.int32))
+        sig = (tuple((k, len(d) if d else 0) for k, _, d in self._spec),
+               self.use_all, self.standardize and self.means is not None,
+               add_intercept)
+        fn = _device_expand_fn(sig)
+        m_a = (jnp.asarray(self.means, jnp.float32)
+               if self.standardize and self.means is not None
+               else jnp.zeros(0, jnp.float32))
+        s_a = (jnp.asarray(self.stds, jnp.float32)
+               if self.standardize and self.stds is not None
+               else jnp.ones(0, jnp.float32))
+        return fn(jnp.asarray(nums_a), jnp.asarray(cats_a), m_a, s_a)
 
     def _expand(self, frame: Frame, fit: bool) -> np.ndarray:
         cols = []
