@@ -19,7 +19,7 @@ from __future__ import annotations
 import functools
 import os
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 _PROFILE = bool(os.environ.get("H2O3_PROFILE"))
 
@@ -139,6 +139,206 @@ def frame_to_matrix(frame: Frame, x: Sequence[str], expected_domains=None):
         cats.append(v.type == "enum")
         doms.append(v.domain)
     return np.column_stack(cols), np.asarray(cats), doms
+
+
+class _StepCfg(NamedTuple):
+    """STRUCTURAL configuration of the per-iteration tree-step program —
+    only what changes the traced computation graph (shapes, depth, bins,
+    problem/mode, static branches). Scalar hyperparameters (learn rate,
+    min_rows, regularization, …) are TRACED inputs (the `hp` vector), so
+    one compiled program serves every model sharing this cfg: CV folds,
+    grid points, and AutoML steps vary scalars without recompiling.
+    The jitted step functions are cached per (cfg, cloud)."""
+
+    npad: int
+    K: int
+    F: int
+    nbins: int
+    problem: str
+    dist: str
+    mode: str
+    max_depth: int
+    mtries: int
+    no_row_sampling: bool
+    has_col_sampling: bool
+    has_monotone: bool
+    tweedie_power: float
+    quantile_alpha: float
+
+
+def _pack_hp(tp, lr, colp) -> "jnp.ndarray":
+    """The traced scalar hyperparameters, in a fixed layout:
+    [min_rows, min_split_improvement, reg_lambda, reg_alpha, lr,
+    learn_rate_annealing, col_sample_product]."""
+    return jnp.asarray(
+        [tp["min_rows"], tp["min_split_improvement"], tp["reg_lambda"],
+         tp.get("reg_alpha", 0.0), lr, tp["learn_rate_annealing"], colp],
+        jnp.float32)
+
+
+_STEP_FNS_CAP = 32
+
+
+def _tree_step_fns(cfg: _StepCfg, cloud):
+    """(tree_jit, single_jit) for one step configuration, cached ON the
+    cloud instance (keyed by cfg) so a mesh re-init naturally drops stale
+    shard_map closures. LRU-bounded: evicting releases the jitted
+    executables, so long-running servers sweeping many structural configs
+    (depths/shapes) don't accumulate programs forever."""
+    from collections import OrderedDict
+
+    cache = cloud.__dict__.setdefault("_step_fns_cache", OrderedDict())
+    fns = cache.get(cfg)
+    if fns is None:
+        fns = _build_tree_step_fns(cfg, cloud)
+        cache[cfg] = fns
+        while len(cache) > _STEP_FNS_CAP:
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(cfg)
+    return fns
+
+
+def _build_tree_step_fns(cfg: _StepCfg, cloud):
+    """Construct (tree_jit, single_jit) for one step configuration.
+
+    All data (including the monotone-constraint vector) arrives as
+    ARGUMENTS — a closure-captured device array would be embedded in the
+    HLO as a literal, defeating the persistent compilation cache and
+    bloating programs."""
+    npad, K, F = cfg.npad, cfg.K, cfg.F
+
+    def _grads(margins, y_d, k):
+        if cfg.mode == "drf":
+            return -y_d[:, k], jnp.ones_like(y_d[:, k])
+        if cfg.problem == "multinomial":
+            p = jax.nn.softmax(margins, axis=1)
+            return p[:, k] - y_d[:, k], p[:, k] * (1 - p[:, k])
+        return dist_mod.grad_hess(
+            cfg.dist, margins[:, 0], y_d[:, 0],
+            tweedie_power=cfg.tweedie_power, alpha=cfg.quantile_alpha,
+        )
+
+    def _build_one(codes, g, h, w, fm, edges, mono, hp, key):
+        kwargs = dict(max_depth=cfg.max_depth, nbins=cfg.nbins,
+                      mtries=cfg.mtries)
+        if cloud.size > 1:
+            from jax import shard_map
+
+            rspec = P(cloudlib.ROWS_AXIS)
+
+            def inner(codes, g, h, w, fm, edges, mono, hp, key):
+                kw = dict(kwargs)
+                if cfg.has_monotone:
+                    kw["monotone"] = mono
+                return treelib.build_tree(
+                    codes, g, h, w, fm, edges, key=key,
+                    min_rows=hp[0], min_split_improvement=hp[1],
+                    reg_lambda=hp[2], reg_alpha=hp[3],
+                    axis_name=cloudlib.ROWS_AXIS, **kw,
+                )
+
+            fn = shard_map(
+                inner, mesh=cloud.mesh,
+                in_specs=(rspec, rspec, rspec, rspec, P(), P(), P(), P(), P()),
+                out_specs=(
+                    treelib.Tree(P(), P(), P(), P(), P()), rspec, P(), P(),
+                ),
+            )
+            return fn(codes, g, h, w, fm, edges, mono, hp, key)
+        if cfg.has_monotone:
+            kwargs["monotone"] = mono
+        return treelib.build_tree(
+            codes, g, h, w, fm, edges, key=key,
+            min_rows=hp[0], min_split_improvement=hp[1],
+            reg_lambda=hp[2], reg_alpha=hp[3], **kwargs)
+
+    def _one_tree(margins, codes_a, y_a, w_a, rate_a, edges_a, mono, hp,
+                  key, m, g_ext=None, h_ext=None):
+        """Build the K trees of boosting iteration m (traced int)."""
+        krow, kcol, ktree = jax.random.split(jax.random.fold_in(key, 0), 3)
+        # rate_a is per-row: constant sample_rate, or per-class rates when
+        # sample_rate_per_class is set. With no sampling at all the
+        # per-tree npad-point RNG draw is skipped entirely (static flag).
+        if cfg.no_row_sampling:
+            row_mask = jnp.ones(npad, jnp.float32)
+            wt = w_a
+        else:
+            row_mask = (
+                jax.random.uniform(krow, (npad,)) < rate_a
+            ).astype(jnp.float32)
+            wt = w_a * row_mask
+        if cfg.has_col_sampling:
+            fm = (jax.random.uniform(kcol, (F,)) < hp[6]).astype(jnp.float32)
+            fm = fm.at[0].set(jnp.maximum(fm[0], 1 - fm.sum().clip(0, 1)))
+        else:
+            fm = jnp.ones(F, jnp.float32)
+        scale = (hp[4] * jnp.power(hp[5], m.astype(jnp.float32))
+                 ).astype(jnp.float32)
+        trs, covs, gains_acc = [], [], jnp.zeros(F, jnp.float32)
+        oob_inc = None
+        for k in range(K):
+            ktree = jax.random.fold_in(ktree, k)
+            if g_ext is not None:
+                g, h = g_ext, h_ext
+            else:
+                g, h = _grads(margins, y_a, k)
+            tr, leaf_idx, gains, cover = _build_one(
+                codes_a, g, h, wt, fm, edges_a, mono, hp, ktree)
+            tr = tr._replace(value=tr.value * scale)
+            # margins track Σ tree outputs for ALL modes: GBM boosting
+            # margins, or DRF leaf-mean sums (÷ntrees at scoring time)
+            leaf_vals = treelib.value_at(tr.value, leaf_idx)
+            margins = margins.at[:, k].add(leaf_vals)
+            if cfg.mode == "drf":
+                # out-of-bag contribution (DRF OOB scoring): rows NOT
+                # sampled into this tree accumulate its prediction
+                col = leaf_vals * (1.0 - row_mask)
+                oob_inc = col[:, None] if oob_inc is None else jnp.concatenate(
+                    [oob_inc, col[:, None]], axis=1)
+            trs.append(tr)
+            covs.append(cover)
+            gains_acc = gains_acc + gains
+        stacked = treelib.Tree(
+            *[jnp.stack([getattr(t, f) for t in trs]) for f in treelib.Tree._fields]
+        )
+        covers = jnp.stack(covs)                      # (K, T)
+        return margins, stacked, covers, gains_acc, oob_inc, (1.0 - row_mask)
+
+    def _pack(stacked, covers):
+        """Tree fields + covers → one f32 array (…, T, 6): a single D2H
+        transfer moves a whole chunk of trees (each sync transfer through
+        a remote-TPU tunnel pays seconds of fixed latency)."""
+        return jnp.stack(
+            [stacked.feat.astype(jnp.float32),
+             stacked.bin.astype(jnp.float32),
+             stacked.thr,
+             stacked.is_split.astype(jnp.float32),
+             stacked.value,
+             covers],
+            axis=-1,
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def tree_jit(margins, oob_sum, oob_cnt, codes_a, y_a, w_a, rate_a,
+                 edges_a, mono, hp, key, m):
+        margins, stacked, covers, gains, oob_inc, oob_mask = _one_tree(
+            margins, codes_a, y_a, w_a, rate_a, edges_a, mono, hp,
+            jax.random.fold_in(key, m), m
+        )
+        if oob_inc is not None:
+            oob_sum = oob_sum + oob_inc
+            oob_cnt = oob_cnt + oob_mask
+        return margins, oob_sum, oob_cnt, _pack(stacked, covers), gains
+
+    single_jit = jax.jit(
+        lambda margins, codes_a, y_a, w_a, rate_a, edges_a, mono, hp, key, m, g_ext, h_ext: (
+            lambda r: (r[0], _pack(r[1], r[2]), r[3])
+        )(_one_tree(margins, codes_a, y_a, w_a, rate_a, edges_a, mono, hp,
+                    jax.random.fold_in(key, m), m, g_ext, h_ext)),
+        donate_argnums=(0,),
+    )
+    return tree_jit, single_jit
 
 
 class SharedTreeModel(H2OModel):
@@ -687,100 +887,19 @@ class H2OSharedTreeEstimator(H2OEstimator):
         no_row_sampling = (tp["sample_rate"] >= 1.0
                            and not self._parms.get("sample_rate_per_class"))
 
-        def _grads(margins, y_d, k):
-            if self._mode == "drf":
-                return -y_d[:, k], jnp.ones_like(y_d[:, k])
-            if problem == "multinomial":
-                p = jax.nn.softmax(margins, axis=1)
-                return p[:, k] - y_d[:, k], p[:, k] * (1 - p[:, k])
-            return dist_mod.grad_hess(
-                dist, margins[:, 0], y_d[:, 0],
-                tweedie_power=tweedie_power, alpha=quantile_alpha,
-            )
-
-        annealing = tp["learn_rate_annealing"]
-
-        def _one_tree(margins, codes_a, y_a, w_a, rate_a, edges_a, key, m,
-                      g_ext=None, h_ext=None):
-            """Build the K trees of boosting iteration m (traced int). All
-            data arrives as ARGUMENTS — a closure-captured device array would
-            be embedded in the HLO as a literal, defeating the persistent
-            compilation cache (new data ⇒ recompile) and bloating programs."""
-            krow, kcol, ktree = jax.random.split(jax.random.fold_in(key, 0), 3)
-            # rate_a is per-row: constant sample_rate, or per-class rates
-            # when sample_rate_per_class is set. With no sampling at all the
-            # per-tree 1M-point RNG draw is skipped entirely (static flag).
-            if no_row_sampling:
-                row_mask = jnp.ones(npad, jnp.float32)
-                wt = w_a
-            else:
-                row_mask = (
-                    jax.random.uniform(krow, (npad,)) < rate_a
-                ).astype(jnp.float32)
-                wt = w_a * row_mask
-            if colp < 1.0:
-                fm = (jax.random.uniform(kcol, (F,)) < colp).astype(jnp.float32)
-                fm = fm.at[0].set(jnp.maximum(fm[0], 1 - fm.sum().clip(0, 1)))
-            else:
-                fm = jnp.ones(F, jnp.float32)
-            scale = (lr * jnp.power(annealing, m.astype(jnp.float32))).astype(jnp.float32)
-            trs, covs, gains_acc = [], [], jnp.zeros(F, jnp.float32)
-            oob_inc = None
-            for k in range(K):
-                ktree = jax.random.fold_in(ktree, k)
-                if g_ext is not None:
-                    g, h = g_ext, h_ext
-                else:
-                    g, h = _grads(margins, y_a, k)
-                tr, leaf_idx, gains, cover = self._build_one(
-                    codes_a, g, h, wt, fm, edges_a, tp, nbins, mtries,
-                    ktree, cloud
-                )
-                tr = tr._replace(value=tr.value * scale)
-                # margins track Σ tree outputs for ALL modes: GBM boosting
-                # margins, or DRF leaf-mean sums (÷ntrees at scoring time)
-                leaf_vals = treelib.value_at(tr.value, leaf_idx)
-                margins = margins.at[:, k].add(leaf_vals)
-                if self._mode == "drf":
-                    # out-of-bag contribution (DRF OOB scoring): rows NOT
-                    # sampled into this tree accumulate its prediction
-                    col = leaf_vals * (1.0 - row_mask)
-                    oob_inc = col[:, None] if oob_inc is None else jnp.concatenate(
-                        [oob_inc, col[:, None]], axis=1)
-                trs.append(tr)
-                covs.append(cover)
-                gains_acc = gains_acc + gains
-            stacked = treelib.Tree(
-                *[jnp.stack([getattr(t, f) for t in trs]) for f in treelib.Tree._fields]
-            )
-            covers = jnp.stack(covs)                      # (K, T)
-            return margins, stacked, covers, gains_acc, oob_inc, (1.0 - row_mask)
-
-        def _pack(stacked, covers):
-            """Tree fields + covers → one f32 array (…, T, 6): a single D2H
-            transfer moves a whole chunk of trees (each sync transfer through
-            a remote-TPU tunnel pays seconds of fixed latency)."""
-            return jnp.stack(
-                [stacked.feat.astype(jnp.float32),
-                 stacked.bin.astype(jnp.float32),
-                 stacked.thr,
-                 stacked.is_split.astype(jnp.float32),
-                 stacked.value,
-                 covers],
-                axis=-1,
-            )
-
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def _tree_jit(margins, oob_sum, oob_cnt, codes_a, y_a, w_a, rate_a,
-                      edges_a, key, m):
-            margins, stacked, covers, gains, oob_inc, oob_mask = _one_tree(
-                margins, codes_a, y_a, w_a, rate_a, edges_a,
-                jax.random.fold_in(key, m), m
-            )
-            if oob_inc is not None:
-                oob_sum = oob_sum + oob_inc
-                oob_cnt = oob_cnt + oob_mask
-            return margins, oob_sum, oob_cnt, _pack(stacked, covers), gains
+        mono_vec = getattr(self, "_monotone_vec", None)
+        cfg = _StepCfg(
+            npad=npad, K=K, F=F, nbins=nbins, problem=problem, dist=dist,
+            mode=self._mode, max_depth=tp["max_depth"],
+            mtries=mtries, no_row_sampling=no_row_sampling,
+            has_col_sampling=colp < 1.0,
+            has_monotone=mono_vec is not None,
+            tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
+        )
+        _tree_jit, _single_jit = _tree_step_fns(cfg, cloud)
+        mono_d = (jnp.asarray(mono_vec) if mono_vec is not None
+                  else jnp.zeros(F, jnp.float32))
+        hp_d = _pack_hp(tp, lr, colp)
 
         def _train_chunk(margins, oob_sum, oob_cnt, key, m0, nsteps: int):
             """nsteps async per-tree dispatches (NOT lax.scan: a scan body
@@ -791,19 +910,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
             for i in range(nsteps):
                 margins, oob_sum, oob_cnt, packed, gains = _tree_jit(
                     margins, oob_sum, oob_cnt, codes_d, y_d, w_d, rate_d,
-                    edges_d, key, np.int32(m0 + i)
+                    edges_d, mono_d, hp_d, key, np.int32(m0 + i)
                 )
                 packed_list.append(packed)
                 gains_list.append(gains)
             return margins, oob_sum, oob_cnt, jnp.stack(packed_list), sum(gains_list)
-
-        _single_jit = jax.jit(
-            lambda margins, codes_a, y_a, w_a, rate_a, edges_a, key, m, g_ext, h_ext: (
-                lambda r: (r[0], _pack(r[1], r[2]), r[3])
-            )(_one_tree(margins, codes_a, y_a, w_a, rate_a, edges_a,
-                        jax.random.fold_in(key, m), m, g_ext, h_ext)),
-            donate_argnums=(0,),
-        )
 
         def _stacked_from_packed_dev(packed, k):
             """Device (nsteps, K, T, 5) → stacked Tree for class k (device)."""
@@ -876,8 +987,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if custom_obj is not None:
                 g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
                 margins, packed, gains = _single_jit(
-                    margins, codes_d, y_d, w_d, rate_d, edges_d, key,
-                    jnp.int32(m), g_ext, h_ext
+                    margins, codes_d, y_d, w_d, rate_d, edges_d, mono_d,
+                    hp_d, key, jnp.int32(m), g_ext, h_ext
                 )
                 packed = packed[None]
                 nsteps = 1
@@ -1070,37 +1181,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
     def _probs_from_margins(self, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:
         return probs_from_margins(self._mode, problem, dist, m, ntrees)
-
-    def _build_one(self, codes, g, h, w, fm, edges, tp, nbins, mtries, key, cloud):
-        kwargs = dict(
-            max_depth=tp["max_depth"], nbins=nbins, min_rows=tp["min_rows"],
-            min_split_improvement=tp["min_split_improvement"],
-            reg_lambda=tp["reg_lambda"], reg_alpha=tp.get("reg_alpha", 0.0),
-            mtries=mtries,
-        )
-        mono = getattr(self, "_monotone_vec", None)
-        if mono is not None:
-            kwargs["monotone"] = mono
-        if cloud.size > 1:
-            from jax import shard_map
-
-            rspec = P(cloudlib.ROWS_AXIS)
-
-            def inner(codes, g, h, w, fm, edges, key):
-                return treelib.build_tree(
-                    codes, g, h, w, fm, edges, key=key,
-                    axis_name=cloudlib.ROWS_AXIS, **kwargs,
-                )
-
-            fn = shard_map(
-                inner, mesh=cloud.mesh,
-                in_specs=(rspec, rspec, rspec, rspec, P(), P(), P()),
-                out_specs=(
-                    treelib.Tree(P(), P(), P(), P(), P()), rspec, P(), P(),
-                ),
-            )
-            return fn(codes, g, h, w, fm, edges, key)
-        return treelib.build_tree(codes, g, h, w, fm, edges, key=key, **kwargs)
 
     def _fit_calibrator(self, model: SharedTreeModel):
         """calibrate_model: fit Platt scaling (default) or isotonic

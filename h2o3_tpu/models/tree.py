@@ -100,8 +100,7 @@ def value_at(table: jax.Array, idx: jax.Array) -> jax.Array:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "max_depth", "nbins", "min_rows", "min_split_improvement",
-        "reg_lambda", "reg_alpha", "hist_method", "axis_name", "mtries",
+        "max_depth", "nbins", "hist_method", "axis_name", "mtries",
     ),
 )
 def build_tree(
@@ -130,6 +129,11 @@ def build_tree(
     mtries > 0 samples ~mtries of F features per node per level (DRF's
     per-split column sampling, `hex/tree/drf/DRF.java` _mtry) — bernoulli
     approximation of exact without-replacement draws, same expectation.
+
+    Scalar hyperparameters (min_rows, min_split_improvement, reg_*) are
+    TRACED, not static: one compiled program serves every model that shares
+    the structural config (shapes, depth, bins) — grids / CV / AutoML vary
+    these scalars freely without recompiling.
     """
     N, F = codes.shape
     T = heap_size(max_depth)
