@@ -88,8 +88,13 @@ upload_file = import_file
 
 def H2OFrame_from_python(data, column_types=None) -> Frame:
     if isinstance(data, dict):
-        return Frame.from_dict(data, column_types=column_types)
-    return Frame.from_numpy(np.asarray(data), column_types=column_types)
+        fr = Frame.from_dict(data, column_types=column_types)
+    else:
+        fr = Frame.from_numpy(np.asarray(data), column_types=column_types)
+    # every client-created frame lives in the DKV (H2OFrame upload → DKV
+    # key), so Rapids expressions and get_frame can resolve it
+    _DKV.put(fr.key, fr)
+    return fr
 
 
 def get_frame(key: str) -> Frame:

@@ -19,9 +19,51 @@ from typing import Any, List
 
 import numpy as np
 
+import math
+
 from . import rapids as rapids_ops
 from .frame import Frame
 from .vec import Vec
+
+
+def _safe_vectorize(fn):
+    def apply(x):
+        x = np.asarray(x, np.float64)
+        out = np.full(x.shape, np.nan)
+        it = np.nditer(x, flags=["multi_index"])
+        for v in it:
+            try:
+                out[it.multi_index] = fn(float(v))
+            except ValueError:
+                pass
+            except OverflowError:
+                out[it.multi_index] = np.inf
+        return out
+    return apply
+
+
+_lgamma = _safe_vectorize(math.lgamma)
+_gamma = _safe_vectorize(math.gamma)
+
+# unary elementwise math (ast/prims/math/AstUniOp subclasses) and the
+# cumulative family — module-level constants (rebuilt-per-node dicts would
+# dominate per-row apply/ddply lambdas). Cumulative ops propagate NA like
+# the reference AstCumSum (no nan-skipping).
+_UNARY = {
+    "abs": np.abs, "sign": np.sign, "sqrt": np.sqrt,
+    "exp": np.exp, "expm1": np.expm1, "log": np.log,
+    "log2": np.log2, "log10": np.log10, "log1p": np.log1p,
+    "floor": np.floor, "ceiling": np.ceil, "trunc": np.trunc,
+    "cos": np.cos, "sin": np.sin, "tan": np.tan,
+    "acos": np.arccos, "asin": np.arcsin, "atan": np.arctan,
+    "cosh": np.cosh, "sinh": np.sinh, "tanh": np.tanh,
+    "gamma": _gamma,
+    "lgamma": _lgamma,
+    "not": lambda x: (~(x.astype(bool))).astype(np.float64),
+    "!": lambda x: (~(x.astype(bool))).astype(np.float64),
+}
+_CUM = {"cumsum": np.cumsum, "cumprod": np.cumprod,
+        "cummin": np.minimum.accumulate, "cummax": np.maximum.accumulate}
 
 
 # -- tokenizer / parser ------------------------------------------------------
@@ -31,7 +73,7 @@ def _tokenize(s: str) -> List[str]:
         c = s[i]
         if c.isspace():
             i += 1
-        elif c in "()[]":
+        elif c in "()[]{}":
             out.append(c)
             i += 1
         elif c in "\"'":
@@ -42,7 +84,7 @@ def _tokenize(s: str) -> List[str]:
             i = j + 1
         else:
             j = i
-            while j < n and not s[j].isspace() and s[j] not in "()[]":
+            while j < n and not s[j].isspace() and s[j] not in "()[]{}":
                 j += 1
             out.append(s[i:j])
             i = j
@@ -65,12 +107,46 @@ def _parse(tokens: List[str], pos: int = 0):
             node, pos = _parse(tokens, pos)
             items.append(node)
         return ("list", items), pos + 1
+    if t == "{":
+        # lambda: { arg1 arg2 . body }  (water/rapids/ast/AstFunction)
+        params = []
+        pos += 1
+        while tokens[pos] != ".":
+            params.append(tokens[pos])
+            pos += 1
+        body, pos = _parse(tokens, pos + 1)
+        if tokens[pos] != "}":
+            raise ValueError("Rapids: malformed lambda (expected '}')")
+        return ("lambda", (params, body)), pos + 1
     if t and t[0] in "\"'":
         return ("str", t[1:-1]), pos + 1
     try:
         return ("num", float(t)), pos + 1
     except ValueError:
         return ("sym", t), pos + 1
+
+
+class _Lambda:
+    """A rapids `{ args . body }` function value (AstFunction)."""
+
+    def __init__(self, params, body, session):
+        self.params = params
+        self.body = body
+        self.session = session
+
+    def __call__(self, *args):
+        sess = self.session
+        saved = {p: sess.dkv.get(p) for p in self.params}
+        try:
+            for p, v in zip(self.params, args):
+                sess.dkv.put(p, v)
+            return sess._eval(self.body)
+        finally:
+            for p, v in saved.items():
+                if v is None:
+                    sess.dkv.remove(p)
+                else:
+                    sess.dkv.put(p, v)
 
 
 class RapidsSession:
@@ -92,6 +168,8 @@ class RapidsSession:
             return val
         if kind == "str":
             return val
+        if kind == "lambda":
+            return _Lambda(val[0], val[1], self)
         if kind == "list":
             return [self._eval(v) for v in val]
         if kind == "sym":
@@ -105,8 +183,12 @@ class RapidsSession:
         return self._apply(op, args)
 
     # -- prims ---------------------------------------------------------------
-    def _apply(self, op: str, a: List[Any]):
+    def _apply(self, op, a: List[Any]):
         import operator
+
+        if callable(op):
+            # a lambda (or other function value) in head position
+            return op(*a)
 
         binops = {
             "+": operator.add, "-": operator.sub, "*": operator.mul,
@@ -269,4 +351,169 @@ class RapidsSession:
             return Frame.from_dict(
                 {n: c.isna_np().astype(np.float64)
                  for n, c in zip(v.names, v.vecs())})
+
+        if op in _UNARY:
+            fn = _UNARY[op]
+            v = a[0]
+            if isinstance(v, (int, float)):
+                return float(fn(np.asarray(v, np.float64)))
+            return Frame({n: Vec(fn(c.numeric_np()).astype(np.float64), "real")
+                          for n, c in zip(v.names, v.vecs())})
+        if op == "round":
+            digits = int(a[1]) if len(a) > 1 else 0
+            v = a[0]
+            return Frame({n: Vec(np.round(c.numeric_np(), digits), "real")
+                          for n, c in zip(v.names, v.vecs())})
+        if op == "signif":
+            digits = int(a[1]) if len(a) > 1 else 6
+            v = a[0]
+
+            def sig(c):
+                with np.errstate(all="ignore"):
+                    mag = np.floor(np.log10(np.abs(c)))
+                    f = 10.0 ** (digits - 1 - mag)
+                    out = np.round(c * f) / f
+                return np.where(np.isfinite(c) & (c != 0), out, c)
+
+            return Frame({n: Vec(sig(c.numeric_np()), "real")
+                          for n, c in zip(v.names, v.vecs())})
+
+        # ---- cumulative / reducers ----------------------------------------
+        if op in _CUM:
+            v = a[0]
+            return Frame({n: Vec(_CUM[op](c.numeric_np()).astype(np.float64), "real")
+                          for n, c in zip(v.names, v.vecs())})
+        if op == "var":
+            c = a[0]._col0()
+            return float(np.nanvar(c, ddof=1))
+        if op == "cor":
+            x, y = a[0], a[1]
+            return float(np.corrcoef(x._col0(), y._col0())[0, 1])
+        if op in ("any", "all"):
+            c = (a[0]._col0() if isinstance(a[0], Frame)
+                 else np.asarray(a[0], np.float64))
+            c = c[~np.isnan(c)]
+            return float(getattr(np, op)(c != 0))
+        if op in ("any.na", "anyNA"):
+            return float(any(v.isna_np().any() for v in a[0].vecs()))
+        if op in ("which.max", "which.min"):
+            c = a[0]._col0()
+            f = np.nanargmax if op == "which.max" else np.nanargmin
+            return Frame.from_dict({op: np.asarray([float(f(c))])})
+        if op == "which":
+            c = (a[0]._col0() if isinstance(a[0], Frame)
+                 else np.asarray(a[0], np.float64))
+            return Frame.from_dict({"which": np.nonzero(c != 0)[0].astype(np.float64)})
+        if op == "prod":
+            return float(np.nanprod(a[0]._col0()))
+
+        # ---- predicates / levels ------------------------------------------
+        if op in ("is.factor", "isfactor"):
+            return float(all(v.type == "enum" for v in a[0].vecs()))
+        if op in ("is.numeric",):
+            return float(all(v.type in ("int", "real") for v in a[0].vecs()))
+        if op in ("is.character",):
+            return float(all(v.type == "string" for v in a[0].vecs()))
+        if op == "levels":
+            v = a[0].vecs()[0]
+            dom = v.domain or []
+            return Frame.from_dict({"levels": np.asarray(dom, dtype=object)},
+                                   column_types={"levels": "enum"})
+        if op == "nlevels":
+            return float(a[0].vecs()[0].nlevels)
+        if op == "nchar":
+            return a[0].nchar()
+        if op == "substring":
+            fr = a[0]
+            start = int(a[1])
+            end = int(a[2]) if len(a) > 2 else None
+            return fr.substring(start, end)
+        if op == "match":
+            fr, table = a[0], a[1]
+            v = fr.vecs()[0]
+            labels = ([str(t) for t in table] if isinstance(table, list)
+                      else [str(table)])
+            if v.type == "enum":
+                vals = np.asarray(
+                    [v.domain[c] if c >= 0 else None for c in np.asarray(v.data)],
+                    dtype=object)
+            else:
+                vals = v.numeric_np().astype(object)
+            lut = {lbl: i + 1 for i, lbl in enumerate(labels)}  # R: 1-based
+            out = np.asarray([float(lut.get(str(x), np.nan))
+                              if x is not None else np.nan for x in vals])
+            return Frame.from_dict({"match": out})
+
+        # ---- random / misc -------------------------------------------------
+        if op == "h2o.runif":
+            fr, seed = a[0], int(a[1]) if len(a) > 1 else -1
+            rng = np.random.default_rng(None if seed < 0 else seed)
+            return Frame.from_dict({"rnd": rng.random(fr.nrow)})
+
+        # ---- group-by / apply (AstGroup, AstDdply, AstApply) --------------
+        if op == "GB":
+            fr, by = a[0], a[1]
+            by_names = [fr.names[int(i)] for i in by]
+            gb = fr.group_by(by_names)
+            i = 2
+            while i + 2 < len(a) + 1:
+                agg = str(a[i])
+                coli = int(a[i + 1])
+                # a[i+2] is the NA-handling mode ("all"/"rm"/"ignore")
+                col = fr.names[coli]
+                fn = {"nrow": "count", "mean": "mean", "sum": "sum",
+                      "min": "min", "max": "max", "sdev": "sd", "sd": "sd",
+                      "var": "var", "median": "median", "mode": "mode"}.get(agg)
+                if fn is None:
+                    raise ValueError(f"Rapids GB: unknown aggregate {agg!r}")
+                getattr(gb, fn)(col) if fn != "count" else gb.count()
+                i += 3
+            return gb.get_frame()
+        if op == "ddply":
+            fr, by, fun = a[0], a[1], a[2]
+            if isinstance(fun, str):
+                # bare prim name as the function (e.g. mean)
+                fun = (lambda name: lambda f: self._apply(name, [f]))(fun)
+            by_names = [fr.names[int(i)] for i in by]
+            cols = [np.asarray(fr.vec(n).data) for n in by_names]
+            keys = list(zip(*[c.tolist() for c in cols])) if cols else []
+            rows = {}
+            for r, k in enumerate(keys):
+                rows.setdefault(k, []).append(r)
+            out_keys, out_vals = [], []
+            for k, idx in sorted(rows.items()):
+                sub = fr.take(np.asarray(idx))
+                res = fun(sub)
+                if isinstance(res, Frame):
+                    res = [float(v.numeric_np()[0]) for v in res.vecs()]
+                elif not isinstance(res, list):
+                    res = [float(res)]
+                out_keys.append(k)
+                out_vals.append(res)
+            d = {}
+            for j, n in enumerate(by_names):
+                v = fr.vec(n)
+                kk = np.asarray([k[j] for k in out_keys])
+                d[n] = (np.asarray(
+                    [v.domain[int(c)] if c >= 0 else None for c in kk],
+                    dtype=object)
+                        if v.type == "enum" else kk.astype(np.float64))
+            for j in range(len(out_vals[0]) if out_vals else 0):
+                d[f"ddply_C{j + 1}"] = np.asarray([r[j] for r in out_vals])
+            return Frame.from_dict(
+                d, column_types={n: "enum" for n in by_names
+                                 if fr.vec(n).type == "enum"})
+        if op == "apply":
+            fr, margin, fun = a[0], int(a[1]), a[2]
+            if isinstance(fun, str):
+                fun = (lambda name: lambda f: self._apply(name, [f]))(fun)
+            if margin == 2:
+                outs = {n: fun(fr[[n]]) for n in fr.names}
+                return Frame.from_dict(
+                    {n: np.asarray([float(v if not isinstance(v, Frame)
+                                          else v._col0()[0])])
+                     for n, v in outs.items()})
+            vals = [float(fun(fr.take(np.asarray([r]))))
+                    for r in range(fr.nrow)]
+            return Frame.from_dict({"apply": np.asarray(vals)})
         raise ValueError(f"Rapids: unknown op {op!r}")
