@@ -214,17 +214,26 @@ class GLMModel(H2OModel):
         out[-1] = b[-1] - float((b[:-1] * self.dinfo.means / self.dinfo.stds).sum())
         return out
 
-    def _eta(self, frame: Frame) -> np.ndarray:
-        X = self.dinfo.transform(frame)
-        Xi = np.concatenate([X, np.ones((X.shape[0], 1), np.float32)], axis=1)
-        return Xi @ np.asarray(self.beta).T  # (n,) or (n, K)
+    def _eta_dev(self, frame: Frame, Xd=None):
+        """Linear predictor as a DEVICE array. Expansion + matvec run on
+        device (compact upload, see DataInfo.device_design); `Xd` lets the
+        training loop reuse its HBM design matrix for training metrics.
+        HIGHEST matmul precision keeps f32 logits exact (the TPU default
+        truncates matmul operands to bf16)."""
+        if Xd is None:
+            Xd = self.dinfo.device_design(frame, fit=False, add_intercept=True)
+        beta = jnp.asarray(np.asarray(self.beta, np.float32))
+        return jnp.matmul(Xd, beta.T, precision=jax.lax.Precision.HIGHEST)
 
-    def _score(self, frame: Frame) -> np.ndarray:
-        eta = self._eta(frame)
+    def _eta(self, frame: Frame, Xd=None) -> np.ndarray:
+        return np.asarray(self._eta_dev(frame, Xd=Xd), np.float64)
+
+    def _score(self, frame: Frame, Xd=None) -> np.ndarray:
+        # link inverse applied on device: ONE n-sized transfer per scoring
+        eta = self._eta_dev(frame, Xd=Xd)
         if self.family == "multinomial":
-            e = np.exp(eta - eta.max(axis=1, keepdims=True))
-            return e / e.sum(axis=1, keepdims=True)
-        return np.asarray(_linkinv(self.family, jnp.asarray(eta)))
+            return np.asarray(jax.nn.softmax(eta, axis=1), np.float64)
+        return np.asarray(_linkinv(self.family, eta), np.float64)
 
     def predict(self, test_data: Frame) -> Frame:
         out = self._score(test_data)
@@ -241,8 +250,8 @@ class GLMModel(H2OModel):
             return Frame.from_dict(d, column_types={"predict": "enum"})
         return Frame.from_dict({"predict": out})
 
-    def _make_metrics(self, frame: Frame):
-        out = self._score(frame)
+    def _make_metrics(self, frame: Frame, Xd=None):
+        out = self._score(frame, Xd=Xd)
         yv = frame.vec(self.y)
         if self.family in ("binomial", "quasibinomial"):
             return ModelMetricsBinomial.make(np.asarray(yv.data), out)
@@ -404,7 +413,12 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         model = GLMModel(self, x, y, dinfo, family, beta, domain,
                          lambda_best=lam_best, stderr=stderr, full_path=full_path)
         model.covmat = cov  # (p+1)² dispersion-scaled covariance (p-values)
-        model.training_metrics = model._make_metrics(train)
+        # reuse the training design matrix already in HBM — single-device
+        # only: a row-sharded Xd may span non-addressable devices (multi-
+        # host mesh) and padded tail rows would corrupt metrics
+        model.training_metrics = model._make_metrics(
+            train,
+            Xd=Xd if (cloud.size == 1 and int(Xd.shape[0]) == n) else None)
         if valid is not None:
             model.validation_metrics = model._make_metrics(valid)
         # GLM varimp = |standardized coefficient| (GLMModel standardized coef magnitudes)
