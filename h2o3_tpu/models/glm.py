@@ -85,6 +85,90 @@ def _gram_step(X, y, w, beta, family: str, tweedie_p: float = 1.5):
     return gram, xy
 
 
+@functools.partial(jax.jit, static_argnames=("family", "max_iter",
+                                              "non_negative"))
+def _glm_path_device(X, y, w, Xe, ye, we, lams, alpha, n_obs, beta0,
+                     beta_eps, tweedie_p, family: str, max_iter: int,
+                     non_negative: bool):
+    """The WHOLE elastic-net regularization path as one XLA program.
+
+    lax.scan over λ (warm-started), lax.while_loop IRLS per λ, penalized
+    solve on device (Cholesky for ridge, 500-step projected ISTA when
+    l1>0), deviance evaluated against (Xe, ye, we) — the validation set
+    when given, else training. Replaces ~nlambda·iters host round-trips
+    (gram D2H + host solve each) with ONE dispatch; the caller re-solves
+    the chosen λ on host in f64 for the reported coefficients
+    (hex/glm/GLM.java lambda search, computeSubmodel loop)."""
+    P = X.shape[1]
+    pen_mask = jnp.ones(P, jnp.float32).at[P - 1].set(0.0)
+
+    def solve_pen(gram, xy, lam, beta_prev):
+        l2 = lam * (1.0 - alpha) * n_obs
+        l1 = lam * alpha * n_obs
+        A = gram + jnp.diag(pen_mask * l2)
+
+        def ridge(_):
+            return jnp.linalg.solve(
+                A + 1e-6 * jnp.eye(P, dtype=jnp.float32), xy)
+
+        def ista(_):
+            L = jnp.linalg.eigvalsh(A)[-1] + 1e-8
+            thr = l1 / L * pen_mask
+
+            def body(i, b):
+                b_new = b - (A @ b - xy) / L
+                b_new = jnp.sign(b_new) * jnp.maximum(
+                    jnp.abs(b_new) - thr, 0.0)
+                if non_negative:
+                    b_new = b_new.at[:P - 1].set(
+                        jnp.maximum(b_new[:P - 1], 0.0))
+                return b_new
+
+            return jax.lax.fori_loop(0, 500, body, beta_prev)
+
+        return jax.lax.cond((l1 > 0) | non_negative, ista, ridge, None)
+
+    def deviance(beta):
+        eta = jnp.matmul(Xe, beta, precision=jax.lax.Precision.HIGHEST)
+        mu = _linkinv(family, eta)
+        if family in ("binomial", "quasibinomial"):
+            mu_c = jnp.clip(mu, 1e-15, 1 - 1e-15)
+            return -2.0 * jnp.sum(
+                we * (ye * jnp.log(mu_c) + (1 - ye) * jnp.log(1 - mu_c)))
+        return jnp.sum(we * (ye - mu) ** 2)
+
+    def fit_one(beta, lam):
+        def cond(state):
+            it, b, delta = state
+            return (it < max_iter) & (delta >= beta_eps)
+
+        def body(state):
+            it, b, _ = state
+            eta = jnp.matmul(X, b, precision=jax.lax.Precision.HIGHEST)
+            mu = _linkinv(family, eta)
+            W, z = _irls_weights(family, eta, mu, y, tweedie_p)
+            Ww = W * w
+            gram = jnp.einsum("np,n,nq->pq", X, Ww, X,
+                              precision=jax.lax.Precision.HIGHEST)
+            xy = jnp.einsum("np,n->p", X, Ww * z,
+                            precision=jax.lax.Precision.HIGHEST)
+            nb = solve_pen(gram, xy, lam, b)
+            return it + 1, nb, jnp.max(jnp.abs(nb - b))
+
+        _, beta, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), beta, jnp.float32(jnp.inf)))
+        # f32 divergence guard: a non-finite β would NaN-poison the
+        # warm-start carry for every later λ — reset instead, and report
+        # +inf deviance so this λ can never be selected
+        ok = jnp.isfinite(beta).all()
+        beta = jnp.where(ok, beta, jnp.zeros_like(beta))
+        dev = jnp.where(ok, deviance(beta), jnp.float32(jnp.inf))
+        return beta, (beta, dev)
+
+    _, (betas, devs) = jax.lax.scan(fit_one, beta0, lams)
+    return betas, devs
+
+
 def _solve_penalized(gram, xy, lam, alpha, n_obs, intercept_idx, beta0,
                      non_negative=False):
     """Solve the IRLS quadratic with elastic-net penalty (host, p×p).
@@ -476,6 +560,36 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         if ratio <= 0:
             ratio = 1e-4 if n > nfeat else 1e-2
         lams = lam_max * np.power(ratio, np.linspace(0, 1, nlam))
+        from ..parallel import mesh as cloudlib
+
+        if cloudlib.cloud().size == 1:
+            # the whole path runs as ONE device program (f32); the chosen λ
+            # is then re-solved on host in f64 for the reported coefficients
+            Xe, ye, we = vdata if vdata is not None else (Xd, yd, wd)
+            betas, devs = _glm_path_device(
+                Xd, jnp.asarray(yd, jnp.float32), jnp.asarray(wd, jnp.float32),
+                Xe, jnp.asarray(ye, jnp.float32), jnp.asarray(we, jnp.float32),
+                jnp.asarray(lams, jnp.float32), float(alpha),
+                float(np.asarray(wd).sum()),
+                jnp.zeros(Xd.shape[1], jnp.float32), float(beta_eps),
+                float(tweedie_p), family=family, max_iter=int(max_iter),
+                non_negative=bool(self._parms.get("non_negative")),
+            )
+            betas = np.asarray(betas, np.float64)
+            devs = np.asarray(devs, np.float64)
+            finite = np.isfinite(devs)
+            if finite.any():
+                path = [(float(lv), betas[i]) for i, lv in enumerate(lams)]
+                best_i = int(np.argmin(np.where(finite, devs, np.inf)))
+                lam_best = float(lams[best_i])
+                beta = self._irls_warm(Xd, yd, wd, family, lam_best, alpha,
+                                       max_iter, beta_eps, tweedie_p,
+                                       betas[best_i].copy())
+                return beta, lam_best, path
+            # every λ diverged in f32 — fall through to the robust host loop
+
+        # host path: multi-host mesh (vdata is process-local and may not be
+        # mixed with row-sharded arrays in one jit), or f32 divergence
         beta = np.zeros(Xd.shape[1], np.float64)
         path = []
         best = (None, np.inf, 0.0)
