@@ -384,12 +384,35 @@ class SharedTreeModel(H2OModel):
         X, _, _ = frame_to_matrix(frame, self.x, expected_domains=self.bm.domains)
         return X
 
+    def _padded_forest(self, k: int):
+        """Class-k forest with ntrees padded to the next power of two
+        (zero-valued unsplit trees add 0 to the margin), cached on the
+        model: models differing only in tree count share one compiled
+        scoring program — AutoML/SE score many models per run — and
+        repeated scoring reuses the same backing arrays."""
+        cache = self.__dict__.setdefault("_padded_forests", {})
+        if k not in cache:
+            stacked = self.forest[k]
+            nt = int(np.asarray(stacked.feat).shape[0])
+            bucket = 1 << (nt - 1).bit_length() if nt else 0
+            if bucket != nt:
+                padn = bucket - nt
+                stacked = treelib.Tree(*[
+                    np.concatenate([np.asarray(f), np.zeros(
+                        (padn,) + np.asarray(f).shape[1:],
+                        np.asarray(f).dtype)], axis=0)
+                    for f in stacked
+                ])
+            cache[k] = stacked
+        return cache[k]
+
     # margin(s) on raw feature matrix
     def _margins(self, X: np.ndarray) -> np.ndarray:
         Xj = jnp.asarray(X, jnp.float32)
         outs = []
-        for k, stacked in enumerate(self.forest):
-            s = treelib.predict_forest_raw(stacked, Xj, self.max_depth)
+        for k in range(len(self.forest)):
+            s = treelib.predict_forest_raw(self._padded_forest(k), Xj,
+                                           self.max_depth)
             f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[k]
             outs.append(np.asarray(s, np.float64) + f0k)
         return np.column_stack(outs)
