@@ -673,6 +673,33 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # clamp nbins to max categorical cardinality like nbins_cats
         max_card = int(max([len(d) for d, c in zip(doms, is_cat) if c and d], default=0))
         nbins = max(tp["nbins"] + 1, min(max_card + 1, 1 << 10))
+        # memory-feasibility depth clamp: the static level-complete heap
+        # materializes ~2^D·F·nbins per-node histograms at the deepest level
+        # (~96 B/bin-slot empirical, incl. XLA tile padding and co-resident
+        # sibling buffers). The reference's dynamic trees shrink with the
+        # data; the static heap must cap depth or the compile OOMs HBM
+        # (e.g. DRF's default max_depth=20 at nbins=20 needs ~22 GB).
+        # Skipped under checkpoint= (the prior model's heap depth governs —
+        # new trees must concatenate onto the same heap shape).
+        requested_depth = tp["max_depth"]
+        if self._parms.get("checkpoint") is None:
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+                hbm_budget = int(stats.get("bytes_limit", 0)) // 2 or (8 << 30)
+            except Exception:
+                hbm_budget = 8 << 30
+            feas = tp["max_depth"]
+            while feas > 4 and (1 << feas) * F * nbins * 96 > hbm_budget:
+                feas -= 1
+            if tp["max_depth"] > feas:
+                from ..runtime.log import Log
+
+                Log.warn(
+                    f"max_depth={tp['max_depth']} clamped to {feas}: the "
+                    f"level-complete heap's deepest histograms (F={F}, "
+                    f"nbins={nbins}) would exceed the HBM budget "
+                    f"({hbm_budget >> 30} GiB)")
+                tp["max_depth"] = feas
         _ph.mark("frame_to_matrix")
         bm = build_bins(
             X, nbins=nbins, histogram_type=tp["histogram_type"], names=list(x),
@@ -803,11 +830,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
             pm = ckpt.model if hasattr(ckpt, "model") else ckpt
             if not isinstance(pm, SharedTreeModel):
                 raise ValueError("checkpoint must be a prior tree model")
-            if pm.max_depth != tp["max_depth"] or pm.nclass != nclass:
+            # compatible iff the user asks for the prior heap depth OR the
+            # same ORIGINAL depth the prior fit clamped down from (the HBM
+            # clamp must not break continuation with identical parameters)
+            depth_ok = tp["max_depth"] in (
+                pm.max_depth, getattr(pm, "requested_max_depth", pm.max_depth))
+            if not depth_ok or pm.nclass != nclass:
                 raise ValueError(
                     "checkpoint incompatible: max_depth/nclass must match "
                     "(SharedTree checkpoint parameter compatibility checks)"
                 )
+            tp["max_depth"] = pm.max_depth
             # re-bin the CURRENT training data with the prior model's edges so
             # split bins stay aligned with the restored trees
             bm = pm.bm
@@ -1143,6 +1176,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             forest, tp["max_depth"], mode=self._mode,
         )
         model.covers = covers_by_class
+        model.requested_max_depth = requested_depth  # pre-clamp user value
         model.balance_dists = balance_dists
         model.calibrator = None
         if self._parms.get("calibrate_model"):
