@@ -261,10 +261,76 @@ def parse_arff(path: str) -> Frame:
     return Frame(vecs, key=os.path.basename(path))
 
 
+def _arrow_table_to_frame(table, key: Optional[str] = None) -> Frame:
+    """Arrow table → Frame. Numerics stay floating (NaN = NA), strings/
+    dictionaries become enum vecs built from Arrow's EXPLICIT null mask
+    (unlike CSV, '' / 'NA' are legitimate values here), booleans become
+    0/1, timestamps become ms-since-epoch 'time' columns (NaT → NaN)."""
+    import pyarrow as pa
+
+    vecs: Dict[str, Vec] = {}
+    for name, col in zip(table.column_names, table.columns):
+        arr = col.combine_chunks() if col.num_chunks != 1 else col.chunk(0)
+        pyt = arr.type
+        if pa.types.is_dictionary(pyt):
+            arr = arr.dictionary_decode()
+            pyt = arr.type
+        if pa.types.is_string(pyt) or pa.types.is_large_string(pyt):
+            vals = arr.to_numpy(zero_copy_only=False)   # object, None=null
+            valid = np.asarray([v is not None for v in vals])
+            uniq = sorted({str(v) for v in vals[valid]})
+            lut = {lbl: i for i, lbl in enumerate(uniq)}
+            codes = np.asarray(
+                [lut[str(v)] if ok else -1 for v, ok in zip(vals, valid)],
+                np.int32)
+            vecs[name] = Vec(codes, "enum", domain=uniq)
+        elif pa.types.is_boolean(pyt):
+            vals = arr.to_numpy(zero_copy_only=False)
+            vecs[name] = Vec(np.asarray(
+                [np.nan if v is None else float(v) for v in vals],
+                np.float32), "int")
+        elif pa.types.is_timestamp(pyt) or pa.types.is_date(pyt):
+            v = arr.cast(pa.timestamp("ms")).to_numpy(zero_copy_only=False)
+            nat = np.isnat(v)
+            out = v.astype("datetime64[ms]").astype(np.float64)
+            out[nat] = np.nan
+            vecs[name] = Vec(out, "time")
+        elif (pa.types.is_integer(pyt) or pa.types.is_floating(pyt)
+              or pa.types.is_decimal(pyt)):
+            np_col = arr.to_numpy(zero_copy_only=False).astype(np.float64)
+            vecs[name] = Vec.from_numpy(np_col)
+        else:
+            raise ValueError(
+                f"unsupported Arrow column type {pyt} in column {name!r} "
+                "(binary/list/struct columns have no Frame representation)")
+    return Frame(vecs, key=key)
+
+
+def parse_parquet(path: str) -> Frame:
+    """Parquet ingest via pyarrow — the `h2o-parsers/h2o-parquet-parser`
+    extension's role (Parquet is columnar already; no tokenizing phase)."""
+    import pyarrow.parquet as pq
+
+    return _arrow_table_to_frame(pq.read_table(path),
+                                 key=os.path.basename(path))
+
+
+def parse_orc(path: str) -> Frame:
+    """ORC ingest via pyarrow — the `h2o-parsers/h2o-orc-parser` role."""
+    from pyarrow import orc
+
+    return _arrow_table_to_frame(orc.read_table(path),
+                                 key=os.path.basename(path))
+
+
 def import_file(path: str, **kw) -> Frame:
     """`h2o.import_file` — dispatch by extension (`ParseDataset.parse`)."""
     if path.endswith((".svm", ".svmlight")):
         return parse_svmlight(path)
     if path.endswith(".arff"):
         return parse_arff(path)
+    if path.endswith((".parquet", ".pq")):
+        return parse_parquet(path)
+    if path.endswith(".orc"):
+        return parse_orc(path)
     return parse_csv(path, **kw)

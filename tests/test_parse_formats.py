@@ -194,3 +194,67 @@ def test_multihost_launcher_single_process(cloud1):
     facts = initialize_multihost()
     assert facts["process_count"] >= 1
     assert facts["global_devices"] >= facts["local_devices"] >= 1
+
+
+def test_parquet_round_trip(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    t = pa.table({
+        "num": pa.array([1.5, 2.5, None, 4.0]),
+        "cat": pa.array(["a", "b", None, "a"]),
+        "flag": pa.array([True, False, True, None]),
+        "count": pa.array([1, 2, 3, 4], type=pa.int64()),
+    })
+    p = tmp_path / "t.parquet"
+    pq.write_table(t, p)
+    fr = h2o.import_file(str(p))
+    assert fr.names == ["num", "cat", "flag", "count"]
+    assert fr.nrow == 4
+    num = fr.vec("num").numeric_np()
+    assert np.isnan(num[2]) and num[0] == 1.5
+    assert fr.vec("cat").type == "enum"
+    assert fr.vec("cat").domain == ["a", "b"]
+    np.testing.assert_allclose(fr.vec("count").numeric_np(), [1, 2, 3, 4])
+
+
+def test_orc_round_trip(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    from pyarrow import orc
+
+    t = pa.table({"x": pa.array([1.0, 2.0, 3.0]),
+                  "s": pa.array(["u", "v", "u"])})
+    p = tmp_path / "t.orc"
+    orc.write_table(t, p)
+    fr = h2o.import_file(str(p))
+    assert fr.nrow == 3 and fr.vec("s").type == "enum"
+    np.testing.assert_allclose(fr.vec("x").numeric_np(), [1, 2, 3])
+
+
+def test_parquet_timestamps_strings_and_errors(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import datetime
+
+    import pyarrow.parquet as pq
+
+    t = pa.table({
+        "ts": pa.array([datetime.datetime(2020, 1, 1), None,
+                        datetime.datetime(2020, 1, 2)]),
+        # '' and 'NA' are REAL values in parquet (nulls are explicit)
+        "s": pa.array(["", "NA", None]),
+    })
+    p = tmp_path / "ts.parquet"
+    pq.write_table(t, p)
+    fr = h2o.import_file(str(p))
+    assert fr.key == "ts.parquet"
+    ts = fr.vec("ts").numeric_np()
+    assert np.isnan(ts[1]) and ts[2] - ts[0] == 86400_000.0
+    v = fr.vec("s")
+    assert v.domain == ["", "NA"]
+    assert np.asarray(v.data).tolist() == [0, 1, -1]
+    # unsupported binary column -> clear error naming the column
+    t2 = pa.table({"b": pa.array([b"ab", b"cd"], type=pa.binary())})
+    p2 = tmp_path / "bin.parquet"
+    pq.write_table(t2, p2)
+    with pytest.raises(ValueError, match="'b'"):
+        h2o.import_file(str(p2))
