@@ -87,6 +87,33 @@ upload_file = import_file
 
 
 def H2OFrame_from_python(data, column_types=None) -> Frame:
+    # pandas DataFrame → dict of columns: missing values normalized to
+    # None/NaN (pd.NA and NaN-in-object would break enum inference),
+    # datetimes → ms-since-epoch 'time' vecs, labels coerced to str
+    if hasattr(data, "to_dict") and hasattr(data, "columns") \
+            and not isinstance(data, dict):
+        import pandas as pd
+
+        cols, auto_types = {}, {}
+        for c in data.columns:
+            s = data[c]
+            name = str(c)
+            if pd.api.types.is_datetime64_any_dtype(s.dtype):
+                v = s.to_numpy()
+                out = v.astype("datetime64[ms]").astype(np.float64)
+                out[np.isnat(v)] = np.nan
+                cols[name] = out
+                auto_types[name] = "time"
+            elif (s.dtype == object
+                  or isinstance(s.dtype, pd.CategoricalDtype)
+                  or pd.api.types.is_string_dtype(s.dtype)):
+                cols[name] = s.astype(object).where(s.notna(), None).to_numpy()
+            else:
+                cols[name] = s.to_numpy()
+        if column_types:
+            auto_types.update({str(k): v for k, v in column_types.items()})
+        column_types = auto_types or None
+        data = cols
     if isinstance(data, dict):
         fr = Frame.from_dict(data, column_types=column_types)
     else:
@@ -143,7 +170,7 @@ def export_file(frame: Frame, path: str, force: bool = False, sep: str = ",",
 
     if _os.path.exists(path) and not force:
         raise FileExistsError(f"{path} exists; pass force=True")
-    cols = frame.as_data_frame()
+    cols = frame.as_data_frame(use_pandas=False)
     names = frame.names
     with open(path, "w", newline="") as f:
         wr = _csv.writer(f, delimiter=sep, quoting=_csv.QUOTE_MINIMAL)

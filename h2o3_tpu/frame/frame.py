@@ -175,8 +175,9 @@ class Frame:
         names = list(names) if names else self.names
         return np.column_stack([self._vecs[n].numeric_np() for n in names])
 
-    def as_data_frame(self):
-        """dict-of-columns (decoded enums), pandas-free."""
+    def as_data_frame(self, use_pandas: bool = True):
+        """pandas DataFrame (h2o-py default), or dict-of-columns with
+        decoded enums when use_pandas=False / pandas is unavailable."""
         out = {}
         for n, v in self._vecs.items():
             if v.type == "enum":
@@ -186,6 +187,13 @@ class Frame:
                 out[n] = v.to_numpy()
             else:
                 out[n] = v.numeric_np()
+        if use_pandas:
+            try:
+                import pandas as pd
+
+                return pd.DataFrame(out)
+            except ImportError:
+                pass
         return out
 
     # -- summaries (Frame.summary / RollupStats) -----------------------------

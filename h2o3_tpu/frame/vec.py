@@ -81,6 +81,8 @@ class Vec:
             full[~mask] = codes.astype(np.int32)
             return Vec(full, "enum", domain=[str(d) for d in domain])
         col = np.asarray(col)
+        if type_hint == "time":
+            return Vec(col.astype(np.float64), "time")
         if type_hint == "enum":
             valid = ~np.isnan(col.astype(np.float64))
             domain, codes = np.unique(col[valid], return_inverse=True)

@@ -124,3 +124,41 @@ def test_h2o_module_functions(tmp_path, cloud1):
     m.train(x=["a"], y="y", training_frame=tr)
     assert h2o.get_model(m.model_id) is m.model
     assert m.model_id in h2o.ls()
+
+
+def test_pandas_interop(cloud1):
+    pd = pytest.importorskip("pandas")
+    df = pd.DataFrame({"x": [1.0, 2.0, 3.0],
+                       "c": ["a", "b", "a"],
+                       "n": [1, 2, 3]})
+    fr = h2o.H2OFrame_from_python(df)
+    assert fr.names == ["x", "c", "n"]
+    assert fr.vec("c").type == "enum" and fr.vec("c").domain == ["a", "b"]
+    np.testing.assert_allclose(fr.vec("x").numeric_np(), [1, 2, 3])
+    back = fr.as_data_frame()
+    assert isinstance(back, pd.DataFrame)
+    assert list(back["c"]) == ["a", "b", "a"]
+    d = fr.as_data_frame(use_pandas=False)
+    assert isinstance(d, dict)
+
+
+def test_pandas_missing_and_datetime(cloud1):
+    pd = pytest.importorskip("pandas")
+    df = pd.DataFrame({
+        "c": ["a", np.nan, "b"],
+        "s": pd.array(["x", pd.NA, "y"], dtype="string"),
+        "t": pd.to_datetime(["2020-01-01", None, "2020-01-02"]),
+    })
+    fr = h2o.H2OFrame_from_python(df)
+    v = fr.vec("c")
+    assert v.domain == ["a", "b"]
+    assert np.asarray(v.data).tolist() == [0, -1, 1]
+    assert fr.vec("s").domain == ["x", "y"]
+    t = fr.vec("t")
+    assert t.type == "time"
+    ts = t.numeric_np()
+    assert np.isnan(ts[1]) and ts[2] - ts[0] == 86400_000.0
+    # non-string column label + typed hint keyed by the original label
+    df2 = pd.DataFrame({0: [1.0, 2.0, 1.0]})
+    fr2 = h2o.H2OFrame_from_python(df2, column_types={0: "enum"})
+    assert fr2.vec("0").type == "enum"
