@@ -163,14 +163,37 @@ def merge(x: Frame, y: Frame, all_x: bool = False, all_y: bool = False,
     return _m(x, y, by=by_x, all_x=all_x, all_y=all_y)
 
 
+def assign(data: Frame, xid: str) -> Frame:
+    """`h2o.assign` — rebind a frame to a new DKV key (water/rapids assign)."""
+    if xid == data.key:
+        raise ValueError("new key must differ from the current key")
+    _DKV.remove(data.key)
+    data.key = xid
+    _DKV.put(xid, data)
+    return data
+
+
 def export_file(frame: Frame, path: str, force: bool = False, sep: str = ",",
-                header: bool = True, quote_header: bool = False) -> str:
-    """`h2o.export_file` — write a Frame as CSV (water/api frames export)."""
+                header: bool = True, quote_header: bool = False,
+                format: Optional[str] = None) -> str:
+    """`h2o.export_file` — write a Frame as CSV, or Parquet when
+    format="parquet" (or, with no explicit format, the path ends in
+    .parquet/.pq). An explicit format always wins over the extension.
+    (water/api frames export; the reference's export_file parquet
+    support.)"""
     import csv as _csv
 
     if _os.path.exists(path) and not force:
         raise FileExistsError(f"{path} exists; pass force=True")
     cols = frame.as_data_frame(use_pandas=False)
+    if format == "parquet" or (format is None
+                               and path.endswith((".parquet", ".pq"))):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(
+            pa.table({n: pa.array(cols[n]) for n in frame.names}), path)
+        return path
     names = frame.names
     with open(path, "w", newline="") as f:
         wr = _csv.writer(f, delimiter=sep, quoting=_csv.QUOTE_MINIMAL)

@@ -196,6 +196,44 @@ class Frame:
                 pass
         return out
 
+    def apply(self, fun, axis: int = 0) -> "Frame":
+        """`H2OFrame.apply` — map a python callable over columns (axis=0)
+        or rows (axis=1). The h2o-py client compiles lambdas to Rapids
+        `{ x . body }` ASTs; in-process the callable runs directly. The
+        callable receives a single-column (or single-row) Frame and must
+        return a scalar or a Frame."""
+        if axis not in (0, 1):
+            raise ValueError("axis must be 0 (columns) or 1 (rows)")
+        if axis == 0:
+            out = {}
+            reduced = None
+            for n in self.names:
+                r = fun(self[[n]])
+                if isinstance(r, Frame) and r.nrow == self.nrow:
+                    # transform lambda: keeps the full column
+                    col = r._col0()
+                    is_red = False
+                else:
+                    col = np.asarray(
+                        [float(r._col0()[0]) if isinstance(r, Frame)
+                         else float(r)])
+                    is_red = True
+                if reduced is None:
+                    reduced = is_red
+                elif reduced != is_red:
+                    raise ValueError(
+                        "apply: callable returned a mix of reductions and "
+                        "full columns across columns")
+                out[n] = col
+            return Frame.from_dict(out)
+        vals = []
+        for i in range(self.nrow):
+            r = fun(self.take(np.asarray([i])))
+            if isinstance(r, Frame):
+                r = float(r._col0()[0])
+            vals.append(float(r))
+        return Frame.from_dict({"apply": np.asarray(vals)})
+
     # -- summaries (Frame.summary / RollupStats) -----------------------------
     def describe(self) -> Dict[str, Dict[str, float]]:
         out = {}

@@ -162,3 +162,33 @@ def test_pandas_missing_and_datetime(cloud1):
     df2 = pd.DataFrame({0: [1.0, 2.0, 1.0]})
     fr2 = h2o.H2OFrame_from_python(df2, column_types={0: "enum"})
     assert fr2.vec("0").type == "enum"
+
+
+def test_assign_apply_export_parquet(tmp_path, cloud1):
+    fr = h2o.H2OFrame_from_python({"x": [1.0, 2.0, 3.0], "y": [4.0, 5.0, 6.0]})
+    old_key = fr.key
+    h2o.assign(fr, "renamed")
+    assert h2o.get_frame("renamed") is fr
+    with pytest.raises(KeyError):
+        h2o.get_frame(old_key)
+    # column apply
+    mx = fr.apply(lambda c: c.vec(c.names[0]).max(), axis=0)
+    assert mx.vec("x").numeric_np()[0] == 3.0
+    assert mx.vec("y").numeric_np()[0] == 6.0
+    # parquet export round trip
+    p = str(tmp_path / "out.parquet")
+    h2o.export_file(fr, p)
+    back = h2o.import_file(p)
+    np.testing.assert_allclose(back.vec("x").numeric_np(), [1, 2, 3])
+
+
+def test_apply_transform_and_format_override(tmp_path, cloud1):
+    fr = h2o.H2OFrame_from_python({"x": [1.0, 2.0, 3.0]})
+    doubled = fr.apply(lambda c: c * 2.0, axis=0)
+    np.testing.assert_allclose(doubled.vec("x").numeric_np(), [2, 4, 6])
+    with pytest.raises(ValueError, match="axis"):
+        fr.apply(lambda c: 0, axis=2)
+    # explicit csv format wins over a .parquet extension
+    p = str(tmp_path / "weird.parquet")
+    h2o.export_file(fr, p, format="csv")
+    assert open(p).readline().strip() == "x"
