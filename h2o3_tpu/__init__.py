@@ -355,7 +355,7 @@ def show_progress():
 def save_model(model, path: str = ".", force: bool = False, filename=None) -> str:
     from .mojo import save_model as _save
 
-    return _save(model, path, filename=filename)
+    return _save(model, path, filename=filename, force=force)
 
 
 def load_model(path: str):

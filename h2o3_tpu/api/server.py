@@ -122,6 +122,10 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Timeline$", "timeline"),
         ("GET", r"^/3/Profiler$", "profiler"),
         ("GET", r"^/3/Metadata/schemas$", "metadata_schemas"),
+        ("POST", r"^/3/Frames/([^/]+)/export$", "frame_export"),
+        ("POST", r"^/99/Models\.bin/([^/]+)$", "model_save"),
+        ("POST", r"^/99/Models\.bin$", "model_load"),
+        ("POST", r"^/3/Shutdown$", "shutdown"),
     ]
 
     def log_message(self, fmt, *args):  # route access logs into our Log
@@ -253,6 +257,60 @@ class _Handler(BaseHTTPRequestHandler):
     def h_frame_delete(self, key):
         DKV.remove(key)
         self._send(dict())
+
+    @staticmethod
+    def _flag(p, name) -> bool:
+        """REST booleans arrive as strings — 'false'/'0' must be False."""
+        v = p.get(name)
+        if isinstance(v, str):
+            return v.lower() in ("true", "t", "1")
+        return bool(v)
+
+    def h_frame_export(self, key):
+        """/3/Frames/{id}/export — write a frame to a server-side path
+        (water/api FramesHandler.export)."""
+        import h2o3_tpu as h2o
+
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise KeyError(key)
+        p = self._params()
+        h2o.export_file(fr, p["path"], force=self._flag(p, "force"))
+        self._send(dict(job=dict(status="DONE"), path=p["path"]))
+
+    def h_model_save(self, model_id):
+        """/99/Models.bin/{id} — persist a model artifact to a server-side
+        directory (the reference's `h2o.save_model` → /99/Models.bin)."""
+        import h2o3_tpu as h2o
+
+        p = self._params()
+        m = h2o.get_model(model_id)
+        path = h2o.save_model(m, p.get("dir") or ".",
+                              force=self._flag(p, "force"))
+        self._send(dict(path=path))
+
+    def h_model_load(self):
+        """/99/Models.bin — load a saved artifact. The offline scorer must
+        NOT clobber a live model under the same id (every model route
+        type-checks for H2OModel), so a taken id gets a _loaded suffix."""
+        import h2o3_tpu as h2o
+
+        p = self._params()
+        scorer = h2o.load_model(p["dir"] if "dir" in p else p["path"])
+        mid = base = scorer.meta.get("model_id", "loaded_model")
+        i = 0
+        while DKV.get(mid) is not None:
+            i += 1
+            mid = f"{base}_loaded{i if i > 1 else ''}"
+        DKV.put(mid, scorer)
+        self._send(dict(models=[dict(model_id=dict(name=mid))]))
+
+    def h_shutdown(self):
+        """/3/Shutdown — stop the REST server (water/api ShutdownHandler)."""
+        self._send(dict(result="shutting down"))
+        import threading
+
+        threading.Thread(target=self.server.shutdown, daemon=True).start()
 
     def h_builder_schema(self, algo):
         self._send(schemas.schema_for(algo))

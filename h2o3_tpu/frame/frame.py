@@ -204,34 +204,34 @@ class Frame:
         return a scalar or a Frame."""
         if axis not in (0, 1):
             raise ValueError("axis must be 0 (columns) or 1 (rows)")
+
+        def _normalize(r):
+            """callable result → ('col', ndarray) | ('scalar', float).
+            Comparison operators on Frames return bare ndarrays, so those
+            count as full columns too."""
+            if isinstance(r, Frame):
+                r = r._col0()
+            arr = np.asarray(r, np.float64)
+            if arr.ndim >= 1 and arr.size == self.nrow and self.nrow != 1:
+                return "col", arr.reshape(-1)
+            return "scalar", float(arr.reshape(-1)[0])
+
         if axis == 0:
             out = {}
             reduced = None
             for n in self.names:
-                r = fun(self[[n]])
-                if isinstance(r, Frame) and r.nrow == self.nrow:
-                    # transform lambda: keeps the full column
-                    col = r._col0()
-                    is_red = False
-                else:
-                    col = np.asarray(
-                        [float(r._col0()[0]) if isinstance(r, Frame)
-                         else float(r)])
-                    is_red = True
+                kind, v = _normalize(fun(self[[n]]))
+                is_red = kind == "scalar"
                 if reduced is None:
                     reduced = is_red
                 elif reduced != is_red:
                     raise ValueError(
                         "apply: callable returned a mix of reductions and "
                         "full columns across columns")
-                out[n] = col
+                out[n] = np.asarray([v]) if is_red else v
             return Frame.from_dict(out)
-        vals = []
-        for i in range(self.nrow):
-            r = fun(self.take(np.asarray([i])))
-            if isinstance(r, Frame):
-                r = float(r._col0()[0])
-            vals.append(float(r))
+        vals = [_normalize(fun(self.take(np.asarray([i]))))[1]
+                for i in range(self.nrow)]
         return Frame.from_dict({"apply": np.asarray(vals)})
 
     # -- summaries (Frame.summary / RollupStats) -----------------------------

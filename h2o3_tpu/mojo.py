@@ -118,7 +118,8 @@ def _dinfo_meta(dinfo) -> Dict:
     }
 
 
-def save_model(est_or_model, path: str = ".", filename: Optional[str] = None) -> str:
+def save_model(est_or_model, path: str = ".", filename: Optional[str] = None,
+               force: bool = False) -> str:
     model = getattr(est_or_model, "model", est_or_model)
     payload = _model_payload(model)
     os.makedirs(path, exist_ok=True) if not os.path.splitext(path)[1] else None
@@ -127,6 +128,8 @@ def save_model(est_or_model, path: str = ".", filename: Optional[str] = None) ->
         out = os.path.join(path, fn)
     else:
         out = path
+    if os.path.exists(out) and not force:
+        raise FileExistsError(f"{out} exists; pass force=True")
     with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("model.json", json.dumps(payload["meta"]))
         buf = io.BytesIO()

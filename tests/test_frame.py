@@ -192,3 +192,17 @@ def test_apply_transform_and_format_override(tmp_path, cloud1):
     p = str(tmp_path / "weird.parquet")
     h2o.export_file(fr, p, format="csv")
     assert open(p).readline().strip() == "x"
+
+
+def test_apply_comparison_and_save_force(tmp_path, cloud1):
+    fr = h2o.H2OFrame_from_python({"x": [0.5, 1.5, 2.5]})
+    mask = fr.apply(lambda c: c > 1, axis=0)
+    np.testing.assert_allclose(mask.vec("x").numeric_np(), [0, 1, 1])
+    # save_model honors force
+    from h2o3_tpu.estimators import H2OKMeansEstimator
+    km = H2OKMeansEstimator(k=2, seed=1)
+    km.train(x=["x"], training_frame=fr)
+    p = h2o.save_model(km, str(tmp_path))
+    with pytest.raises(FileExistsError):
+        h2o.save_model(km, str(tmp_path))
+    h2o.save_model(km, str(tmp_path), force=True)

@@ -201,3 +201,31 @@ def test_model_metrics_endpoint(server):
     row = mm["model_metrics"][0]
     assert row["model"]["name"] == mk
     assert 0.5 <= row["auc"] <= 1.0
+
+
+def test_model_save_load_and_frame_export(server, tmp_path):
+    srv, csv = server
+    imp = _post(srv, "/3/ImportFiles", path=csv)
+    key = imp["destination_frames"][0]
+    _post(srv, "/99/Rapids", ast=f"(tmp= expfr (cbind (cols {key} [0 1 2]) (as.factor (cols {key} [3]))))")
+    out = _post(srv, "/3/ModelBuilders/gbm",
+                training_frame="expfr", response_column="y",
+                ntrees=3, max_depth=3)
+    import time as _t
+    for _ in range(200):
+        jobs = _get(srv, "/3/Jobs")["jobs"]
+        if all(j["status"] in ("DONE", "FAILED") for j in jobs):
+            break
+        _t.sleep(0.25)
+    models = _get(srv, "/3/Models")["models"]
+    assert models, "no model trained via REST"
+    mid = models[-1]["model_id"]["name"]
+    saved = _post(srv, f"/99/Models.bin/{mid}", dir=str(tmp_path))
+    assert saved["path"].endswith(".h2o3")
+    loaded = _post(srv, "/99/Models.bin", path=saved["path"])
+    assert loaded["models"][0]["model_id"]["name"]
+    exp = _post(srv, f"/3/Frames/{key}/export",
+                path=str(tmp_path / "out.csv"), force=True)
+    assert exp["job"]["status"] == "DONE"
+    import os
+    assert os.path.exists(tmp_path / "out.csv")
