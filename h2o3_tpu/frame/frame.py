@@ -214,6 +214,10 @@ class Frame:
             arr = np.asarray(r, np.float64)
             if arr.ndim >= 1 and arr.size == self.nrow and self.nrow != 1:
                 return "col", arr.reshape(-1)
+            if arr.size != 1:
+                raise ValueError(
+                    f"apply: callable returned {arr.size} values; expected "
+                    f"a scalar or a full column of {self.nrow}")
             return "scalar", float(arr.reshape(-1)[0])
 
         if axis == 0:

@@ -324,7 +324,29 @@ def parse_orc(path: str) -> Frame:
 
 
 def import_file(path: str, **kw) -> Frame:
-    """`h2o.import_file` — dispatch by extension (`ParseDataset.parse`)."""
+    """`h2o.import_file` — dispatch by extension (`ParseDataset.parse`).
+    Non-file URIs (http/s3/gs/hdfs) are fetched through the Persist SPI
+    (`runtime/persist.py`, the water.persist backends) into a temp file
+    first, then parsed by format as usual."""
+    if "://" in path and not path.startswith("file://"):
+        import tempfile
+
+        from ..runtime import persist as persist_spi
+
+        import shutil
+
+        backend = persist_spi.for_uri(path)
+        suffix = os.path.splitext(path.split("?", 1)[0])[1] or ".csv"
+        tmp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+        try:
+            with backend.open(path) as src:
+                shutil.copyfileobj(src, tmp)   # streamed, not buffered
+            tmp.close()
+            fr = import_file(tmp.name, **kw)
+            fr.key = os.path.basename(path.split("?", 1)[0]) or fr.key
+            return fr
+        finally:
+            os.unlink(tmp.name)
     if path.endswith((".svm", ".svmlight")):
         return parse_svmlight(path)
     if path.endswith(".arff"):

@@ -177,7 +177,7 @@ class H2OGridSearch:
                     from ..mojo import save_model
 
                     fname = f"{self.grid_id}_combo{len(self._done_combos)}.h2o3"
-                    save_model(est, self.recovery_dir, filename=fname)
+                    save_model(est, self.recovery_dir, filename=fname, force=True)
                     m = est.model
                     metrics = dict(m.training_metrics._ser()
                                    if m.training_metrics else {})

@@ -661,7 +661,7 @@ class H2OEstimator:
             try:
                 from ..mojo import save_model
 
-                save_model(model, ckpt_dir)
+                save_model(model, ckpt_dir, force=True)
             except TypeError:
                 pass  # artifact format doesn't cover this algo yet
         return self

@@ -2,16 +2,22 @@
 
 Reference parity: `h2o-core/src/main/java/water/persist/Persist.java` with
 `PersistNFS`/`PersistFS` in-tree and `h2o-persist-{s3,hdfs,gcs,http}`
-extension modules. Scheme-dispatched; local file is fully supported, cloud
-schemes are registered stubs that raise with the reference's module name so
-the surface (and error text) matches even in this network-less build.
+extension modules. Scheme-dispatched:
+
+* local file (PersistNFS/PersistFS) — stdlib
+* http/https (h2o-persist-http) — urllib, read-only
+* s3/s3a, gs, hdfs (h2o-persist-{s3,gcs,hdfs}) — pyarrow.fs filesystems,
+  constructed lazily; credential/connectivity errors surface at first use
+  with the scheme and reference module named (this build's CI machine has
+  no egress, so these paths are exercised in deployment, not tests).
 """
 
 from __future__ import annotations
 
 import glob as _glob
+import io
 import os
-from typing import Callable, Dict, List
+from typing import Dict, List
 
 
 class Persist:
@@ -39,28 +45,112 @@ class Persist:
         return uri[len("file://"):] if uri.startswith("file://") else uri
 
 
-class _StubPersist(Persist):
+class HttpPersist(Persist):
+    """h2o-persist-http — read-only HTTP(S) import."""
+
+    def __init__(self, scheme: str = "http"):
+        self.scheme = scheme
+
+    def open(self, uri: str, mode: str = "rb"):
+        if "r" not in mode:
+            raise NotImplementedError("http persistence is read-only")
+        import urllib.request
+
+        # the response object is file-like (read/close, context manager) —
+        # returning it directly lets callers stream instead of buffering
+        return urllib.request.urlopen(uri)
+
+    def exists(self, uri: str) -> bool:
+        import urllib.error
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(uri, method="HEAD")
+            with urllib.request.urlopen(req):
+                return True
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def list(self, uri: str) -> List[str]:
+        return [uri]
+
+    def size(self, uri: str) -> int:
+        import urllib.request
+
+        req = urllib.request.Request(uri, method="HEAD")
+        with urllib.request.urlopen(req) as r:
+            return int(r.headers.get("Content-Length", -1))
+
+
+class ArrowFsPersist(Persist):
+    """s3/gs/hdfs via pyarrow.fs — the h2o-persist-{s3,gcs,hdfs} roles.
+
+    The filesystem object is built lazily on first use so importing this
+    module never requires credentials; failures name the scheme and the
+    reference module they correspond to."""
+
     def __init__(self, scheme: str, module: str):
         self.scheme = scheme
         self._module = module
+        self._fs: Dict[str, object] = {}   # keyed by URI authority
+
+    def _resolve(self, uri: str):
+        """(filesystem, path) for one URI — hdfs URIs carry the namenode in
+        their authority, so the filesystem is constructed (and cached) per
+        authority via from_uri, which also yields the correct path."""
+        try:
+            from pyarrow import fs as pafs
+
+            if self.scheme == "hdfs":
+                rest = uri.split("://", 1)[1]
+                authority = rest.split("/", 1)[0]
+                if authority not in self._fs:
+                    self._fs[authority], _ = pafs.FileSystem.from_uri(uri)
+                path = "/" + rest.split("/", 1)[1] if "/" in rest else "/"
+                return self._fs[authority], path
+            if "" not in self._fs:
+                self._fs[""] = (pafs.S3FileSystem()
+                                if self.scheme in ("s3", "s3a")
+                                else pafs.GcsFileSystem())
+            return self._fs[""], uri.split("://", 1)[1]
+        except Exception as e:
+            raise RuntimeError(
+                f"{self.scheme}:// backend ({self._module} role) could "
+                f"not initialize a pyarrow filesystem: {e}") from e
 
     def open(self, uri: str, mode: str = "rb"):
-        raise NotImplementedError(
-            f"{self.scheme}:// requires the {self._module} persistence "
-            f"backend (not available in this build)"
-        )
+        fs, path = self._resolve(uri)
+        if "w" in mode:
+            return fs.open_output_stream(path)
+        return fs.open_input_file(path)
 
-    exists = list = size = open  # type: ignore[assignment]
+    def exists(self, uri: str) -> bool:
+        from pyarrow import fs as pafs
+
+        fs, path = self._resolve(uri)
+        return fs.get_file_info(path).type != pafs.FileType.NotFound
+
+    def list(self, uri: str) -> List[str]:
+        from pyarrow import fs as pafs
+
+        fs, path = self._resolve(uri)
+        sel = pafs.FileSelector(path, recursive=False, allow_not_found=True)
+        return sorted(f"{self.scheme}://{i.path}"
+                      for i in fs.get_file_info(sel))
+
+    def size(self, uri: str) -> int:
+        fs, path = self._resolve(uri)
+        return int(fs.get_file_info(path).size)
 
 
 _REGISTRY: Dict[str, Persist] = {
     "file": Persist(),
-    "s3": _StubPersist("s3", "h2o-persist-s3"),
-    "s3a": _StubPersist("s3a", "h2o-persist-s3"),
-    "hdfs": _StubPersist("hdfs", "h2o-persist-hdfs"),
-    "gs": _StubPersist("gs", "h2o-persist-gcs"),
-    "http": _StubPersist("http", "h2o-persist-http"),
-    "https": _StubPersist("https", "h2o-persist-http"),
+    "s3": ArrowFsPersist("s3", "h2o-persist-s3"),
+    "s3a": ArrowFsPersist("s3a", "h2o-persist-s3"),
+    "hdfs": ArrowFsPersist("hdfs", "h2o-persist-hdfs"),
+    "gs": ArrowFsPersist("gs", "h2o-persist-gcs"),
+    "http": HttpPersist("http"),
+    "https": HttpPersist("https"),
 }
 
 

@@ -5,6 +5,7 @@ water/api/ProfilerHandler)."""
 import numpy as np
 import pytest
 
+import h2o3_tpu as h2o
 from h2o3_tpu.frame.frame import Frame
 from h2o3_tpu.runtime import profiler
 from h2o3_tpu.runtime.dkv import DKV
@@ -59,9 +60,11 @@ def test_persist_spi(tmp_path):
         assert fh.read().startswith(b"a,b")
     # glob listing
     assert p.list(str(tmp_path / "*.csv")) == [str(f)]
-    # cloud schemes are present but stubbed
+    # cloud schemes are real pyarrow.fs backends now; in this egress-less
+    # environment first use surfaces a connectivity/credential error
+    # (NOT NotImplementedError — the backend exists)
     s3 = for_uri("s3://bucket/key")
-    with pytest.raises(NotImplementedError):
+    with pytest.raises((OSError, RuntimeError)):
         s3.open("s3://bucket/key")
     with pytest.raises(ValueError):
         for_uri("weird://x")
@@ -72,3 +75,40 @@ def test_profiler_samples():
     assert any("MainThread" in s["thread"] for s in samples)
     prof = profiler.profile(nsamples=2, interval=0.0)
     assert prof and all(p["count"] >= 1 for p in prof)
+
+
+def test_http_persist_import(tmp_path, cloud1):
+    """h2o-persist-http: import_file over a loopback HTTP server."""
+    import http.server
+    import threading
+
+    d = tmp_path / "serve"
+    d.mkdir()
+    (d / "data.csv").write_text("a,b\n1,2\n3,4\n")
+
+    handler = lambda *a, **k: http.server.SimpleHTTPRequestHandler(
+        *a, directory=str(d), **k)
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/data.csv"
+        from h2o3_tpu.runtime import persist as P
+
+        assert P.for_uri(url).exists(url)
+        assert P.for_uri(url).size(url) > 0
+        fr = h2o.import_file(url)
+        assert fr.key == "data.csv"
+        assert fr.vec("a").numeric_np().tolist() == [1.0, 3.0]
+    finally:
+        httpd.shutdown()
+
+
+def test_cloud_scheme_backends_registered(cloud1):
+    from h2o3_tpu.runtime import persist as P
+
+    for scheme in ("s3", "gs", "hdfs"):
+        b = P.for_uri(f"{scheme}://bucket/key")
+        assert b.scheme == scheme
+    with pytest.raises(ValueError):
+        P.for_uri("ftp://x/y")
