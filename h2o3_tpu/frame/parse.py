@@ -328,7 +328,9 @@ def import_file(path: str, **kw) -> Frame:
     Non-file URIs (http/s3/gs/hdfs) are fetched through the Persist SPI
     (`runtime/persist.py`, the water.persist backends) into a temp file
     first, then parsed by format as usual."""
-    if "://" in path and not path.startswith("file://"):
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    if "://" in path:
         import tempfile
 
         from ..runtime import persist as persist_spi
@@ -343,7 +345,16 @@ def import_file(path: str, **kw) -> Frame:
                 shutil.copyfileobj(src, tmp)   # streamed, not buffered
             tmp.close()
             fr = import_file(tmp.name, **kw)
-            fr.key = os.path.basename(path.split("?", 1)[0]) or fr.key
+            # key by basename like local parses, but uniquified: two URLs
+            # ending in the same filename must not collide in the DKV
+            from ..runtime.dkv import DKV
+
+            base = os.path.basename(path.split("?", 1)[0]) or fr.key
+            keyname, i = base, 0
+            while DKV.get(keyname) is not None:
+                i += 1
+                keyname = f"{base}_{i}"
+            fr.key = keyname
             return fr
         finally:
             os.unlink(tmp.name)

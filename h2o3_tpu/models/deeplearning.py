@@ -409,19 +409,30 @@ class H2ODeepLearningEstimator(H2OEstimator):
         max_runtime = float(p.get("max_runtime_secs", 0) or 0)
         model = DeepLearningModel(self, x, y, dinfo, problem, nclass, domain,
                                   params, activation, dist)
-        # single-device fast path: data device-resident, scan over steps.
-        # (Multi-device keeps the sharded per-batch step: a global batch
-        # gather across row shards would need an all-gather per step.)
-        use_scan = rs is None and not (max_runtime and max_runtime > 0)
+        # device-resident fast path: data in HBM (row-sharded on a mesh),
+        # scan over steps; GSPMD turns the per-chunk permutation gather into
+        # collectives and psums the sharded-batch gradients automatically.
+        # max_runtime keeps the per-batch path (its wall check needs host
+        # control between steps).
+        use_scan = not (max_runtime and max_runtime > 0)
         if use_scan:
-            X_dev = jnp.asarray(X)
-            y_dev = jnp.asarray(yarr)
-            w_dev = jnp.asarray(w)
-            X_score = X_dev                  # scoring reuses the HBM copy
+            if rs is not None:
+                # shard straight from host — an unsharded intermediate on
+                # device 0 would defeat row sharding for data that only
+                # fits when split across the mesh
+                X_dev = jax.device_put(X, rs)
+                y_dev = jax.device_put(yarr, rs)
+                w_dev = jax.device_put(w, rs)
+            else:
+                X_dev = jnp.asarray(X)
+                y_dev = jnp.asarray(yarr)
+                w_dev = jnp.asarray(w)
+            # scoring reuses the HBM copy — except on a multi-process mesh,
+            # where fetching a cross-process-sharded eager result raises
+            X_score = X_dev if jax.process_count() == 1 else None
         else:
-            # sharded / max_runtime path: no persistent unsharded device
-            # copy (it could evict params on data sized for row sharding);
-            # scoring falls back to the transient per-event transform
+            # max_runtime path: no persistent device copy; scoring falls
+            # back to the transient per-event transform
             X_score = None
         while seen < total:
             if use_scan:

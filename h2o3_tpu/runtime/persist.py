@@ -15,7 +15,6 @@ extension modules. Scheme-dispatched:
 from __future__ import annotations
 
 import glob as _glob
-import io
 import os
 from typing import Dict, List
 
@@ -125,15 +124,15 @@ class ArrowFsPersist(Persist):
         return fs.open_input_file(path)
 
     def exists(self, uri: str) -> bool:
+        fs, path = self._resolve(uri)       # raises RuntimeError w/ context
         from pyarrow import fs as pafs
 
-        fs, path = self._resolve(uri)
         return fs.get_file_info(path).type != pafs.FileType.NotFound
 
     def list(self, uri: str) -> List[str]:
+        fs, path = self._resolve(uri)
         from pyarrow import fs as pafs
 
-        fs, path = self._resolve(uri)
         sel = pafs.FileSelector(path, recursive=False, allow_not_found=True)
         return sorted(f"{self.scheme}://{i.path}"
                       for i in fs.get_file_info(sel))
