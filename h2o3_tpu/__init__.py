@@ -86,42 +86,8 @@ def import_file(path: str, destination_frame=None, header=0, sep=None,
 upload_file = import_file
 
 
-def H2OFrame_from_python(data, column_types=None) -> Frame:
-    # pandas DataFrame → dict of columns: missing values normalized to
-    # None/NaN (pd.NA and NaN-in-object would break enum inference),
-    # datetimes → ms-since-epoch 'time' vecs, labels coerced to str
-    if hasattr(data, "to_dict") and hasattr(data, "columns") \
-            and not isinstance(data, dict):
-        import pandas as pd
-
-        cols, auto_types = {}, {}
-        for c in data.columns:
-            s = data[c]
-            name = str(c)
-            if pd.api.types.is_datetime64_any_dtype(s.dtype):
-                v = s.to_numpy()
-                out = v.astype("datetime64[ms]").astype(np.float64)
-                out[np.isnat(v)] = np.nan
-                cols[name] = out
-                auto_types[name] = "time"
-            elif (s.dtype == object
-                  or isinstance(s.dtype, pd.CategoricalDtype)
-                  or pd.api.types.is_string_dtype(s.dtype)):
-                cols[name] = s.astype(object).where(s.notna(), None).to_numpy()
-            else:
-                cols[name] = s.to_numpy()
-        if column_types:
-            auto_types.update({str(k): v for k, v in column_types.items()})
-        column_types = auto_types or None
-        data = cols
-    if isinstance(data, dict):
-        fr = Frame.from_dict(data, column_types=column_types)
-    else:
-        fr = Frame.from_numpy(np.asarray(data), column_types=column_types)
-    # every client-created frame lives in the DKV (H2OFrame upload → DKV
-    # key), so Rapids expressions and get_frame can resolve it
-    _DKV.put(fr.key, fr)
-    return fr
+def H2OFrame_from_python(data, column_types=None, column_names=None) -> Frame:
+    return Frame(data, column_names=column_names, column_types=column_types)
 
 
 def get_frame(key: str) -> Frame:

@@ -26,13 +26,90 @@ from .vec import Vec
 _key_counter = itertools.count()
 
 
+def _python_obj_to_vecs(data, column_names=None, column_types=None):
+    """h2o-py `H2OFrame(python_obj)` coercion: pandas DataFrame/Series /
+    numpy array / dict of sequences / list of rows → Dict[str, Vec].
+
+    As in h2o-py, a flat list is one ROW (1×n), not a column. pandas
+    handling: missing values normalized to None/NaN (pd.NA and NaN-in-object
+    would break enum inference), datetimes → ms-since-epoch 'time' vecs,
+    labels coerced to str."""
+    auto_types: Dict[str, str] = {}
+    if hasattr(data, "to_frame") and not hasattr(data, "columns"):
+        data = data.to_frame()  # pandas Series → one-column DataFrame
+    if hasattr(data, "to_dict") and hasattr(data, "columns") \
+            and not isinstance(data, dict):
+        import pandas as pd
+
+        cols = {}
+        for c in data.columns:
+            s = data[c]
+            name = str(c)
+            if pd.api.types.is_datetime64_any_dtype(s.dtype):
+                v = s.to_numpy()
+                out = v.astype("datetime64[ms]").astype(np.float64)
+                out[np.isnat(v)] = np.nan
+                cols[name] = out
+                auto_types[name] = "time"
+            elif (s.dtype == object
+                  or isinstance(s.dtype, pd.CategoricalDtype)
+                  or pd.api.types.is_string_dtype(s.dtype)):
+                cols[name] = s.astype(object).where(s.notna(), None).to_numpy()
+            else:
+                cols[name] = s.to_numpy()
+        data = cols
+    if isinstance(data, dict):
+        names = [str(n) for n in data]
+        cols = [np.asarray(c) for c in data.values()]
+    else:
+        # list of rows: optional header row (all-string first row, h2o-py rule)
+        if (isinstance(data, (list, tuple)) and data
+                and isinstance(data[0], (list, tuple))):
+            rows = [list(r) for r in data]
+            if (column_names is None
+                    and all(isinstance(v, str) for v in rows[0])
+                    and len(rows) > 1
+                    and not all(isinstance(v, str) for r in rows[1:] for v in r)):
+                column_names, rows = rows[0], rows[1:]
+            arr = np.asarray(rows, dtype=object)
+        else:
+            arr = np.atleast_2d(np.asarray(data))
+        names = ([str(n) for n in column_names] if column_names
+                 else [f"C{i+1}" for i in range(arr.shape[1])])
+        cols = [arr[:, i] for i in range(arr.shape[1])]
+    # resolve a positional column_types list only after names are known
+    if isinstance(column_types, (list, tuple)):
+        column_types = {n: t for n, t in zip(names, column_types)}
+    types = dict(auto_types)
+    types.update({str(k): v for k, v in (column_types or {}).items()})
+    return {n: Vec.from_numpy(c, types.get(n)) for n, c in zip(names, cols)}
+
+
 class Frame:
-    def __init__(self, vecs: Dict[str, Vec], key: Optional[str] = None):
+    def __init__(self, vecs=None, key: Optional[str] = None,
+                 column_names: Optional[Sequence[str]] = None,
+                 column_types=None, destination_frame: Optional[str] = None,
+                 **_h2o_compat):
+        """Frame from Dict[str, Vec] (internal) or, matching h2o-py's
+        `H2OFrame(python_obj)`, from a pandas DataFrame / numpy array /
+        dict of sequences / list of rows."""
+        if vecs is None:
+            vecs = {}
+        client_created = not (isinstance(vecs, dict)
+                              and all(isinstance(v, Vec) for v in vecs.values()))
+        if client_created:
+            vecs = _python_obj_to_vecs(vecs, column_names, column_types)
         lens = {len(v) for v in vecs.values()}
         if len(lens) > 1:
             raise ValueError(f"ragged frame: column lengths {lens}")
         self._vecs: Dict[str, Vec] = dict(vecs)
-        self.key = key or f"frame_{next(_key_counter)}"
+        self.key = key or destination_frame or f"frame_{next(_key_counter)}"
+        if client_created:
+            # client-created frames live in the DKV (H2OFrame upload → DKV
+            # key) so Rapids expressions and get_frame can resolve them
+            from ..runtime.dkv import DKV
+
+            DKV.put(self.key, self)
 
     # -- construction -------------------------------------------------------
     @staticmethod

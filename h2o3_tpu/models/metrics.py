@@ -60,6 +60,20 @@ def auc_exact(y: np.ndarray, p: np.ndarray) -> float:
     return float((r[y == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg))
 
 
+class MetricValue(float):
+    """Float that is also callable with h2o-py's method signature.
+
+    h2o-py exposes metrics as methods (`perf.auc()`, `perf.rmse()`) while the
+    internal code reads attributes (`m.auc`); wrapping plain-float fields in
+    this keeps both call styles working.
+    """
+
+    __slots__ = ()
+
+    def __call__(self, *_a, **_kw) -> float:
+        return float(self)
+
+
 @dataclass
 class ModelMetricsBase:
     mse: float = float("nan")
@@ -67,8 +81,17 @@ class ModelMetricsBase:
     nobs: int = 0
     description: str = ""
 
+    def __setattr__(self, k, v):
+        # dataclass __init__ assigns via setattr, so this wraps both
+        # construction and later post-hoc assignments (e.g. KMeans metrics)
+        if isinstance(v, (float, np.floating)) \
+                and not isinstance(v, MetricValue):
+            v = MetricValue(v)
+        object.__setattr__(self, k, v)
+
     def _ser(self) -> Dict:
-        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+        return {k: float(v) if isinstance(v, MetricValue) else v
+                for k, v in self.__dict__.items() if not k.startswith("_")}
 
 
 @dataclass
