@@ -32,6 +32,7 @@ class H2ORandomForestEstimator(H2OSharedTreeEstimator):
         col_sample_rate_per_tree=1.0,
         min_split_improvement=1e-5,
         histogram_type="AUTO",
+        hist_method="auto",  # auto|onehot|segment|pallas|pallas_factored (tpu_hist strategy)
         distribution="AUTO",
         binomial_double_trees=False,
         score_tree_interval=0,

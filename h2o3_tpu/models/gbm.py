@@ -34,6 +34,7 @@ class H2OGradientBoostingEstimator(H2OSharedTreeEstimator):
         col_sample_rate_per_tree=1.0,
         min_split_improvement=1e-5,
         histogram_type="AUTO",
+        hist_method="auto",  # auto|onehot|segment|pallas|pallas_factored (tpu_hist strategy)
         distribution="AUTO",
         tweedie_power=1.5,
         quantile_alpha=0.5,

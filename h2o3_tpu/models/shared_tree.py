@@ -164,6 +164,7 @@ class _StepCfg(NamedTuple):
     has_monotone: bool
     tweedie_power: float
     quantile_alpha: float
+    hist_method: str = "auto"
 
 
 def _pack_hp(tp, lr, colp) -> "jnp.ndarray":
@@ -221,7 +222,7 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
 
     def _build_one(codes, g, h, w, fm, edges, mono, hp, key):
         kwargs = dict(max_depth=cfg.max_depth, nbins=cfg.nbins,
-                      mtries=cfg.mtries)
+                      mtries=cfg.mtries, hist_method=cfg.hist_method)
         if cloud.size > 1:
             from jax import shard_map
 
@@ -648,11 +649,41 @@ class H2OSharedTreeEstimator(H2OEstimator):
             col_sample_rate_per_tree=float(p.get("col_sample_rate_per_tree", 1.0)),
             min_split_improvement=float(p.get("min_split_improvement", 1e-5)),
             histogram_type=p.get("histogram_type", "AUTO"),
+            hist_method=p.get("hist_method", "auto"),
             mtries=int(p.get("mtries", -1)) if "mtries" in p else 0,
             reg_lambda=float(p.get("reg_lambda"))
             if p.get("reg_lambda") is not None
             else (0.0 if self._mode == "drf" else 1.0),
             reg_alpha=float(p.get("reg_alpha") or 0.0) if "reg_alpha" in p else 0.0,
+        )
+
+    def _make_step_cfg(self, tp, npad, K, F, nbins, problem, dist) -> _StepCfg:
+        """The structural step config, derivable before any device upload —
+        built identically by the early warm-up thread and the training path
+        so both hit the same cached program."""
+        mtries = tp["mtries"]
+        if self._mode == "drf":
+            if mtries in (-1, 0):
+                mtries = (max(1, int(np.sqrt(F))) if problem != "regression"
+                          else max(1, F // 3))
+            elif mtries == -2:
+                mtries = F
+        else:
+            mtries = 0
+        colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
+        return _StepCfg(
+            npad=npad, K=K, F=F, nbins=nbins, problem=problem, dist=dist,
+            mode=self._mode, max_depth=tp["max_depth"],
+            mtries=mtries,
+            no_row_sampling=(tp["sample_rate"] >= 1.0
+                             and not self._parms.get("sample_rate_per_class")),
+            has_col_sampling=colp < 1.0,
+            has_monotone=getattr(self, "_monotone_vec", None) is not None,
+            tweedie_power=(float(self._parms.get("tweedie_power", 1.5))
+                           if "tweedie_power" in self._parms else 1.5),
+            quantile_alpha=(float(self._parms.get("quantile_alpha", 0.5))
+                            if "quantile_alpha" in self._parms else 0.5),
+            hist_method=tp.get("hist_method", "auto"),
         )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
@@ -793,8 +824,70 @@ class H2OSharedTreeEstimator(H2OEstimator):
             return np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
 
         _ph.mark("build_bins")
+
+        # ---- background program warm-up ----------------------------------
+        # The first dispatch of the tree-step program pays trace + XLA
+        # compile-cache load (~3 s through a remote-TPU tunnel) in the
+        # calling thread, and the big H2D uploads below are synchronous
+        # through the same tunnel. Overlap the two: a daemon thread traces
+        # and dispatches the step program on device-CREATED dummy zeros (no
+        # tunnel traffic) while this thread streams the real data up.
+        # Checkpoint continuation mutates max_depth/nbins after this point,
+        # so it skips the early warm-up (rare path; costs only the load).
+        warm_thread = None
+        if self._parms.get("checkpoint") is None \
+                and getattr(self, "_objective_fn", None) is None \
+                and os.environ.get("H2O3_WARM_THREAD", "1") != "0":
+            cfg_early = self._make_step_cfg(tp, npad, K, F, nbins, problem,
+                                            dist)
+            code_dt = jnp.uint8 if nbins <= 256 else jnp.uint16
+            drf = self._mode == "drf"
+
+            def _warm():
+                try:
+                    tj, _ = _tree_step_fns(cfg_early, cloud)
+                    args = [
+                        jnp.zeros((npad, K), jnp.float32),                # margins
+                        jnp.zeros((npad, K) if drf else (1, K), jnp.float32),
+                        jnp.zeros(npad if drf else 1, jnp.float32),
+                        jnp.zeros((npad, F), code_dt),                    # codes
+                        jnp.zeros((npad, K), jnp.float32),                # y
+                        jnp.zeros(npad, jnp.float32),                     # w
+                        jnp.ones(npad, jnp.float32),                      # rate
+                        jnp.zeros((F, nbins - 2), jnp.float32),           # edges
+                        jnp.zeros(F, jnp.float32),                        # mono
+                        jnp.zeros(7, jnp.float32),                        # hp
+                        jax.random.PRNGKey(0),
+                        np.int32(0),
+                    ]
+                    if ndev > 1:
+                        # shard exactly the args the real call shards
+                        # (mono/hp/key stay uncommitted there — committing
+                        # them here would compile a different executable)
+                        rs_ = cloud.row_sharding()
+                        rep = cloud.replicated()
+                        shardings = [rs_, rs_ if drf else None,
+                                     rs_ if drf else None, rs_, rs_, rs_,
+                                     rs_, rep, None, None, None, None]
+                        args = [a if s is None else jax.device_put(a, s)
+                                for a, s in zip(args, shardings)]
+                    tj(*args)
+                except Exception:  # warm-up is advisory; real call reports
+                    pass
+
+            import threading
+
+            warm_thread = threading.Thread(target=_warm, daemon=True)
+            warm_thread.start()
+
         codes_d = jnp.asarray(padr(bm.codes))
-        y_d = jnp.asarray(padr(yk))
+        if yk.size and bool(np.all((yk >= 0) & (yk <= 255)
+                                   & (yk == np.floor(yk)))):
+            # integer-ish response (class indicators, counts): ship uint8
+            # through the tunnel (4× smaller) and widen on device
+            y_d = jnp.asarray(padr(yk.astype(np.uint8))).astype(jnp.float32)
+        else:
+            y_d = jnp.asarray(padr(yk))
         if np.all(w == 1.0):
             # trivial weights: build on device (zero-weight padded tail)
             # instead of pushing 4·npad bytes of 1.0s through the tunnel
@@ -901,15 +994,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
         _ph.mark("device_put", sync=codes_d)
         key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
-        mtries = tp["mtries"]
-        if self._mode == "drf":
-            if mtries in (-1, 0):
-                mtries = max(1, int(np.sqrt(F))) if problem != "regression" else max(1, F // 3)
-            elif mtries == -2:
-                mtries = F
-        else:
-            mtries = 0
-
         ntrees_target = max(int(tp["ntrees"]) - n_prior, 0)
         gain_total = np.zeros(F, np.float64)
         stopper = (
@@ -934,24 +1018,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # and the margin updates are fused into a single XLA program — the
         # analog of the fused ScoreBuildHistogram2 pass (hex/tree/
         # ScoreBuildHistogram2.java fuses scoring into histogram building).
-        tweedie_power = float(self._parms.get("tweedie_power", 1.5)) \
-            if "tweedie_power" in self._parms else 1.5
-        quantile_alpha = float(self._parms.get("quantile_alpha", 0.5)) \
-            if "quantile_alpha" in self._parms else 0.5
         colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
         custom_obj = getattr(self, "_objective_fn", None)
-        no_row_sampling = (tp["sample_rate"] >= 1.0
-                           and not self._parms.get("sample_rate_per_class"))
-
         mono_vec = getattr(self, "_monotone_vec", None)
-        cfg = _StepCfg(
-            npad=npad, K=K, F=F, nbins=nbins, problem=problem, dist=dist,
-            mode=self._mode, max_depth=tp["max_depth"],
-            mtries=mtries, no_row_sampling=no_row_sampling,
-            has_col_sampling=colp < 1.0,
-            has_monotone=mono_vec is not None,
-            tweedie_power=tweedie_power, quantile_alpha=quantile_alpha,
-        )
+        cfg = self._make_step_cfg(tp, npad, K, F, nbins, problem, dist)
+        if warm_thread is not None:
+            warm_thread.join()
         _tree_jit, _single_jit = _tree_step_fns(cfg, cloud)
         mono_d = (jnp.asarray(mono_vec) if mono_vec is not None
                   else jnp.zeros(F, jnp.float32))

@@ -33,6 +33,30 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _pallas_available() -> bool:
+    from . import hist_pallas
+
+    return hist_pallas._HAVE_PLTPU
+
+
+def _factored_row_chunk(n_nodes: int, nbins: int) -> int:
+    """Largest row chunk whose co-resident VMEM buffers fit: the (3L,R) f32
+    scratch and (8B,R) bf16 bin one-hot each ≤8 MB (empirical pass/fail
+    boundary on the bench chip) AND scratch + one-hot + the revisited
+    (3L,8B) f32 output block ≤16 MB together. Returns <512 when no chunk
+    fits (caller falls back to the XLA onehot path)."""
+    out_bytes = 3 * n_nodes * 8 * nbins * 4
+    rc = 8192
+    while rc >= 512:
+        scratch = 3 * n_nodes * rc * 4
+        onehot = 8 * nbins * rc * 2
+        if scratch <= (8 << 20) and onehot <= (8 << 20) \
+                and scratch + onehot + out_bytes <= (16 << 20):
+            break
+        rc //= 2
+    return rc
+
+
 def _hist_onehot(codes, node_id, vals, n_nodes: int, nbins: int):
     """MXU path. codes (N,F) int, node_id (N,) int, vals (3,N) f32.
     Returns (n_nodes, F, nbins, 3).
@@ -109,7 +133,15 @@ def build_histograms(
     vals = jnp.stack([w, g * w, h * w]).astype(jnp.float32)  # (3, N)
     if method == "auto":
         platform = jax.default_backend()
-        method = "segment" if platform == "cpu" else "onehot"
+        if platform == "cpu":
+            method = "segment"
+        elif platform == "tpu":
+            # measured on the real chip (1M×28, B=64, BENCH_r02 sweep): the
+            # factored pallas kernel is ≥ parity with onehot at L≤16 and
+            # 5–14× faster at L≥64 (flat ~10–27 ms vs 130–390 ms)
+            method = "pallas_factored" if _pallas_available() else "onehot"
+        else:
+            method = "onehot"  # non-TPU accelerators: Mosaic won't lower
     if method == "onehot":
         hist = _hist_onehot(codes, node_id, vals, n_nodes, nbins)
     elif method == "segment":
@@ -121,12 +153,14 @@ def build_histograms(
     elif method == "pallas_factored":
         from . import hist_pallas
 
-        # VMEM-guard: scratch is (3L, R) f32 — fall back past ~64 nodes
-        if n_nodes > 64:
+        rc = _factored_row_chunk(n_nodes, nbins)
+        if rc < 512:
+            # scratch would not fit VMEM at any useful chunk — fused onehot
             hist = _hist_onehot(codes, node_id, vals, n_nodes, nbins)
         else:
             hist = hist_pallas.build_histograms_pallas_factored(
-                codes.T.astype(jnp.float32), node_id, vals, n_nodes, nbins
+                codes.T.astype(jnp.float32), node_id, vals, n_nodes, nbins,
+                row_chunk=rc,
             )
     else:
         raise ValueError(f"unknown histogram method {method!r}")
