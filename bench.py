@@ -7,9 +7,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The real HIGGS csv is not shipped in this image; the synthetic generator
 reproduces its shape (11M rows × 28 numeric features in the full set; we
 default to 1M rows to keep the bench under control) with an XOR-ish nonlinear
-response so the trees actually learn. vs_baseline is wall-clock relative to
-BASELINE.md's reference number when one exists (none published in-repo —
-SURVEY.md §6), else 1.0.
+response so the trees actually learn. vs_baseline compares against the
+round-1 warm measurements in R01_BASELINE below (mirrored in BASELINE.md),
+normalized so >1.0 always means better than round 1; metrics without an
+anchor (env-overridden shapes) report 1.0.
 """
 
 import json
@@ -170,6 +171,21 @@ def bench_automl():
             {"n_models": len(rows), "best_auc": best_auc})
 
 
+# Round-1 warm measurements on the same chip (BASELINE.md table, recorded
+# 2026-07-30) — the de-facto baseline every later round must beat. Keyed by
+# metric name so env-overridden shapes (different name) fall back to 1.0.
+# vs_baseline is normalized so >1.0 ALWAYS means better than round 1:
+# baseline/value for wall-clock, value/baseline for throughput.
+R01_BASELINE = {
+    "higgs_gbm_1000k_100trees_wall_s": 14.9,
+    "higgs_gbm_100k_10trees_wall_s": 7.0,
+    "airlines_glm_1000k_wall_s": 8.4,
+    "mnist_dl_60k_samples_per_s": 15850.0,
+    "mslr_xgb_rank_200k_50trees_wall_s": 21.5,
+    "automl_50k_8models_wall_s": 297.0,
+}
+
+
 def main():
     import jax
 
@@ -183,11 +199,18 @@ def main():
     fn = {"gbm": bench_gbm, "glm": bench_glm, "dl": bench_dl,
           "xgb_rank": bench_xgb_rank, "automl": bench_automl}[config]
     metric, value, extra = fn()
+    base = R01_BASELINE.get(metric)
+    if base is None:
+        vs = 1.0
+    elif metric.endswith("samples_per_s"):
+        vs = float(value) / base
+    else:
+        vs = base / float(value)
     result = {
         "metric": metric,
         "value": round(float(value), 3),
         "unit": extra.pop("unit_override", "s"),
-        "vs_baseline": 1.0,
+        "vs_baseline": round(vs, 3),
         "backend": jax.default_backend(),
     }
     result.update({k: v for k, v in extra.items() if v is not None})

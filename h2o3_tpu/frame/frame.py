@@ -297,6 +297,16 @@ class Frame:
                     f"a scalar or a full column of {self.nrow}")
             return "scalar", float(arr.reshape(-1)[0])
 
+        def _row_values(r):
+            """axis=1 result for ONE row → flat f64 values. A k-value result
+            yields k output columns (upstream AstApply row semantics) —
+            sizing against self.nrow here would silently misread an
+            ncol-sized row result whenever ncol == nrow."""
+            if isinstance(r, Frame):
+                return np.asarray(
+                    [float(r.vec(nm).numeric_np()[0]) for nm in r.names])
+            return np.asarray(r, np.float64).reshape(-1)
+
         if axis == 0:
             out = {}
             reduced = None
@@ -311,9 +321,17 @@ class Frame:
                         "full columns across columns")
                 out[n] = np.asarray([v]) if is_red else v
             return Frame.from_dict(out)
-        vals = [_normalize(fun(self.take(np.asarray([i]))))[1]
+        rows = [_row_values(fun(self.take(np.asarray([i]))))
                 for i in range(self.nrow)]
-        return Frame.from_dict({"apply": np.asarray(vals)})
+        widths = {len(r) for r in rows}
+        if len(widths) > 1:
+            raise ValueError(
+                f"apply: row callable returned ragged widths {sorted(widths)}")
+        arr = np.asarray(rows, np.float64)
+        if arr.shape[1] == 1:
+            return Frame.from_dict({"apply": arr[:, 0]})
+        return Frame.from_dict(
+            {f"C{j + 1}": arr[:, j] for j in range(arr.shape[1])})
 
     # -- summaries (Frame.summary / RollupStats) -----------------------------
     def describe(self) -> Dict[str, Dict[str, float]]:
@@ -693,6 +711,19 @@ class Frame:
         return op(a, b)
 
     def _arith(self, other, op, name):
+        if self.ncol > 1:
+            # h2o-py semantics: arithmetic maps over ALL columns; a 1-col
+            # frame or scalar broadcasts, an equal-width frame is pairwise
+            if isinstance(other, Frame) and other.ncol == self.ncol:
+                pairs = zip(self.names, other.names)
+                return Frame({n: Vec(op(self.vec(n).numeric_np(),
+                                        other.vec(m).numeric_np()
+                                        ).astype(np.float32), "real")
+                              for n, m in pairs})
+            b = other._col0() if isinstance(other, Frame) else other
+            return Frame({n: Vec(op(self.vec(n).numeric_np(), b
+                                    ).astype(np.float32), "real")
+                          for n in self.names})
         return Frame({name: Vec(self._binop(other, op).astype(np.float32), "real")})
 
     def __add__(self, other):

@@ -513,7 +513,8 @@ class RapidsSession:
                     {n: np.asarray([float(v if not isinstance(v, Frame)
                                           else v._col0()[0])])
                      for n, v in outs.items()})
-            vals = [float(fun(fr.take(np.asarray([r]))))
-                    for r in range(fr.nrow)]
-            return Frame.from_dict({"apply": np.asarray(vals)})
+            # margin=1 delegates to Frame.apply's row path: scalar results
+            # become one column, k-value results become k columns (upstream
+            # AstApply row semantics), ragged widths raise
+            return fr.apply(fun, axis=1)
         raise ValueError(f"Rapids: unknown op {op!r}")

@@ -52,6 +52,38 @@ def _linkinv(family: str, eta):
     return eta
 
 
+def _family_deviance_sum(family: str, y, mu, w, tweedie_p=1.5, xp=jnp):
+    """Σ w·d(y,μ) with the per-family unit deviance d — the quantity lambda
+    search minimizes (hex/glm/GLMModel.GLMParameters.deviance per family;
+    squared error only for gaussian). `xp` is jnp (device path) or np (host
+    f64 path)."""
+    if family in ("binomial", "quasibinomial", "fractionalbinomial"):
+        mu_c = xp.clip(mu, 1e-15, 1 - 1e-15)
+        return -2.0 * xp.sum(w * (y * xp.log(mu_c)
+                                  + (1 - y) * xp.log(1 - mu_c)))
+    if family == "poisson":
+        mu_c = xp.clip(mu, 1e-10, None)
+        ylogy = xp.where(y > 0, y * xp.log(xp.clip(y, 1e-10, None) / mu_c), 0.0)
+        return 2.0 * xp.sum(w * (ylogy - (y - mu_c)))
+    if family == "gamma":
+        mu_c = xp.clip(mu, 1e-10, None)
+        y_c = xp.clip(y, 1e-10, None)
+        return 2.0 * xp.sum(w * (-xp.log(y_c / mu_c) + (y - mu_c) / mu_c))
+    if family == "tweedie":
+        p = float(tweedie_p)
+        if abs(p - 1.0) < 1e-8:     # limit form: poisson deviance
+            return _family_deviance_sum("poisson", y, mu, w, xp=xp)
+        if abs(p - 2.0) < 1e-8:     # limit form: gamma deviance
+            return _family_deviance_sum("gamma", y, mu, w, xp=xp)
+        mu_c = xp.clip(mu, 1e-10, None)
+        y_c = xp.clip(y, 0.0, None)
+        return 2.0 * xp.sum(w * (
+            y_c ** (2 - p) / ((1 - p) * (2 - p))
+            - y_c * mu_c ** (1 - p) / (1 - p)
+            + mu_c ** (2 - p) / (2 - p)))
+    return xp.sum(w * (y - mu) ** 2)
+
+
 def _irls_weights(family: str, eta, mu, y, tweedie_p=1.5):
     """(W, z): working weights and response for one IRLS iteration."""
     if family in ("binomial", "quasibinomial", "fractionalbinomial"):
@@ -86,7 +118,7 @@ def _gram_step(X, y, w, beta, family: str, tweedie_p: float = 1.5):
 
 
 @functools.partial(jax.jit, static_argnames=("family", "max_iter",
-                                              "non_negative"))
+                                              "non_negative", "tweedie_p"))
 def _glm_path_device(X, y, w, Xe, ye, we, lams, alpha, n_obs, beta0,
                      beta_eps, tweedie_p, family: str, max_iter: int,
                      non_negative: bool):
@@ -131,11 +163,7 @@ def _glm_path_device(X, y, w, Xe, ye, we, lams, alpha, n_obs, beta0,
     def deviance(beta):
         eta = jnp.matmul(Xe, beta, precision=jax.lax.Precision.HIGHEST)
         mu = _linkinv(family, eta)
-        if family in ("binomial", "quasibinomial"):
-            mu_c = jnp.clip(mu, 1e-15, 1 - 1e-15)
-            return -2.0 * jnp.sum(
-                we * (ye * jnp.log(mu_c) + (1 - ye) * jnp.log(1 - mu_c)))
-        return jnp.sum(we * (ye - mu) ** 2)
+        return _family_deviance_sum(family, ye, mu, we, tweedie_p)
 
     def fit_one(beta, lam):
         def cond(state):
@@ -597,9 +625,10 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             beta = self._irls_warm(Xd, yd, wd, family, float(lv), alpha,
                                    max_iter, beta_eps, tweedie_p, beta)
             if vdata is not None:
-                dev = self._deviance(vdata[0], vdata[1], vdata[2], family, beta)
+                dev = self._deviance(vdata[0], vdata[1], vdata[2], family,
+                                     beta, tweedie_p)
             else:
-                dev = self._deviance(Xd, yd, wd, family, beta)
+                dev = self._deviance(Xd, yd, wd, family, beta, tweedie_p)
             path.append((float(lv), beta.copy()))
             if dev < best[1]:
                 best = (beta.copy(), dev, float(lv))
@@ -621,15 +650,12 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 break
         return beta
 
-    def _deviance(self, Xd, yd, wd, family, beta):
+    def _deviance(self, Xd, yd, wd, family, beta, tweedie_p=1.5):
         eta = np.asarray(Xd @ jnp.asarray(beta, jnp.float32), np.float64)
         y = np.asarray(yd, np.float64)
         w = np.asarray(wd, np.float64)
         mu = np.asarray(_linkinv(family, jnp.asarray(eta)), np.float64)
-        if family in ("binomial", "quasibinomial"):
-            mu = np.clip(mu, 1e-15, 1 - 1e-15)
-            return float(-2 * np.sum(w * (y * np.log(mu) + (1 - y) * np.log(1 - mu))))
-        return float(np.sum(w * (y - mu) ** 2))
+        return float(_family_deviance_sum(family, y, mu, w, tweedie_p, xp=np))
 
     def _fit_multinomial(self, Xd, ycodes, wd, K, alpha, lam, max_iter):
         """Softmax GLM via optax L-BFGS (the reference's multinomial L_BFGS)."""

@@ -379,6 +379,12 @@ class SharedTreeModel(H2OModel):
                  min_depth=int(min(depths, default=0)),
                  max_depth=int(max(depths, default=0)),
                  mean_leaves=float(np.mean(leaves)) if leaves else 0.0)
+        req = getattr(self, "requested_max_depth", self.max_depth)
+        if req != self.max_depth:
+            # the HBM-feasibility clamp reduced the user's max_depth — make
+            # that visible in the model summary, not just a log line
+            s.update(requested_max_depth=int(req),
+                     max_depth_clamped_to=int(self.max_depth))
         return s
 
     def _matrix(self, frame: Frame) -> np.ndarray:

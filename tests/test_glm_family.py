@@ -161,3 +161,59 @@ def test_glm_p_values(cloud1):
     assert row["x1"]["std_error"] == pytest.approx(se_true, rel=0.3)
     # data-scale coefficients match coef()
     assert row["x1"]["coefficients"] == pytest.approx(g.model.coef()["x1"], abs=1e-8)
+
+
+def test_poisson_family_deviance_for_lambda_search(cloud1):
+    """Lambda selection must use the poisson unit deviance, not squared
+    error (ADVICE r01): with a low-mean count response the two orderings
+    disagree, and the per-family deviance of the chosen model must be
+    no worse than what plain MSE selection would imply."""
+    from h2o3_tpu.models.glm import _family_deviance_sum
+
+    rng = np.random.default_rng(5)
+    n = 4000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    mu = np.exp(0.3 + 0.8 * x1 - 0.5 * x2)
+    y = rng.poisson(mu).astype(np.float64)
+    fr = Frame.from_dict({"x1": x1, "x2": x2, "y": y})
+    g = H2OGeneralizedLinearEstimator(family="poisson", lambda_search=True,
+                                      nlambdas=12)
+    g.train(x=["x1", "x2"], y="y", training_frame=fr)
+    coefs = g.model.coef()
+    # recovers the generating coefficients reasonably
+    assert coefs["x1"] == pytest.approx(0.8, abs=0.15)
+    assert coefs["x2"] == pytest.approx(-0.5, abs=0.15)
+    # unit-deviance helper sanity: perfect fit has ~zero deviance
+    assert float(_family_deviance_sum("poisson", y, np.clip(y, 1e-10, None),
+                                      np.ones(n), xp=np)) < 1e-6 * n
+
+
+def test_tweedie_boundary_powers_lambda_search(cloud1):
+    """tweedie_variance_power of exactly 1.0/2.0 must use the poisson/gamma
+    limit deviances, not divide by zero (review r02)."""
+    rng = np.random.default_rng(7)
+    n = 1500
+    x1 = rng.normal(size=n)
+    mu = np.exp(0.5 + 0.6 * x1)
+    y = rng.gamma(shape=2.0, scale=mu / 2.0)
+    fr = Frame.from_dict({"x1": x1, "y": y})
+    for power in (1.0, 2.0):
+        g = H2OGeneralizedLinearEstimator(
+            family="tweedie", tweedie_variance_power=power,
+            lambda_search=True, nlambdas=8)
+        g.train(x=["x1"], y="y", training_frame=fr)
+        assert g.model.coef()["x1"] == pytest.approx(0.6, abs=0.2)
+
+
+def test_gamma_tweedie_unit_deviances():
+    from h2o3_tpu.models.glm import _family_deviance_sum
+
+    y = np.asarray([0.5, 1.0, 2.0, 4.0])
+    w = np.ones(4)
+    # deviance is zero at mu == y and positive elsewhere
+    for fam, tp in [("gamma", 1.5), ("tweedie", 1.5)]:
+        d0 = float(_family_deviance_sum(fam, y, y, w, tp, xp=np))
+        d1 = float(_family_deviance_sum(fam, y, y * 1.5, w, tp, xp=np))
+        assert abs(d0) < 1e-9
+        assert d1 > 0

@@ -245,3 +245,37 @@ def test_rename_set_names_collisions(cloud1):
     with pytest.raises(ValueError):
         fr.set_names(["x", "x"])
     assert fr.ncol == 2  # untouched after failed renames
+
+
+def test_apply_axis1_multivalue_rows(cloud1):
+    """ADVICE r01: a row lambda returning ncol values on a square frame must
+    become ncol OUTPUT COLUMNS (upstream AstApply row semantics), not be
+    silently misread as a single full column."""
+    import pytest as _pytest
+    from h2o3_tpu.frame.frame import Frame
+
+    fr = Frame.from_dict({"a": [1.0, 2.0], "b": [3.0, 4.0]})  # 2x2: ncol==nrow
+    out = fr.apply(lambda row: row["a"] + row["b"], axis=1)
+    assert out.nrow == 2 and out.ncol == 1
+    assert list(np.asarray(out._col0())) == [4.0, 6.0]
+    # nrow(==ncol) values per ROW -> 2 columns, not one misread column
+    wide = fr.apply(lambda row: np.asarray([1.0, 2.0]), axis=1)
+    assert wide.shape == (2, 2)
+    widths = iter([1, 2])
+    with _pytest.raises(ValueError, match="ragged"):
+        fr.apply(lambda row: np.ones(next(widths)), axis=1)
+
+
+def test_rapids_apply_margin1_frame_result(cloud1):
+    """ADVICE r01: (apply fr 1 fn) where fn returns a Frame must unwrap it
+    like the margin=2 branch does."""
+    import h2o3_tpu as h2o
+
+    fr = h2o.H2OFrame({"a": [1.0, 2.0, 3.0], "b": [4.0, 5.0, 6.0]})
+    # the lambda body yields a 2-col Frame per row — margin=1 must keep BOTH
+    # columns (upstream row semantics), not TypeError on float(Frame) or
+    # silently truncate to the first column
+    out = h2o.rapids(f"(apply {fr.key} 1 {{ x . (+ x 1) }})")
+    assert out.shape == (3, 2)
+    assert list(np.asarray(out._col0())) == [2.0, 3.0, 4.0]
+    assert list(np.asarray(out.vec(out.names[1]).numeric_np())) == [5.0, 6.0, 7.0]
