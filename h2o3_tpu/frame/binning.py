@@ -59,6 +59,7 @@ def build_bins(
     is_categorical: Optional[np.ndarray] = None,
     domains: Optional[List[Optional[List[str]]]] = None,
     seed: int = 0,
+    col_ranges: Optional[np.ndarray] = None,
 ) -> BinnedMatrix:
     """Quantize columns of X (float, NaN=NA) into bin codes.
 
@@ -93,11 +94,23 @@ def build_bins(
             e = np.arange(0.5, nvalue - 0.5, 1.0)  # identity edges for export
         else:
             fin = col[~na]
-            if fin.size == 0:
+            if fin.size == 0 and col_ranges is None:
                 e = np.zeros(0)
                 c = np.zeros(n, dtype=np.int64)
             else:
-                lo, hi = float(fin.min()), float(fin.max())
+                # col_ranges: externally supplied global (lo, hi) — a
+                # multi-host cloud's min/max collective, so every process
+                # builds IDENTICAL edges from its local shard
+                if col_ranges is not None:
+                    lo, hi = float(col_ranges[j, 0]), float(col_ranges[j, 1])
+                    if not np.isfinite(lo):
+                        e = np.zeros(0)
+                        c = np.zeros(n, dtype=np.int64)
+                        codes[:, j] = np.where(na, nvalue, c).astype(dtype)
+                        edges.append(e)
+                        continue
+                else:
+                    lo, hi = float(fin.min()), float(fin.max())
                 if histogram_type == "UniformAdaptive":
                     e = np.linspace(lo, hi, nvalue + 1)[1:-1]
                     # arithmetic quantize == searchsorted(e, col, 'left') for

@@ -97,6 +97,17 @@ def parse_csv(
     return Frame(vecs, key=os.path.basename(path))
 
 
+def _split_lines(lines: List[str], sep: str, ncol: int) -> List[np.ndarray]:
+    """Shared line splitter for the python tokenize paths (whole-file and
+    distributed byte-range) — one place for quoting/strip semantics."""
+    cols: List[list] = [[] for _ in range(ncol)]
+    for ln in lines:
+        parts = ln.split(sep)
+        for c in range(ncol):
+            cols[c].append(parts[c].strip().strip('"') if c < len(parts) else "")
+    return [np.asarray(c, dtype=object) for c in cols]
+
+
 def _tokenize_numpy(path: str, sep: str, header: bool, ncol: int) -> List[np.ndarray]:
     """Fallback tokenizer: whole-file read + per-line split. The native C++
     path (`native/csv_parser.cpp`) replaces this when compiled."""
@@ -106,12 +117,7 @@ def _tokenize_numpy(path: str, sep: str, header: bool, ncol: int) -> List[np.nda
     if header:
         lines = lines[1:]
     lines = [ln for ln in lines if ln.strip()]
-    cols: List[list] = [[] for _ in range(ncol)]
-    for ln in lines:
-        parts = ln.split(sep)
-        for c in range(ncol):
-            cols[c].append(parts[c].strip().strip('"') if c < len(parts) else "")
-    return [np.asarray(c, dtype=object) for c in cols]
+    return _split_lines(lines, sep, ncol)
 
 
 def _column_to_vec(col: np.ndarray, hint: Optional[str]) -> Vec:
@@ -366,4 +372,13 @@ def import_file(path: str, **kw) -> Frame:
         return parse_parquet(path)
     if path.endswith(".orc"):
         return parse_orc(path)
+    import jax
+
+    if jax.process_count() > 1:
+        # multi-host cloud: every process parses its own byte range, then
+        # the phase-2 collectives agree on types/domains (ParseDataset's
+        # MultiFileParseTask + Categorical merge)
+        from .distributed_parse import parse_csv_distributed
+
+        return parse_csv_distributed(path, **kw)
     return parse_csv(path, **kw)

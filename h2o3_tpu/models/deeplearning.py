@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..frame.frame import Frame
+from ..parallel import distdata
 from ..parallel import mesh as cloudlib
 from .metrics import (
     ModelMetricsBinomial,
@@ -264,6 +265,16 @@ class H2ODeepLearningEstimator(H2OEstimator):
         ).astype(np.float32)
 
         cloud = cloudlib.cloud()
+        multiproc = distdata.multiprocess()
+        if multiproc:
+            if int(p.get("stopping_rounds", 0)) > 0 or p.get("max_runtime_secs"):
+                raise ValueError(
+                    "stopping_rounds/max_runtime_secs are not yet supported "
+                    "on multi-process clouds (host control flow would "
+                    "diverge across processes)")
+            n_global = int(distdata.global_sum(np.asarray([n]))[0])
+        else:
+            n_global = n
         batch = int(p.get("mini_batch_size", 32))
         batch = max(batch, cloud.size)
         batch = cloudlib.pad_to_multiple(batch, cloud.size)
@@ -367,8 +378,9 @@ class H2ODeepLearningEstimator(H2OEstimator):
             per-chunk reshuffle matches `shuffle_training_data` semantics."""
             kperm, kdrop = jax.random.split(key)
             need = nsteps * batch
-            perm = jax.random.permutation(kperm, n)
-            reps = -(-need // n)                       # ceil: allow short n
+            nrows = X_d.shape[0]            # global padded rows on a mesh
+            perm = jax.random.permutation(kperm, nrows)
+            reps = -(-need // nrows)                   # ceil: allow short n
             sel = jnp.tile(perm, reps)[:need]
             xs = (X_d[sel].reshape(nsteps, batch, -1),
                   y_d[sel].reshape((nsteps, batch) + y_d.shape[1:]),
@@ -391,7 +403,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
         rs = cloud.row_sharding() if cloud.size > 1 else None
         epochs = float(p.get("epochs", 10.0))
         tspi = int(p.get("train_samples_per_iteration", -2))
-        score_every = tspi if tspi > 0 else max(n, batch)
+        score_every = tspi if tspi > 0 else max(n_global, batch)
         stopper = (
             ScoreKeeper(int(p.get("stopping_rounds", 0)),
                         "logloss" if problem != "regression" else "deviance",
@@ -400,7 +412,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
         )
 
         rng = np.random.default_rng(seed)
-        total = int(epochs * n)
+        total = int(epochs * n_global)
         seen = 0
         it = 0
         next_score = score_every
@@ -416,7 +428,15 @@ class H2ODeepLearningEstimator(H2OEstimator):
         # control between steps).
         use_scan = not (max_runtime and max_runtime > 0)
         if use_scan:
-            if rs is not None:
+            if multiproc:
+                # each process contributes its ingest shard; zero-weight
+                # padding balances unequal byte ranges (loss is Σw-normalized
+                # so padded rows are exact no-ops)
+                quota = distdata.local_quota(n)
+                X_dev = distdata.global_row_array(X, quota, cloud)
+                y_dev = distdata.global_row_array(yarr, quota, cloud)
+                w_dev = distdata.global_row_array(w, quota, cloud)
+            elif rs is not None:
                 # shard straight from host — an unsharded intermediate on
                 # device 0 would defeat row sharding for data that only
                 # fits when split across the mesh
@@ -434,15 +454,20 @@ class H2ODeepLearningEstimator(H2OEstimator):
             # max_runtime path: no persistent device copy; scoring falls
             # back to the transient per-event transform
             X_score = None
+        # on a multi-host mesh the permutation covers padded rows too —
+        # discount the zero-weight slots so `epochs` counts REAL samples
+        real_frac = (n_global / float(X_dev.shape[0])
+                     if use_scan and multiproc else 1.0)
         while seen < total:
             if use_scan:
                 upto = min(next_score, total)
-                steps = max(1, -(-(upto - seen) // batch))   # ceil
+                eff_batch = max(batch * real_frac, 1e-9)
+                steps = max(1, -(-int(upto - seen) // int(max(eff_batch, 1))))
                 key, sub = jax.random.split(key)
                 params, opt_state = train_chunk(
                     params, opt_state, X_dev, y_dev, w_dev, sub,
                     float(it), int(steps))
-                seen += steps * batch
+                seen += max(int(steps * eff_batch), 1)
                 it += steps
             else:
                 idx = rng.integers(0, n, batch)

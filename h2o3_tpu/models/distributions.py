@@ -32,18 +32,23 @@ def infer_distribution(problem: str, requested: str = "AUTO") -> str:
     return {"binomial": "bernoulli", "multinomial": "multinomial"}.get(problem, "gaussian")
 
 
-def init_margin(dist: str, y: np.ndarray, w: np.ndarray, **kw) -> float:
-    """Initial constant margin f0 (Distribution.init / GBM initial value)."""
-    mu = float(np.average(y, weights=w))
+def init_margin(dist: str, y: np.ndarray, w: np.ndarray, mu: float = None,
+                **kw) -> float:
+    """Initial constant margin f0 (Distribution.init / GBM initial value).
+    `mu` overrides the locally computed weighted mean — a multi-host cloud
+    passes the global mean (quantile/laplace need full-column order
+    statistics and stay single-host)."""
+    if dist in ("quantile",):
+        return float(np.quantile(y, kw.get("alpha", 0.5)))
+    if dist in ("laplace",):
+        return float(np.median(y))
+    if mu is None:
+        mu = float(np.average(y, weights=w))
     if dist == "bernoulli":
         mu = min(max(mu, 1e-10), 1 - 1e-10)
         return float(np.log(mu / (1 - mu)))
     if dist in ("poisson", "gamma", "tweedie"):
         return float(np.log(max(mu, 1e-10)))
-    if dist in ("quantile",):
-        return float(np.quantile(y, kw.get("alpha", 0.5)))
-    if dist in ("laplace",):
-        return float(np.median(y))
     return mu
 
 

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..frame.frame import Frame
+from ..parallel import distdata
 from ..parallel import mesh as cloudlib
 from .metrics import (
     ModelMetricsBinomial,
@@ -102,6 +103,19 @@ def _irls_weights(family: str, eta, mu, y, tweedie_p=1.5):
         W = jnp.ones_like(mu)
         z = y
     return W, z
+
+
+@jax.jit
+def _wsums(y, w):
+    """(Σw, Σw·y) as replicated device scalars — safe on sharded inputs."""
+    return jnp.sum(w), jnp.sum(w * y)
+
+
+@functools.partial(jax.jit, static_argnames=("family", "tweedie_p"))
+def _deviance_device(X, y, w, beta, family: str, tweedie_p: float):
+    eta = jnp.matmul(X, beta, precision=jax.lax.Precision.HIGHEST)
+    mu = _linkinv(family, eta)
+    return _family_deviance_sum(family, y, mu, w, tweedie_p)
 
 
 @functools.partial(jax.jit, static_argnames=("family",))
@@ -443,7 +457,32 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         cloud = cloudlib.cloud()
         yd = jnp.asarray(yarr if family != "multinomial" else yarr.astype(np.float32))
         wd = jnp.asarray(w)
-        if cloud.size > 1 and n >= cloud.size:
+        if distdata.multiprocess():
+            # multi-host cloud: this process holds only its ingest shard —
+            # assemble global row-sharded arrays homed where the data was
+            # parsed (MRTask compute-where-the-chunks-live), zero-weight
+            # padding balancing unequal byte ranges
+            if family == "multinomial":
+                raise ValueError(
+                    "multinomial GLM is not yet supported on multi-process "
+                    "clouds")
+            if valid is not None:
+                raise ValueError(
+                    "validation_frame is not yet supported on multi-process "
+                    "clouds (each process holds only its shard, so lambda "
+                    "selection would diverge across processes)")
+            X = dinfo.fit_transform(train)      # standardization stats are
+            #                                     global (DataInfo collective)
+            Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
+            quota = distdata.local_quota(n)
+            Xd = distdata.global_row_array(Xi.astype(np.float32), quota, cloud)
+            yd = distdata.global_row_array(
+                np.asarray(yarr, np.float32), quota, cloud)
+            wd = distdata.global_row_array(w, quota, cloud)
+            n = int(getattr(train, "dist").global_nrow
+                    if getattr(train, "dist", None) else
+                    distdata.global_sum(np.asarray([n]))[0])
+        elif cloud.size > 1 and n >= cloud.size:
             X = dinfo.fit_transform(train)
             Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
             npad = cloudlib.pad_to_multiple(n, cloud.size)
@@ -497,6 +536,10 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
                 beta = self._irls(Xd, yd, wd, family, lam_v, alpha, max_iter, beta_eps, tweedie_p)
                 lam_best = lam_v
+            if p.get("compute_p_values") and (lam_best == 0) \
+                    and distdata.multiprocess():
+                raise ValueError("compute_p_values is not yet supported on "
+                                 "multi-process clouds")
             if p.get("compute_p_values") and (lam_best == 0):
                 gram, _ = _gram_step(Xd, yd, wd, jnp.asarray(beta), family, tweedie_p)
                 try:
@@ -547,14 +590,16 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
 
     def _irls(self, Xd, yd, wd, family, lam, alpha, max_iter, beta_eps, tweedie_p):
         pdim = Xd.shape[1]
-        n_obs = float(np.asarray(wd).sum())
+        # device reductions: global + replicated under a multi-host mesh,
+        # where a host np.asarray of the sharded arrays would not be
+        n_obs, wy = (float(v) for v in _wsums(yd, wd))
         beta = np.zeros(pdim, np.float64)
         if family in ("binomial", "quasibinomial", "fractionalbinomial"):
-            mu0 = float(np.average(np.asarray(yd), weights=np.asarray(wd) + 1e-12))
+            mu0 = wy / (n_obs + 1e-12)
             mu0 = min(max(mu0, 1e-6), 1 - 1e-6)
             beta[-1] = np.log(mu0 / (1 - mu0))
         elif family in ("poisson", "gamma", "tweedie"):
-            beta[-1] = np.log(max(float(np.average(np.asarray(yd), weights=np.asarray(wd) + 1e-12)), 1e-6))
+            beta[-1] = np.log(max(wy / (n_obs + 1e-12), 1e-6))
         for it in range(max_iter):
             gram, xy = _gram_step(Xd, yd, wd, jnp.asarray(beta, jnp.float32), family, tweedie_p)
             new_beta = _solve_penalized(
@@ -636,7 +681,7 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
 
     def _irls_warm(self, Xd, yd, wd, family, lam, alpha, max_iter, beta_eps, tweedie_p, beta0):
         beta = beta0.copy()
-        n_obs = float(np.asarray(wd).sum())
+        n_obs = float(_wsums(yd, wd)[0])
         for it in range(max_iter):
             gram, xy = _gram_step(Xd, yd, wd, jnp.asarray(beta, jnp.float32), family, tweedie_p)
             new_beta = _solve_penalized(
@@ -651,6 +696,11 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         return beta
 
     def _deviance(self, Xd, yd, wd, family, beta, tweedie_p=1.5):
+        if distdata.multiprocess():
+            # sharded inputs never reach the host; the jitted sum is global
+            return float(_deviance_device(
+                Xd, yd, wd, jnp.asarray(beta, jnp.float32), family,
+                float(tweedie_p)))
         eta = np.asarray(Xd @ jnp.asarray(beta, jnp.float32), np.float64)
         y = np.asarray(yd, np.float64)
         w = np.asarray(wd, np.float64)

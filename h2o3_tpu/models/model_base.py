@@ -177,8 +177,24 @@ class DataInfo:
     def fit_transform(self, frame: Frame) -> np.ndarray:
         X = self._expand(frame, fit=True)
         if self.standardize:
-            self.means = np.nanmean(X, axis=0)
-            self.stds = np.nanstd(X, axis=0)
+            from ..parallel import distdata
+
+            if distdata.multiprocess():
+                # global moments across the multi-host cloud (the MRTask
+                # mean/σ reduce) — local stats would skew each shard.
+                # Two-pass: mean first, then Σ(x−μ)² — the one-pass
+                # E[x²]−E[x]² form cancels catastrophically for columns
+                # with large mean and small spread
+                finite = ~np.isnan(X)
+                s = distdata.global_sum(np.nansum(X, axis=0))
+                c = np.maximum(distdata.global_sum(finite.sum(axis=0)), 1.0)
+                self.means = s / c
+                dev2 = distdata.global_sum(
+                    np.nansum((X - self.means) ** 2, axis=0))
+                self.stds = np.sqrt(dev2 / c)
+            else:
+                self.means = np.nanmean(X, axis=0)
+                self.stds = np.nanstd(X, axis=0)
             self.stds = np.where(self.stds < 1e-10, 1.0, self.stds)
             X = (X - self.means) / self.stds
         return np.nan_to_num(X, nan=0.0).astype(np.float32)

@@ -55,6 +55,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..frame.binning import BinnedMatrix, bin_apply, build_bins
 from ..frame.frame import Frame
+from ..parallel import distdata
 from ..parallel import mesh as cloudlib
 from . import distributions as dist_mod
 from . import tree as treelib
@@ -178,6 +179,16 @@ def _pack_hp(tp, lr, colp) -> "jnp.ndarray":
 
 
 _STEP_FNS_CAP = 32
+
+
+@jax.jit
+def _stack_args(*xs):
+    return jnp.stack(xs)
+
+
+@jax.jit
+def _sum_args(*xs):
+    return sum(xs[1:], xs[0])
 
 
 def _tree_step_fns(cfg: _StepCfg, cloud):
@@ -738,9 +749,45 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     f"({hbm_budget >> 30} GiB)")
                 tp["max_depth"] = feas
         _ph.mark("frame_to_matrix")
+        multiproc = distdata.multiprocess()
+        col_ranges = None
+        if multiproc:
+            # multi-host cloud: this process holds its ingest shard; global
+            # facts come from collectives. Features outside the v1 envelope
+            # fail loudly rather than silently training on local-only stats.
+            htype = tp["histogram_type"]
+            if htype == "AUTO":
+                htype = "UniformAdaptive"
+            unsupported = [
+                ("checkpoint", self._parms.get("checkpoint") is not None),
+                ("validation_frame", valid is not None),
+                ("score_each_iteration",
+                 bool(self._parms.get("score_each_iteration"))),
+                ("score_tree_interval",
+                 bool(self._parms.get("score_tree_interval"))),
+                ("stopping_rounds",
+                 int(self._parms.get("stopping_rounds", 0)) > 0),
+                ("balance_classes", bool(self._parms.get("balance_classes"))),
+                ("custom objective",
+                 getattr(self, "_objective_fn", None) is not None),
+                ("histogram_type=" + htype, htype == "QuantilesGlobal"),
+                ("distribution=" + str(dist),
+                 dist in ("quantile", "laplace")),
+                ("calibrate_model", bool(self._parms.get("calibrate_model"))),
+            ]
+            bad = [name for name, cond in unsupported if cond]
+            if bad:
+                raise ValueError(
+                    f"not yet supported on multi-process clouds: {bad}")
+            with np.errstate(all="ignore"):
+                lmin = np.nanmin(np.where(np.isnan(X), np.inf, X), axis=0)
+                lmax = np.nanmax(np.where(np.isnan(X), -np.inf, X), axis=0)
+            gmin, gmax = distdata.global_minmax(lmin, lmax)
+            col_ranges = np.stack([gmin, gmax], axis=1)
         bm = build_bins(
             X, nbins=nbins, histogram_type=tp["histogram_type"], names=list(x),
             is_categorical=is_cat, domains=doms, seed=seed,
+            col_ranges=col_ranges,
         )
 
         w = (
@@ -807,22 +854,33 @@ class H2OSharedTreeEstimator(H2OEstimator):
             yk = np.zeros((n, K), np.float32)
             yk[np.arange(n), codes] = 1.0
 
-        # initial margins
+        # initial margins (global moments on a multi-host cloud)
+        if multiproc:
+            sw = float(distdata.global_sum(np.asarray([w.sum()]))[0])
+            swy = distdata.global_sum((yk * w[:, None]).sum(axis=0))
         if self._mode == "drf":
             f0 = np.zeros(K, np.float32)
         elif problem == "multinomial":
-            pri = np.average(yk, axis=0, weights=w)
+            pri = (swy / max(sw, 1e-12) if multiproc
+                   else np.average(yk, axis=0, weights=w))
             f0 = np.log(np.clip(pri, 1e-10, 1.0)).astype(np.float32)
         elif getattr(self, "_objective_fn", None) is not None:
             f0 = np.zeros(1, np.float32)  # custom objectives start at 0 margin
         else:
-            f0 = np.float32(dist_mod.init_margin(dist, yk[:, 0], w))
+            f0 = np.float32(dist_mod.init_margin(
+                dist, yk[:, 0], w,
+                mu=(float(swy[0]) / max(sw, 1e-12)) if multiproc else None))
             f0 = np.asarray([f0])
 
         cloud = cloudlib.cloud()
         ndev = cloud.size
-        npad = cloudlib.pad_to_multiple(n, max(ndev * 8, 8))
-        pad = npad - n
+        if multiproc:
+            quota = distdata.local_quota(n)
+            npad = quota * jax.process_count()
+            pad = quota - n          # LOCAL padding (zero-weight rows)
+        else:
+            npad = cloudlib.pad_to_multiple(n, max(ndev * 8, 8))
+            pad = npad - n
 
         def padr(a, fill=0):
             if a.ndim == 1:
@@ -843,6 +901,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         warm_thread = None
         if self._parms.get("checkpoint") is None \
                 and getattr(self, "_objective_fn", None) is None \
+                and not multiproc \
                 and os.environ.get("H2O3_WARM_THREAD", "1") != "0":
             cfg_early = self._make_step_cfg(tp, npad, K, F, nbins, problem,
                                             dist)
@@ -886,38 +945,57 @@ class H2OSharedTreeEstimator(H2OEstimator):
             warm_thread = threading.Thread(target=_warm, daemon=True)
             warm_thread.start()
 
-        codes_d = jnp.asarray(padr(bm.codes))
-        if yk.size and bool(np.all((yk >= 0) & (yk <= 255)
-                                   & (yk == np.floor(yk)))):
-            # integer-ish response (class indicators, counts): ship uint8
-            # through the tunnel (4× smaller) and widen on device
-            y_d = jnp.asarray(padr(yk.astype(np.uint8))).astype(jnp.float32)
-        else:
-            y_d = jnp.asarray(padr(yk))
-        if np.all(w == 1.0):
-            # trivial weights: build on device (zero-weight padded tail)
-            # instead of pushing 4·npad bytes of 1.0s through the tunnel
-            w_d = jnp.ones(npad, jnp.float32).at[n:].set(0.0) if pad else (
-                jnp.ones(npad, jnp.float32))
-        else:
-            w_d = jnp.asarray(padr(w))
-        edges = np.full((F, nbins - 2), np.inf, np.float32)
+        edges = np.full((F, nbins - 2), np.float32(np.inf), np.float32)
         for j, e in enumerate(bm.edges):
             edges[j, : min(len(e), nbins - 2)] = e[: nbins - 2]
-        edges_d = jnp.asarray(edges)
 
-        if ndev > 1:
-            rs = cloud.row_sharding()
-            codes_d = jax.device_put(codes_d, rs)
-            y_d = jax.device_put(y_d, rs)
-            w_d = jax.device_put(w_d, rs)
-            edges_d = jax.device_put(edges_d, cloud.replicated())
+        if multiproc:
+            # each process supplies its ingest shard of the global arrays,
+            # homed on its own devices (the DKV chunk-home placement)
+            codes_d = distdata.global_row_array(padr(bm.codes), quota, cloud)
+            y_d = distdata.global_row_array(
+                padr(yk).astype(np.float32), quota, cloud)
+            w_d = distdata.global_row_array(padr(w), quota, cloud)
+            edges_d = distdata.replicated_array(edges, cloud)
+            rs_m = cloud.row_sharding()
+            margins = jax.jit(
+                lambda: jnp.broadcast_to(
+                    jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32),
+                out_shardings=rs_m)()
+            if offset is not None:
+                off_g = distdata.global_row_array(padr(offset), quota, cloud)
+                margins = jax.jit(lambda m, o: m + o[:, None],
+                                  out_shardings=rs_m)(margins, off_g)
+        else:
+            codes_d = jnp.asarray(padr(bm.codes))
+            if yk.size and bool(np.all((yk >= 0) & (yk <= 255)
+                                       & (yk == np.floor(yk)))):
+                # integer-ish response (class indicators, counts): ship uint8
+                # through the tunnel (4× smaller) and widen on device
+                y_d = jnp.asarray(padr(yk.astype(np.uint8))).astype(jnp.float32)
+            else:
+                y_d = jnp.asarray(padr(yk))
+            if np.all(w == 1.0):
+                # trivial weights: build on device (zero-weight padded tail)
+                # instead of pushing 4·npad bytes of 1.0s through the tunnel
+                w_d = jnp.ones(npad, jnp.float32).at[n:].set(0.0) if pad else (
+                    jnp.ones(npad, jnp.float32))
+            else:
+                w_d = jnp.asarray(padr(w))
+            edges_d = jnp.asarray(edges)
 
-        margins = jnp.broadcast_to(jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
-        if offset is not None:
-            margins = margins + jnp.asarray(padr(offset))[:, None]
-        if ndev > 1:
-            margins = jax.device_put(margins, cloud.row_sharding())
+            if ndev > 1:
+                rs = cloud.row_sharding()
+                codes_d = jax.device_put(codes_d, rs)
+                y_d = jax.device_put(y_d, rs)
+                w_d = jax.device_put(w_d, rs)
+                edges_d = jax.device_put(edges_d, cloud.replicated())
+
+            margins = jnp.broadcast_to(jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
+            if offset is not None:
+                margins = margins + jnp.asarray(padr(offset))[:, None]
+            if ndev > 1:
+                margins = jax.device_put(margins, cloud.row_sharding())
 
         # checkpoint= continue-training: restore the prior forest and fast-
         # forward margins (SharedTree checkpoint restart — `_parms.checkpoint`
@@ -1034,6 +1112,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
         mono_d = (jnp.asarray(mono_vec) if mono_vec is not None
                   else jnp.zeros(F, jnp.float32))
         hp_d = _pack_hp(tp, lr, colp)
+        if multiproc:
+            # small per-call args go in as host numpy (identical on every
+            # process ⇒ jit replicates them); locally-committed jnp arrays
+            # would carry a single-device sharding the global mesh rejects
+            mono_d = np.asarray(mono_d)
+            hp_d = np.asarray(hp_d)
+            key = np.asarray(key)
 
         def _train_chunk(margins, oob_sum, oob_cnt, key, m0, nsteps: int):
             """nsteps async per-tree dispatches (NOT lax.scan: a scan body
@@ -1048,7 +1133,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 )
                 packed_list.append(packed)
                 gains_list.append(gains)
-            return margins, oob_sum, oob_cnt, jnp.stack(packed_list), sum(gains_list)
+            # jitted combine: eager stack/sum would reject process-spanning
+            # arrays on a multi-host mesh (single-host cost is one dispatch)
+            return (margins, oob_sum, oob_cnt,
+                    _stack_args(*packed_list), _sum_args(*gains_list))
 
         def _stacked_from_packed_dev(packed, k):
             """Device (nsteps, K, T, 5) → stacked Tree for class k (device)."""
@@ -1086,19 +1174,35 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 raise ValueError(
                     f"sample_rate_per_class needs {nclass} entries, got {len(rates_np)}")
             rate_rows = rates_np[np.asarray(yvec.data, np.int64)]
-            rate_d = jnp.asarray(padr(rate_rows.astype(np.float32)))
+            rate_d = (distdata.global_row_array(
+                          padr(rate_rows.astype(np.float32)), quota, cloud)
+                      if multiproc
+                      else jnp.asarray(padr(rate_rows.astype(np.float32))))
+        elif multiproc:
+            rate_d = distdata.sharded_full(
+                (npad,), np.float32(tp["sample_rate"]), jnp.float32, cloud)
         else:
             rate_d = jnp.full(npad, np.float32(tp["sample_rate"]))
         row_sampled = tp["sample_rate"] < 1.0 or bool(srpc)
-        if ndev > 1:
+        if ndev > 1 and not multiproc:
             rate_d = jax.device_put(rate_d, cloud.row_sharding())
         # DRF OOB accumulators (out-of-bag prediction sums / counts per row)
         if self._mode == "drf":
-            oob_sum = jnp.zeros((npad, K), jnp.float32)
-            oob_cnt = jnp.zeros(npad, jnp.float32)
-            if ndev > 1:
-                oob_sum = jax.device_put(oob_sum, cloud.row_sharding())
-                oob_cnt = jax.device_put(oob_cnt, cloud.row_sharding())
+            if multiproc:
+                oob_sum = distdata.sharded_full((npad, K), 0.0, jnp.float32,
+                                                cloud)
+                oob_cnt = distdata.sharded_full((npad,), 0.0, jnp.float32,
+                                                cloud)
+            else:
+                oob_sum = jnp.zeros((npad, K), jnp.float32)
+                oob_cnt = jnp.zeros(npad, jnp.float32)
+                if ndev > 1:
+                    oob_sum = jax.device_put(oob_sum, cloud.row_sharding())
+                    oob_cnt = jax.device_put(oob_cnt, cloud.row_sharding())
+        elif multiproc:
+            # unused placeholders, replicated via implicit np conversion
+            oob_sum = np.zeros((1, K), np.float32)
+            oob_cnt = np.zeros(1, np.float32)
         else:
             oob_sum = jnp.zeros((1, K), jnp.float32)  # unused placeholder
             oob_cnt = jnp.zeros(1, jnp.float32)
@@ -1205,14 +1309,23 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # remaining device chunks: single device-side concat + ONE D2H
             # (per-chunk sync transfers only happen on over-budget flushes)
             if packed_chunks:
-                rest = (packed_chunks[0] if len(packed_chunks) == 1
-                        else jnp.concatenate(packed_chunks, axis=0))
-                packed_host.append(np.asarray(rest))
+                if multiproc:
+                    # eager concat of process-spanning arrays needs jit;
+                    # chunks are replicated, so host concat is equivalent
+                    packed_host.extend(np.asarray(pk) for pk in packed_chunks)
+                else:
+                    rest = (packed_chunks[0] if len(packed_chunks) == 1
+                            else jnp.concatenate(packed_chunks, axis=0))
+                    packed_host.append(np.asarray(rest))
                 packed_chunks.clear()
             all_packed = (packed_host[0] if len(packed_host) == 1
                           else np.concatenate(packed_host, axis=0))
             _ph.mark("forest_D2H")
-            gain_total += np.asarray(sum(gains_chunks), np.float64)
+            if multiproc:
+                gain_total += np.sum([np.asarray(g, np.float64)
+                                      for g in gains_chunks], axis=0)
+            else:
+                gain_total += np.asarray(sum(gains_chunks), np.float64)
             _ph.mark("gains_D2H")
         else:
             all_packed = np.zeros((0, K, treelib.heap_size(tp["max_depth"]), 6),
@@ -1274,7 +1387,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # training metrics straight from the final margins (already on device)
         # instead of a fresh forest re-predict — saves transfers + a compile
         _ph.mark("forest_unpack")
-        margins_np = np.asarray(margins[:n]).astype(np.float64)
+        if multiproc:
+            # this process's real rows (training metrics are local-shard on
+            # a multi-host cloud; the forest itself is identical everywhere)
+            margins_np = distdata.local_shard(margins)[:n].astype(np.float64)
+        else:
+            margins_np = np.asarray(margins[:n]).astype(np.float64)
         _ph.mark("margins_D2H")
         if self._mode == "drf" and row_sampled and n_prior > 0:
             # checkpoint continuation: the prior forest's per-tree sample
@@ -1288,8 +1406,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # DRF training metrics are OUT-OF-BAG (DRF OOB scoring): each
             # row is scored only by trees that did not sample it; in-bag
             # margins back-fill rows every tree happened to include
-            osum = np.asarray(oob_sum[:n], np.float64)
-            ocnt = np.asarray(oob_cnt[:n], np.float64)
+            if multiproc:
+                osum = distdata.local_shard(oob_sum)[:n].astype(np.float64)
+                ocnt = distdata.local_shard(oob_cnt)[:n].astype(np.float64)
+            else:
+                osum = np.asarray(oob_sum[:n], np.float64)
+                ocnt = np.asarray(oob_cnt[:n], np.float64)
             have = ocnt > 0
             oob_mean = np.where(
                 have[:, None], osum / np.maximum(ocnt[:, None], 1.0),

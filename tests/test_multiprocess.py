@@ -1,0 +1,173 @@
+"""Multi-process training correctness: N local processes under
+jax.distributed (the reference's multi-JVM loopback cloud, SURVEY.md §4)
+must reproduce the single-process model within tolerance — VERDICT r01
+item 5. Ingest is per-process byte ranges (distributed_parse), so these
+tests exercise the full distributed path: parse → global domains → global
+row-sharded arrays → collective training math."""
+
+import csv
+
+import numpy as np
+import pytest
+
+from tests.multiproc_util import run_workers
+
+
+def _write_glm_csv(path, n=4000, seed=11):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = rng.integers(0, 4, size=n)
+    eff = 1.2 * x1 - 0.7 * x2 + 0.5 * (cat == 2)
+    y = (rng.random(n) < 1 / (1 + np.exp(-eff))).astype(int)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["x1", "x2", "cat", "y"])
+        for i in range(n):
+            w.writerow([f"{x1[i]:.6f}", f"{x2[i]:.6f}", f"g{cat[i]}",
+                        "yes" if y[i] else "no"])
+
+
+GLM_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+g = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
+                                  solver="IRLSM")
+g.train(x=["x1", "x2", "cat"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    c = g.model.coef()
+    np.savez({out!r}, **{{k: float(v) for k, v in c.items()}})
+print("rank", jax.process_index(), "done")
+"""
+
+
+def test_glm_two_process_matches_single(tmp_path, cloud1):
+    p = str(tmp_path / "glm.csv")
+    _write_glm_csv(p)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    ref = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
+                                        solver="IRLSM")
+    ref.train(x=["x1", "x2", "cat"], y="y", training_frame=fr)
+    ref_coef = ref.model.coef()
+
+    out = str(tmp_path / "coef2.npz")
+    run_workers(2, GLM_BODY.format(csv=p, out=out))
+    got = np.load(out)
+    assert set(got.files) == set(ref_coef)
+    for k in ref_coef:
+        assert float(got[k]) == pytest.approx(float(ref_coef[k]),
+                                              abs=2e-3), k
+
+
+def _write_gbm_csv(path, n=3000, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    cat = rng.integers(0, 3, size=n)
+    eff = X[:, 0] + 0.8 * X[:, 1] * X[:, 2] + 0.6 * (cat == 1)
+    y = (eff + 0.3 * rng.normal(size=n) > 0).astype(int)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow([f"x{i}" for i in range(6)] + ["c", "y"])
+        for i in range(n):
+            w.writerow([f"{v:.6f}" for v in X[i]] + [f"k{cat[i]}", int(y[i])])
+
+
+GBM_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+g = H2OGradientBoostingEstimator(ntrees=15, max_depth=4, seed=5)
+g.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    m = g.model
+    feat = np.concatenate([np.asarray(t.feat).ravel() for t in m.forest])
+    thr = np.concatenate([np.asarray(t.thr).ravel() for t in m.forest])
+    val = np.concatenate([np.asarray(t.value).ravel() for t in m.forest])
+    np.savez({out!r}, feat=feat, thr=thr, val=val,
+             auc=float(m.training_metrics.auc))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_gbm_two_process_matches_single(tmp_path, cloud1):
+    p = str(tmp_path / "gbm.csv")
+    _write_gbm_csv(p)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    ref = H2OGradientBoostingEstimator(ntrees=15, max_depth=4, seed=5)
+    ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
+              training_frame=fr)
+    rm = ref.model
+    ref_feat = np.concatenate([np.asarray(t.feat).ravel() for t in rm.forest])
+    ref_thr = np.concatenate([np.asarray(t.thr).ravel() for t in rm.forest])
+    ref_val = np.concatenate([np.asarray(t.value).ravel() for t in rm.forest])
+
+    out = str(tmp_path / "gbm2.npz")
+    run_workers(2, GBM_BODY.format(csv=p, out=out))
+    got = np.load(out)
+    # identical binning edges + exact psum histograms -> same split structure
+    assert (got["feat"] == ref_feat).mean() > 0.98
+    np.testing.assert_allclose(got["thr"], ref_thr, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got["val"], ref_val, rtol=5e-3, atol=5e-3)
+    assert float(got["auc"]) == pytest.approx(
+        float(rm.training_metrics.auc), abs=0.02)
+
+
+DL_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+d = H2ODeepLearningEstimator(hidden=[16], epochs=6, seed=3,
+                             mini_batch_size=32)
+d.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    m = d.model_performance(fr)
+    np.savez({out!r}, auc=float(m.auc))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_dl_two_process_learns(tmp_path, cloud1):
+    p = str(tmp_path / "dl.csv")
+    _write_gbm_csv(p)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    ref = H2ODeepLearningEstimator(hidden=[16], epochs=6, seed=3,
+                                   mini_batch_size=32)
+    ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
+              training_frame=fr)
+    ref_auc = float(ref.model_performance(fr).auc())
+
+    out = str(tmp_path / "dl2.npz")
+    run_workers(2, DL_BODY.format(csv=p, out=out))
+    got_auc = float(np.load(out)["auc"])
+    # different batch composition (padded permutation) -> tolerance, not
+    # bit-identity; both must clearly learn the signal
+    assert ref_auc > 0.85
+    assert got_auc == pytest.approx(ref_auc, abs=0.08)
