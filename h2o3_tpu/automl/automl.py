@@ -187,6 +187,10 @@ class H2OAutoML:
         parms["seed"] = self.seed
         parms["nfolds"] = self.nfolds
         parms["keep_cross_validation_predictions"] = True
+        # reference AutoML default: fold models are discarded once their
+        # holdout predictions/metrics are extracted (frees the device-
+        # resident fold forests — deep DRF folds are ~600 MB HBM each)
+        parms["keep_cross_validation_models"] = False
         if self.max_runtime_secs_per_model:
             parms["max_runtime_secs"] = self.max_runtime_secs_per_model
         try:

@@ -79,29 +79,18 @@ def _allgather_int(value: int) -> List[int]:
 
 
 def _allgather_f64_vec(vec: np.ndarray) -> np.ndarray:
-    """(nproc, len(vec)) gather of a small f64 fact vector — transported as
-    raw bytes so boundary-exact comparisons (e.g. the 2^24 downcast
-    threshold) survive; a f32 device gather would round them."""
-    v = np.asarray(vec, np.float64)
-    blobs = _allgather_bytes(v.tobytes())
-    return np.stack([np.frombuffer(b, np.float64) for b in blobs])
+    """(nproc, len(vec)) gather of a small f64 fact vector — raw-byte
+    transport (see distdata.allgather_host) so boundary-exact comparisons
+    (e.g. the 2^24 downcast threshold) survive."""
+    from ..parallel.distdata import allgather_host
+
+    return allgather_host(np.asarray(vec, np.float64))
 
 
 def _allgather_bytes(payload: bytes) -> List[bytes]:
-    """Variable-length byte blobs from every process, in rank order."""
-    if _process_count() == 1:
-        return [payload]
-    import jax.numpy as jnp
-    from jax.experimental import multihost_utils
+    from ..parallel.distdata import allgather_bytes
 
-    lens = _allgather_int(len(payload))
-    maxlen = max(max(lens), 1)
-    buf = np.zeros(maxlen, np.uint8)
-    buf[: len(payload)] = np.frombuffer(payload, np.uint8)
-    out = np.asarray(
-        multihost_utils.process_allgather(jnp.asarray(buf)))
-    out = out.reshape(len(lens), maxlen)
-    return [out[r, : lens[r]].tobytes() for r in range(len(lens))]
+    return allgather_bytes(payload)
 
 
 def _union_domains(local: List[str]) -> List[str]:

@@ -303,7 +303,19 @@ class DataInfo:
                 c = v.numeric_np()
                 if self.impute_missing:
                     if fit:
-                        self.col_means[n] = float(np.nanmean(c))
+                        from ..parallel import distdata
+
+                        if distdata.multiprocess():
+                            # global imputation mean — a local shard mean
+                            # would bake different values into each
+                            # process's design matrix (and into the saved
+                            # model's col_means)
+                            sc = distdata.global_sum(np.asarray(
+                                [np.nansum(c), float((~np.isnan(c)).sum())],
+                                np.float64))
+                            self.col_means[n] = float(sc[0] / max(sc[1], 1.0))
+                        else:
+                            self.col_means[n] = float(np.nanmean(c))
                     c = np.where(np.isnan(c), self.col_means.get(n, 0.0), c)
                 cols.append(c[:, None])
             else:

@@ -187,6 +187,25 @@ def _stack_args(*xs):
 
 
 @jax.jit
+def _concat_args(*xs):
+    return jnp.concatenate(xs, axis=0)
+
+
+def _bucket_rows(npad: int) -> int:
+    """Round a padded row count up to {1, 1.125, 1.25, ..., 2}·2^k so
+    near-same-size datasets share compiled programs (≤12.5% pad overhead).
+    Small shapes stay exact — their compiles are cheap and padding is not."""
+    if npad <= 8192:
+        return npad
+    p = 1 << (npad.bit_length() - 1)
+    for eighths in range(8, 17):
+        cand = p * eighths // 8
+        if cand >= npad:
+            return cand
+    return 2 * p
+
+
+@jax.jit
 def _sum_args(*xs):
     return sum(xs[1:], xs[0])
 
@@ -357,7 +376,8 @@ class SharedTreeModel(H2OModel):
     algo = "sharedtree"
 
     def __init__(self, params, x, y, bm: BinnedMatrix, problem, nclass, domain,
-                 distribution, f0, forest, max_depth, mode="gbm"):
+                 distribution, f0, forest, max_depth, mode="gbm",
+                 packed_dev=None, nclasses_packed=1):
         # report the concrete builder's algo (gbm/drf/...), not the shared base
         self.algo = getattr(params, "algo", self.algo)
         super().__init__(params)
@@ -369,23 +389,80 @@ class SharedTreeModel(H2OModel):
         self.domain = domain
         self.distribution = distribution
         self.f0 = f0              # scalar or (K,) initial margin
+        # device-resident pack: (ntrees, K, T, 6) in HBM. Deep heaps are
+        # 12.6 MB/tree and a remote-chip tunnel moves ~6 MB/s, so the host
+        # copy (mojo/save/tree-API consumers) is materialized LAZILY;
+        # scoring slices the pack on device and never pays the transfer.
+        self._packed_dev = packed_dev
+        self._K_packed = nclasses_packed
         self.forest = forest      # list over classes of stacked Tree arrays
         self.max_depth = max_depth
         self.mode = mode          # 'gbm' (summed margins) | 'drf' (averaged leaves)
-        self.ntrees_built = int(forest[0].feat.shape[0]) if forest else 0
-        self.covers = None        # list over classes of (ntrees, T) — TreeSHAP
+        if packed_dev is not None:
+            self.ntrees_built = int(packed_dev.shape[0])
+        else:
+            self.ntrees_built = int(forest[0].feat.shape[0]) if forest else 0
+        if packed_dev is None:
+            self.covers = None    # list over classes of (ntrees, T) — TreeSHAP
+
+    @property
+    def forest(self):
+        if self._forest is None and self._packed_dev is not None:
+            self._materialize_host_forest()
+        return self._forest
+
+    @forest.setter
+    def forest(self, v):
+        self._forest = v
+
+    @property
+    def covers(self):
+        if self.__dict__.get("_covers") is None and self._packed_dev is not None:
+            self._materialize_host_forest()
+        return self.__dict__.get("_covers")
+
+    @covers.setter
+    def covers(self, v):
+        self._covers = v
+
+    def _materialize_host_forest(self):
+        """The deferred forest D2H: one bulk transfer, then host slicing."""
+        ap = np.asarray(self._packed_dev)
+        forest, covers = [], []
+        for k in range(self._K_packed):
+            forest.append(treelib.Tree(
+                np.ascontiguousarray(ap[:, k, :, 0]).astype(np.int32),
+                np.ascontiguousarray(ap[:, k, :, 1]).astype(np.int32),
+                np.ascontiguousarray(ap[:, k, :, 2]),
+                ap[:, k, :, 3] > 0.5,
+                np.ascontiguousarray(ap[:, k, :, 4]),
+            ))
+            covers.append(np.ascontiguousarray(ap[:, k, :, 5]))
+        self._forest = forest
+        self._covers = covers
 
     def summary(self):
         """ModelSummary of SharedTreeModel: tree count + depth/leaf stats."""
         s = super().summary()
         depths, leaves = [], []
-        for stacked in self.forest:
-            issp = np.asarray(stacked.is_split)
-            node_depth = np.floor(np.log2(np.arange(1, issp.shape[1] + 1)))
-            for t in range(issp.shape[0]):
-                d = node_depth[issp[t]].max() + 1 if issp[t].any() else 0
-                depths.append(int(d))
-                leaves.append(int(issp[t].sum() + 1))
+        if self._forest is None and self._packed_dev is not None:
+            # device reduction — stats without materializing the host forest
+            issp = self._packed_dev[..., 3] > 0.5          # (nt, K, T)
+            T = issp.shape[2]
+            nd = jnp.floor(jnp.log2(jnp.arange(1, T + 1, dtype=jnp.float32)))
+            d_tk = jnp.max(jnp.where(issp, nd[None, None, :] + 1, 0.0),
+                           axis=2)                          # (nt, K)
+            l_tk = issp.sum(axis=2) + 1
+            depths = [int(v) for v in np.asarray(d_tk).ravel()]
+            leaves = [int(v) for v in np.asarray(l_tk).ravel()]
+        else:
+            for stacked in self.forest:
+                issp = np.asarray(stacked.is_split)
+                node_depth = np.floor(np.log2(np.arange(1, issp.shape[1] + 1)))
+                for t in range(issp.shape[0]):
+                    d = node_depth[issp[t]].max() + 1 if issp[t].any() else 0
+                    depths.append(int(d))
+                    leaves.append(int(issp[t].sum() + 1))
         s.update(number_of_trees=self.ntrees_built,
                  min_depth=int(min(depths, default=0)),
                  max_depth=int(max(depths, default=0)),
@@ -410,6 +487,21 @@ class SharedTreeModel(H2OModel):
         repeated scoring reuses the same backing arrays."""
         cache = self.__dict__.setdefault("_padded_forests", {})
         if k not in cache:
+            if self._forest is None and self._packed_dev is not None:
+                # slice the device pack in HBM — scoring never pulls the
+                # forest to host
+                ap = self._packed_dev
+                nt = int(ap.shape[0])
+                bucket = 1 << (nt - 1).bit_length() if nt else 0
+                sl = ap[:, k]                              # (nt, T, 6)
+                if bucket != nt:
+                    sl = jnp.concatenate(
+                        [sl, jnp.zeros((bucket - nt,) + sl.shape[1:],
+                                       sl.dtype)], axis=0)
+                cache[k] = treelib.Tree(
+                    sl[..., 0].astype(jnp.int32), sl[..., 1].astype(jnp.int32),
+                    sl[..., 2], sl[..., 3] > 0.5, sl[..., 4])
+                return cache[k]
             stacked = self.forest[k]
             nt = int(np.asarray(stacked.feat).shape[0])
             bucket = 1 << (nt - 1).bit_length() if nt else 0
@@ -424,11 +516,17 @@ class SharedTreeModel(H2OModel):
             cache[k] = stacked
         return cache[k]
 
+    @property
+    def _n_class_forests(self) -> int:
+        if self._forest is None and self._packed_dev is not None:
+            return self._K_packed
+        return len(self.forest)
+
     # margin(s) on raw feature matrix
     def _margins(self, X: np.ndarray) -> np.ndarray:
         Xj = jnp.asarray(X, jnp.float32)
         outs = []
-        for k in range(len(self.forest)):
+        for k in range(self._n_class_forests):
             s = treelib.predict_forest_raw(self._padded_forest(k), Xj,
                                            self.max_depth)
             f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[k]
@@ -880,6 +978,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
             pad = quota - n          # LOCAL padding (zero-weight rows)
         else:
             npad = cloudlib.pad_to_multiple(n, max(ndev * 8, 8))
+            # row-count bucketing (the ntrees-bucketing trick, applied to
+            # rows): CV folds and near-same-size frames land on a shared
+            # padded shape, so they reuse ONE compiled tree program instead
+            # of paying a compile-cache load each (~4-10 s through a remote
+            # chip tunnel). ≤12.5% extra zero-weight rows — exact no-ops.
+            npad = _bucket_rows(npad)
             pad = npad - n
 
         def padr(a, fill=0):
@@ -1211,8 +1315,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
         packed_host: List = []     # flushed-to-host chunks (OOM guard)
         dev_bytes = 0
         # deep forests (heap 2^(d+1) nodes × 5 fields × K) can exceed HBM if
-        # the whole run stays device-resident — flush to host past this budget
-        _PACK_BUDGET = 512 << 20
+        # the whole run stays device-resident — flush to host past this
+        # budget. Generous by default (the bench chip has 16 GB): a flush
+        # costs minutes of tunnel D2H, an HBM-resident pack costs bytes
+        _PACK_BUDGET = int(os.environ.get("H2O3_PACK_BUDGET_MB", 4096)) << 20
 
         def _flush_packed():
             nonlocal dev_bytes
@@ -1303,8 +1409,25 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if self.job:
                 self.job.update(built / max(ntrees_target, 1))
 
-        # ---- ONE bulk D2H of the whole new forest + gains ----------------
-        if packed_chunks or packed_host:
+        # ---- forest stays ON DEVICE; host materialization is lazy --------
+        # Deep heaps are big (depth-18 ⇒ 12.6 MB/tree) and a remote-chip
+        # tunnel moves ~6 MB/s — an eager D2H of a 50-tree DRF forest costs
+        # ~80 s, dominating training. The packed array is kept in HBM;
+        # `.forest` (mojo/save/tree-API consumers) pulls it to host on
+        # first access. Fallbacks to the eager host path: checkpoint
+        # continuation (needs host concat with the prior forest), multi-host
+        # meshes, and over-budget runs that already flushed chunks.
+        packed_dev = None
+        if packed_chunks and not packed_host and not prior_stacked \
+                and not multiproc:
+            _ph.mark("train_loop_dispatch")
+            packed_dev = (packed_chunks[0] if len(packed_chunks) == 1
+                          else _concat_args(*packed_chunks))
+            packed_chunks.clear()
+            all_packed = None
+            _ph.mark("forest_devkeep")
+            gain_total += np.asarray(sum(gains_chunks), np.float64)
+        elif packed_chunks or packed_host:
             _ph.mark("train_loop_dispatch")
             # remaining device chunks: single device-side concat + ONE D2H
             # (per-chunk sync transfers only happen on over-budget flushes)
@@ -1330,43 +1453,48 @@ class H2OSharedTreeEstimator(H2OEstimator):
         else:
             all_packed = np.zeros((0, K, treelib.heap_size(tp["max_depth"]), 6),
                                   np.float32)
-        # stacked forests sliced straight off the bulk array — no per-tree
-        # host Trees, no 6×ntrees tiny H2D transfers (stack_trees on device)
-        forest = []
-        covers_by_class = []
-        prior_covers = getattr(pm, "covers", None) if prior_stacked else None
-        for k in range(K):
-            new = treelib.Tree(
-                np.ascontiguousarray(all_packed[:, k, :, 0]).astype(np.int32),
-                np.ascontiguousarray(all_packed[:, k, :, 1]).astype(np.int32),
-                np.ascontiguousarray(all_packed[:, k, :, 2]),
-                all_packed[:, k, :, 3] > 0.5,
-                np.ascontiguousarray(all_packed[:, k, :, 4]),
-            )
-            cov_k = np.ascontiguousarray(all_packed[:, k, :, 5])
-            if prior_stacked:
-                prior = prior_stacked[k]
-                new = treelib.Tree(*[
-                    np.concatenate([np.asarray(getattr(prior, f)),
-                                    getattr(new, f)], axis=0)
-                    for f in treelib.Tree._fields
-                ])
-                if prior_covers is not None and k < len(prior_covers):
-                    cov_k = np.concatenate(
-                        [np.asarray(prior_covers[k], np.float32), cov_k], axis=0)
-            forest.append(new)
-            covers_by_class.append(cov_k)
-        if prior_stacked and prior_covers is None:
-            # continued from a pre-TreeSHAP checkpoint: the prior trees have
-            # no covers, so a partial covers array would misalign with the
-            # forest — disable contributions for this model instead
-            covers_by_class = None
+        forest = None
+        covers_by_class = None
+        if packed_dev is None:
+            # stacked forests sliced straight off the bulk array — no
+            # per-tree host Trees, no 6×ntrees tiny H2D transfers
+            forest = []
+            covers_by_class = []
+            prior_covers = getattr(pm, "covers", None) if prior_stacked else None
+            for k in range(K):
+                new = treelib.Tree(
+                    np.ascontiguousarray(all_packed[:, k, :, 0]).astype(np.int32),
+                    np.ascontiguousarray(all_packed[:, k, :, 1]).astype(np.int32),
+                    np.ascontiguousarray(all_packed[:, k, :, 2]),
+                    all_packed[:, k, :, 3] > 0.5,
+                    np.ascontiguousarray(all_packed[:, k, :, 4]),
+                )
+                cov_k = np.ascontiguousarray(all_packed[:, k, :, 5])
+                if prior_stacked:
+                    prior = prior_stacked[k]
+                    new = treelib.Tree(*[
+                        np.concatenate([np.asarray(getattr(prior, f)),
+                                        getattr(new, f)], axis=0)
+                        for f in treelib.Tree._fields
+                    ])
+                    if prior_covers is not None and k < len(prior_covers):
+                        cov_k = np.concatenate(
+                            [np.asarray(prior_covers[k], np.float32), cov_k], axis=0)
+                forest.append(new)
+                covers_by_class.append(cov_k)
+            if prior_stacked and prior_covers is None:
+                # continued from a pre-TreeSHAP checkpoint: the prior trees
+                # have no covers, so a partial covers array would misalign
+                # with the forest — disable contributions for this model
+                covers_by_class = None
         model = SharedTreeModel(
             self, x, y, bm, problem, nclass, domain, dist,
             np.asarray(f0) if K > 1 else float(f0[0]),
             forest, tp["max_depth"], mode=self._mode,
+            packed_dev=packed_dev, nclasses_packed=K,
         )
-        model.covers = covers_by_class
+        if packed_dev is None:
+            model.covers = covers_by_class
         model.requested_max_depth = requested_depth  # pre-clamp user value
         model.balance_dists = balance_dists
         model.calibrator = None

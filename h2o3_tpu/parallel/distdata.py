@@ -29,19 +29,44 @@ def multiprocess() -> bool:
     return jax.process_count() > 1
 
 
-def allgather_host(arr: np.ndarray) -> np.ndarray:
-    """(nproc, *arr.shape) stack of every process's host array (f64-safe)."""
+def allgather_bytes(payload: bytes) -> "list[bytes]":
+    """Variable-length byte blobs from every process, in rank order."""
     import jax
 
     if jax.process_count() == 1:
-        return np.asarray(arr)[None]
+        return [payload]
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
-    out = multihost_utils.process_allgather(
-        jnp.asarray(np.asarray(arr, np.float64), jnp.float64)
-        if np.asarray(arr).dtype == np.float64
-        else jnp.asarray(arr))
+    lens = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([len(payload)], jnp.int32))).reshape(-1)
+    maxlen = int(max(lens.max(), 1))
+    buf = np.zeros(maxlen, np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    out = np.asarray(multihost_utils.process_allgather(jnp.asarray(buf)))
+    out = out.reshape(len(lens), maxlen)
+    return [out[r, : lens[r]].tobytes() for r in range(len(lens))]
+
+
+def allgather_host(arr: np.ndarray) -> np.ndarray:
+    """(nproc, *arr.shape) stack of every process's host array. f64 arrays
+    travel as raw bytes: with x64 disabled a device gather would silently
+    truncate them to f32, rounding exactly the quantities (global sums,
+    min/max of timestamp-scale columns) this transport exists to keep
+    exact."""
+    import jax
+
+    a = np.asarray(arr)
+    if jax.process_count() == 1:
+        return a[None]
+    if a.dtype == np.float64:
+        blobs = allgather_bytes(np.ascontiguousarray(a).tobytes())
+        return np.stack([np.frombuffer(b, np.float64).reshape(a.shape)
+                         for b in blobs])
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.process_allgather(jnp.asarray(a))
     return np.asarray(out)
 
 

@@ -18,13 +18,17 @@ def _write_glm_csv(path, n=4000, seed=11):
     x1 = rng.normal(size=n)
     x2 = rng.normal(size=n)
     cat = rng.integers(0, 4, size=n)
-    eff = 1.2 * x1 - 0.7 * x2 + 0.5 * (cat == 2)
+    # order-correlated column with NAs: a per-shard imputation mean or
+    # one-pass variance would visibly skew the 2-process coefficients
+    xs = np.sort(rng.normal(size=n)) * 0.3
+    eff = 1.2 * x1 - 0.7 * x2 + 0.5 * (cat == 2) + 0.4 * xs
     y = (rng.random(n) < 1 / (1 + np.exp(-eff))).astype(int)
     with open(path, "w", newline="") as f:
         w = csv.writer(f)
-        w.writerow(["x1", "x2", "cat", "y"])
+        w.writerow(["x1", "x2", "xs", "cat", "y"])
         for i in range(n):
-            w.writerow([f"{x1[i]:.6f}", f"{x2[i]:.6f}", f"g{cat[i]}",
+            xs_tok = "" if i % 17 == 0 else f"{xs[i]:.6f}"
+            w.writerow([f"{x1[i]:.6f}", f"{x2[i]:.6f}", xs_tok, f"g{cat[i]}",
                         "yes" if y[i] else "no"])
 
 
@@ -37,7 +41,7 @@ fr = h2o.import_file({csv!r})
 fr["y"] = fr["y"].asfactor()
 g = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
                                   solver="IRLSM")
-g.train(x=["x1", "x2", "cat"], y="y", training_frame=fr)
+g.train(x=["x1", "x2", "xs", "cat"], y="y", training_frame=fr)
 import jax
 if jax.process_index() == 0:
     c = g.model.coef()
@@ -57,7 +61,7 @@ def test_glm_two_process_matches_single(tmp_path, cloud1):
     fr["y"] = fr["y"].asfactor()
     ref = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
                                         solver="IRLSM")
-    ref.train(x=["x1", "x2", "cat"], y="y", training_frame=fr)
+    ref.train(x=["x1", "x2", "xs", "cat"], y="y", training_frame=fr)
     ref_coef = ref.model.coef()
 
     out = str(tmp_path / "coef2.npz")
