@@ -312,8 +312,101 @@ def table(frame: Frame, dense: bool = True) -> Frame:
         col = v.numeric_np()
         u, cnt = np.unique(col[~np.isnan(col)], return_counts=True)
         return Frame.from_dict({frame.names[0]: u, "Count": cnt.astype(np.float64)})
-    raise NotImplementedError("table: only 1-column tables in round 1")
+    if len(vs) == 2:
+        # two-column cross-tab, long format (col1, col2, Counts) — the
+        # AstTable 2-arg form
+        def _labels(v):
+            if v.type == "enum":
+                codes = np.asarray(v.data)
+                return np.asarray(
+                    [v.domain[c] if c >= 0 else None for c in codes],
+                    dtype=object)
+            return v.numeric_np().astype(object)
+
+        a = _labels(vs[0])
+        b = _labels(vs[1])
+        keep = np.asarray([x is not None and x == x and y is not None
+                           and y == y for x, y in zip(a, b)])
+        pairs: Dict = {}
+        for x, y in zip(a[keep], b[keep]):
+            pairs[(x, y)] = pairs.get((x, y), 0) + 1
+        keys = sorted(pairs)
+        t1 = "enum" if vs[0].type == "enum" else None
+        t2 = "enum" if vs[1].type == "enum" else None
+        return Frame.from_dict(
+            {frame.names[0]: np.asarray([k[0] for k in keys], dtype=object),
+             frame.names[1]: np.asarray([k[1] for k in keys], dtype=object),
+             "Counts": np.asarray([pairs[k] for k in keys], np.float64)},
+            column_types={k: v for k, v in
+                          [(frame.names[0], t1), (frame.names[1], t2)] if v})
+    raise ValueError("table: at most 2 columns")
 
 
 def ifelse(cond: np.ndarray, yes, no) -> np.ndarray:
     return np.where(cond, yes, no)
+
+
+def melt(frame: Frame, id_vars: List[str], value_vars: Optional[List[str]],
+         var_name: str = "variable", value_name: str = "value",
+         skipna: bool = False) -> Frame:
+    """`AstMelt` — wide → long: one output row per (row, value column)."""
+    value_vars = value_vars or [n for n in frame.names if n not in id_vars]
+    n = frame.nrow
+    k = len(value_vars)
+    out: Dict[str, np.ndarray] = {}
+    types: Dict[str, str] = {}
+    for idc in id_vars:
+        v = frame.vec(idc)
+        if v.type == "enum":
+            lab = np.asarray([v.domain[c] if c >= 0 else None
+                              for c in np.asarray(v.data)], dtype=object)
+            out[idc] = np.tile(lab, k)
+            types[idc] = "enum"
+        else:
+            out[idc] = np.tile(v.numeric_np(), k)
+    out[var_name] = np.repeat(np.asarray(value_vars, dtype=object), n)
+    types[var_name] = "enum"
+    vals = np.concatenate([frame.vec(c).numeric_np() for c in value_vars])
+    out[value_name] = vals
+    fr = Frame.from_dict(out, column_types=types)
+    if skipna:
+        fr = fr.take(np.nonzero(~np.isnan(vals))[0])
+    return fr
+
+
+def pivot(frame: Frame, index: str, column: str, value: str) -> Frame:
+    """`AstPivot` — long → wide: rows keyed by `index`, one output column
+    per level of `column`, cells from `value` (last write wins, NaN where
+    absent)."""
+    iv, cv = frame.vec(index), frame.vec(column)
+
+    def _labels(v):
+        if v.type == "enum":
+            return np.asarray([v.domain[c] if c >= 0 else None
+                               for c in np.asarray(v.data)], dtype=object)
+        return v.numeric_np().astype(object)
+
+    ilab, clab = _labels(iv), _labels(cv)
+    vals = frame.vec(value).numeric_np()
+
+    def _sorted_levels(lab):
+        lv = {x for x in lab if x is not None and x == x}
+        try:
+            return sorted(lv)          # natural order (numeric keys ascend)
+        except TypeError:
+            return sorted(lv, key=str)
+
+    uidx = _sorted_levels(ilab)
+    ucol = _sorted_levels(clab)
+    ipos = {x: i for i, x in enumerate(uidx)}
+    cpos = {x: i for i, x in enumerate(ucol)}
+    grid = np.full((len(uidx), len(ucol)), np.nan)
+    for r in range(len(vals)):
+        if ilab[r] in ipos and clab[r] in cpos:
+            grid[ipos[ilab[r]], cpos[clab[r]]] = vals[r]
+    out: Dict[str, np.ndarray] = {
+        index: np.asarray(uidx, dtype=object)}
+    types = {index: "enum"} if iv.type == "enum" else {}
+    for j, cname in enumerate(ucol):
+        out[str(cname)] = grid[:, j]
+    return Frame.from_dict(out, column_types=types)

@@ -61,6 +61,10 @@ _UNARY = {
     "lgamma": _lgamma,
     "not": lambda x: (~(x.astype(bool))).astype(np.float64),
     "!": lambda x: (~(x.astype(bool))).astype(np.float64),
+    "acosh": np.arccosh, "asinh": np.arcsinh, "atanh": np.arctanh,
+    "cospi": lambda x: np.cos(np.pi * x),
+    "sinpi": lambda x: np.sin(np.pi * x),
+    "tanpi": lambda x: np.tan(np.pi * x),
 }
 _CUM = {"cumsum": np.cumsum, "cumprod": np.cumprod,
         "cummin": np.minimum.accumulate, "cummax": np.maximum.accumulate}
@@ -198,9 +202,47 @@ class RapidsSession:
         }
         if op in binops:
             x, y = a
-            if isinstance(x, Frame) or isinstance(y, Frame):
-                return binops[op](x, y) if isinstance(x, Frame) else binops[op](y, x)
+            if isinstance(x, Frame):
+                return binops[op](x, y)
+            if isinstance(y, Frame):
+                # scalar-first, non-commutative ops must NOT swap operands:
+                # (- 5 fr) is 5 − fr. Compute on the column, mirroring the
+                # frame-first return types (Frame for arithmetic, raw mask
+                # ndarray for comparisons)
+                out = binops[op](np.asarray(x, np.float64),
+                                 y._col0().astype(np.float64))
+                if op in ("+", "-", "*", "/"):
+                    return Frame.from_dict({y.names[0]: out})
+                return out
             return binops[op](x, y)
+        if op in ("^", "%%", "%/%", "&", "|", "&&", "||"):
+            def _val(v):
+                return (v._col0().astype(np.float64) if isinstance(v, Frame)
+                        else np.asarray(v, np.float64))
+
+            x, y = _val(a[0]), _val(a[1])
+            if op == "^":
+                out = np.power(x, y)
+            elif op == "%%":
+                out = np.mod(x, y)
+            elif op == "%/%":
+                out = np.floor_divide(x, y)
+            else:
+                # R three-valued logic: NA&FALSE is FALSE, NA|TRUE is TRUE
+                nx, ny = np.isnan(x), np.isnan(y)
+                tx = np.where(nx, False, x != 0)
+                ty = np.where(ny, False, y != 0)
+                if op in ("&", "&&"):
+                    known_false = (~nx & ~tx) | (~ny & ~ty)
+                    out = np.where(known_false, 0.0,
+                                   np.where(nx | ny, np.nan, 1.0))
+                else:
+                    known_true = tx | ty
+                    out = np.where(known_true, 1.0,
+                                   np.where(nx | ny, np.nan, 0.0))
+            if out.ndim == 0:
+                return float(out)
+            return Frame.from_dict({"C1": out})
         if op in ("assign", "tmp="):
             key, value = a
             if isinstance(value, Frame):
@@ -517,4 +559,276 @@ class RapidsSession:
             # become one column, k-value results become k columns (upstream
             # AstApply row semantics), ragged widths raise
             return fr.apply(fun, axis=1)
+        out = self._apply_tail(op, a, _truthy)
+        if out is not NotImplemented:
+            return out
         raise ValueError(f"Rapids: unknown op {op!r}")
+
+    def _apply_tail(self, op, a: List[Any], _truthy):
+        """The long tail of `ast/prims/**`: NA-propagating reducers, time
+        component/construction prims, string metrics, frame reshapers, fold
+        columns, sequences. Returns NotImplemented for unknown ops."""
+        # ---- NA-propagating reducers + NA counting ------------------------
+        if op in ("maxNA", "minNA", "sumNA"):
+            c = a[0]._col0()
+            return float({"maxNA": np.max, "minNA": np.min,
+                          "sumNA": np.sum}[op](c))
+        if op == "nacnt":
+            return [float(v.isna_np().sum()) for v in a[0].vecs()]
+        if op == "mode":
+            c = a[0]._col0()
+            c = c[~np.isnan(c)]
+            u, cnt = np.unique(c, return_counts=True)
+            return float(u[np.argmax(cnt)]) if len(u) else float("nan")
+        # ---- time components / construction -------------------------------
+        if op == "week":
+            ms = a[0]._col0()
+            # vectorized ISO week: week of the Thursday in the same ISO week
+            di = np.floor_divide(np.where(np.isnan(ms), 0.0, ms), 86400000.0
+                                 ).astype(np.int64)          # days since epoch
+            wd = ((di + 3) % 7) + 1                           # ISO 1=Mon..7=Sun
+            thu = (di + 4 - wd).astype("datetime64[D]")
+            ystart = thu.astype("datetime64[Y]").astype("datetime64[D]")
+            week = ((thu - ystart).astype(np.int64) // 7) + 1.0
+            return Frame.from_dict(
+                {"week": np.where(np.isnan(ms), np.nan, week)})
+        if op == "millis":
+            ms = a[0]._col0()
+            return Frame.from_dict({"millis": np.where(
+                np.isnan(ms), np.nan, np.mod(ms, 1000.0))})
+        if op == "mktime":
+            # (mktime year month day hour minute second msec) — month/day
+            # 0-based like AstMktime; columns or scalars, broadcast
+            import datetime
+
+            parts = []
+            nmax = 1
+            for v in a:
+                col = (v._col0() if isinstance(v, Frame)
+                       else np.asarray([float(v)]))
+                parts.append(col)
+                nmax = max(nmax, len(col))
+            if any(len(p) not in (1, nmax) for p in parts):
+                raise ValueError("mktime: component columns must share one "
+                                 "length (or be scalars)")
+            parts = [np.broadcast_to(p, (nmax,)) for p in parts]
+            while len(parts) < 7:
+                parts.append(np.zeros(nmax))
+            out = np.empty(nmax)
+            for i in range(nmax):
+                row = [p[i] for p in parts[:7]]
+                if any(np.isnan(r) for r in row):
+                    out[i] = np.nan   # AstMktime: NA component ⇒ NA time
+                    continue
+                y, mo, d, h, mi, s, msec = (int(r) for r in row)
+                dt = datetime.datetime(y, mo + 1, d + 1, h, mi, s,
+                                       msec * 1000,
+                                       tzinfo=datetime.timezone.utc)
+                out[i] = dt.timestamp() * 1000.0
+            return Frame.from_dict({"mktime": out})
+        # ---- string metrics ------------------------------------------------
+        if op in ("lstrip", "rstrip"):
+            chars = str(a[1]) if len(a) > 1 else None
+            fn = ((lambda s: s.lstrip(chars)) if op == "lstrip"
+                  else (lambda s: s.rstrip(chars)))
+            return a[0]._map_strings(fn)
+        if op == "entropy":
+            def ent(s):
+                if not s:
+                    return 0.0
+                _, cnt = np.unique(list(s), return_counts=True)
+                p = cnt / cnt.sum()
+                return float(-(p * np.log2(p)).sum())
+
+            return self._string_metric(a[0], "entropy", ent)
+        if op == "grep":
+            import re
+
+            fr, pattern = a[0], str(a[1])
+            ignore_case = len(a) > 2 and _truthy(a[2], default=False)
+            invert = len(a) > 3 and _truthy(a[3], default=False)
+            output_logical = len(a) > 4 and _truthy(a[4], default=False)
+            fl = re.IGNORECASE if ignore_case else 0
+            hit = self._string_metric(
+                fr, "grep",
+                lambda s: float(bool(re.search(pattern, s, fl))))._col0()
+            if invert:
+                hit = 1.0 - hit
+            if output_logical:
+                return Frame.from_dict({"grep": hit})
+            return Frame.from_dict(
+                {"grep": np.nonzero(hit > 0)[0].astype(np.float64)})
+        # ---- frame introspection / reshapers -------------------------------
+        if op in ("colnames", "names"):
+            return Frame.from_dict(
+                {"names": np.asarray(a[0].names, dtype=object)},
+                column_types={"names": "enum"})
+        if op == "columnsByType":
+            want = str(a[1]).lower() if len(a) > 1 else "numeric"
+            sel = {
+                "numeric": ("int", "real"),
+                "categorical": ("enum",),
+                "string": ("string",),
+                "time": ("time",),
+            }.get(want, ("int", "real"))
+            idx = [float(i) for i, n in enumerate(a[0].names)
+                   if a[0].vec(n).type in sel]
+            return Frame.from_dict({"columns": np.asarray(idx)})
+        if op == "filterNACols":
+            frac = float(a[1]) if len(a) > 1 else 0.1
+            fr = a[0]
+            keep = [float(i) for i, n in enumerate(fr.names)
+                    if fr.vec(n).isna_np().mean() <= frac]
+            return Frame.from_dict({"columns": np.asarray(keep)})
+        if op == "flatten":
+            fr = a[0]
+            v = fr.vecs()[0]
+            if v.type in ("enum",):
+                c = int(np.asarray(v.data)[0])
+                return (v.domain[c] if c >= 0 else None)
+            if v.type == "string":
+                return v.to_numpy()[0]
+            return float(v.numeric_np()[0])
+        if op == "getrow":
+            fr = a[0]
+            if fr.nrow != 1:
+                raise ValueError("getrow: frame must have exactly 1 row")
+            vals = [float(v.numeric_np()[0]) if v.type != "string" else np.nan
+                    for v in fr.vecs()]
+            return Frame.from_dict({"getrow": np.asarray(vals)})
+        if op == "melt":
+            fr = a[0]
+            ids = [fr.names[int(i)] for i in (a[1] if isinstance(a[1], list) else [a[1]])]
+            vv = (None if len(a) < 3 or a[2] is None or a[2] == []
+                  else [fr.names[int(i)] for i in
+                        (a[2] if isinstance(a[2], list) else [a[2]])])
+            var_name = str(a[3]) if len(a) > 3 else "variable"
+            value_name = str(a[4]) if len(a) > 4 else "value"
+            skipna = len(a) > 5 and _truthy(a[5], default=False)
+            return rapids_ops.melt(fr, ids, vv, var_name, value_name, skipna)
+        if op == "pivot":
+            fr = a[0]
+            return rapids_ops.pivot(fr, str(a[1]), str(a[2]), str(a[3]))
+        if op == "relevel":
+            fr, level = a[0], str(a[1])
+            v = fr.vecs()[0]
+            if v.type != "enum" or level not in (v.domain or []):
+                raise ValueError(f"relevel: {level!r} is not a level")
+            dom = [level] + [d for d in v.domain if d != level]
+            remap = np.asarray([dom.index(d) for d in v.domain])
+            codes = np.asarray(v.data)
+            new = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+            return Frame({fr.names[0]: Vec(new.astype(np.int32), "enum",
+                                           domain=dom)})
+        if op == "setDomain":
+            fr, labels = a[0], [str(s) for s in a[1]]
+            v = fr.vecs()[0]
+            if v.type != "enum":
+                raise ValueError("setDomain: column is not categorical")
+            if len(labels) != len(v.domain or []):
+                raise ValueError(
+                    f"setDomain: {len(labels)} labels for "
+                    f"{len(v.domain or [])} levels")
+            return Frame({fr.names[0]: Vec(np.asarray(v.data), "enum",
+                                           domain=labels)})
+        if op == "difflag1":
+            c = a[0]._col0()
+            return Frame.from_dict(
+                {"difflag1": np.r_[np.nan, np.diff(c)]})
+        if op == "h2o.fillna":
+            fr = a[0]
+            method = str(a[1]).lower() if len(a) > 1 else "forward"
+            axis = int(a[2]) if len(a) > 2 else 0
+            maxlen = int(a[3]) if len(a) > 3 else 1
+
+            def _fill1d(c):
+                c = c.copy()
+                idx = np.arange(len(c))
+                if method == "backward":
+                    c = c[::-1]
+                last = np.where(~np.isnan(c), idx, -1)
+                last = np.maximum.accumulate(last)
+                gap = idx - last
+                fill = (last >= 0) & np.isnan(c) & (gap <= maxlen)
+                c[fill] = c[last[fill]]
+                return c[::-1] if method == "backward" else c
+
+            if axis == 1:
+                # fill along ROWS (across columns, left→right)
+                M = np.column_stack([v.numeric_np() for v in fr.vecs()])
+                M = np.apply_along_axis(_fill1d, 1, M)
+                return Frame.from_dict(
+                    {n2: M[:, j] for j, n2 in enumerate(fr.names)})
+            return Frame.from_dict(
+                {n2: _fill1d(v.numeric_np())
+                 for n2, v in zip(fr.names, fr.vecs())})
+        # ---- fold columns / sequences --------------------------------------
+        if op == "kfold_column":
+            fr, nfolds = a[0], int(a[1])
+            seed = int(a[2]) if len(a) > 2 else -1
+            rng = np.random.default_rng(None if seed < 0 else seed)
+            return Frame.from_dict(
+                {"fold": rng.integers(0, nfolds, fr.nrow).astype(np.float64)})
+        if op == "modulo_kfold_column":
+            fr, nfolds = a[0], int(a[1])
+            return Frame.from_dict(
+                {"fold": (np.arange(fr.nrow) % nfolds).astype(np.float64)})
+        if op == "stratified_kfold_column":
+            fr, nfolds = a[0], int(a[1])
+            seed = int(a[2]) if len(a) > 2 else -1
+            rng = np.random.default_rng(None if seed < 0 else seed)
+            y = np.asarray(fr.vecs()[0].data)
+            fold = np.zeros(fr.nrow)
+            for cls in np.unique(y):
+                ridx = np.nonzero(y == cls)[0]
+                ridx = rng.permutation(ridx)
+                fold[ridx] = np.arange(len(ridx)) % nfolds
+            return Frame.from_dict({"fold": fold})
+        if op == "seq":
+            frm, to = float(a[0]), float(a[1])
+            by = float(a[2]) if len(a) > 2 else (1.0 if to >= frm else -1.0)
+            return Frame.from_dict(
+                {"seq": np.arange(frm, to + by * 0.5, by)})
+        if op == "seq_len":
+            return Frame.from_dict(
+                {"seq_len": np.arange(1, int(a[0]) + 1).astype(np.float64)})
+        if op == "rep_len":
+            x, length = a[0], int(a[1])
+            vals = (x._col0() if isinstance(x, Frame)
+                    else np.asarray([float(x)]))
+            reps = -(-length // len(vals))
+            return Frame.from_dict({"rep_len": np.tile(vals, reps)[:length]})
+        if op == "topn":
+            fr, coli = a[0], int(a[1])
+            pct = float(a[2]) if len(a) > 2 else 10.0
+            top = _truthy(a[3], default=True) if len(a) > 3 else True
+            c = fr.vec(fr.names[coli]).numeric_np()
+            valid = np.nonzero(~np.isnan(c))[0]   # AstTopN skips NAs
+            k = max(1, int(round(len(c) * pct / 100.0)))
+            k = min(k, len(valid))
+            order = valid[np.argsort(c[valid], kind="mergesort")]
+            pick = order[-k:][::-1] if top else order[:k]
+            return Frame.from_dict({
+                "row_idx": pick.astype(np.float64),
+                fr.names[coli]: c[pick]})
+        if op == "ls":
+            return Frame.from_dict(
+                {"key": np.asarray(sorted(self.dkv.keys()), dtype=object)},
+                column_types={"key": "enum"})
+        return NotImplemented
+
+    @staticmethod
+    def _string_metric(fr: Frame, name: str, fn) -> Frame:
+        """Per-string numeric metric over the first string/enum column."""
+        v = fr.vecs()[0]
+        if v.type == "string":
+            vals = [None if s is None else fn(str(s)) for s in v.to_numpy()]
+            return Frame.from_dict({name: np.asarray(
+                [np.nan if x is None else x for x in vals])})
+        if v.type == "enum":
+            per_level = [fn(str(d)) for d in (v.domain or [])]
+            codes = np.asarray(v.data)
+            out = np.asarray([per_level[c] if c >= 0 else np.nan
+                              for c in codes])
+            return Frame.from_dict({name: out})
+        raise ValueError(f"{name}: column is not string/categorical")

@@ -372,6 +372,33 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
     return tree_jit, single_jit
 
 
+_DEV_PACKS: List = []  # weakrefs of models holding HBM forest packs (FIFO)
+
+
+def _register_dev_pack(model, budget: int) -> None:
+    """Track device-resident forests; past `budget` total bytes, evict the
+    OLDEST packs to host so long grid/AutoML runs on small-HBM devices
+    cannot accumulate forests until allocation fails. The newest pack is
+    never evicted (it is the model being trained)."""
+    import weakref
+
+    _DEV_PACKS.append(weakref.ref(model))
+    live, total = [], 0
+    for r in _DEV_PACKS:
+        m = r()
+        if m is not None and m.__dict__.get("_packed_dev") is not None:
+            live.append(r)
+            total += int(np.prod(m._packed_dev.shape)) * 4
+    drop = 0
+    while total > budget and drop < len(live) - 1:
+        m = live[drop]()
+        if m is not None:
+            total -= int(np.prod(m._packed_dev.shape)) * 4
+            m.release_device_forest()
+        drop += 1
+    _DEV_PACKS[:] = live[drop:]
+
+
 class SharedTreeModel(H2OModel):
     algo = "sharedtree"
 
@@ -424,6 +451,13 @@ class SharedTreeModel(H2OModel):
     @covers.setter
     def covers(self, v):
         self._covers = v
+
+    def release_device_forest(self):
+        """Materialize the host copy and free the HBM pack (eviction)."""
+        if self.__dict__.get("_packed_dev") is not None:
+            self._materialize_host_forest()
+            self._packed_dev = None
+            self.__dict__.pop("_padded_forests", None)
 
     def _materialize_host_forest(self):
         """The deferred forest D2H: one bulk transfer, then host slicing."""
@@ -1495,6 +1529,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
         )
         if packed_dev is None:
             model.covers = covers_by_class
+        else:
+            _register_dev_pack(model, _PACK_BUDGET)
         model.requested_max_depth = requested_depth  # pre-clamp user value
         model.balance_dists = balance_dists
         model.calibrator = None
