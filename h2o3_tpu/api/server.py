@@ -15,6 +15,7 @@ behaves like the reference's async job keys.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import urllib.parse
@@ -125,6 +126,14 @@ class _Handler(BaseHTTPRequestHandler):
         ("POST", r"^/3/Frames/([^/]+)/export$", "frame_export"),
         ("POST", r"^/99/Models\.bin/([^/]+)$", "model_save"),
         ("POST", r"^/99/Models\.bin$", "model_load"),
+        ("POST", r"^/3/PostFile$", "post_file"),
+        ("POST", r"^/99/Grid/([^/]+)$", "grid_train"),
+        ("GET", r"^/99/Grids$", "grids_list"),
+        ("GET", r"^/99/Grids/([^/]+)$", "grid_get"),
+        ("POST", r"^/99/AutoMLBuilder$", "automl_build"),
+        ("GET", r"^/99/AutoML/([^/]+)$", "automl_get"),
+        ("GET", r"^/99/Leaderboards/([^/]+)$", "leaderboard_get"),
+        ("POST", r"^/3/Recovery$", "recovery"),
         ("POST", r"^/3/Shutdown$", "shutdown"),
     ]
 
@@ -165,11 +174,20 @@ class _Handler(BaseHTTPRequestHandler):
                     getattr(self, "h_" + name)(*[urllib.parse.unquote(x) for x in g.groups()])
                 except KeyError as e:
                     self._send(dict(__meta=dict(schema_type="H2OError"),
-                                    msg=f"not found: {e}"), 404)
-                except Exception as e:  # H2OErrorV3
+                                    msg=f"not found: {e}",
+                                    http_status=404), 404)
+                except (ValueError, TypeError) as e:
+                    # client errors → 4xx (H2OErrorV3 with http_status)
+                    self._send(dict(__meta=dict(schema_type="H2OError"),
+                                    msg=str(e), http_status=400,
+                                    exception_type=type(e).__name__), 400)
+                except Exception as e:
+                    # server bugs are 5xx, not blamed on the client
                     Log.err(f"REST {path}: {e}")
                     self._send(dict(__meta=dict(schema_type="H2OError"),
-                                    msg=str(e), exception_type=type(e).__name__), 400)
+                                    msg=str(e), http_status=500,
+                                    dev_msg=f"unhandled in h_{name}",
+                                    exception_type=type(e).__name__), 500)
                 return
         self._send(dict(msg=f"no route for {method} {path}"), 404)
 
@@ -453,6 +471,224 @@ class _Handler(BaseHTTPRequestHandler):
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()))
+
+    # -- uploads (PostFileHandler) ------------------------------------------
+    def h_post_file(self):
+        """`POST /3/PostFile` — raw or multipart upload to a server-side
+        temp file; the returned destination key is a path usable as
+        `source_frames` in ParseSetup/Parse (PostFileHandler semantics)."""
+        import tempfile
+
+        q = urllib.parse.urlparse(self.path).query
+        qs = {k: v[0] for k, v in urllib.parse.parse_qs(q).items()}
+        ln = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(ln) if ln else b""
+        ctype = self.headers.get("Content-Type", "")
+        if "multipart/form-data" in ctype and b"\r\n\r\n" in body:
+            # minimal multipart: first part's payload up to the boundary
+            # (RFC 2046: the boundary parameter may be quoted and need not
+            # be the last Content-Type parameter)
+            bpart = ctype.split("boundary=")[-1].split(";")[0].strip()
+            boundary = bpart.strip('"').encode()
+            payload = body.split(b"\r\n\r\n", 1)[1]
+            end = payload.rfind(b"\r\n--" + boundary)
+            if end >= 0:
+                payload = payload[:end]
+            body = payload
+        name = qs.get("destination_frame") or "upload"
+        suffix = os.path.splitext(name)[1] or ".csv"
+        tmp = tempfile.NamedTemporaryFile(
+            prefix="h2o3_upload_", suffix=suffix, delete=False)
+        tmp.write(body)
+        tmp.close()
+        self._send(dict(destination_frame=tmp.name,
+                        total_bytes=len(body)))
+
+    # -- grid search (GridSearchHandler, /99/Grids*) ------------------------
+    def h_grid_train(self, algo):
+        reg = schemas.algo_registry()
+        if algo not in reg:
+            raise KeyError(algo)
+        p = self._params()
+        train_key = p.pop("training_frame", None)
+        y = p.pop("response_column", p.pop("y", None))
+        x = p.pop("x", None)
+        if isinstance(x, str):
+            x = json.loads(x)
+        train = DKV.get(train_key) if train_key else None
+        if train is None:
+            raise ValueError(f"training_frame {train_key!r} not in DKV")
+        hyper = p.pop("hyper_parameters", None)
+        if hyper is None:
+            raise ValueError("hyper_parameters is required")
+        if isinstance(hyper, str):
+            hyper = json.loads(hyper)
+        criteria = p.pop("search_criteria", None)
+        if isinstance(criteria, str):
+            criteria = json.loads(criteria)
+        grid_id = p.pop("grid_id", None)
+        cls = reg[algo]
+        known = {**cls._common_defaults, **cls._param_defaults}
+        base = {}
+        for k, v in p.items():
+            if k in known:
+                if isinstance(v, str):
+                    try:
+                        v = json.loads(v)
+                    except (ValueError, TypeError):
+                        pass
+                base[k] = v
+        from ..models.grid import H2OGridSearch
+
+        gs = H2OGridSearch(cls(**base), hyper, grid_id=grid_id,
+                           search_criteria=criteria)
+        import uuid
+
+        job = Job(dest=f"grid_rest_{uuid.uuid4().hex[:8]}",
+                  description=f"{algo} grid").start()
+        job.result = gs.grid_id
+        DKV.put(job.dest, job)
+        DKV.put(gs.grid_id, gs)
+
+        def run():
+            try:
+                gs.train(x=x, y=y, training_frame=train)
+                job.done()
+            except Exception as e:
+                Log.err(f"grid {algo}: {e}")
+                job.status = "FAILED"
+                job.warnings.append(str(e))
+
+        threading.Thread(target=run, daemon=True).start()
+        self._send(dict(job=dict(key=dict(name=job.dest), status=job.status),
+                        grid_id=gs.grid_id))
+
+    @staticmethod
+    def _grid_model_ids(gs):
+        # live entries are estimators; recovered entries carry the artifact
+        # path of the already-built model (grid recovery_dir semantics)
+        return [e.model.model_id if hasattr(e, "model") else e.model_id
+                for e in gs.models]
+
+    def _grid_json(self, gs):
+        return dict(
+            grid_id=dict(name=gs.grid_id),
+            model_ids=[dict(name=i) for i in self._grid_model_ids(gs)],
+            hyper_names=list(gs.hyper_params),
+            failure_details=[f.get("error", "") for f in gs.failed],
+        )
+
+    def h_grids_list(self):
+        from ..models.grid import H2OGridSearch
+
+        grids = [DKV.get(k) for k in DKV.keys(H2OGridSearch)]
+        self._send(dict(grids=[self._grid_json(g) for g in grids]))
+
+    def h_grid_get(self, grid_id):
+        from ..models.grid import H2OGridSearch
+
+        gs = DKV.get(grid_id)
+        if not isinstance(gs, H2OGridSearch):
+            raise KeyError(grid_id)
+        self._send(self._grid_json(gs))
+
+    # -- AutoML (/99/AutoMLBuilder, /99/Leaderboards) -----------------------
+    def h_automl_build(self):
+        p = self._params()
+        spec = p.get("input_spec") or {}
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        train_key = (spec.get("training_frame")
+                     or p.get("training_frame"))
+        y = spec.get("response_column") or p.get("response_column") or p.get("y")
+        train = DKV.get(train_key) if train_key else None
+        if train is None:
+            raise ValueError(f"training_frame {train_key!r} not in DKV")
+        if not y:
+            raise ValueError("response_column is required")
+        build = p.get("build_control") or {}
+        if isinstance(build, str):
+            build = json.loads(build)
+        from ..automl.automl import H2OAutoML
+
+        kw = dict(seed=int(p.get("seed", build.get("seed", -1)) or -1),
+                  nfolds=int(p.get("nfolds", build.get("nfolds", 5)) or 5),
+                  project_name=p.get("project_name"))
+        max_models = int(p.get("max_models", build.get("max_models", 0)) or 0)
+        if max_models:
+            kw["max_models"] = max_models
+        max_rt = float(p.get("max_runtime_secs",
+                             build.get("max_runtime_secs", 0)) or 0)
+        if max_rt:
+            kw["max_runtime_secs"] = max_rt
+        aml = H2OAutoML(**kw)
+        import uuid
+
+        job = Job(dest=f"automl_rest_{uuid.uuid4().hex[:8]}",
+                  description="AutoML").start()
+        job.result = aml.project_name
+        DKV.put(job.dest, job)
+        DKV.put(aml.project_name, aml)
+        x = spec.get("x") or p.get("x")
+        if isinstance(x, str):
+            x = json.loads(x)
+
+        def run():
+            try:
+                aml.train(x=x, y=y, training_frame=train)
+                job.done()
+            except Exception as e:
+                Log.err(f"automl: {e}")
+                job.status = "FAILED"
+                job.warnings.append(str(e))
+
+        threading.Thread(target=run, daemon=True).start()
+        self._send(dict(job=dict(key=dict(name=job.dest), status=job.status),
+                        automl_id=dict(name=aml.project_name)))
+
+    def _leaderboard_json(self, aml):
+        # the build runs on a worker thread: leaderboard is None until
+        # train() populates it — polling clients get an empty board, not 500
+        rows = ([{k: v for k, v in r.items() if not k.startswith("_")}
+                 for r in aml.leaderboard.rows]
+                if aml.leaderboard is not None else [])
+        return dict(project_name=aml.project_name,
+                    leaderboard=dict(rows=rows))
+
+    def h_automl_get(self, project):
+        from ..automl.automl import H2OAutoML
+
+        aml = DKV.get(project)
+        if not isinstance(aml, H2OAutoML):
+            raise KeyError(project)
+        out = self._leaderboard_json(aml)
+        leader = getattr(aml, "leader", None)
+        out.update(leader=(dict(name=leader.model.model_id)
+                           if leader is not None else None),
+                   event_log=aml.event_log.events)
+        self._send(out)
+
+    def h_leaderboard_get(self, project):
+        from ..automl.automl import H2OAutoML
+
+        aml = DKV.get(project)
+        if not isinstance(aml, H2OAutoML):
+            raise KeyError(project)
+        self._send(self._leaderboard_json(aml))
+
+    # -- grid recovery (RecoveryHandler: POST /3/Recovery) ------------------
+    def h_recovery(self):
+        import h2o3_tpu as h2o
+
+        p = self._params()
+        rdir = p.get("recovery_dir")
+        if not rdir:
+            raise ValueError("recovery_dir is required")
+        gs = h2o.load_grid(rdir, grid_id=p.get("grid_id"))
+        DKV.put(gs.grid_id, gs)
+        self._send(dict(grid_id=dict(name=gs.grid_id),
+                        model_ids=[dict(name=i)
+                                   for i in self._grid_model_ids(gs)]))
 
 
 class H2OApiServer:

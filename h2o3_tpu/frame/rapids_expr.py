@@ -206,14 +206,14 @@ class RapidsSession:
                 return binops[op](x, y)
             if isinstance(y, Frame):
                 # scalar-first, non-commutative ops must NOT swap operands:
-                # (- 5 fr) is 5 − fr. Compute on the column, mirroring the
-                # frame-first return types (Frame for arithmetic, raw mask
-                # ndarray for comparisons)
-                out = binops[op](np.asarray(x, np.float64),
-                                 y._col0().astype(np.float64))
+                # (- 5 fr) is 5 − fr. Mirror the frame-first return types
+                # (per-column Frame for arithmetic, raw mask for comparisons)
+                xv = np.asarray(x, np.float64)
                 if op in ("+", "-", "*", "/"):
-                    return Frame.from_dict({y.names[0]: out})
-                return out
+                    return Frame.from_dict(
+                        {n: binops[op](xv, y.vec(n).numeric_np())
+                         for n in y.names})
+                return binops[op](xv, y._col0().astype(np.float64))
             return binops[op](x, y)
         if op in ("^", "%%", "%/%", "&", "|", "&&", "||"):
             def _val(v):

@@ -467,6 +467,9 @@ class H2ODeepLearningEstimator(H2OEstimator):
                 params, opt_state = train_chunk(
                     params, opt_state, X_dev, y_dev, w_dev, sub,
                     float(it), int(steps))
+                # CPU mesh: serialize collective executables (see
+                # parallel.mesh.collective_fence)
+                cloudlib.collective_fence(params[0][0])
                 seen += max(int(steps * eff_batch), 1)
                 it += steps
             else:
@@ -479,6 +482,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
                 key, sub = jax.random.split(key)
                 params, opt_state = train_step(params, opt_state, xb, yb, wb,
                                                sub, jnp.float32(it))
+                cloudlib.collective_fence(params[0][0])
                 seen += batch
                 it += 1
             if seen >= next_score or seen >= total:

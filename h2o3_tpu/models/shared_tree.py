@@ -1074,7 +1074,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                      rs_, rep, None, None, None, None]
                         args = [a if s is None else jax.device_put(a, s)
                                 for a, s in zip(args, shardings)]
-                    tj(*args)
+                    out = tj(*args)
+                    # the warm execution must FINISH before the real tree
+                    # programs dispatch — a CPU mesh deadlocks on two
+                    # concurrent collective executables (collective_fence)
+                    cloudlib.collective_fence(out[0])
                 except Exception:  # warm-up is advisory; real call reports
                     pass
 
@@ -1269,12 +1273,20 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     margins, oob_sum, oob_cnt, codes_d, y_d, w_d, rate_d,
                     edges_d, mono_d, hp_d, key, np.int32(m0 + i)
                 )
+                # CPU mesh: one collective executable in flight at a time
+                cloudlib.collective_fence(margins)
                 packed_list.append(packed)
                 gains_list.append(gains)
-            # jitted combine: eager stack/sum would reject process-spanning
-            # arrays on a multi-host mesh (single-host cost is one dispatch)
+            # jitted combine only on multi-host meshes (eager stack/sum
+            # would reject process-spanning arrays there). Single-process
+            # stays EAGER: a jitted multi-arg combine has been observed to
+            # interleave with in-flight collective tree programs on the
+            # XLA:CPU thunk pool and deadlock the all-reduce rendezvous.
+            if distdata.multiprocess():
+                return (margins, oob_sum, oob_cnt,
+                        _stack_args(*packed_list), _sum_args(*gains_list))
             return (margins, oob_sum, oob_cnt,
-                    _stack_args(*packed_list), _sum_args(*gains_list))
+                    jnp.stack(packed_list), sum(gains_list))
 
         def _stacked_from_packed_dev(packed, k):
             """Device (nsteps, K, T, 5) → stacked Tree for class k (device)."""
@@ -1368,6 +1380,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     margins, codes_d, y_d, w_d, rate_d, edges_d, mono_d,
                     hp_d, key, jnp.int32(m), g_ext, h_ext
                 )
+                cloudlib.collective_fence(margins)
                 packed = packed[None]
                 nsteps = 1
             else:
@@ -1453,7 +1466,15 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # meshes, and over-budget runs that already flushed chunks.
         packed_dev = None
         if packed_chunks and not packed_host and not prior_stacked \
-                and not multiproc:
+                and not multiproc and ndev == 1:
+            # single-device only: on a multi-device mesh the pack becomes a
+            # multi-device array whose later (scoring/eviction) executions
+            # can interleave with the next model's COLLECTIVE tree programs —
+            # XLA:CPU runs concurrent executions on one thunk pool and the
+            # all-reduce rendezvous deadlocks (observed: 7/8 participants).
+            # Multi-device hosts also have fast local D2H, so the eager host
+            # path costs little there; the pack exists for the single
+            # remote-chip tunnel where D2H is ~6 MB/s.
             _ph.mark("train_loop_dispatch")
             packed_dev = (packed_chunks[0] if len(packed_chunks) == 1
                           else _concat_args(*packed_chunks))

@@ -104,6 +104,25 @@ def reset() -> None:
         _cloud = None
 
 
+def collective_fence(x) -> None:
+    """Serialize multi-device collective programs on the CPU backend.
+
+    XLA:CPU executes async-dispatched executables CONCURRENTLY on one shared
+    thunk pool. Two in-flight collective programs can starve each other: one
+    holds pool threads at its all-reduce rendezvous while the other's thunks
+    occupy the rest, so the final participant never runs and the runtime
+    aborts after its 40 s rendezvous timeout (observed as 7/8 participants
+    on the 8-virtual-device test cloud of a 1-core host). Blocking on the
+    previous program's output before dispatching the next collective keeps
+    at most one collective executable in flight. TPU streams already
+    serialize executions, so this is a no-op there."""
+    import jax
+
+    c = _cloud
+    if c is not None and c.size > 1 and jax.default_backend() == "cpu":
+        jax.block_until_ready(x)
+
+
 def pad_to_multiple(n: int, k: int) -> int:
     """Rows are padded so each mesh shard is equal-sized (XLA needs static,
     uniform shards; H2O chunks could be ragged — ours cannot)."""

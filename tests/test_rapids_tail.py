@@ -173,3 +173,11 @@ def test_review_fixes_r02(cloud1):
     # vectorized week still correct across a year boundary (2021-01-01 -> 53)
     wfr = _fr(t=[1609459200000.0])
     assert _col(h2o.rapids(f"(week {wfr.key})"))[0] == 53.0
+
+
+def test_scalar_first_multicolumn(cloud1):
+    fr = _fr(a=[1.0, 2.0], b=[10.0, 20.0])
+    out = h2o.rapids(f"(- 100 {fr.key})")
+    assert out.ncol == 2
+    assert list(_col(out, 0)) == [99.0, 98.0]
+    assert list(_col(out, 1)) == [90.0, 80.0]

@@ -229,3 +229,123 @@ def test_model_save_load_and_frame_export(server, tmp_path):
     assert exp["job"]["status"] == "DONE"
     import os
     assert os.path.exists(tmp_path / "out.csv")
+
+
+def test_post_file_upload_parse(server):
+    srv, csv = server
+    body = open(csv, "rb").read()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/3/PostFile?destination_frame=up.csv",
+        data=body, headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    assert out["total_bytes"] == len(body)
+    dest = out["destination_frame"]
+    # uploaded key works as a Parse source
+    p = _post(srv, "/3/Parse", source_frames=dest,
+              destination_frame="uploaded")
+    assert p["destination_frame"]["name"] == "uploaded"
+    s = _get(srv, "/3/Frames/uploaded/summary")["frames"][0]
+    assert s["rows"] == 500 and s["num_columns"] == 4
+
+
+def test_grid_endpoints(server):
+    srv, csv = server
+    r = _post(srv, "/3/ImportFiles", path=csv)
+    key = r["destination_frames"][0]
+    _post(srv, "/99/Rapids",
+          ast=f"(assign gtrain (cbind (cols {key} [0 1 2])"
+              f" (as.factor (cols {key} [3]))))")
+    r = _post(srv, "/99/Grid/gbm", training_frame="gtrain",
+              response_column="y", grid_id="g1", ntrees="5",
+              hyper_parameters=json.dumps({"max_depth": [2, 3]}))
+    assert r["grid_id"] == "g1"
+    job_key = r["job"]["key"]["name"]
+    for _ in range(600):
+        j = _get(srv, f"/3/Jobs/{job_key}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED"):
+            break
+        time.sleep(0.25)
+    assert j["status"] == "DONE", j
+    g = _get(srv, "/99/Grids/g1")
+    assert len(g["model_ids"]) == 2
+    assert g["hyper_names"] == ["max_depth"]
+    lst = _get(srv, "/99/Grids")
+    assert any(x["grid_id"]["name"] == "g1" for x in lst["grids"])
+    # 4xx for bad request, 404 for missing grid
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv, "/99/Grid/gbm", training_frame="gtrain",
+              response_column="y")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/99/Grids/nope")
+    assert e.value.code == 404
+
+
+def test_automl_endpoints(server):
+    srv, csv = server
+    r = _post(srv, "/3/ImportFiles", path=csv)
+    key = r["destination_frames"][0]
+    _post(srv, "/99/Rapids",
+          ast=f"(assign atrain (cbind (cols {key} [0 1 2])"
+              f" (as.factor (cols {key} [3]))))")
+    r = _post(srv, "/99/AutoMLBuilder", training_frame="atrain",
+              response_column="y", max_models="2", nfolds="2",
+              seed="1", project_name="aml_rest")
+    job_key = r["job"]["key"]["name"]
+    for _ in range(1200):
+        j = _get(srv, f"/3/Jobs/{job_key}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED"):
+            break
+        time.sleep(0.5)
+    assert j["status"] == "DONE", j
+    lb = _get(srv, "/99/Leaderboards/aml_rest")["leaderboard"]["rows"]
+    assert len(lb) >= 2
+    a = _get(srv, "/99/AutoML/aml_rest")
+    assert a["leader"]["name"] == lb[0]["model_id"]
+
+
+def test_recovery_endpoint(server, tmp_path):
+    srv, csv = server
+    r = _post(srv, "/3/ImportFiles", path=csv)
+    key = r["destination_frames"][0]
+    _post(srv, "/99/Rapids",
+          ast=f"(assign rtrain (cbind (cols {key} [0 1 2])"
+              f" (as.factor (cols {key} [3]))))")
+    import h2o3_tpu as h2o_mod
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    gs = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=3),
+                       {"max_depth": [2]}, grid_id="grec",
+                       recovery_dir=str(tmp_path))
+    gs.train(x=["a", "b", "c"], y="y", training_frame=DKV.get("rtrain"))
+    out = _post(srv, "/3/Recovery", recovery_dir=str(tmp_path))
+    assert out["grid_id"]["name"] == "grec"
+    assert len(out["model_ids"]) == 1
+
+
+def test_automl_poll_while_running(server):
+    """Polling /99/AutoML and /99/Leaderboards mid-build must return the
+    (possibly empty) board, not 500 (review r02)."""
+    srv, csv = server
+    r = _post(srv, "/3/ImportFiles", path=csv)
+    key = r["destination_frames"][0]
+    _post(srv, "/99/Rapids",
+          ast=f"(assign ptrain (cbind (cols {key} [0 1 2])"
+              f" (as.factor (cols {key} [3]))))")
+    r = _post(srv, "/99/AutoMLBuilder", training_frame="ptrain",
+              response_column="y", max_models="1", nfolds="2",
+              seed="2", project_name="aml_poll")
+    # immediately poll — build has barely started
+    a = _get(srv, "/99/AutoML/aml_poll")
+    assert "leaderboard" in a       # empty board, never a 500
+    lb = _get(srv, "/99/Leaderboards/aml_poll")
+    assert "leaderboard" in lb
+    job_key = r["job"]["key"]["name"]
+    for _ in range(1200):
+        j = _get(srv, f"/3/Jobs/{job_key}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED"):
+            break
+        time.sleep(0.5)
+    assert j["status"] == "DONE", j
