@@ -1,0 +1,153 @@
+"""Flow — the built-in web UI, served at `/flow/`.
+
+Reference parity: `h2o-web/` (H2O Flow, the CoffeeScript notebook UI served
+by the JVM at `/flow/index.html`). This is a deliberately small single-page
+analog covering Flow's operational core — cloud status, frames (with column
+summaries), models (metrics, variable importances), jobs, grids, AutoML
+leaderboards, and a Rapids cell — all driven by the same `/3` + `/99` REST
+routes the Python client uses. The notebook/cell system and plotting of the
+original are out of scope; parity here means "a browser on the cluster can
+inspect and drive it", which is what the reference's own docs position Flow
+for.
+"""
+
+FLOW_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>h2o3-tpu Flow</title>
+<style>
+  :root { --fg:#222; --muted:#777; --line:#e0e0e0; --accent:#1565c0; }
+  body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin:0; color:var(--fg); }
+  header { padding:10px 20px; border-bottom:1px solid var(--line);
+           display:flex; align-items:baseline; gap:16px; }
+  header h1 { font-size:18px; margin:0; }
+  header span { color:var(--muted); font-size:13px; }
+  nav { display:flex; gap:4px; padding:8px 20px; border-bottom:1px solid var(--line); }
+  nav button { border:1px solid var(--line); background:#fff; padding:6px 14px;
+               border-radius:4px; cursor:pointer; font-size:13px; }
+  nav button.active { background:var(--accent); color:#fff; border-color:var(--accent); }
+  main { padding:16px 20px; }
+  table { border-collapse:collapse; font-size:13px; margin:8px 0; }
+  th, td { border:1px solid var(--line); padding:4px 10px; text-align:left; }
+  th { background:#f7f7f7; }
+  .muted { color:var(--muted); }
+  textarea { width:100%; font-family:monospace; font-size:13px; }
+  pre { background:#f7f7f7; padding:10px; overflow:auto; font-size:12px; }
+  .err { color:#b00020; }
+</style>
+</head>
+<body>
+<header><h1>H2O Flow</h1><span id="cloud" class="muted">connecting…</span></header>
+<nav id="tabs"></nav>
+<main id="view">loading…</main>
+<script>
+const TABS = ["Frames", "Models", "Jobs", "Grids", "AutoML", "Rapids"];
+let active = "Frames";
+const esc = (v) => String(v).replace(/[&<>"']/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+async function api(path, opts) {
+  const r = await fetch(path, opts);
+  const j = await r.json();
+  if (!r.ok) throw new Error(j.msg || r.statusText);
+  return j;
+}
+function table(rows, cols) {
+  if (!rows.length) return "<p class='muted'>none</p>";
+  cols = cols || Object.keys(rows[0]);
+  const h = cols.map(c => `<th>${esc(c)}</th>`).join("");
+  const b = rows.map(r => "<tr>" + cols.map(c => {
+    let v = r[c];
+    if (v && typeof v === "object" && "name" in v) v = v.name;
+    if (typeof v === "number") v = +v.toFixed(5);
+    return `<td>${v === null || v === undefined ? "" : esc(v)}</td>`;
+  }).join("") + "</tr>").join("");
+  return `<table><tr>${h}</tr>${b}</table>`;
+}
+const views = {
+  async Frames() {
+    const fr = (await api("/3/Frames")).frames || [];
+    let html = "<h3>Frames</h3>" + table(fr.map(f => ({
+      key: f.frame_id, rows: f.rows, columns: f.columns })));
+    html += "<p class='muted'>click a key in the table? use the summary box:</p>";
+    html += "<input id='fkey' placeholder='frame key'> <button onclick='frameSummary()'>summary</button><div id='fsum'></div>";
+    return html;
+  },
+  async Models() {
+    const ms = (await api("/3/Models")).models || [];
+    return "<h3>Models</h3>" + table(ms.map(m => {
+      const tm = (m.output || {}).training_metrics || {};
+      return { model_id: m.model_id, algo: m.algo,
+               auc: tm.auc, rmse: tm.rmse, logloss: tm.logloss };
+    }));
+  },
+  async Jobs() {
+    const js = (await api("/3/Jobs")).jobs || [];
+    return "<h3>Jobs</h3>" + table(js.map(j => ({
+      key: j.key, status: j.status, progress: j.progress, dest: j.dest })));
+  },
+  async Grids() {
+    const gs = (await api("/99/Grids")).grids || [];
+    return "<h3>Grids</h3>" + table(gs.map(g => ({
+      grid_id: g.grid_id, models: (g.model_ids || []).length,
+      hyper: (g.hyper_names || []).join(", ") })));
+  },
+  async AutoML() {
+    return "<h3>AutoML</h3><input id='proj' placeholder='project name'>" +
+      " <button onclick='loadLb()'>leaderboard</button><div id='lb'></div>";
+  },
+  async Rapids() {
+    return "<h3>Rapids</h3><textarea id='ast' rows='3'>(nrow frame)</textarea>" +
+      "<br><button onclick='runRapids()'>run</button><pre id='rout'></pre>";
+  },
+};
+async function frameSummary() {
+  const k = document.getElementById("fkey").value;
+  try {
+    const s = (await api(`/3/Frames/${encodeURIComponent(k)}/summary`)).frames[0];
+    document.getElementById("fsum").innerHTML = table(s.columns.map(c => ({
+      column: c.label, type: c.type, mean: c.mean, min: c.min, max: c.max,
+      missing: c.nacnt })));
+  } catch (e) { document.getElementById("fsum").innerHTML = `<p class='err'>${esc(e.message)}</p>`; }
+}
+async function loadLb() {
+  const p = document.getElementById("proj").value;
+  try {
+    const lb = (await api(`/99/Leaderboards/${encodeURIComponent(p)}`)).leaderboard.rows;
+    document.getElementById("lb").innerHTML = table(lb);
+  } catch (e) { document.getElementById("lb").innerHTML = `<p class='err'>${esc(e.message)}</p>`; }
+}
+async function runRapids() {
+  const ast = document.getElementById("ast").value;
+  const out = document.getElementById("rout");
+  try {
+    const r = await api("/99/Rapids", { method: "POST",
+      headers: {"Content-Type": "application/json"},
+      body: JSON.stringify({ ast }) });
+    out.textContent = JSON.stringify(r, null, 2);
+  } catch (e) { out.textContent = "error: " + e.message; }
+}
+function renderTabs() {
+  document.getElementById("tabs").innerHTML = TABS.map(t =>
+    `<button class="${t === active ? 'active' : ''}" onclick="go('${t}')">${t}</button>`).join("");
+}
+async function go(tab) {
+  active = tab; renderTabs();
+  const v = document.getElementById("view");
+  try { v.innerHTML = await views[tab](); }
+  catch (e) { v.innerHTML = `<p class='err'>${esc(e.message)}</p>`; }
+}
+(async () => {
+  try {
+    const c = await api("/3/Cloud");
+    document.getElementById("cloud").textContent =
+      `${c.cloud_name} · ${c.cloud_size} node(s) · v${c.version}`;
+  } catch (e) { document.getElementById("cloud").textContent = "cloud unreachable"; }
+  renderTabs(); go(active);
+  setInterval(() => { if (active === "Jobs") go("Jobs"); }, 3000);
+})();
+</script>
+</body>
+</html>
+"""

@@ -100,6 +100,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # route table (method, regex) → handler name — RequestServer.register
     ROUTES = [
+        ("GET", r"^/(?:flow(?:/index\.html)?/?)?$", "flow"),
         ("GET", r"^/3/Cloud/?$", "cloud"),
         ("GET", r"^/3/About$", "about"),
         ("POST", r"^/3/ImportFiles$", "import_files"),
@@ -201,6 +202,17 @@ class _Handler(BaseHTTPRequestHandler):
         self._dispatch("DELETE")
 
     # -- handlers ------------------------------------------------------------
+    def h_flow(self):
+        """`/flow/` — the built-in web UI (h2o-web's Flow analog)."""
+        from .flow import FLOW_HTML
+
+        body = FLOW_HTML.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def h_cloud(self):
         import h2o3_tpu
         from ..parallel import mesh
@@ -212,7 +224,10 @@ class _Handler(BaseHTTPRequestHandler):
             size, healthy = 0, False
         self._send(dict(version=h2o3_tpu.__version__, cloud_name="h2o3_tpu",
                         cloud_size=size, cloud_healthy=healthy,
-                        consensus=True, locked=True))
+                        consensus=True, locked=True,
+                        # store accounting (the reference's per-node
+                        # free_mem/Cleaner bookkeeping, reported per cloud)
+                        dkv=DKV.stats()))
 
     def h_about(self):
         import h2o3_tpu

@@ -32,9 +32,13 @@ class _Phase:
         self.t = time.time()
 
     def mark(self, name, sync=None):
-        if not _PROFILE:
-            return
-        if sync is not None:
+        """Record a phase boundary into /3/Timeline (always); under
+        H2O3_PROFILE=1 additionally device-sync first and print, so the
+        recorded seconds are execution (not dispatch) time."""
+        from ..runtime.timeline import Timeline
+
+        synced = _PROFILE and sync is not None
+        if synced:
             # fetch one element: through a remote-device tunnel,
             # block_until_ready can return before the computation lands —
             # a tiny D2H is the only reliable barrier
@@ -45,7 +49,10 @@ class _Phase:
             except Exception:
                 jax.block_until_ready(sync)
         now = time.time()
-        print(f"[h2o3-profile] {name}: {now - self.t:.3f}s", flush=True)
+        if _PROFILE:
+            print(f"[h2o3-profile] {name}: {now - self.t:.3f}s", flush=True)
+        Timeline.record("train_phase", name, secs=round(now - self.t, 4),
+                        synced=synced)
         self.t = now
 
 import jax
@@ -375,6 +382,12 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
 _DEV_PACKS: List = []  # weakrefs of models holding HBM forest packs (FIFO)
 
 
+def pack_nbytes(pd) -> int:
+    """HBM footprint of a packed forest — the ONE sizing rule shared by
+    eviction and DKV accounting."""
+    return int(np.prod(pd.shape)) * getattr(pd.dtype, "itemsize", 4)
+
+
 def _register_dev_pack(model, budget: int) -> None:
     """Track device-resident forests; past `budget` total bytes, evict the
     OLDEST packs to host so long grid/AutoML runs on small-HBM devices
@@ -388,12 +401,12 @@ def _register_dev_pack(model, budget: int) -> None:
         m = r()
         if m is not None and m.__dict__.get("_packed_dev") is not None:
             live.append(r)
-            total += int(np.prod(m._packed_dev.shape)) * 4
+            total += pack_nbytes(m._packed_dev)
     drop = 0
     while total > budget and drop < len(live) - 1:
         m = live[drop]()
         if m is not None:
-            total -= int(np.prod(m._packed_dev.shape)) * 4
+            total -= pack_nbytes(m._packed_dev)
             m.release_device_forest()
         drop += 1
     _DEV_PACKS[:] = live[drop:]

@@ -44,3 +44,57 @@ class DKV:
     def clear(cls) -> None:
         with cls._lock:
             cls._store.clear()
+
+    # -- size accounting (water.Cleaner / MemoryManager's bookkeeping role) -
+    @staticmethod
+    def _nbytes(value) -> int:
+        """Approximate host+device footprint of one entry."""
+        import numpy as np
+
+        seen = 0
+        vecs = getattr(value, "_vecs", None)
+        if isinstance(vecs, dict):              # Frame
+            for v in vecs.values():
+                data = getattr(v, "data", None)
+                if data is not None:
+                    seen += int(np.asarray(data).nbytes)
+                strs = getattr(v, "_strings", None)
+                if strs is not None and len(strs):
+                    # sampled estimate — a per-element Python loop would make
+                    # /3/Cloud O(total string cells)
+                    import itertools
+
+                    sample = list(itertools.islice(
+                        (s for s in strs if s is not None), 256))
+                    avg = (sum(len(str(s)) for s in sample) / len(sample)
+                           if sample else 0.0)
+                    seen += int(avg * len(strs))
+            return seen
+        pd = getattr(value, "_packed_dev", None)  # tree model, HBM pack
+        if pd is not None:
+            from ..models.shared_tree import pack_nbytes
+
+            seen += pack_nbytes(pd)
+        forest = value.__dict__.get("_forest") if hasattr(value, "__dict__") else None
+        if forest:
+            for stacked in forest:
+                for f in stacked:
+                    seen += int(np.asarray(f).nbytes)
+        return seen
+
+    @classmethod
+    def stats(cls) -> Dict:
+        """Entry counts + approximate bytes per kind — the store-level
+        accounting `water.Cleaner` keeps for its eviction decisions."""
+        with cls._lock:
+            items = list(cls._store.items())
+        out: Dict[str, Dict] = {}
+        total = 0
+        for k, v in items:
+            kind = type(v).__name__
+            b = cls._nbytes(v)
+            d = out.setdefault(kind, {"count": 0, "bytes": 0})
+            d["count"] += 1
+            d["bytes"] += b
+            total += b
+        return {"entries": len(items), "total_bytes": total, "by_kind": out}

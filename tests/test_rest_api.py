@@ -349,3 +349,12 @@ def test_automl_poll_while_running(server):
             break
         time.sleep(0.5)
     assert j["status"] == "DONE", j
+
+
+def test_flow_ui_served(server):
+    srv, _ = server
+    for path in ("/flow/", "/flow/index.html", "/"):
+        req = urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}")
+        body = req.read().decode()
+        assert req.headers["Content-Type"].startswith("text/html")
+        assert "H2O Flow" in body and "/99/Rapids" in body
