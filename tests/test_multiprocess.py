@@ -175,3 +175,43 @@ def test_dl_two_process_learns(tmp_path, cloud1):
     # bit-identity; both must clearly learn the signal
     assert ref_auc > 0.85
     assert got_auc == pytest.approx(ref_auc, abs=0.08)
+
+
+DRF_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.drf import H2ORandomForestEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+d = H2ORandomForestEstimator(ntrees=10, max_depth=6, seed=9)
+d.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    np.savez({out!r}, auc=float(d.model.training_metrics.auc))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_drf_two_process_learns(tmp_path, cloud1):
+    """DRF adds OOB accounting + row sampling + mtries on top of the GBM
+    path — the 2-process OOB AUC must match single-process within noise."""
+    p = str(tmp_path / "drf.csv")
+    _write_gbm_csv(p)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.drf import H2ORandomForestEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    ref = H2ORandomForestEstimator(ntrees=10, max_depth=6, seed=9)
+    ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
+              training_frame=fr)
+    ref_auc = float(ref.model.training_metrics.auc)
+
+    out = str(tmp_path / "drf2.npz")
+    run_workers(2, DRF_BODY.format(csv=p, out=out))
+    got_auc = float(np.load(out)["auc"])
+    assert ref_auc > 0.8
+    # different sampling RNG (npad differs) -> tolerance, not bit-identity
+    assert got_auc == pytest.approx(ref_auc, abs=0.06)
