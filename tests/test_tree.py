@@ -112,3 +112,15 @@ def test_nan_goes_right():
 
     bm = build_bins(x[:, None], nbins=B)
     assert (bm.codes[::7, 0] == B - 1).all()
+
+
+def test_pack6_roundtrip(cloud1):
+    """6-bit code packing (H2D compression) is bit-exact."""
+    import numpy as np
+
+    from h2o3_tpu.models.shared_tree import _pack6_host, _unpack6_device
+
+    rng = np.random.default_rng(3)
+    codes = rng.integers(0, 64, size=(4096, 7)).astype(np.uint8)
+    got = np.asarray(_unpack6_device(_pack6_host(codes)))
+    np.testing.assert_array_equal(got, codes)
