@@ -199,6 +199,13 @@ class Frame:
         if self._vecs and len(value) != self.nrow:
             raise ValueError("length mismatch")
         self._vecs[name] = value
+        self._touch()
+
+    def _touch(self) -> None:
+        """In-place mutation hook: every mutator calls this so per-frame
+        caches (e.g. stacked-ensemble level-one predictions) can never
+        serve results computed from the frame's previous contents."""
+        self.__dict__.pop("_lvl1_preds", None)
 
     def take(self, idx: np.ndarray) -> "Frame":
         return Frame({n: v.take(idx) for n, v in self._vecs.items()})
@@ -380,6 +387,7 @@ class Frame:
         if len(set(names)) != len(names):
             raise ValueError("set_names: duplicate column names")
         self._vecs = dict(zip(names, self._vecs.values()))
+        self._touch()
         return self
 
     def rename(self, columns: Dict[str, str]) -> "Frame":
@@ -388,6 +396,7 @@ class Frame:
         if len(set(new_names)) != len(new_names):
             raise ValueError("rename: would create duplicate column names")
         self._vecs = dict(zip(new_names, self._vecs.values()))
+        self._touch()
         return self
 
     def columns_by_type(self, coltype: str = "numeric"):
@@ -477,6 +486,7 @@ class Frame:
                         sub[~ok] = np.bincount(sub[ok]).argmax()
                         codes[rows] = sub
                 self._vecs[n] = Vec(codes.astype(np.int32), "enum", domain=v.domain)
+                self._touch()
             elif v.type != "string":
                 col = v.numeric_np().copy()  # never mutate a shared Vec buffer
                 for gi in range(len(bounds) - 1):
@@ -487,6 +497,7 @@ class Frame:
                         sub[na] = fill_value(sub)
                         col[rows] = sub
                 self._vecs[n] = Vec(col.astype(np.float32), v.type)
+                self._touch()
         return self
 
     def scale(self, center=True, scale=True) -> "Frame":

@@ -45,7 +45,17 @@ class StackedEnsembleModel(H2OModel):
         cols = {}
         for i, bm in enumerate(self.base_models):
             t0 = time.time()
-            p = bm._cv_predict(bm.model, frame)
+            # one base-model prediction per FRAME, shared across ensembles
+            # (BestOfFamily ⊆ AllModels would otherwise re-predict every
+            # model). Living on the frame object, the cache dies with the
+            # frame, cannot collide across frames that reuse a DKV key, and
+            # Frame._touch() clears it on any in-place mutation. Computed
+            # BEFORE insertion so a failed predict can't poison it.
+            preds = frame.__dict__.setdefault("_lvl1_preds", {})
+            mid = bm.model.model_id
+            if mid not in preds:
+                preds[mid] = bm._cv_predict(bm.model, frame)
+            p = preds[mid]
             if prof:
                 print(f"[h2o3-profile] SE level-one {bm.algo} "
                       f"({bm.model_id}): {time.time()-t0:.2f}s", flush=True)

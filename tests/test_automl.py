@@ -144,3 +144,35 @@ def test_leaderboard_frame_and_best_model(cloud1):
     best_glm = aml.get_best_model(algorithm="glm")
     assert best_glm is not None and best_glm.algo == "glm"
     assert aml.get_best_model() is aml.leaderboard[0]["_est"]
+
+
+def test_se_level_one_cache_invalidation(cloud1):
+    """The SE level-one cache must refresh when the frame mutates in
+    place (keyed on the frame version counter)."""
+    import numpy as np
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(600, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    fr = h2o.H2OFrame_from_python(
+        {**{f"c{i}": X[:, i] for i in range(4)}, "y": y.astype(str)},
+        column_types={"y": "enum"})
+    bases = []
+    for depth in (2, 3):
+        g = H2OGradientBoostingEstimator(
+            ntrees=5, max_depth=depth, nfolds=2,
+            keep_cross_validation_predictions=True, seed=1)
+        g.train(x=[f"c{i}" for i in range(4)], y="y", training_frame=fr)
+        bases.append(g)
+    se = H2OStackedEnsembleEstimator(base_models=bases)
+    se.train(x=[f"c{i}" for i in range(4)], y="y", training_frame=fr)
+    p1 = se.predict(fr).as_data_frame()["1"].to_numpy()
+    p1b = se.predict(fr).as_data_frame()["1"].to_numpy()  # cache hit
+    np.testing.assert_array_equal(p1, p1b)
+    fr["c0"] = np.zeros(600)  # in-place mutation bumps the version
+    p2 = se.predict(fr).as_data_frame()["1"].to_numpy()
+    assert not np.allclose(p1, p2)  # stale cache would return p1
