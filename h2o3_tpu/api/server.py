@@ -388,8 +388,12 @@ class _Handler(BaseHTTPRequestHandler):
         DKV.put(job.dest, job)
 
         def run():
+            from ..parallel import mesh
+
             try:
-                est.train(x=x, y=y, training_frame=train, validation_frame=valid)
+                with mesh.training_guard():
+                    est.train(x=x, y=y, training_frame=train,
+                              validation_frame=valid)
                 m = est.model
                 DKV.put(m.model_id, m)
                 job.result = m.model_id
@@ -500,16 +504,20 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(ln) if ln else b""
         ctype = self.headers.get("Content-Type", "")
         if "multipart/form-data" in ctype and b"\r\n\r\n" in body:
-            # minimal multipart: first part's payload up to the boundary
-            # (RFC 2046: the boundary parameter may be quoted and need not
-            # be the last Content-Type parameter)
+            # minimal multipart: split on the boundary FIRST so a body with
+            # several parts yields only the first part's payload instead of
+            # embedding the later parts' headers (RFC 2046: the boundary
+            # parameter may be quoted and need not be the last parameter)
             bpart = ctype.split("boundary=")[-1].split(";")[0].strip()
-            boundary = bpart.strip('"').encode()
-            payload = body.split(b"\r\n\r\n", 1)[1]
-            end = payload.rfind(b"\r\n--" + boundary)
-            if end >= 0:
-                payload = payload[:end]
-            body = payload
+            boundary = b"--" + bpart.strip('"').encode()
+            for part in body.split(boundary):
+                if b"\r\n\r\n" not in part:
+                    continue  # preamble / trailing "--\r\n"
+                payload = part.split(b"\r\n\r\n", 1)[1]
+                if payload.endswith(b"\r\n"):
+                    payload = payload[:-2]
+                body = payload
+                break
         name = qs.get("destination_frame") or "upload"
         suffix = os.path.splitext(name)[1] or ".csv"
         tmp = tempfile.NamedTemporaryFile(
@@ -566,8 +574,11 @@ class _Handler(BaseHTTPRequestHandler):
         DKV.put(gs.grid_id, gs)
 
         def run():
+            from ..parallel import mesh
+
             try:
-                gs.train(x=x, y=y, training_frame=train)
+                with mesh.training_guard():
+                    gs.train(x=x, y=y, training_frame=train)
                 job.done()
             except Exception as e:
                 Log.err(f"grid {algo}: {e}")
@@ -626,8 +637,12 @@ class _Handler(BaseHTTPRequestHandler):
             build = json.loads(build)
         from ..automl.automl import H2OAutoML
 
-        kw = dict(seed=int(p.get("seed", build.get("seed", -1)) or -1),
-                  nfolds=int(p.get("nfolds", build.get("nfolds", 5)) or 5),
+        # 0 is meaningful for both (nfolds=0 disables CV, seed=0 is a valid
+        # seed) — only fall back to the default when the key is truly absent
+        seed = p.get("seed", build.get("seed"))
+        nfolds = p.get("nfolds", build.get("nfolds"))
+        kw = dict(seed=-1 if seed is None else int(seed),
+                  nfolds=5 if nfolds is None else int(nfolds),
                   project_name=p.get("project_name"))
         max_models = int(p.get("max_models", build.get("max_models", 0)) or 0)
         if max_models:
@@ -649,8 +664,11 @@ class _Handler(BaseHTTPRequestHandler):
             x = json.loads(x)
 
         def run():
+            from ..parallel import mesh
+
             try:
-                aml.train(x=x, y=y, training_frame=train)
+                with mesh.training_guard():
+                    aml.train(x=x, y=y, training_frame=train)
                 job.done()
             except Exception as e:
                 Log.err(f"automl: {e}")

@@ -490,7 +490,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
                 model.net_params = params
                 sm = model._make_metrics(train, X_pre=X_score)
                 ev = {
-                    "epochs": seen / n, "iterations": it,
+                    "epochs": seen / n_global, "iterations": it,
                     "samples": seen, "timestamp": time.time(),
                 }
                 if problem in ("regression", "autoencoder"):

@@ -173,15 +173,19 @@ class _StepCfg(NamedTuple):
     tweedie_power: float
     quantile_alpha: float
     hist_method: str = "auto"
+    grow_policy: str = "depthwise"   # "lossguide" = xgboost leaf-wise
+    max_leaves: int = 0              # lossguide leaf budget (0 = 2^depth)
 
 
 def _pack_hp(tp, lr, colp) -> "jnp.ndarray":
     """The traced scalar hyperparameters, in a fixed layout:
     [min_rows, min_split_improvement, reg_lambda, reg_alpha, lr,
-    learn_rate_annealing, col_sample_product]."""
+    learn_rate_annealing, col_sample_product, max_abs_leaf]."""
+    cap = float(tp.get("max_abs_leaf", np.inf))
     return jnp.asarray(
         [tp["min_rows"], tp["min_split_improvement"], tp["reg_lambda"],
-         tp.get("reg_alpha", 0.0), lr, tp["learn_rate_annealing"], colp],
+         tp.get("reg_alpha", 0.0), lr, tp["learn_rate_annealing"], colp,
+         cap if np.isfinite(cap) else 3.4e38],
         jnp.float32)
 
 
@@ -228,8 +232,10 @@ def _unpack6_device(packed):
 def _bucket_rows(npad: int) -> int:
     """Round a padded row count up to {1, 1.125, 1.25, ..., 2}·2^k so
     near-same-size datasets share compiled programs (≤12.5% pad overhead).
-    Small shapes stay exact — their compiles are cheap and padding is not."""
-    if npad <= 8192:
+    Small shapes stay exact — their compiles are cheap and padding is not.
+    H2O3_BUCKET_ROWS=0 disables (exact shapes; used by determinism tests
+    to show padded-shape invariance of the trained model)."""
+    if npad <= 8192 or os.environ.get("H2O3_BUCKET_ROWS", "1") == "0":
         return npad
     p = 1 << (npad.bit_length() - 1)
     for eighths in range(8, 17):
@@ -285,6 +291,39 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
         )
 
     def _build_one(codes, g, h, w, fm, edges, mono, hp, key):
+        if cfg.grow_policy == "lossguide":
+            lg_kwargs = dict(max_depth=cfg.max_depth, nbins=cfg.nbins,
+                             max_leaves=cfg.max_leaves,
+                             hist_method=cfg.hist_method)
+            if cloud.size > 1:
+                from jax import shard_map
+
+                rspec = P(cloudlib.ROWS_AXIS)
+
+                def inner_lg(codes, g, h, w, fm, edges, mono, hp, key):
+                    return treelib.build_tree_lossguide(
+                        codes, g, h, w, fm, edges,
+                        min_rows=hp[0], min_split_improvement=hp[1],
+                        reg_lambda=hp[2], reg_alpha=hp[3],
+                        max_abs_leaf=hp[7],
+                        axis_name=cloudlib.ROWS_AXIS, **lg_kwargs,
+                    )
+
+                fn = shard_map(
+                    inner_lg, mesh=cloud.mesh,
+                    in_specs=(rspec, rspec, rspec, rspec, P(), P(), P(),
+                              P(), P()),
+                    out_specs=(
+                        treelib.Tree(P(), P(), P(), P(), P()), rspec,
+                        P(), P(),
+                    ),
+                )
+                return fn(codes, g, h, w, fm, edges, mono, hp, key)
+            return treelib.build_tree_lossguide(
+                codes, g, h, w, fm, edges,
+                min_rows=hp[0], min_split_improvement=hp[1],
+                reg_lambda=hp[2], reg_alpha=hp[3], max_abs_leaf=hp[7],
+                **lg_kwargs)
         kwargs = dict(max_depth=cfg.max_depth, nbins=cfg.nbins,
                       mtries=cfg.mtries, hist_method=cfg.hist_method)
         if cloud.size > 1:
@@ -299,7 +338,7 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                 return treelib.build_tree(
                     codes, g, h, w, fm, edges, key=key,
                     min_rows=hp[0], min_split_improvement=hp[1],
-                    reg_lambda=hp[2], reg_alpha=hp[3],
+                    reg_lambda=hp[2], reg_alpha=hp[3], max_abs_leaf=hp[7],
                     axis_name=cloudlib.ROWS_AXIS, **kw,
                 )
 
@@ -314,7 +353,7 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
         if cfg.has_monotone:
             kwargs["monotone"] = mono
         return treelib.build_tree(
-            codes, g, h, w, fm, edges, key=key,
+            codes, g, h, w, fm, edges, key=key, max_abs_leaf=hp[7],
             min_rows=hp[0], min_split_improvement=hp[1],
             reg_lambda=hp[2], reg_alpha=hp[3], **kwargs)
 
@@ -844,6 +883,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if p.get("reg_lambda") is not None
             else (0.0 if self._mode == "drf" else 1.0),
             reg_alpha=float(p.get("reg_alpha") or 0.0) if "reg_alpha" in p else 0.0,
+            max_abs_leaf=float(p.get("max_abs_leafnode_pred") or np.inf)
+            if "max_abs_leafnode_pred" in p else np.inf,
         )
 
     def _make_step_cfg(self, tp, npad, K, F, nbins, problem, dist) -> _StepCfg:
@@ -872,7 +913,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
                            if "tweedie_power" in self._parms else 1.5),
             quantile_alpha=(float(self._parms.get("quantile_alpha", 0.5))
                             if "quantile_alpha" in self._parms else 0.5),
-            hist_method=tp.get("hist_method", "auto"),
+            # the env override is resolved HERE (a structural cfg field →
+            # program-cache key), not inside the jitted kernel: an env read
+            # at trace time would be frozen into the compiled program and
+            # silently ignored on later in-process changes
+            hist_method=os.environ.get(
+                "H2O3_HIST_METHOD", tp.get("hist_method", "auto")),
+            grow_policy=tp.get("grow_policy", "depthwise"),
+            max_leaves=int(tp.get("max_leaves", 0)),
         )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
@@ -1057,7 +1105,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # padded shape, so they reuse ONE compiled tree program instead
             # of paying a compile-cache load each (~4-10 s through a remote
             # chip tunnel). ≤12.5% extra zero-weight rows — exact no-ops.
-            npad = _bucket_rows(npad)
+            # bucket values are (2^k/8)·{8..16} — divisible by any power-of-
+            # two shard count but not e.g. a 6-device mesh, so round back up
+            # to the mesh multiple to keep shard_map's equal-shard invariant
+            npad = cloudlib.pad_to_multiple(
+                _bucket_rows(npad), max(ndev * 8, 8))
             pad = npad - n
 
         def padr(a, fill=0):

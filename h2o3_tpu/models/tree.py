@@ -121,6 +121,8 @@ def build_tree(
     mtries: int = 0,
     key: Optional[jax.Array] = None,
     monotone: Optional[jax.Array] = None,  # (F,) ∈ {-1,0,1}
+    max_abs_leaf=None,  # traced scalar: |leaf value| cap (GBM
+    #                     max_abs_leafnode_pred / xgboost max_delta_step)
 ):
     """Build one tree; returns (Tree, final_leaf_heap_idx (N,),
     gain_per_feature (F,), cover (T,) — Σ training row weights per heap node,
@@ -185,6 +187,10 @@ def build_tree(
         # CalcWeight: soft-threshold G by alpha, shrink by lambda)
         gthr = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - reg_alpha, 0.0)
         node_val = (-gthr / (hsum + reg_lambda + 1e-12)).astype(jnp.float32)
+        if max_abs_leaf is not None:
+            # cap before monotone bounds so the bounds (which encode the
+            # constraint) always win over the magnitude cap
+            node_val = jnp.clip(node_val, -max_abs_leaf, max_abs_leaf)
         if monotone is not None:
             node_val = jnp.clip(node_val, lo_lvl, hi_lvl)
         value_a = value_a.at[base : base + L].set(node_val)
@@ -199,10 +205,14 @@ def build_tree(
         H = hsum[:, None, None]
         W = wsum[:, None, None]
         GR, HR, WR = G - GL, H - HL, W - WL
+        # xgboost CalcSplitGain: L1 soft-threshold the gradient sums before
+        # squaring (ThresholdL1); exact no-op at reg_alpha=0
+        tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
+        GLt, GRt, Gt = tl1(GL), tl1(GR), tl1(G)
         gain = (
-            GL * GL / (HL + reg_lambda)
-            + GR * GR / (HR + reg_lambda)
-            - G * G / (H + reg_lambda)
+            GLt * GLt / (HL + reg_lambda)
+            + GRt * GRt / (HR + reg_lambda)
+            - Gt * Gt / (H + reg_lambda)
         )
         ok = (WL >= min_rows) & (WR >= min_rows)
         ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)   # no split at NA bin
@@ -304,6 +314,8 @@ def build_tree(
         tot = jax.lax.psum(tot, axis_name)
     gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
     leaf_val = (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
+    if max_abs_leaf is not None:
+        leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf)
     if monotone is not None:
         leaf_val = jnp.clip(leaf_val, lo_lvl, hi_lvl)
     value_a = value_a.at[basef:].set(leaf_val)
@@ -312,6 +324,205 @@ def build_tree(
         Tree(feat_a, bin_a, thr_a, split_a, value_a),
         idx + basef,
         gain_per_feature,
+        cover_a,
+    )
+
+
+def _search_splits(hist, feat_mask, nbins, min_rows, reg_lambda, reg_alpha):
+    """Best (gain, feat, bin) per node for an (L, F, B, 3) histogram —
+    the split search of `build_tree` without the level-wise bookkeeping
+    (`hex/tree/DTree.Split.findBestSplitPoint`; xgboost EvaluateSplits)."""
+    L, F = hist.shape[0], hist.shape[1]
+    wsum = hist[..., 0].sum(axis=2)[:, 0]
+    gsum = hist[..., 1].sum(axis=2)[:, 0]
+    hsum = hist[..., 2].sum(axis=2)[:, 0]
+    GL = jnp.cumsum(hist[..., 1], axis=2)
+    HL = jnp.cumsum(hist[..., 2], axis=2)
+    WL = jnp.cumsum(hist[..., 0], axis=2)
+    G, H, W = (a[:, None, None] for a in (gsum, hsum, wsum))
+    GR, HR, WR = G - GL, H - HL, W - WL
+    # xgboost CalcSplitGain: L1 soft-threshold before squaring (ThresholdL1)
+    tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
+    GLt, GRt, Gt = tl1(GL), tl1(GR), tl1(G)
+    gain = (GLt * GLt / (HL + reg_lambda)
+            + GRt * GRt / (HR + reg_lambda)
+            - Gt * Gt / (H + reg_lambda))
+    ok = (WL >= min_rows) & (WR >= min_rows)
+    ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)  # NA bin
+    ok = ok & (feat_mask[None, :, None] > 0)
+    gain = jnp.where(ok, gain, -jnp.inf)
+    flat = gain.reshape(L, F * nbins)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    return (best_gain, (best // nbins).astype(jnp.int32),
+            (best % nbins).astype(jnp.int32), wsum, gsum, hsum)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth", "nbins", "max_leaves", "hist_method", "axis_name",
+    ),
+)
+def build_tree_lossguide(
+    codes: jax.Array,        # (N, F) uint bin codes
+    g: jax.Array,
+    h: jax.Array,
+    w: jax.Array,
+    feat_mask: jax.Array,    # (F,) per-tree column mask
+    edges: jax.Array,
+    max_depth: int,
+    nbins: int,
+    max_leaves: int,
+    min_rows: float = 1.0,
+    min_split_improvement: float = 0.0,
+    reg_lambda: float = 1.0,
+    reg_alpha: float = 0.0,
+    hist_method: str = "auto",
+    axis_name: Optional[str] = None,
+    max_abs_leaf=None,
+):
+    """Leaf-wise (best-first) growth — xgboost `grow_policy=lossguide`
+    (`h2o-ext-xgboost/.../XGBoostModel.java` grow_policy passthrough to the
+    native `hist` updater; LightGBM's growth strategy).
+
+    TPU-first shape: the frontier is a fixed array of `max_leaves` leaf
+    slots, each holding its node's histogram and cached best split; every
+    iteration of a `lax.fori_loop` splits the best-gain slot, builds the
+    LEFT child's histogram in one masked pass and derives the right child
+    by parent-minus-left subtraction. All shapes are static, so one
+    compiled program serves the whole forest. The tree still lives in the
+    same depth-capped heap as `build_tree`, so scoring, packing, MOJO
+    export and TreeSHAP are unchanged.
+
+    Returns the same tuple as `build_tree`.
+    """
+    N, F = codes.shape
+    T = heap_size(max_depth)
+    S = max(2, min(max_leaves if max_leaves > 0 else 2 ** max_depth,
+                   2 ** max_depth))
+    # derived from codes (not a fresh constant) so that under shard_map the
+    # fori_loop row-state carry is device-varying from iteration 0
+    zeros_n = codes[:, 0].astype(jnp.int32) * 0
+
+    hist0 = build_histograms(codes, zeros_n, g, h, w, 1, nbins,
+                             method=hist_method, axis_name=axis_name)
+    bg0, bf0, bb0, ws0, gs0, hs0 = _search_splits(
+        hist0, feat_mask, nbins, min_rows, reg_lambda, reg_alpha)
+
+    def newton(gs, hs):
+        gthr = jnp.sign(gs) * jnp.maximum(jnp.abs(gs) - reg_alpha, 0.0)
+        v = (-gthr / (hs + reg_lambda + 1e-12)).astype(jnp.float32)
+        if max_abs_leaf is not None:
+            v = jnp.clip(v, -max_abs_leaf, max_abs_leaf)
+        return v
+
+    def depth_of(node):
+        # floor(log2(node+1)) by exact integer comparisons (max_depth small)
+        return (node[..., None] + 1 >=
+                2 ** jnp.arange(1, max_depth + 1, dtype=jnp.int32)
+                ).sum(axis=-1).astype(jnp.int32)
+
+    pad_edges = jnp.concatenate(
+        [edges.astype(jnp.float32), jnp.full((F, 1), jnp.inf, jnp.float32)],
+        axis=1)
+
+    value_a = jnp.zeros(T, jnp.float32).at[0].set(newton(gs0, hs0)[0])
+    cover_a = jnp.zeros(T, jnp.float32).at[0].set(ws0.astype(jnp.float32)[0])
+    feat_a = jnp.zeros(T, jnp.int32)
+    bin_a = jnp.zeros(T, jnp.int32)
+    thr_a = jnp.zeros(T, jnp.float32)
+    split_a = jnp.zeros(T, bool)
+
+    slot_node = jnp.full(S, -1, jnp.int32).at[0].set(0)
+    slot_hist = jnp.zeros((S,) + hist0.shape[1:], hist0.dtype
+                          ).at[0].set(hist0[0])
+    # root at depth 0 can always be considered (max_depth >= 1)
+    slot_gain = jnp.full(S, -jnp.inf, jnp.float32).at[0].set(bg0[0])
+    slot_feat = jnp.zeros(S, jnp.int32).at[0].set(bf0[0])
+    slot_bin = jnp.zeros(S, jnp.int32).at[0].set(bb0[0])
+
+    def body(t, st):
+        (feat_a, bin_a, thr_a, split_a, value_a, cover_a,
+         row_node, row_slot, slot_node, slot_hist,
+         slot_gain, slot_feat, slot_bin, gain_pf) = st
+        s_star = jnp.argmax(slot_gain).astype(jnp.int32)
+        gain = slot_gain[s_star]
+        do = gain > jnp.maximum(min_split_improvement, 1e-10)
+        node = slot_node[s_star]
+        bf = slot_feat[s_star]
+        bb = slot_bin[s_star]
+        left = 2 * node + 1
+        right = 2 * node + 2
+        new_slot = (t + 1).astype(jnp.int32)
+
+        bthr = pad_edges[bf, jnp.minimum(bb, nbins - 2)]
+        feat_a = feat_a.at[node].set(jnp.where(do, bf, feat_a[node]))
+        bin_a = bin_a.at[node].set(jnp.where(do, bb, bin_a[node]))
+        thr_a = thr_a.at[node].set(jnp.where(do, bthr, thr_a[node]))
+        split_a = split_a.at[node].set(split_a[node] | do)
+
+        in_node = row_slot == s_star
+        rcode = jnp.take(codes, bf, axis=1).astype(jnp.int32)
+        go_right = in_node & (rcode > bb) & do
+        row_node = jnp.where(go_right, right,
+                             jnp.where(in_node & do, left, row_node))
+        row_slot = jnp.where(go_right, new_slot, row_slot)
+
+        # left child = one masked histogram pass; right = parent − left
+        wl = w * (in_node & ~go_right & do).astype(w.dtype)
+        hist_l = build_histograms(codes, zeros_n, g, h, wl, 1, nbins,
+                                  method=hist_method, axis_name=axis_name)[0]
+        hist_r = slot_hist[s_star] - hist_l
+        slot_hist = slot_hist.at[s_star].set(
+            jnp.where(do, hist_l, slot_hist[s_star]))
+        slot_hist = slot_hist.at[new_slot].set(
+            jnp.where(do, hist_r, slot_hist[new_slot]))
+        slot_node = slot_node.at[s_star].set(jnp.where(do, left, node))
+        slot_node = slot_node.at[new_slot].set(
+            jnp.where(do, right, slot_node[new_slot]))
+
+        ch = jnp.stack([hist_l, hist_r])           # (2, F, B, 3)
+        cg, cbf, cbb, cws, cgs, chs = _search_splits(
+            ch, feat_mask, nbins, min_rows, reg_lambda, reg_alpha)
+        cval = newton(cgs, chs)
+        value_a = value_a.at[left].set(jnp.where(do, cval[0], value_a[left]))
+        value_a = value_a.at[right].set(jnp.where(do, cval[1], value_a[right]))
+        cover_a = cover_a.at[left].set(
+            jnp.where(do, cws.astype(jnp.float32)[0], cover_a[left]))
+        cover_a = cover_a.at[right].set(
+            jnp.where(do, cws.astype(jnp.float32)[1], cover_a[right]))
+
+        # children at the depth cap cannot split further
+        can = depth_of(jnp.stack([left, right])) < max_depth
+        cg = jnp.where(can, cg, -jnp.inf)
+        slot_gain = slot_gain.at[s_star].set(jnp.where(do, cg[0], -jnp.inf))
+        slot_gain = slot_gain.at[new_slot].set(
+            jnp.where(do, cg[1], slot_gain[new_slot]))
+        slot_feat = slot_feat.at[s_star].set(jnp.where(do, cbf[0], 0))
+        slot_feat = slot_feat.at[new_slot].set(
+            jnp.where(do, cbf[1], slot_feat[new_slot]))
+        slot_bin = slot_bin.at[s_star].set(jnp.where(do, cbb[0], 0))
+        slot_bin = slot_bin.at[new_slot].set(
+            jnp.where(do, cbb[1], slot_bin[new_slot]))
+
+        gain_pf = gain_pf + jnp.where(
+            do & (jnp.arange(F, dtype=jnp.int32) == bf), gain, 0.0
+        ).astype(jnp.float32)
+        return (feat_a, bin_a, thr_a, split_a, value_a, cover_a,
+                row_node, row_slot, slot_node, slot_hist,
+                slot_gain, slot_feat, slot_bin, gain_pf)
+
+    st = (feat_a, bin_a, thr_a, split_a, value_a, cover_a,
+          zeros_n, zeros_n, slot_node, slot_hist,
+          slot_gain, slot_feat, slot_bin, jnp.zeros(F, jnp.float32))
+    st = jax.lax.fori_loop(0, S - 1, body, st)
+    (feat_a, bin_a, thr_a, split_a, value_a, cover_a,
+     row_node, _, _, _, _, _, _, gain_pf) = st
+    return (
+        Tree(feat_a, bin_a, thr_a, split_a, value_a),
+        row_node,
+        gain_pf,
         cover_a,
     )
 

@@ -98,9 +98,54 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
             mtries=0,
             reg_lambda=float(p.get("reg_lambda", 1.0)),
             reg_alpha=float(p.get("reg_alpha", 0.0)),
+            grow_policy=str(p.get("grow_policy", "depthwise")),
+            max_leaves=int(p.get("max_leaves", 0) or 0),
+            # xgboost: 0 = no cap, for both knobs
+            max_abs_leaf=min(
+                float(p.get("max_abs_leafnode_pred", 0) or 0) or np.inf,
+                float(p.get("max_delta_step", 0) or 0) or np.inf),
         )
 
+    def _check_params(self):
+        """Reject accepted-but-unimplemented combinations LOUDLY — upstream
+        either honors or errors on these (`hex/tree/xgboost/XGBoostModel.java`
+        createParamsMap); training something silently different is worse
+        than failing."""
+        p = self._parms
+        if str(p.get("booster", "gbtree")) == "dart":
+            raise ValueError(
+                "booster='dart' (DART dropout boosting) is not implemented "
+                "by tree_method=tpu_hist; use booster='gbtree'")
+        for k in ("rate_drop", "skip_drop"):
+            if float(p.get(k, 0) or 0) != 0.0:
+                raise ValueError(f"{k} is a DART parameter; booster='dart' "
+                                 "is not implemented by tpu_hist")
+        if bool(p.get("one_drop", False)):
+            raise ValueError("one_drop is a DART parameter; booster='dart' "
+                             "is not implemented by tpu_hist")
+        gp = str(p.get("grow_policy", "depthwise"))
+        if gp not in ("depthwise", "lossguide"):
+            raise ValueError(f"grow_policy={gp!r}: expected 'depthwise' or "
+                             "'lossguide'")
+        if gp == "lossguide":
+            ml = int(p.get("max_leaves", 0) or 0)
+            if ml == 1 or ml < 0:
+                raise ValueError(f"max_leaves={ml}: a tree has at least 2 "
+                                 "leaves (0 = bounded by max_depth only)")
+            if int(p.get("max_depth", 6)) < 1:
+                raise ValueError(
+                    "grow_policy='lossguide' needs max_depth >= 1: the heap "
+                    "tree layout is depth-capped (leaf-wise growth stops at "
+                    "max_leaves OR max_depth, whichever binds first)")
+            if p.get("monotone_constraints"):
+                raise ValueError("monotone_constraints are not yet supported "
+                                 "with grow_policy='lossguide'")
+        elif int(p.get("max_leaves", 0) or 0) > 0:
+            raise ValueError("max_leaves needs grow_policy='lossguide' "
+                             "(depthwise growth is bounded by max_depth)")
+
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
+        self._check_params()
         obj = self._parms.get("objective")
         if obj and str(obj).startswith("rank"):
             gcol = self._parms.get("group_column") or "qid"

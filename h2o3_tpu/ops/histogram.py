@@ -26,6 +26,7 @@ single `lax.psum` over the ``hosts`` mesh axis, applied by the caller inside
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -131,6 +132,8 @@ def build_histograms(
     cross-host psum (the MRTask.reduce step) when called under shard_map.
     """
     vals = jnp.stack([w, g * w, h * w]).astype(jnp.float32)  # (3, N)
+    if method == "auto":
+        method = os.environ.get("H2O3_HIST_METHOD", "auto")
     if method == "auto":
         platform = jax.default_backend()
         if platform == "cpu":

@@ -123,6 +123,29 @@ def collective_fence(x) -> None:
         jax.block_until_ready(x)
 
 
+_training_lock = threading.RLock()
+
+
+def training_guard():
+    """Context manager serializing whole training jobs across threads on
+    multi-device CPU meshes.
+
+    `collective_fence` keeps at most one collective executable in flight
+    *within* a training loop, but two REST-spawned jobs (grid + AutoML, or
+    two concurrent model builds) interleave dispatches from separate
+    threads, recreating the XLA:CPU thunk-pool deadlock it exists to avoid.
+    On TPU (streams serialize) or single-device clouds this returns a no-op
+    context so concurrent jobs still overlap host-side work."""
+    import contextlib
+
+    import jax
+
+    c = _cloud
+    if c is not None and c.size > 1 and jax.default_backend() == "cpu":
+        return _training_lock
+    return contextlib.nullcontext()
+
+
 def pad_to_multiple(n: int, k: int) -> int:
     """Rows are padded so each mesh shard is equal-sized (XLA needs static,
     uniform shards; H2O chunks could be ragged — ours cannot)."""

@@ -1,0 +1,105 @@
+"""XGBoost estimator surface: lossguide growth, parameter honesty, leaf caps.
+
+Reference behaviors: `h2o-ext-xgboost/.../XGBoostModel.java` createParamsMap
+(grow_policy / max_leaves / booster passthrough to the native booster);
+xgboost's `hist` updater semantics.
+"""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+
+
+def _frame(n=4000, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * rng.normal(size=n)) > 0)
+    d = {f"f{i}": X[:, i] for i in range(f)}
+    d["y"] = y.astype(int).astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    return fr, [f"f{i}" for i in range(f)]
+
+
+def _leaf_counts(model):
+    """Leaves per tree from the heap arrays: #splits + 1."""
+    out = []
+    for k_forest in model.forest:
+        for t in range(k_forest.is_split.shape[0]):
+            out.append(int(np.asarray(k_forest.is_split[t]).sum()) + 1)
+    return out
+
+
+def test_lossguide_leaf_cap_honored():
+    fr, x = _frame()
+    xgb = H2OXGBoostEstimator(ntrees=8, max_depth=6, seed=1,
+                              grow_policy="lossguide", max_leaves=8)
+    xgb.train(x=x, y="y", training_frame=fr)
+    leaves = _leaf_counts(xgb.model)
+    assert max(leaves) <= 8, leaves
+    assert max(leaves) > 2, "trees did not grow at all"
+    assert float(xgb.auc()) > 0.85
+
+
+def test_lossguide_depth_cap_binds():
+    fr, x = _frame()
+    xgb = H2OXGBoostEstimator(ntrees=5, max_depth=2, seed=1,
+                              grow_policy="lossguide", max_leaves=64)
+    xgb.train(x=x, y="y", training_frame=fr)
+    # depth 2 heap can hold at most 4 leaves regardless of the leaf budget
+    assert max(_leaf_counts(xgb.model)) <= 4
+
+
+def test_lossguide_matches_depthwise_when_unconstrained():
+    # with a leaf budget >= 2^depth every positive-gain node splits in both
+    # policies; split decisions are local, so the models score identically
+    fr, x = _frame(n=2000)
+    kw = dict(ntrees=4, max_depth=3, seed=7, min_rows=10)
+    a = H2OXGBoostEstimator(**kw)
+    a.train(x=x, y="y", training_frame=fr)
+    b = H2OXGBoostEstimator(grow_policy="lossguide", max_leaves=8, **kw)
+    b.train(x=x, y="y", training_frame=fr)
+    pa = a.predict(fr).vec("1").numeric_np()
+    pb = b.predict(fr).vec("1").numeric_np()
+    np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("params", [
+    dict(booster="dart"),
+    dict(rate_drop=0.1),
+    dict(one_drop=True),
+    dict(skip_drop=0.5),
+    dict(grow_policy="bogus"),
+    dict(max_leaves=16),                       # needs lossguide
+    dict(grow_policy="lossguide", max_depth=0),
+    dict(grow_policy="lossguide", max_leaves=1),
+])
+def test_unimplemented_params_raise(params):
+    fr, x = _frame(n=500)
+    est = H2OXGBoostEstimator(ntrees=2, **params)
+    with pytest.raises(ValueError):
+        est.train(x=x, y="y", training_frame=fr)
+
+
+def test_max_abs_leafnode_pred_clamps_gbm():
+    fr, x = _frame(n=2000)
+    cap, lr = 0.02, 0.1
+    gbm = H2OGradientBoostingEstimator(ntrees=5, max_depth=4, seed=3,
+                                       learn_rate=lr,
+                                       max_abs_leafnode_pred=cap)
+    gbm.train(x=x, y="y", training_frame=fr)
+    for k_forest in gbm.model.forest:
+        vals = np.asarray(k_forest.value)
+        assert np.abs(vals).max() <= cap * lr * (1 + 1e-5)
+
+
+def test_max_delta_step_clamps_xgb():
+    fr, x = _frame(n=2000)
+    xgb = H2OXGBoostEstimator(ntrees=5, max_depth=4, seed=3, learn_rate=0.3,
+                              max_delta_step=0.05)
+    xgb.train(x=x, y="y", training_frame=fr)
+    for k_forest in xgb.model.forest:
+        vals = np.asarray(k_forest.value)
+        assert np.abs(vals).max() <= 0.05 * 0.3 * (1 + 1e-5)
