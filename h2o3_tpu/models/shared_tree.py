@@ -202,10 +202,24 @@ def _concat_args(*xs):
     return jnp.concatenate(xs, axis=0)
 
 
-def _pack6_host(codes: np.ndarray) -> np.ndarray:
-    """Pack uint8 bin codes < 64 into 6 bits: 4 row-groups → 3 bytes.
-    Rows must be a multiple of 4 (the padded row counts always are)."""
-    # stays uint8 end to end: every packed byte fits (max 63<<2 = 252)
+def _pack_host(codes: np.ndarray, bits: int) -> np.ndarray:
+    """Pack uint8 bin codes < 2^bits into `bits` bits per value along rows.
+    bits ∈ {4, 5, 6}: {2, 8, 4} row-groups → {1, 5, 3} bytes. Rows must be
+    a multiple of the group size (padded row counts are multiples of 8).
+    The bin-code matrix is the dominant fixed H2D cost through a ~6 MB/s
+    remote-chip tunnel — every shaved bit is ~2% of upload wall."""
+    if bits == 4:
+        return (codes[0::2] << 4) | codes[1::2]
+    if bits == 5:
+        a, b, c, d, e, f, g, hh = (codes[i::8] for i in range(8))
+        out = np.empty((5 * a.shape[0],) + codes.shape[1:], np.uint8)
+        out[0::5] = (a << 3) | (b >> 2)
+        out[1::5] = ((b & 0x3) << 6) | (c << 1) | (d >> 4)
+        out[2::5] = ((d & 0xF) << 4) | (e >> 1)
+        out[3::5] = ((e & 0x1) << 7) | (f << 2) | (g >> 3)
+        out[4::5] = ((g & 0x7) << 5) | hh
+        return out
+    # 6-bit: stays uint8 end to end (max 63<<2 = 252)
     a, b, c, d = codes[0::4], codes[1::4], codes[2::4], codes[3::4]
     out = np.empty((3 * a.shape[0],) + codes.shape[1:], np.uint8)
     out[0::3] = (a << 2) | (b >> 4)
@@ -214,9 +228,28 @@ def _pack6_host(codes: np.ndarray) -> np.ndarray:
     return out
 
 
-@jax.jit
-def _unpack6_device(packed):
-    """Inverse of _pack6_host, on device: (3k, F) uint8 → (4k, F) uint8."""
+@functools.partial(jax.jit, static_argnames=("bits",))
+def _unpack_device(packed, bits: int):
+    """Inverse of _pack_host, on device: one tiny widening program."""
+    if bits == 4:
+        k = packed.shape[0]
+        out = jnp.stack([packed >> 4, packed & 0xF], axis=1)
+        return out.reshape((2 * k,) + packed.shape[1:]).astype(jnp.uint8)
+    if bits == 5:
+        b = [packed[i::5].astype(jnp.uint16) for i in range(5)]
+        k = packed.shape[0] // 5
+        vals = [
+            b[0] >> 3,
+            ((b[0] & 0x7) << 2) | (b[1] >> 6),
+            (b[1] >> 1) & 0x1F,
+            ((b[1] & 0x1) << 4) | (b[2] >> 4),
+            ((b[2] & 0xF) << 1) | (b[3] >> 7),
+            (b[3] >> 2) & 0x1F,
+            ((b[3] & 0x3) << 3) | (b[4] >> 5),
+            b[4] & 0x1F,
+        ]
+        out = jnp.stack(vals, axis=1).reshape((8 * k,) + packed.shape[1:])
+        return out.astype(jnp.uint8)
     b0 = packed[0::3].astype(jnp.uint16)
     b1 = packed[1::3].astype(jnp.uint16)
     b2 = packed[2::3].astype(jnp.uint16)
@@ -227,6 +260,14 @@ def _unpack6_device(packed):
     k = packed.shape[0] // 3
     out = jnp.stack([a, b, c, d], axis=1).reshape((4 * k,) + packed.shape[1:])
     return out.astype(jnp.uint8)
+
+
+def _pack_bits_for(nbins: int, nrows: int) -> int:
+    """Narrowest usable packing for codes < nbins (0 = ship unpacked)."""
+    for bits, group in ((4, 2), (5, 8), (6, 4)):
+        if nbins <= (1 << bits) and nrows % group == 0:
+            return bits
+    return 0
 
 
 def _bucket_rows(npad: int) -> int:
@@ -1202,12 +1243,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                   out_shardings=rs_m)(margins, off_g)
         else:
             codes_p = padr(bm.codes)
-            if nbins <= 64 and codes_p.shape[0] % 4 == 0 \
-                    and codes_p.dtype == np.uint8:
-                # 6-bit packing: the bin-code matrix is the biggest fixed
-                # H2D cost (~6 MB/s tunnel) — ship 3/4 of the bytes and
-                # widen on device with one tiny program
-                codes_d = _unpack6_device(jnp.asarray(_pack6_host(codes_p)))
+            pack_bits = (_pack_bits_for(nbins, codes_p.shape[0])
+                         if codes_p.dtype == np.uint8 else 0)
+            if pack_bits:
+                # sub-byte packing: the bin-code matrix is the biggest fixed
+                # H2D cost (~6 MB/s tunnel) — ship 4/5/6-bit codes (half to
+                # 3/4 of the bytes) and widen on device with a tiny program
+                codes_d = _unpack_device(
+                    jnp.asarray(_pack_host(codes_p, pack_bits)), pack_bits)
             else:
                 codes_d = jnp.asarray(codes_p)
             if yk.size and bool(np.all((yk >= 0) & (yk <= 255)

@@ -114,13 +114,20 @@ def test_nan_goes_right():
     assert (bm.codes[::7, 0] == B - 1).all()
 
 
-def test_pack6_roundtrip(cloud1):
-    """6-bit code packing (H2D compression) is bit-exact."""
+def test_pack_roundtrip(cloud1):
+    """4/5/6-bit code packing (H2D compression) is bit-exact."""
     import numpy as np
 
-    from h2o3_tpu.models.shared_tree import _pack6_host, _unpack6_device
+    from h2o3_tpu.models.shared_tree import (_pack_bits_for, _pack_host,
+                                             _unpack_device)
 
     rng = np.random.default_rng(3)
-    codes = rng.integers(0, 64, size=(4096, 7)).astype(np.uint8)
-    got = np.asarray(_unpack6_device(_pack6_host(codes)))
-    np.testing.assert_array_equal(got, codes)
+    for bits, nbins in ((4, 16), (5, 32), (6, 64)):
+        codes = rng.integers(0, nbins, size=(4096, 7)).astype(np.uint8)
+        got = np.asarray(_unpack_device(_pack_host(codes, bits), bits))
+        np.testing.assert_array_equal(got, codes)
+    assert _pack_bits_for(16, 4096) == 4
+    assert _pack_bits_for(21, 4096) == 5
+    assert _pack_bits_for(33, 4096) == 6
+    assert _pack_bits_for(65, 4096) == 0
+    assert _pack_bits_for(21, 4098) == 0  # 4098 % 8 != 0 (and % 4 != 0)
