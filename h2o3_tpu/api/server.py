@@ -136,6 +136,10 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/99/Leaderboards/([^/]+)$", "leaderboard_get"),
         ("POST", r"^/3/Recovery$", "recovery"),
         ("POST", r"^/3/Shutdown$", "shutdown"),
+        ("GET", r"^/3/Tree$", "tree"),
+        ("GET", r"^/3/ModelMetrics$", "model_metrics_list"),
+        ("GET", r"^/99/Typeahead/files$", "typeahead"),
+        ("GET", r"^/3/WaterMeterCpuTicks/(\d+)$", "water_meter"),
     ]
 
     def log_message(self, fmt, *args):  # route access logs into our Log
@@ -165,6 +169,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str):
         path = urllib.parse.urlparse(self.path).path
+        token = getattr(self.server, "auth_token", None)
+        if token:
+            # bearer-token auth (the `-internal_security_conf` stance:
+            # reject before any handler runs; /3/Cloud stays open so
+            # clients can discover the cloud and fail with a clear 401)
+            import hmac
+
+            sent = self.headers.get("Authorization", "")
+            ok = (hmac.compare_digest(sent, f"Bearer {token}")
+                  or hmac.compare_digest(sent, f"Basic {token}"))
+            if not ok and path not in ("/3/Cloud", "/3/Cloud/"):
+                self._send(dict(__meta=dict(schema_type="H2OError"),
+                                msg="unauthorized: missing or bad "
+                                    "Authorization header",
+                                http_status=401), 401)
+                return
         for m, pat, name in self.ROUTES:
             if m != method:
                 continue
@@ -405,6 +425,90 @@ class _Handler(BaseHTTPRequestHandler):
 
         threading.Thread(target=run, daemon=True).start()
         self._send(dict(job=dict(key=dict(name=job.dest), status=job.status)))
+
+    def h_tree(self):
+        """`GET /3/Tree` — fetch one tree of a tree model (TreeV3 /
+        `hex/tree/TreeHandler.java`): params model, tree_number,
+        tree_class."""
+        from ..tree_api import H2OTree
+
+        p = self._params()
+        mkey = p.get("model")
+        m = DKV.get(mkey) if mkey else None
+        if m is None:
+            raise KeyError(f"model {mkey!r}")
+        tree = H2OTree(m, int(p.get("tree_number", 0) or 0),
+                       p.get("tree_class") or None)
+        self._send(dict(
+            model=dict(name=tree.model_id),
+            tree_number=tree.tree_number,
+            tree_class=tree.tree_class,
+            root_node_id=tree.root_node_id,
+            left_children=tree.left_children,
+            right_children=tree.right_children,
+            features=tree.features,
+            thresholds=tree.thresholds,
+            predictions=tree.predictions,
+            nas=tree.nas,
+            descriptions=tree.descriptions,
+        ))
+
+    def h_model_metrics_list(self):
+        """`GET /3/ModelMetrics` — every stored model's metrics
+        (ModelMetricsListSchemaV3 / water/api ModelMetricsHandler list)."""
+        out = []
+        for k in DKV.keys(H2OModel):
+            m = DKV.get(k)
+            for kind in ("training_metrics", "validation_metrics",
+                         "cross_validation_metrics"):
+                mm = getattr(m, kind, None)
+                if mm is None:
+                    continue
+                d = {"model": dict(name=m.model_id), "kind": kind}
+                for f in ("auc", "logloss", "rmse", "mse", "mean_residual_deviance"):
+                    v = getattr(mm, f, None)
+                    if v is not None:
+                        try:
+                            d[f] = float(v)
+                        except (TypeError, ValueError):
+                            pass
+                out.append(d)
+        self._send(dict(model_metrics=out))
+
+    def h_typeahead(self):
+        """`GET /99/Typeahead/files?src=...&limit=N` — filesystem path
+        completion (water/api TypeaheadHandler)."""
+        p = self._params()
+        src = p.get("src", "") or ""
+        limit = int(p.get("limit", 100) or 100)
+        base = os.path.dirname(src) or "/"
+        prefix = os.path.basename(src)
+        matches = []
+        try:
+            for name in sorted(os.listdir(base)):
+                if name.startswith(prefix):
+                    full = os.path.join(base, name)
+                    matches.append(full + ("/" if os.path.isdir(full) else ""))
+                    if len(matches) >= limit:
+                        break
+        except OSError:
+            pass
+        self._send(dict(src=src, matches=matches, limit=limit))
+
+    def h_water_meter(self, nodeidx):
+        """`GET /3/WaterMeterCpuTicks/{node}` — per-cpu tick counters
+        (water/util WaterMeterCpuTicks; Flow's CPU meter)."""
+        ticks = []
+        try:
+            with open("/proc/stat") as f:
+                for line in f:
+                    if re.match(r"^cpu\d+ ", line):
+                        parts = line.split()
+                        user, nice, sys_, idle = (int(v) for v in parts[1:5])
+                        ticks.append([user + nice, sys_, 0, idle])
+        except OSError:
+            pass
+        self._send(dict(cpu_ticks=ticks))
 
     def h_models_list(self):
         models = [DKV.get(k) for k in DKV.keys(H2OModel)]
@@ -727,8 +831,12 @@ class _Handler(BaseHTTPRequestHandler):
 class H2OApiServer:
     """webserver-iface: owns the listening socket + handler thread."""
 
-    def __init__(self, port: int = 54321, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 54321, host: str = "127.0.0.1",
+                 auth_token: Optional[str] = None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        # opt-in bearer-token auth (the reference's -internal_security_conf
+        # hash-login analog); None = open, like the reference's default
+        self.httpd.auth_token = auth_token
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: Optional[threading.Thread] = None
@@ -745,5 +853,6 @@ class H2OApiServer:
         self.httpd.server_close()
 
 
-def start_server(port: int = 0, host: str = "127.0.0.1") -> H2OApiServer:
-    return H2OApiServer(port=port, host=host).start()
+def start_server(port: int = 0, host: str = "127.0.0.1",
+                 auth_token: Optional[str] = None) -> H2OApiServer:
+    return H2OApiServer(port=port, host=host, auth_token=auth_token).start()

@@ -60,6 +60,7 @@ def build_bins(
     domains: Optional[List[Optional[List[str]]]] = None,
     seed: int = 0,
     col_ranges: Optional[np.ndarray] = None,
+    col_quantile_edges: Optional[List[Optional[np.ndarray]]] = None,
 ) -> BinnedMatrix:
     """Quantize columns of X (float, NaN=NA) into bin codes.
 
@@ -123,8 +124,15 @@ def build_bins(
                     edges.append(np.asarray(e, dtype=np.float64))
                     continue
                 elif histogram_type == "QuantilesGlobal":
-                    qs = np.linspace(0, 1, nvalue + 1)[1:-1]
-                    e = np.unique(np.quantile(fin, qs))
+                    if (col_quantile_edges is not None
+                            and col_quantile_edges[j] is not None):
+                        # externally supplied GLOBAL quantile edges — a
+                        # multi-host cloud's distributed refinement, so
+                        # every process bins with identical cut points
+                        e = np.asarray(col_quantile_edges[j], np.float64)
+                    else:
+                        qs = np.linspace(0, 1, nvalue + 1)[1:-1]
+                        e = np.unique(np.quantile(fin, qs))
                 else:  # Random (DHistogram histogram_type=Random)
                     if hi > lo:
                         e = np.sort(rng.uniform(lo, hi, nvalue - 1))

@@ -640,6 +640,19 @@ class Frame:
         })
 
     # string ops (water/rapids/ast/prims/string/*) — enum/string columns
+    def _string_rows(self):
+        """First column as a list of python strings (None for NA) — shared
+        row-wise access for the string prims."""
+        v = self.vecs()[0]
+        if v.type == "string":
+            return list(v.to_numpy())
+        if v.type == "enum":
+            codes = np.asarray(v.data)
+            dom = v.domain or []
+            return [None if c < 0 or c >= len(dom) else dom[c]
+                    for c in codes]
+        return [None if x != x else str(x) for x in v.numeric_np()]
+
     def _map_strings(self, fn) -> "Frame":
         out = {}
         for n, v in self._vecs.items():

@@ -45,6 +45,112 @@ def _safe_vectorize(fn):
 _lgamma = _safe_vectorize(math.lgamma)
 _gamma = _safe_vectorize(math.gamma)
 
+
+def _digamma(x):
+    """ψ(x) without scipy: reflection for x<0, recurrence to x≥6, then the
+    asymptotic series (Abramowitz & Stegun 6.3.18) — ~1e-12 accurate."""
+    x = np.asarray(x, np.float64)
+    neg = x < 0.5
+    # reflection ψ(1−x) − π/tan(πx) keeps the series region positive
+    xr = np.where(neg, 1.0 - x, x)
+    res = np.zeros_like(xr)
+    for _ in range(9):                       # push into the asymptotic zone
+        small = xr < 9
+        res -= np.where(small, 1.0 / xr, 0.0)
+        xr = xr + small
+    inv = 1.0 / xr
+    inv2 = inv * inv
+    res += (np.log(xr) - 0.5 * inv
+            - inv2 * (1 / 12.0 - inv2 * (1 / 120.0 - inv2 * (
+                1 / 252.0 - inv2 / 240.0))))
+    with np.errstate(all="ignore"):
+        res = np.where(neg, res - np.pi / np.tan(np.pi * x), res)
+    # poles at non-positive integers
+    return np.where((x <= 0) & (x == np.floor(x)), np.nan, res)
+
+
+def _trigamma(x):
+    """ψ′(x): reflection ψ′(1−x) = π²/sin²(πx) − ψ′(x), recurrence, series."""
+    x = np.asarray(x, np.float64)
+    neg = x < 0.5
+    xr = np.where(neg, 1.0 - x, x)
+    res = np.zeros_like(xr)
+    for _ in range(9):
+        small = xr < 9
+        res += np.where(small, 1.0 / (xr * xr), 0.0)
+        xr = xr + small
+    inv = 1.0 / xr
+    inv2 = inv * inv
+    res += inv * (1.0 + 0.5 * inv
+                  + inv2 * (1 / 6.0 - inv2 * (1 / 30.0 - inv2 * (
+                      1 / 42.0 - inv2 / 30.0))))
+    with np.errstate(all="ignore"):
+        refl = (np.pi / np.sin(np.pi * x)) ** 2 - res
+        res = np.where(neg, refl, res)
+    return np.where((x <= 0) & (x == np.floor(x)), np.nan, res)
+
+
+def _levenshtein(a: str, b: str) -> float:
+    if a == b:
+        return 0.0
+    la, lb = len(a), len(b)
+    if la == 0 or lb == 0:
+        return float(max(la, lb))
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        ca = a[i - 1]
+        for j in range(1, lb + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (ca != b[j - 1]))
+        prev = cur
+    return float(prev[lb])
+
+
+def _jaro_winkler(a: str, b: str) -> float:
+    """Jaro-Winkler SIMILARITY in [0,1] (Apache commons-text semantics)."""
+    if a == b:
+        return 1.0
+    la, lb = len(a), len(b)
+    if la == 0 or lb == 0:
+        return 0.0
+    window = max(la, lb) // 2 - 1
+    ma = [False] * la
+    mb = [False] * lb
+    matches = 0
+    for i in range(la):
+        lo, hi = max(0, i - window), min(lb, i + window + 1)
+        for j in range(lo, hi):
+            if not mb[j] and a[i] == b[j]:
+                ma[i] = mb[j] = True
+                matches += 1
+                break
+    if matches == 0:
+        return 0.0
+    t = 0
+    k = 0
+    for i in range(la):
+        if ma[i]:
+            while not mb[k]:
+                k += 1
+            if a[i] != b[k]:
+                t += 1
+            k += 1
+    m = float(matches)
+    jaro = (m / la + m / lb + (m - t / 2) / m) / 3
+    prefix = 0
+    for ca, cb in zip(a, b):
+        if ca != cb or prefix == 4:
+            break
+        prefix += 1
+    return jaro + prefix * 0.1 * (1 - jaro)
+
+
+# (setproperty k v) — the reference sets a JVM system property; the analog
+# here is a session-scoped property table (readable for parity tests)
+_SYS_PROPS: dict = {}
+_TIME_ZONE = ["UTC"]  # (getTimeZone)/(setTimeZone tz) mutable holder
+
 # unary elementwise math (ast/prims/math/AstUniOp subclasses) and the
 # cumulative family — module-level constants (rebuilt-per-node dicts would
 # dominate per-row apply/ddply lambdas). Cumulative ops propagate NA like
@@ -65,6 +171,8 @@ _UNARY = {
     "cospi": lambda x: np.cos(np.pi * x),
     "sinpi": lambda x: np.sin(np.pi * x),
     "tanpi": lambda x: np.tan(np.pi * x),
+    "digamma": _digamma,
+    "trigamma": _trigamma,
 }
 _CUM = {"cumsum": np.cumsum, "cumprod": np.cumprod,
         "cummin": np.minimum.accumulate, "cummax": np.maximum.accumulate}
@@ -385,6 +493,257 @@ class RapidsSession:
             return a[0].strsplit(str(a[1]))
         if op == "countmatches":
             return a[0].countmatches(a[1] if isinstance(a[1], list) else str(a[1]))
+        if op == "toTitle":
+            return a[0]._map_strings(str.title)
+        if op == "strDistance":
+            # (strDistance x y measure compare_empty) — ast/prims/string/
+            # AstStrDistance over Apache commons-text measures; "lv" is the
+            # edit count, "jw" the Jaro-Winkler similarity
+            measure = str(a[2]).lower() if len(a) > 2 else "lv"
+            cmp_empty = _truthy(a[3] if len(a) > 3 else None, default=True)
+            fn = {"lv": _levenshtein, "jw": _jaro_winkler}.get(measure)
+            if fn is None:
+                raise ValueError(f"strDistance measure {measure!r}: only "
+                                 "'lv' and 'jw' are implemented")
+            xs = a[0]._string_rows()
+            ys = a[1]._string_rows()
+            out = np.asarray([
+                np.nan if (sx is None or sy is None
+                           or (not cmp_empty and (sx == "" or sy == "")))
+                else fn(str(sx), str(sy))
+                for sx, sy in zip(xs, ys)], np.float64)
+            return Frame.from_dict({"distance": out})
+        if op == "num_valid_substrings":
+            # (num_valid_substrings x path) — count DISTINCT substrings
+            # (length >= 2) of each string present in the line-separated
+            # words file (ast/prims/string/AstCountSubstringsWords)
+            with open(str(a[1])) as f:
+                words = {ln.strip() for ln in f if ln.strip()}
+            out = []
+            for s in a[0]._string_rows():
+                if s is None:
+                    out.append(np.nan)
+                    continue
+                s = str(s)
+                subs = {s[i:j] for i in range(len(s))
+                        for j in range(i + 2, len(s) + 1)}
+                out.append(float(len(subs & words)))
+            return Frame.from_dict(
+                {"num_valid_substrings": np.asarray(out, np.float64)})
+        if op == "moment":
+            # (moment yr mo dy hr mi se ms) — epoch millis in UTC
+            # (ast/prims/time/AstMoment); each arg a scalar or a column
+            import datetime as _dt
+            import zoneinfo
+
+            tz = zoneinfo.ZoneInfo(_TIME_ZONE[0])
+            cols = [(np.asarray(v._col0()) if isinstance(v, Frame)
+                     else None) for v in a[:7]]
+            nrow = max((len(c) for c in cols if c is not None), default=1)
+            vals = [(c if c is not None
+                     else np.full(nrow, float(a[i])))
+                    for i, c in enumerate(cols)]
+            out = np.empty(nrow, np.float64)
+            for r in range(nrow):
+                y_, mo, dy, hr, mi, se, ms = (vals[j][r] for j in range(7))
+                try:
+                    t = _dt.datetime(int(y_), int(mo), int(dy), int(hr),
+                                     int(mi), int(se), int(ms) * 1000,
+                                     tzinfo=tz)
+                    out[r] = t.timestamp() * 1000.0
+                except (ValueError, OverflowError):
+                    out[r] = np.nan
+            return Frame.from_dict({"moment": out})
+        if op == "asDate":
+            # (asDate col format) — java SimpleDateFormat pattern subset
+            fmt = str(a[1])
+            for j, py in (("yyyy", "%Y"), ("yy", "%y"), ("MMM", "%b"),
+                          ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+                          ("mm", "%M"), ("ss", "%S")):
+                fmt = fmt.replace(j, py)
+            import datetime as _dt
+            import zoneinfo
+
+            tz = zoneinfo.ZoneInfo(_TIME_ZONE[0])
+            out = []
+            for s in a[0]._string_rows():
+                try:
+                    t = _dt.datetime.strptime(str(s), fmt).replace(tzinfo=tz)
+                    out.append(t.timestamp() * 1000.0)
+                except (ValueError, TypeError):
+                    out.append(np.nan)
+            fr0 = a[0]
+            return Frame({fr0.names[0]: Vec(np.asarray(out, np.float64),
+                                            "time")})
+        if op == "listTimeZones":
+            import zoneinfo
+
+            tz = np.asarray(sorted(zoneinfo.available_timezones()),
+                            dtype=object)
+            return Frame({"timezones": Vec(None, "string", strings=tz)})
+        if op == "getTimeZone":
+            return Frame({"tz": Vec(None, "string", strings=np.asarray(
+                [_TIME_ZONE[0]], dtype=object))})
+        if op == "setTimeZone":
+            import zoneinfo
+
+            name = str(a[0])
+            if name not in zoneinfo.available_timezones():
+                raise ValueError(f"unknown time zone {name!r}")
+            _TIME_ZONE[0] = name
+            return Frame({"tz": Vec(None, "string", strings=np.asarray(
+                [name], dtype=object))})
+        if op == "setproperty":
+            _SYS_PROPS[str(a[0])] = str(a[1])
+            return str(a[1])
+        if op == "rank_within_groupby":
+            # (rank_within_groupby fr groupby_cols sort_cols ascending
+            #  new_name sort_cols_sorted) — row-number rank within each
+            # group following the sort order (prims/mungers
+            # AstRankWithinGroupBy)
+            fr = a[0]
+            gcols = [int(i) for i in (a[1] if isinstance(a[1], list) else [a[1]])]
+            scols = [int(i) for i in (a[2] if isinstance(a[2], list) else [a[2]])]
+            asc = a[3] if len(a) > 3 else []
+            asc = [_truthy(f) for f in (asc if isinstance(asc, list) else [asc])]
+            if len(asc) < len(scols):
+                asc += [True] * (len(scols) - len(asc))
+            new_name = str(a[4]) if len(a) > 4 else "New_Rank_column"
+            sorted_out = _truthy(a[5] if len(a) > 5 else None, default=False)
+            vecs = fr.vecs()
+            gdata = [np.asarray(vecs[i].numeric_np()) for i in gcols]
+            sdata = [np.asarray(vecs[i].numeric_np()) for i in scols]
+            skeys = [(-d if not asc[k] else d) for k, d in enumerate(sdata)]
+            order = np.lexsort(tuple(reversed(gdata + skeys)))
+            gsorted = np.stack([d[order] for d in gdata], axis=1)
+            # NaN == NaN for grouping purposes: NA is its own level (the
+            # lexsort already made NA rows contiguous at the end)
+            diff = ((gsorted[1:] != gsorted[:-1])
+                    & ~(np.isnan(gsorted[1:]) & np.isnan(gsorted[:-1])))
+            newgrp = np.r_[True, diff.any(axis=1)]
+            pos = np.arange(len(order))
+            # groups are contiguous after the lexsort: each row's group
+            # start is the latest position flagged as a group head
+            gstart = np.maximum.accumulate(np.where(newgrp, pos, 0))
+            rank_sorted = pos - gstart + 1
+            # NAs in sort columns get NaN rank (reference excludes them)
+            na_sorted = np.zeros(len(order), bool)
+            for d in sdata:
+                na_sorted |= np.isnan(d[order])
+            rank_out = np.where(na_sorted, np.nan,
+                                rank_sorted.astype(np.float64))
+            if sorted_out:
+                cols = {n: Vec(np.asarray(v.numeric_np())[order]
+                               if v.type != "enum"
+                               else np.asarray(v.data)[order],
+                               v.type, domain=v.domain)
+                        for n, v in zip(fr.names, vecs)}
+                cols[new_name] = Vec(rank_out, "real")
+                return Frame(cols)
+            inv = np.empty_like(order)
+            inv[order] = np.arange(len(order))
+            cols = dict(zip(fr.names, vecs))
+            cols[new_name] = Vec(rank_out[inv], "real")
+            return Frame(cols)
+        if op == "relevel.by.freq":
+            # reorder every enum domain by descending level frequency
+            # (prims/mungers AstRelevelByFreq); ties keep lexical order
+            fr = a[0]
+            topn = int(a[1]) if len(a) > 1 and a[1] is not None else -1
+            out = {}
+            for n, v in zip(fr.names, fr.vecs()):
+                if v.type != "enum" or not v.domain:
+                    out[n] = v
+                    continue
+                codes = np.asarray(v.data)
+                counts = np.bincount(codes[codes >= 0],
+                                     minlength=len(v.domain))
+                order = np.argsort(-counts, kind="stable")
+                if topn > 0:
+                    # only the topn most frequent move to the front
+                    moved = order[:topn]
+                    rest = np.asarray(
+                        [i for i in range(len(v.domain)) if i not in set(moved.tolist())],
+                        np.int64)
+                    order = np.concatenate([moved, rest])
+                remap = np.empty(len(v.domain), np.int64)
+                remap[order] = np.arange(len(v.domain))
+                new_codes = np.where(codes >= 0, remap[np.maximum(codes, 0)],
+                                     codes)
+                out[n] = Vec(new_codes, "enum",
+                             domain=[v.domain[i] for i in order])
+            return Frame(out)
+        if op == "distance":
+            # (distance references queries measure) — pairwise row distance,
+            # result references.nrow × queries.nrow (prims/advmath
+            # AstDistance measures l1/l2/cosine/cosine_sq)
+            X = a[0].to_numpy().astype(np.float64)
+            Y = a[1].to_numpy().astype(np.float64)
+            measure = str(a[2]).lower() if len(a) > 2 else "l2"
+            if measure == "l1":
+                D = np.abs(X[:, None, :] - Y[None, :, :]).sum(axis=2)
+            elif measure == "l2":
+                D = np.sqrt(((X[:, None, :] - Y[None, :, :]) ** 2).sum(axis=2))
+            elif measure in ("cosine", "cosine_sq"):
+                nx = np.linalg.norm(X, axis=1, keepdims=True)
+                ny = np.linalg.norm(Y, axis=1, keepdims=True)
+                C = (X @ Y.T) / np.maximum(nx * ny.T, 1e-300)
+                D = C * C if measure == "cosine_sq" else C
+            else:
+                raise ValueError(f"distance measure {measure!r}: expected "
+                                 "l1/l2/cosine/cosine_sq")
+            return Frame.from_dict({f"C{j+1}": D[:, j]
+                                    for j in range(D.shape[1])})
+        if op == "isax":
+            # (isax fr num_words max_cardinality optimize_card) — per-row
+            # z-normalized PAA then SAX discretization; the iSAX word joins
+            # symbol ids with '^' (prims/timeseries AstIsax)
+            fr = a[0]
+            nw = int(a[1])
+            card = int(a[2]) if len(a) > 2 else 8
+            X = fr.to_numpy().astype(np.float64)
+            mu = np.nanmean(X, axis=1, keepdims=True)
+            sd = np.nanstd(X, axis=1, keepdims=True)
+            Z = (X - mu) / np.where(sd > 0, sd, 1.0)
+            # PAA: split each row into nw near-equal segments
+            idx = np.linspace(0, X.shape[1], nw + 1).astype(int)
+            paa = np.stack([Z[:, idx[i]:max(idx[i + 1], idx[i] + 1)].mean(axis=1)
+                            for i in range(nw)], axis=1)
+            # gaussian breakpoints for `card` symbols
+            from statistics import NormalDist
+
+            bps = np.asarray([NormalDist().inv_cdf(q) for q in
+                              np.linspace(0, 1, card + 1)[1:-1]])
+            sym = np.searchsorted(bps, paa)
+            words = np.asarray(["^".join(str(int(s)) for s in row)
+                                for row in sym], dtype=object)
+            out = {"iSax_index": Vec(None, "string", strings=words)}
+            for i in range(nw):
+                out[f"iSax_word_{i}"] = Vec(sym[:, i].astype(np.float64),
+                                            "real")
+            return Frame(out)
+        if op == "setLevel":
+            # (setLevel col level) — every row becomes `level`
+            fr = a[0]
+            v = fr.vecs()[0]
+            if v.type != "enum":
+                raise ValueError("setLevel requires a categorical column")
+            lvl = str(a[1])
+            if lvl not in (v.domain or []):
+                raise ValueError(f"setLevel: {lvl!r} not in domain")
+            code = v.domain.index(lvl)
+            return Frame({fr.names[0]: Vec(
+                np.full(fr.nrow, code, np.int64), "enum", domain=v.domain)})
+        if op == "append":
+            # (append fr value name) — add a column (prims/mungers AstAppend)
+            fr, val, name = a[0], a[1], str(a[2])
+            cols = dict(zip(fr.names, fr.vecs()))
+            if isinstance(val, Frame):
+                cols[name] = val.vecs()[0]
+            else:
+                cols[name] = Vec(np.full(fr.nrow, float(val), np.float64),
+                                 "real")
+            return Frame(cols)
         if op == "is.na":
             v = a[0]
             if isinstance(v, (int, float)):

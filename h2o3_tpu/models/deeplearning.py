@@ -267,11 +267,8 @@ class H2ODeepLearningEstimator(H2OEstimator):
         cloud = cloudlib.cloud()
         multiproc = distdata.multiprocess()
         if multiproc:
-            if int(p.get("stopping_rounds", 0)) > 0 or p.get("max_runtime_secs"):
-                raise ValueError(
-                    "stopping_rounds/max_runtime_secs are not yet supported "
-                    "on multi-process clouds (host control flow would "
-                    "diverge across processes)")
+            # early stopping / time budget use a global any-rank-stops vote
+            # at every scoring event, so host control flow stays aligned
             n_global = int(distdata.global_sum(np.asarray([n]))[0])
         else:
             n_global = n
@@ -500,10 +497,22 @@ class H2ODeepLearningEstimator(H2OEstimator):
                     ev["logloss"] = sm.logloss
                     metric_val = sm.logloss
                 history.append(ev)
-                if stopper is not None and stopper.record(metric_val):
+                stop = stopper is not None and stopper.record(metric_val)
+                if multiproc:
+                    # metrics are local-shard here, so ranks may disagree —
+                    # a global any-rank-stops vote keeps the remaining
+                    # collective programs aligned across processes
+                    stop = float(distdata.global_sum(
+                        np.asarray([1.0 if stop else 0.0]))[0]) > 0
+                if stop:
                     break
-            if max_runtime and time.time() - t0 > max_runtime:
-                break
+            if max_runtime:
+                hit = time.time() - t0 > max_runtime
+                if multiproc:
+                    hit = float(distdata.global_sum(
+                        np.asarray([1.0 if hit else 0.0]))[0]) > 0
+                if hit:
+                    break
             if self.job:
                 self.job.update(min(seen / total, 1.0))
 

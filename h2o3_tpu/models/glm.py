@@ -118,6 +118,21 @@ def _deviance_device(X, y, w, beta, family: str, tweedie_p: float):
     return _family_deviance_sum(family, y, mu, w, tweedie_p)
 
 
+@functools.partial(jax.jit, static_argnames=("family", "tweedie_p"))
+def _pearson_sums(X, y, w, beta, family: str, tweedie_p: float):
+    """(Σ w·(y−μ)²/V(μ), Σw) — the Pearson X² pieces of the dispersion
+    estimate as jit-global reductions (safe on row-sharded X)."""
+    eta = jnp.matmul(X, beta, precision=jax.lax.Precision.HIGHEST)
+    mu = _linkinv(family, eta)
+    if family == "gamma":
+        vfun = jnp.maximum(mu, 1e-12) ** 2
+    elif family == "tweedie":
+        vfun = jnp.maximum(mu, 1e-12) ** tweedie_p
+    else:
+        vfun = jnp.ones_like(mu)
+    return jnp.sum(w * (y - mu) ** 2 / vfun), jnp.sum(w)
+
+
 @functools.partial(jax.jit, static_argnames=("family",))
 def _gram_step(X, y, w, beta, family: str, tweedie_p: float = 1.5):
     """One GLMIterationTask: distributed Gram X'WX and X'Wz (+ psum by XLA
@@ -462,15 +477,6 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             # assemble global row-sharded arrays homed where the data was
             # parsed (MRTask compute-where-the-chunks-live), zero-weight
             # padding balancing unequal byte ranges
-            if family == "multinomial":
-                raise ValueError(
-                    "multinomial GLM is not yet supported on multi-process "
-                    "clouds")
-            if valid is not None:
-                raise ValueError(
-                    "validation_frame is not yet supported on multi-process "
-                    "clouds (each process holds only its shard, so lambda "
-                    "selection would diverge across processes)")
             X = dinfo.fit_transform(train)      # standardization stats are
             #                                     global (DataInfo collective)
             Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
@@ -501,7 +507,8 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         stderr = None
         cov = None
         if family == "multinomial":
-            beta = self._fit_multinomial(Xd, yarr, wd, nclass, alpha, lam or 0.0, max_iter)
+            beta = self._fit_multinomial(Xd, yarr, wd, nclass, alpha,
+                                         lam or 0.0, max_iter, n_global=n)
             lam_best = lam or 0.0
         else:
             if lambda_search:
@@ -527,7 +534,22 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                           if p.get("weights_column")
                           and p["weights_column"] in valid.names
                           else np.ones(Xv.shape[0])).astype(np.float32)
-                    vdata = (jnp.asarray(Xvi), jnp.asarray(yva), jnp.asarray(wv))
+                    if distdata.multiprocess():
+                        # each process holds its valid shard; zero-weight
+                        # pads drop out of the (jit-global) deviance sums,
+                        # so lambda selection is consistent on every rank
+                        quota_v = distdata.local_quota(Xv.shape[0])
+                        vdata = (
+                            distdata.global_row_array(
+                                Xvi.astype(np.float32), quota_v, cloud),
+                            distdata.global_row_array(
+                                yva.astype(np.float32), quota_v, cloud),
+                            distdata.global_row_array(
+                                wv.astype(np.float32), quota_v, cloud),
+                        )
+                    else:
+                        vdata = (jnp.asarray(Xvi), jnp.asarray(yva),
+                                 jnp.asarray(wv))
                 beta, lam_best, full_path = self._lambda_path(
                     Xd, yd, wd, family, alpha, n, nfeat, max_iter, beta_eps,
                     tweedie_p, p, vdata=vdata,
@@ -536,18 +558,25 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
                 beta = self._irls(Xd, yd, wd, family, lam_v, alpha, max_iter, beta_eps, tweedie_p)
                 lam_best = lam_v
-            if p.get("compute_p_values") and (lam_best == 0) \
-                    and distdata.multiprocess():
-                raise ValueError("compute_p_values is not yet supported on "
-                                 "multi-process clouds")
             if p.get("compute_p_values") and (lam_best == 0):
                 gram, _ = _gram_step(Xd, yd, wd, jnp.asarray(beta), family, tweedie_p)
                 try:
+                    # the Gram comes out of the jit replicated on every rank,
+                    # so the inverse/dispersion below agree across processes
                     cov = np.linalg.inv(np.asarray(gram, np.float64))
                     # dispersion: Pearson X²/(n−p) for the families whose
                     # variance is estimated (gaussian/gamma/tweedie); fixed
                     # at 1 for binomial/poisson (GLM dispersion_estimated)
-                    if family in ("gaussian", "gamma", "tweedie"):
+                    if family in ("gaussian", "gamma", "tweedie") \
+                            and distdata.multiprocess():
+                        # jit-global Pearson sums — the sharded Xd never
+                        # reaches the host; f32 accumulation, like the Gram
+                        x2, wsum = _pearson_sums(
+                            Xd, yd, wd, jnp.asarray(beta, jnp.float32),
+                            family, float(tweedie_p))
+                        dof = max(float(wsum) - Xd.shape[1], 1.0)
+                        dispersion = float(x2) / dof
+                    elif family in ("gaussian", "gamma", "tweedie"):
                         eta = np.asarray(Xd @ jnp.asarray(beta, jnp.float32), np.float64)
                         mu = np.asarray(_linkinv(family, jnp.asarray(eta)), np.float64)
                         yv_ = np.asarray(yd, np.float64)
@@ -661,8 +690,9 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 return beta, lam_best, path
             # every λ diverged in f32 — fall through to the robust host loop
 
-        # host path: multi-host mesh (vdata is process-local and may not be
-        # mixed with row-sharded arrays in one jit), or f32 divergence
+        # host path: multi-host mesh (the fused device path's closure-
+        # captured group tensors would embed non-addressable arrays in the
+        # HLO; vdata itself is row-sharded and fine), or f32 divergence
         beta = np.zeros(Xd.shape[1], np.float64)
         path = []
         best = (None, np.inf, 0.0)
@@ -707,23 +737,42 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         mu = np.asarray(_linkinv(family, jnp.asarray(eta)), np.float64)
         return float(_family_deviance_sum(family, y, mu, w, tweedie_p, xp=np))
 
-    def _fit_multinomial(self, Xd, ycodes, wd, K, alpha, lam, max_iter):
-        """Softmax GLM via optax L-BFGS (the reference's multinomial L_BFGS)."""
+    def _fit_multinomial(self, Xd, ycodes, wd, K, alpha, lam, max_iter,
+                         n_global=None):
+        """Softmax GLM via optax L-BFGS (the reference's multinomial L_BFGS).
+
+        Works unchanged on a multi-host cloud: `Xd`/`wd` arrive row-sharded,
+        the local one-hot responses are assembled into a matching global
+        array (zero rows in the pad tail carry wd=0), and every reduction
+        in `loss` is a jit-global sum."""
         import optax
 
         pdim = Xd.shape[1]
         n = len(ycodes)
-        Y = np.zeros((Xd.shape[0], K), np.float32)
-        Y[np.arange(n), ycodes] = 1.0
-        Yd = jnp.asarray(Y)
+        if distdata.multiprocess():
+            Y = np.zeros((n, K), np.float32)
+            Y[np.arange(n), ycodes] = 1.0
+            from ..parallel import mesh as cloudlib
+
+            Yd = distdata.global_row_array(
+                Y, Xd.shape[0] // jax.process_count(), cloudlib.cloud())
+        else:
+            Y = np.zeros((Xd.shape[0], K), np.float32)
+            Y[np.arange(n), ycodes] = 1.0
+            Yd = jnp.asarray(Y)
+        n_eff = float(n_global if n_global is not None else n)
         lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
 
-        def loss(B):
+        # data arrays are ARGUMENTS, not closure captures: a jit may not
+        # close over process-spanning (multi-host) arrays
+        def loss(B, Xd, Yd, wd):
             logits = Xd @ B.T  # (n, K)
             lse = jax.scipy.special.logsumexp(logits, axis=1)
             ll = (jnp.sum(logits * Yd, axis=1) - lse) * wd
             ridge = 0.5 * lam_v * (1 - alpha) * jnp.sum(B[:, :-1] ** 2)
-            return -jnp.mean(ll) + ridge
+            # sum/n_eff (not mean): the padded global row count must not
+            # rescale the data term against the ridge
+            return -jnp.sum(ll) / n_eff + ridge
 
         B = jnp.zeros((K, pdim), jnp.float32)
         try:
@@ -731,14 +780,18 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             state = opt.init(B)
 
             @jax.jit
-            def step(B, state):
-                v, g = jax.value_and_grad(loss)(B)
-                updates, state = opt.update(g, state, B, value=v, grad=g, value_fn=loss)
-                return optax.apply_updates(B, updates), state, v
+            def step(B, state, Xd, Yd, wd):
+                def f(b):
+                    return loss(b, Xd, Yd, wd)
+
+                v, g = jax.value_and_grad(f)(B)
+                updates, state2 = opt.update(g, state, B, value=v, grad=g,
+                                             value_fn=f)
+                return optax.apply_updates(B, updates), state2, v
 
             prev = np.inf
             for it in range(max(100, max_iter * 4)):
-                B, state, v = step(B, state)
+                B, state, v = step(B, state, Xd, Yd, wd)
                 v = float(v)
                 if abs(prev - v) < 1e-9:
                     break
@@ -748,7 +801,7 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             state = opt.init(B)
             vg = jax.jit(jax.value_and_grad(loss))
             for it in range(500):
-                v, g = vg(B)
+                v, g = vg(B, Xd, Yd, wd)
                 updates, state = opt.update(g, state)
                 B = optax.apply_updates(B, updates)
         return np.asarray(B, np.float64)

@@ -726,6 +726,10 @@ class H2OEstimator:
             sub._parms["nfolds"] = 0
             sub._parms["model_id"] = None  # fold models get their own ids
             sub._parms["_actual_seed"] = self._parms["_actual_seed"]
+            # pad fold fits up to the parent's padded row shape so every
+            # fold reuses the parent's compiled tree program (the second
+            # program load costs seconds through a remote-chip tunnel)
+            sub._parms["_npad_floor"] = getattr(model, "_npad", 0)
             cvm = sub._fit(x, y, tr, None)
             pred = sub._cv_predict(cvm, ho)
             if holdout is None:

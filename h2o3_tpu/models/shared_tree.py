@@ -84,6 +84,24 @@ def _predict_forest_codes_jit(forest, codes, max_depth: int):
     return per_tree.sum(axis=0)
 
 
+@functools.partial(jax.jit, static_argnames=("max_depth",),
+                   donate_argnums=(2,))
+def _valid_margin_update(packed, codes_v, margins_v, k, max_depth: int):
+    """Add class-k leaf sums of a packed tree chunk to the validation
+    margins — one jitted program so it also runs on process-spanning
+    (multi-host) arrays, where eager slicing is rejected. `k` is TRACED
+    (dynamic slice): one compiled program serves all K classes instead of
+    K compile-cache loads."""
+    sl = jax.lax.dynamic_index_in_dim(packed, k, axis=1, keepdims=False)
+    forest = treelib.Tree(
+        sl[..., 0].astype(jnp.int32), sl[..., 1].astype(jnp.int32),
+        sl[..., 2], sl[..., 3] > 0.5, sl[..., 4],
+    )
+    per_tree = jax.vmap(
+        lambda t: treelib.predict_codes(t, codes_v, max_depth))(forest)
+    return margins_v.at[:, k].add(per_tree.sum(axis=0))
+
+
 def probs_from_margins(mode, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:
     """margins → predictions, shared by train-time scoring and model.predict
     (single source of truth for the per-mode link mapping)."""
@@ -1016,24 +1034,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # multi-host cloud: this process holds its ingest shard; global
             # facts come from collectives. Features outside the v1 envelope
             # fail loudly rather than silently training on local-only stats.
-            htype = tp["histogram_type"]
-            if htype == "AUTO":
-                htype = "UniformAdaptive"
             unsupported = [
                 ("checkpoint", self._parms.get("checkpoint") is not None),
-                ("validation_frame", valid is not None),
-                ("score_each_iteration",
-                 bool(self._parms.get("score_each_iteration"))),
-                ("score_tree_interval",
-                 bool(self._parms.get("score_tree_interval"))),
-                ("stopping_rounds",
-                 int(self._parms.get("stopping_rounds", 0)) > 0),
-                ("balance_classes", bool(self._parms.get("balance_classes"))),
                 ("custom objective",
                  getattr(self, "_objective_fn", None) is not None),
-                ("histogram_type=" + htype, htype == "QuantilesGlobal"),
-                ("distribution=" + str(dist),
-                 dist in ("quantile", "laplace")),
                 ("calibrate_model", bool(self._parms.get("calibrate_model"))),
             ]
             bad = [name for name, cond in unsupported if cond]
@@ -1045,10 +1049,26 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 lmax = np.nanmax(np.where(np.isnan(X), -np.inf, X), axis=0)
             gmin, gmax = distdata.global_minmax(lmin, lmax)
             col_ranges = np.stack([gmin, gmax], axis=1)
+        col_qedges = None
+        if multiproc and (tp["histogram_type"] == "QuantilesGlobal"):
+            # distributed QuantilesGlobal: per-column GLOBAL quantile edges
+            # via iterative histogram refinement (hex/quantile/Quantile.java
+            # as a host collective) — every process derives identical edges
+            nvalue = nbins - 1
+            qs = np.linspace(0, 1, nvalue + 1)[1:-1]
+            col_qedges = []
+            for j in range(X.shape[1]):
+                if is_cat[j]:
+                    col_qedges.append(None)
+                    continue
+                colv = X[:, j]
+                colv = colv[np.isfinite(colv)]
+                col_qedges.append(
+                    np.unique(distdata.global_quantiles(colv, qs)))
         bm = build_bins(
             X, nbins=nbins, histogram_type=tp["histogram_type"], names=list(x),
             is_categorical=is_cat, domains=doms, seed=seed,
-            col_ranges=col_ranges,
+            col_ranges=col_ranges, col_quantile_edges=col_qedges,
         )
 
         w = (
@@ -1081,13 +1101,18 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # priorClassDist/modelClassDist probability correction below
             codes_y = np.asarray(yvec.data)
             counts = np.bincount(codes_y, minlength=nclass).astype(np.float64)
+            n_bal = n
+            if multiproc:
+                # global class distribution (the MRTask class-count reduce)
+                counts = distdata.global_sum(counts)
+                n_bal = float(counts.sum())
             csf = self._parms.get("class_sampling_factors")
             if csf is not None:
                 factors = np.asarray(csf, np.float64)
             else:
-                factors = n / (len(counts) * np.maximum(counts, 1.0))
+                factors = n_bal / (len(counts) * np.maximum(counts, 1.0))
             cap = float(self._parms.get("max_after_balance_size", 5.0))
-            factors = np.minimum(factors, cap * n / np.maximum(counts, 1.0))
+            factors = np.minimum(factors, cap * n_bal / np.maximum(counts, 1.0))
             w = (w * factors[codes_y]).astype(np.float32)
             prior_dist = counts / counts.sum()
             model_w = counts * factors
@@ -1127,10 +1152,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
             f0 = np.log(np.clip(pri, 1e-10, 1.0)).astype(np.float32)
         elif getattr(self, "_objective_fn", None) is not None:
             f0 = np.zeros(1, np.float32)  # custom objectives start at 0 margin
+        elif multiproc and dist in ("quantile", "laplace"):
+            # order-statistic inits need GLOBAL quantiles of the response
+            alpha = (float(self._parms.get("quantile_alpha", 0.5))
+                     if dist == "quantile" else 0.5)
+            f0 = np.asarray([np.float32(
+                distdata.global_quantiles(yk[:, 0], [alpha])[0])])
         else:
             f0 = np.float32(dist_mod.init_margin(
                 dist, yk[:, 0], w,
-                mu=(float(swy[0]) / max(sw, 1e-12)) if multiproc else None))
+                mu=(float(swy[0]) / max(sw, 1e-12)) if multiproc else None,
+                alpha=float(self._parms.get("quantile_alpha", 0.5))))
             f0 = np.asarray([f0])
 
         cloud = cloudlib.cloud()
@@ -1151,6 +1183,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # to the mesh multiple to keep shard_map's equal-shard invariant
             npad = cloudlib.pad_to_multiple(
                 _bucket_rows(npad), max(ndev * 8, 8))
+            # CV fold fits inherit the parent fit's padded row count
+            # (_npad_floor): the fold then reuses the parent's ALREADY-LOADED
+            # executable instead of paying a second compile-cache load for
+            # the smaller bucket (~4-10 s through a remote-chip tunnel);
+            # the extra rows are zero-weight no-ops
+            floor = int(self._parms.get("_npad_floor") or 0)
+            if floor > npad and floor % max(ndev * 8, 8) == 0:
+                npad = floor
             pad = npad - n
 
         def padr(a, fill=0):
@@ -1192,7 +1232,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         jnp.ones(npad, jnp.float32),                      # rate
                         jnp.zeros((F, nbins - 2), jnp.float32),           # edges
                         jnp.zeros(F, jnp.float32),                        # mono
-                        jnp.zeros(7, jnp.float32),                        # hp
+                        jnp.zeros(8, jnp.float32),                        # hp
                         jax.random.PRNGKey(0),
                         np.int32(0),
                     ]
@@ -1336,19 +1376,35 @@ class H2OSharedTreeEstimator(H2OEstimator):
         valid_state = None
         if valid is not None:
             Xv, _, _ = frame_to_matrix(valid, x, expected_domains=bm.domains)
-            codes_v = jnp.asarray(bin_apply(bm, Xv))
+            codes_np_v = bin_apply(bm, Xv)
             yvv = valid.vec(y)
+            n_v = valid.nrow          # LOCAL valid rows on a multi-proc cloud
             if problem == "regression":
                 ykv = yvv.numeric_np().astype(np.float32)[:, None]
             elif problem == "binomial":
                 ykv = np.asarray(yvv.data, np.float32)[:, None]
             else:
                 cv = np.asarray(yvv.data)
-                ykv = np.zeros((valid.nrow, K), np.float32)
-                ykv[np.arange(valid.nrow), cv] = 1.0
-            margins_v = jnp.broadcast_to(
-                jnp.asarray(np.asarray(f0).reshape(-1))[None, :], (valid.nrow, K)
-            ).astype(jnp.float32)
+                ykv = np.zeros((n_v, K), np.float32)
+                ykv[np.arange(n_v), cv] = 1.0
+            if multiproc:
+                # each process scores its ingest shard of the valid frame;
+                # metric pieces are globally reduced in _score_event
+                quota_v = distdata.local_quota(n_v)
+                codes_v = distdata.global_row_array(codes_np_v, quota_v,
+                                                    cloud)
+                rs_v = cloud.row_sharding()
+                margins_v = jax.jit(
+                    lambda f: jnp.broadcast_to(
+                        f[None, :],
+                        (quota_v * jax.process_count(), K)
+                    ).astype(jnp.float32),
+                    out_shardings=rs_v)(np.asarray(f0).reshape(-1))
+            else:
+                codes_v = jnp.asarray(codes_np_v)
+                margins_v = jnp.broadcast_to(
+                    jnp.asarray(np.asarray(f0).reshape(-1))[None, :],
+                    (n_v, K)).astype(jnp.float32)
             if n_prior:
                 for k in range(K):
                     vsum = _predict_forest_codes_jit(
@@ -1358,8 +1414,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     margins_v = margins_v.at[:, k].add(vsum)
             if self._parms.get("offset_column") and self._parms["offset_column"] in valid.names:
                 off_v = valid.vec(self._parms["offset_column"]).numeric_np().astype(np.float32)
-                margins_v = margins_v + jnp.asarray(off_v)[:, None]
-            valid_state = [codes_v, ykv, margins_v]
+                if multiproc:
+                    off_g = distdata.global_row_array(off_v, quota_v, cloud)
+                    margins_v = jax.jit(lambda m, o: m + o[:, None],
+                                        out_shardings=rs_v)(margins_v, off_g)
+                else:
+                    margins_v = margins_v + jnp.asarray(off_v)[:, None]
+            valid_state = [codes_v, ykv, margins_v, n_v]
 
         _ph.mark("device_put", sync=codes_d)
         key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
@@ -1430,14 +1491,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         _stack_args(*packed_list), _sum_args(*gains_list))
             return (margins, oob_sum, oob_cnt,
                     jnp.stack(packed_list), sum(gains_list))
-
-        def _stacked_from_packed_dev(packed, k):
-            """Device (nsteps, K, T, 5) → stacked Tree for class k (device)."""
-            sl = packed[:, k]
-            return treelib.Tree(
-                sl[..., 0].astype(jnp.int32), sl[..., 1].astype(jnp.int32),
-                sl[..., 2], sl[..., 3] > 0.5, sl[..., 4],
-            )
 
         # chunking: one device dispatch per `chunk` trees (remote dispatch
         # latency amortization); scoring/stopping checks at chunk boundaries
@@ -1540,11 +1593,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 _flush_packed()
             if valid_state is not None:
                 for k in range(K):
-                    vsum = _predict_forest_codes_jit(
-                        _stacked_from_packed_dev(packed, k),
-                        valid_state[0], tp["max_depth"],
-                    )
-                    valid_state[2] = valid_state[2].at[:, k].add(vsum)
+                    valid_state[2] = _valid_margin_update(
+                        packed, valid_state[0], valid_state[2],
+                        jnp.int32(k), tp["max_depth"])
+                cloudlib.collective_fence(valid_state[2])
             if _PROFILE:
                 _ph.mark(f"chunk_{m}_{nsteps}trees", sync=margins)
             m += nsteps
@@ -1558,10 +1610,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if do_score:
                 if self._mode == "drf" and row_sampled and n_prior == 0:
                     # score on OOB predictions (DRF scoring history is OOB)
-                    osum = np.asarray(oob_sum[:n], np.float64)
-                    ocnt = np.asarray(oob_cnt[:n], np.float64)
+                    osum = distdata.to_local(oob_sum)[:n].astype(np.float64)
+                    ocnt = distdata.to_local(oob_cnt)[:n].astype(np.float64)
                     have = ocnt > 0
-                    mnp = np.asarray(margins[:n], np.float64)
+                    mnp = distdata.to_local(margins)[:n].astype(np.float64)
                     oob_mean = np.where(have[:, None],
                                         osum / np.maximum(ocnt[:, None], 1.0),
                                         mnp / max(built, 1))
@@ -1573,7 +1625,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 if valid_state is not None:
                     vev = self._score_event(
                         problem, dist, valid_state[2],
-                        jnp.asarray(valid_state[1]), None, valid_state[1].shape[0],
+                        valid_state[1], None, valid_state[3],
                         built + n_prior,
                     )
                     ev.update({f"validation_{k2}": v for k2, v in vev.items()
@@ -1594,8 +1646,15 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         )
                     if stopper.record(val):
                         break
-            if max_runtime and time.time() - t0 > max_runtime:
-                break
+            if max_runtime:
+                hit = time.time() - t0 > max_runtime
+                if multiproc:
+                    # clock consensus: every rank must take the same branch
+                    # or the next chunk's collectives deadlock
+                    hit = float(distdata.global_sum(
+                        np.asarray([1.0 if hit else 0.0]))[0]) > 0
+                if hit:
+                    break
             if self.job:
                 self.job.update(built / max(ntrees_target, 1))
 
@@ -1691,6 +1750,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             forest, tp["max_depth"], mode=self._mode,
             packed_dev=packed_dev, nclasses_packed=K,
         )
+        model._npad = npad  # CV passes this to folds as _npad_floor
         if packed_dev is None:
             model.covers = covers_by_class
         else:
@@ -1756,7 +1816,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
         _ph.mark("training_metrics")
         if valid is not None:
             if valid_state is not None and self._mode != "drf":
-                mv = np.asarray(valid_state[2]).astype(np.float64)
+                # multiproc: local-shard validation metrics, matching the
+                # local-shard training metrics above (forest is identical
+                # on every rank; scoring history carried the global numbers)
+                mv = (distdata.local_shard(valid_state[2])
+                      if multiproc else np.asarray(valid_state[2]))
+                mv = mv[:valid_state[3]].astype(np.float64)
                 probs_v = self._probs_from_margins(problem, dist, mv,
                                                    model.ntrees_built)
                 model.validation_metrics = _metrics_for(problem, valid.vec(y), probs_v)
@@ -1816,22 +1881,38 @@ class H2OSharedTreeEstimator(H2OEstimator):
         return "logloss" if problem in ("binomial", "multinomial") else "deviance"
 
     def _score_event(self, problem, dist, margins, y_d, w_d, n, ntrees) -> Dict:
-        m = np.asarray(margins)[:n].astype(np.float64)
-        y = np.asarray(y_d)[:n].astype(np.float64)
+        """One scoring-history event. On a multi-process cloud, `margins` /
+        `y_d` may be process-spanning arrays and `n` the LOCAL row count:
+        each process computes its local loss pieces and ONE `global_sum`
+        makes the event metrics global (and identical on every rank — the
+        early-stopping decisions that read them therefore agree)."""
+        multiproc = distdata.multiprocess()
+        m = distdata.to_local(margins)[:n].astype(np.float64)
+        y = distdata.to_local(y_d)[:n].astype(np.float64)
         probs = self._probs_from_margins(problem, dist, m, ntrees)
+
+        def _gmean(local_sum: float, local_cnt: float) -> float:
+            if multiproc:
+                tot = distdata.global_sum(
+                    np.asarray([local_sum, local_cnt], np.float64))
+                return float(tot[0] / max(tot[1], 1e-12))
+            return float(local_sum / max(local_cnt, 1e-12))
+
         ev: Dict = {"number_of_trees": ntrees, "timestamp": time.time()}
         if problem == "binomial":
             p = np.clip(probs[:, 1], 1e-15, 1 - 1e-15)
-            ev["logloss"] = float(-np.mean(np.log(np.where(y[:, 0] > 0.5, p, 1 - p))))
+            nll = -np.log(np.where(y[:, 0] > 0.5, p, 1 - p))
+            ev["logloss"] = _gmean(float(nll.sum()), float(len(nll)))
             ev["auc"] = float("nan")  # full AUC computed at final scoring
             ev["training_deviance"] = ev["logloss"]
         elif problem == "multinomial":
             p = np.clip(probs, 1e-15, 1)
-            ev["logloss"] = float(-np.mean(np.log(p[y.astype(bool)])))
+            nll = -np.log(p[y.astype(bool)])
+            ev["logloss"] = _gmean(float(nll.sum()), float(len(nll)))
             ev["training_deviance"] = ev["logloss"]
         else:
-            mu = probs[:, 0]
-            ev["deviance"] = float(np.mean((mu - y[:, 0]) ** 2))
+            sq = (probs[:, 0] - y[:, 0]) ** 2
+            ev["deviance"] = _gmean(float(sq.sum()), float(len(sq)))
             ev["rmse"] = float(np.sqrt(ev["deviance"]))
             ev["training_deviance"] = ev["deviance"]
         return ev

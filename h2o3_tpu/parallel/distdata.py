@@ -137,7 +137,88 @@ def sharded_full(shape, value, dtype, cloud):
                    out_shardings=cloud.row_sharding())()
 
 
+def global_order_stats(values: np.ndarray, ranks: Sequence[int],
+                       iters: int = 4, nb: int = 512) -> np.ndarray:
+    """Exact-to-f32-ulp global order statistics x_(k) (0-based, global sort
+    order) of a column whose rows are scattered across processes — the
+    `hex/quantile/Quantile.java` iterative-histogram-refinement design as a
+    host collective.
+
+    Each iteration histograms the local shard into `nb` uniform bins per
+    tracked rank interval, `global_sum`s the counts (exact integers ⇒ the
+    refinement path is DETERMINISTIC and independent of the process count),
+    and shrinks each interval to the bin containing its rank. After `iters`
+    rounds the interval width is range·nb^-iters (~1e-11 of range), below
+    f32 ulp for f32-sourced data; the midpoint is returned.
+
+    `values` must be this process's finite values (NaNs pre-dropped).
+    """
+    v = np.sort(np.asarray(values, np.float64))
+    ranks = np.asarray(ranks, np.int64)
+    lo0, hi0 = ((v[0], v[-1]) if v.size else (np.inf, -np.inf))
+    glo, ghi = global_minmax(np.asarray([lo0]), np.asarray([hi0]))
+    glo, ghi = float(glo[0]), float(ghi[0])
+    if not np.isfinite(glo):
+        return np.full(len(ranks), np.nan)
+    if ghi <= glo:
+        return np.full(len(ranks), glo)
+    M = len(ranks)
+    lo = np.full(M, glo)
+    hi = np.full(M, ghi)
+    below = np.zeros(M, np.int64)       # global count of values < lo[m]
+    for _ in range(iters):
+        # counts[m, b] = #local values in bin b of interval m (right-closed
+        # last bin, matching np.histogram)
+        edges = lo[:, None] + (hi - lo)[:, None] * (
+            np.arange(nb + 1)[None, :] / nb)
+        idx = np.searchsorted(v, edges)           # (M, nb+1)
+        idx[:, -1] = np.searchsorted(v, edges[:, -1], side="right")
+        counts = np.diff(idx, axis=1).astype(np.float64)
+        gc = global_sum(counts)                   # exact: integer-valued
+        cum = below[:, None] + np.cumsum(gc, axis=1)   # (M, nb)
+        # bin containing rank k: first bin with cum > k
+        b = (cum <= ranks[:, None]).sum(axis=1)
+        b = np.minimum(b, nb - 1)
+        prev = np.where(b > 0, np.take_along_axis(cum, np.maximum(
+            b - 1, 0)[:, None], axis=1)[:, 0], below)
+        below = np.where(b > 0, prev.astype(np.int64), below)
+        width = (hi - lo) / nb
+        lo = lo + b * width
+        hi = lo + width
+    return (lo + hi) / 2
+
+
+def global_quantiles(values: np.ndarray, probs: Sequence[float],
+                     n_global: Optional[int] = None) -> np.ndarray:
+    """np.quantile (linear interpolation) over the global multiset of a
+    scattered column: locate the two adjacent order statistics per prob via
+    `global_order_stats` and interpolate. Deterministic across cloud sizes."""
+    v = np.asarray(values, np.float64)
+    v = v[np.isfinite(v)]
+    if n_global is None:
+        n_global = int(global_sum(np.asarray([v.size], np.int64))[0])
+    if n_global == 0:
+        return np.full(len(probs), np.nan)
+    t = np.asarray(probs, np.float64) * (n_global - 1)
+    k = np.floor(t).astype(np.int64)
+    frac = t - k
+    k2 = np.minimum(k + 1, n_global - 1)
+    ks = np.concatenate([k, k2])
+    xs = global_order_stats(v, ks)
+    xk, xk2 = xs[: len(k)], xs[len(k):]
+    return xk + frac * (xk2 - xk)
+
+
 def local_shard(garr) -> np.ndarray:
     """This process's rows of a global row-sharded array, in device order."""
     shards = sorted(garr.addressable_shards, key=lambda s: s.index[0].start)
     return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def to_local(a) -> np.ndarray:
+    """Host view of `a`: the local shard for process-spanning global arrays,
+    plain np.asarray otherwise — the one rule for bringing possibly-sharded
+    values to the host in metric/scoring code."""
+    if multiprocess() and getattr(a, "is_fully_addressable", True) is False:
+        return local_shard(a)
+    return np.asarray(a)

@@ -98,18 +98,23 @@ g.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
 import jax
 if jax.process_index() == 0:
     m = g.model
-    feat = np.concatenate([np.asarray(t.feat).ravel() for t in m.forest])
-    thr = np.concatenate([np.asarray(t.thr).ravel() for t in m.forest])
-    val = np.concatenate([np.asarray(t.value).ravel() for t in m.forest])
-    np.savez({out!r}, feat=feat, thr=thr, val=val,
+    t = m.forest[0]
+    np.savez({out!r}, feat=np.asarray(t.feat), bins=np.asarray(t.bin),
+             thr=np.asarray(t.thr), val=np.asarray(t.value),
              auc=float(m.training_metrics.auc))
 print("rank", jax.process_index(), "ok")
 """
 
 
-def test_gbm_two_process_matches_single(tmp_path, cloud1):
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_gbm_multiprocess_matches_single(tmp_path, cloud1, nproc):
+    """n=4 exercises uneven byte ranges / odd local row counts that n=2
+    cannot (3001 rows split 4 ways); the first three tree levels must match
+    the single-process build EXACTLY — the psum'd histograms are the same
+    sums, so early splits are deterministic; only deep near-tie levels may
+    drift via f32 accumulation order."""
     p = str(tmp_path / "gbm.csv")
-    _write_gbm_csv(p)
+    _write_gbm_csv(p, n=3001 if nproc == 4 else 3000)
 
     import h2o3_tpu as h2o
     from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
@@ -120,17 +125,20 @@ def test_gbm_two_process_matches_single(tmp_path, cloud1):
     ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
               training_frame=fr)
     rm = ref.model
-    ref_feat = np.concatenate([np.asarray(t.feat).ravel() for t in rm.forest])
-    ref_thr = np.concatenate([np.asarray(t.thr).ravel() for t in rm.forest])
-    ref_val = np.concatenate([np.asarray(t.value).ravel() for t in rm.forest])
+    rt = rm.forest[0]
 
-    out = str(tmp_path / "gbm2.npz")
-    run_workers(2, GBM_BODY.format(csv=p, out=out))
+    out = str(tmp_path / f"gbm{nproc}.npz")
+    run_workers(nproc, GBM_BODY.format(csv=p, out=out))
     got = np.load(out)
-    # identical binning edges + exact psum histograms -> same split structure
-    assert (got["feat"] == ref_feat).mean() > 0.98
-    np.testing.assert_allclose(got["thr"], ref_thr, rtol=1e-4, atol=1e-4)
-    np.testing.assert_allclose(got["val"], ref_val, rtol=5e-3, atol=5e-3)
+    # heap levels 0-2 (nodes 0..6): exact structural identity
+    np.testing.assert_array_equal(got["feat"][:, :7], np.asarray(rt.feat)[:, :7])
+    np.testing.assert_array_equal(got["bins"][:, :7], np.asarray(rt.bin)[:, :7])
+    # full-tree agreement: near-identity with late-level tie tolerance
+    assert (got["feat"] == np.asarray(rt.feat)).mean() > 0.98
+    np.testing.assert_allclose(got["thr"], np.asarray(rt.thr),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got["val"], np.asarray(rt.value),
+                               rtol=5e-3, atol=5e-3)
     assert float(got["auc"]) == pytest.approx(
         float(rm.training_metrics.auc), abs=0.02)
 
@@ -215,3 +223,265 @@ def test_drf_two_process_learns(tmp_path, cloud1):
     assert ref_auc > 0.8
     # different sampling RNG (npad differs) -> tolerance, not bit-identity
     assert got_auc == pytest.approx(ref_auc, abs=0.06)
+
+
+# ---- round-3 envelope: valid frames, early stopping, QuantilesGlobal, ----
+# ---- order-statistic dists, balance_classes, GLM multinomial/p-values ----
+
+VALID_STOP_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+va = h2o.import_file({vcsv!r})
+va["y"] = va["y"].asfactor()
+g = H2OGradientBoostingEstimator(ntrees=40, max_depth=3, seed=5,
+                                 learn_rate=0.3, stopping_rounds=2,
+                                 score_tree_interval=5,
+                                 histogram_type="QuantilesGlobal")
+g.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr,
+        validation_frame=va)
+import jax
+if jax.process_index() == 0:
+    m = g.model
+    hist = m.scoring_history
+    np.savez({out!r}, ntrees=m.ntrees_built,
+             vll=np.asarray([h["validation_logloss"] for h in hist]),
+             vauc=float(m.validation_metrics.logloss))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_gbm_valid_early_stop_quantiles_two_process(tmp_path, cloud1):
+    """validation_frame + stopping_rounds + QuantilesGlobal binning on a
+    2-process cloud: the scoring-history validation logloss is globally
+    reduced, so the early-stop decision and stopped tree count must match
+    the single-process run."""
+    p = str(tmp_path / "t.csv")
+    pv = str(tmp_path / "v.csv")
+    _write_gbm_csv(p, n=3000)
+    _write_gbm_csv(pv, n=1000, seed=99)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    va = h2o.import_file(pv)
+    va["y"] = va["y"].asfactor()
+    ref = H2OGradientBoostingEstimator(ntrees=40, max_depth=3, seed=5,
+                                       learn_rate=0.3, stopping_rounds=2,
+                                       score_tree_interval=5,
+                                       histogram_type="QuantilesGlobal")
+    ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
+              training_frame=fr, validation_frame=va)
+    rm = ref.model
+    ref_vll = np.asarray([h["validation_logloss"] for h in rm.scoring_history])
+
+    out = str(tmp_path / "vs2.npz")
+    run_workers(2, VALID_STOP_BODY.format(csv=p, vcsv=pv, out=out))
+    got = np.load(out)
+    assert int(got["ntrees"]) == rm.ntrees_built
+    assert len(got["vll"]) == len(ref_vll)
+    np.testing.assert_allclose(got["vll"], ref_vll, rtol=5e-3, atol=5e-3)
+
+
+QDIST_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+g = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=5,
+                                 distribution="quantile",
+                                 quantile_alpha=0.8)
+g.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="x0",
+        training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    np.savez({out!r}, rmse=float(g.model.training_metrics.rmse))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+BALANCE_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+g = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=5,
+                                 balance_classes=True)
+g.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    t = g.model.forest[0]
+    np.savez({out!r}, feat=np.asarray(t.feat),
+             auc=float(g.model.training_metrics.auc))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_gbm_quantile_dist_and_balance_two_process(tmp_path, cloud1):
+    """quantile distribution (global order-statistic init) and
+    balance_classes (global class counts) on a 2-process cloud."""
+    p = str(tmp_path / "q.csv")
+    _write_gbm_csv(p, n=2500)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    fr = h2o.import_file(p)
+    ref = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=5,
+                                       distribution="quantile",
+                                       quantile_alpha=0.8)
+    ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="x0",
+              training_frame=fr)
+    out = str(tmp_path / "qd2.npz")
+    run_workers(2, QDIST_BODY.format(csv=p, out=out))
+    got = float(np.load(out)["rmse"])
+    assert got == pytest.approx(float(ref.model.training_metrics.rmse),
+                                rel=0.02)
+
+    fr["y"] = fr["y"].asfactor()
+    ref2 = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=5,
+                                        balance_classes=True)
+    ref2.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
+               training_frame=fr)
+    out2 = str(tmp_path / "bal2.npz")
+    run_workers(2, BALANCE_BODY.format(csv=p, out=out2))
+    got2 = np.load(out2)
+    rt = ref2.model.forest[0]
+    assert (got2["feat"] == np.asarray(rt.feat)).mean() > 0.95
+    assert float(got2["auc"]) == pytest.approx(
+        float(ref2.model.training_metrics.auc), abs=0.02)
+
+
+GLM_MULTI_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["cls"] = fr["cls"].asfactor()
+g = H2OGeneralizedLinearEstimator(family="multinomial", lambda_=0.0)
+g.train(x=["x1", "x2", "xs"], y="cls", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    np.savez({out!r}, beta=np.asarray(g.model.beta, np.float64))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+GLM_PV_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+g = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
+                                  compute_p_values=True)
+g.train(x=["x1", "x2", "xs", "cat"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    tab = g.model.coef_with_p_values()
+    np.savez({out!r}, pv=np.asarray([r["p_value"] for r in tab], np.float64),
+             names=np.asarray([r["names"] for r in tab]))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def _write_multiclass_csv(path, n=3000, seed=21):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    xs = rng.normal(size=n) * 0.5
+    logits = np.stack([1.5 * x1, -1.0 * x1 + x2, 0.8 * xs - 0.5 * x2], axis=1)
+    cls = (logits + rng.gumbel(size=(n, 3))).argmax(axis=1)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["x1", "x2", "xs", "cls"])
+        for i in range(n):
+            w.writerow([f"{x1[i]:.6f}", f"{x2[i]:.6f}", f"{xs[i]:.6f}",
+                        f"c{cls[i]}"])
+
+
+def test_glm_multinomial_two_process(tmp_path, cloud1):
+    p = str(tmp_path / "m.csv")
+    _write_multiclass_csv(p)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+    fr = h2o.import_file(p)
+    fr["cls"] = fr["cls"].asfactor()
+    ref = H2OGeneralizedLinearEstimator(family="multinomial", lambda_=0.0)
+    ref.train(x=["x1", "x2", "xs"], y="cls", training_frame=fr)
+
+    out = str(tmp_path / "m2.npz")
+    run_workers(2, GLM_MULTI_BODY.format(csv=p, out=out))
+    got = np.load(out)["beta"]
+    ref_b = np.asarray(ref.model.beta, np.float64)
+    # L-BFGS over a padded global array vs local: same optimum within
+    # optimizer tolerance
+    np.testing.assert_allclose(got, ref_b, rtol=0.05, atol=0.02)
+
+
+def test_glm_p_values_two_process(tmp_path, cloud1):
+    p = str(tmp_path / "pv.csv")
+    _write_glm_csv(p)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    ref = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
+                                        compute_p_values=True)
+    ref.train(x=["x1", "x2", "xs", "cat"], y="y", training_frame=fr)
+    ref_tab = ref.model.coef_with_p_values()
+    ref_pv = np.asarray([r["p_value"] for r in ref_tab], np.float64)
+
+    out = str(tmp_path / "pv2.npz")
+    run_workers(2, GLM_PV_BODY.format(csv=p, out=out))
+    d = np.load(out)
+    assert list(d["names"]) == [r["names"] for r in ref_tab]
+    np.testing.assert_allclose(d["pv"], ref_pv, rtol=0.05, atol=2e-3)
+
+
+DL_STOP_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+d = H2ODeepLearningEstimator(hidden=[16], epochs=50, seed=3,
+                             mini_batch_size=64, stopping_rounds=2,
+                             score_interval=1,
+                             train_samples_per_iteration=2000)
+d.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    m = d.model
+    np.savez({out!r}, events=len(m.scoring_history),
+             auc=float(d.model_performance(fr).auc()))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_dl_early_stop_two_process(tmp_path, cloud1):
+    """DL early stopping on a 2-process cloud: the any-rank-stops vote must
+    keep the ranks aligned (no collective deadlock) and stop before the
+    full 50 epochs."""
+    p = str(tmp_path / "dls.csv")
+    _write_gbm_csv(p)
+    out = str(tmp_path / "dls2.npz")
+    run_workers(2, DL_STOP_BODY.format(csv=p, out=out), timeout=420)
+    got = np.load(out)
+    assert int(got["events"]) >= 2          # scored more than once
+    assert float(got["auc"]) > 0.8          # actually learned

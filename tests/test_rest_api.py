@@ -6,6 +6,7 @@ driven the way `h2o-py/h2o/backend/connection.py` drives it (JSON over HTTP).
 
 import json
 import time
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -358,3 +359,86 @@ def test_flow_ui_served(server):
         body = req.read().decode()
         assert req.headers["Content-Type"].startswith("text/html")
         assert "H2O Flow" in body and "/99/Rapids" in body
+
+
+def test_tree_endpoint(server):
+    """`GET /3/Tree` (hex/tree/TreeHandler analog) over a freshly trained
+    GBM."""
+    srv, csv = server
+    _post(srv, "/3/ImportFiles", path=csv)
+    _post(srv, "/3/Parse", source_frames=csv, destination_frame="treefr",
+          asfactor="y")
+    _post(srv, "/3/ModelBuilders/gbm", training_frame="treefr",
+          response_column="y", ntrees="3", max_depth="3",
+          model_id="treegbm")
+    for _ in range(200):
+        jobs = _get(srv, "/3/Jobs")["jobs"]
+        if all(j["status"] != "RUNNING" for j in jobs):
+            break
+        time.sleep(0.1)
+    models = [m["model_id"]["name"] for m in _get(srv, "/3/Models")["models"]]
+    mid = [m for m in models if "gbm" in m][0]
+    t = _get(srv, f"/3/Tree?model={mid}&tree_number=1")
+    assert t["model"]["name"] == mid
+    assert len(t["left_children"]) == len(t["features"])
+    assert t["root_node_id"] == 0
+    assert any(c >= 0 for c in t["left_children"])  # actually split
+    # out-of-range tree -> 4xx
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, f"/3/Tree?model={mid}&tree_number=99")
+    assert e.value.code == 400
+
+
+def test_model_metrics_list_endpoint(server):
+    srv, _ = server
+    out = _get(srv, "/3/ModelMetrics")
+    assert isinstance(out["model_metrics"], list)
+    if out["model_metrics"]:
+        row = out["model_metrics"][0]
+        assert "model" in row and "kind" in row
+
+
+def test_typeahead_endpoint(server, tmp_path):
+    srv, _ = server
+    (tmp_path / "data_a.csv").write_text("x\n1\n")
+    (tmp_path / "data_b.csv").write_text("x\n2\n")
+    (tmp_path / "other.txt").write_text("")
+    q = urllib.parse.quote(str(tmp_path / "data"))
+    out = _get(srv, f"/99/Typeahead/files?src={q}&limit=10")
+    names = [p.rsplit("/", 1)[-1] for p in out["matches"]]
+    assert names == ["data_a.csv", "data_b.csv"]
+
+
+def test_water_meter_endpoint(server):
+    srv, _ = server
+    out = _get(srv, "/3/WaterMeterCpuTicks/0")
+    assert isinstance(out["cpu_ticks"], list)
+    if out["cpu_ticks"]:
+        assert len(out["cpu_ticks"][0]) == 4
+
+
+def test_auth_token():
+    """Opt-in bearer auth: 401 without the token, 200 with it; /3/Cloud
+    stays open for discovery."""
+    import urllib.error
+
+    from h2o3_tpu.api import start_server as _start
+
+    srv = _start(port=0, auth_token="sekrit")
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        # open cloud endpoint
+        with urllib.request.urlopen(f"{base}/3/Cloud") as r:
+            assert json.loads(r.read())["cloud_name"] == "h2o3_tpu"
+        # protected endpoint without token
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/3/Models")
+        assert e.value.code == 401
+        # with token
+        req = urllib.request.Request(
+            f"{base}/3/Models",
+            headers={"Authorization": "Bearer sekrit"})
+        with urllib.request.urlopen(req) as r:
+            assert "models" in json.loads(r.read())
+    finally:
+        srv.stop()
