@@ -192,7 +192,7 @@ R02_BASELINE = {
 # randomly evicts cached executables; a single run measures the weather,
 # not the machine. Repeat each wall-clock config and report the BEST run
 # (first run also absorbs executable deserialization for later ones).
-DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 1}
+DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2}
 
 
 def main():

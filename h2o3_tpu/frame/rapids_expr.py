@@ -507,6 +507,10 @@ class RapidsSession:
                                  "'lv' and 'jw' are implemented")
             xs = a[0]._string_rows()
             ys = a[1]._string_rows()
+            if len(xs) != len(ys):
+                raise ValueError(
+                    f"strDistance: frames disagree on row count "
+                    f"({len(xs)} vs {len(ys)})")
             out = np.asarray([
                 np.nan if (sx is None or sy is None
                            or (not cmp_empty and (sx == "" or sy == "")))
@@ -681,9 +685,17 @@ class RapidsSession:
             Y = a[1].to_numpy().astype(np.float64)
             measure = str(a[2]).lower() if len(a) > 2 else "l2"
             if measure == "l1":
-                D = np.abs(X[:, None, :] - Y[None, :, :]).sum(axis=2)
+                # chunk the broadcast over the query side: peak memory is
+                # O(R · chunk · cols), never R·Q·cols
+                qc = max(1, (1 << 24) // max(X.shape[0] * X.shape[1], 1))
+                parts = [np.abs(X[:, None, :] - Y[None, j:j + qc, :]
+                                ).sum(axis=2)
+                         for j in range(0, Y.shape[0], qc)]
+                D = np.concatenate(parts, axis=1)
             elif measure == "l2":
-                D = np.sqrt(((X[:, None, :] - Y[None, :, :]) ** 2).sum(axis=2))
+                # |x−y|² = |x|² + |y|² − 2x·y — O(R·Q) via one matmul
+                sq = (X * X).sum(axis=1)[:, None] + (Y * Y).sum(axis=1)[None]
+                D = np.sqrt(np.maximum(sq - 2.0 * (X @ Y.T), 0.0))
             elif measure in ("cosine", "cosine_sq"):
                 nx = np.linalg.norm(X, axis=1, keepdims=True)
                 ny = np.linalg.norm(Y, axis=1, keepdims=True)

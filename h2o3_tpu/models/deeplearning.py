@@ -422,8 +422,11 @@ class H2ODeepLearningEstimator(H2OEstimator):
         # scan over steps; GSPMD turns the per-chunk permutation gather into
         # collectives and psums the sharded-batch gradients automatically.
         # max_runtime keeps the per-batch path (its wall check needs host
-        # control between steps).
-        use_scan = not (max_runtime and max_runtime > 0)
+        # control between steps) — EXCEPT on multi-process clouds, where the
+        # per-batch path would draw rank-divergent local batches; there the
+        # scan path stays and the budget is checked (with the clock-
+        # consensus vote) at scoring boundaries instead.
+        use_scan = not (max_runtime and max_runtime > 0) or multiproc
         if use_scan:
             if multiproc:
                 # each process contributes its ingest shard; zero-weight

@@ -152,7 +152,13 @@ class H2OStackedEnsembleEstimator(H2OEstimator):
         meta.train(y="__y__", training_frame=lvl1)
 
         model = StackedEnsembleModel(self, base_models, meta, problem, nclass, domain, y)
-        model.training_metrics = model._make_metrics(train)
+        # the SE's training frame IS the level-one frame (out-of-fold base
+        # predictions), so the metalearner's training metrics are exactly
+        # the SE's cross-validated training metrics — no re-prediction of
+        # every base model on the raw frame (which costs seconds per deep
+        # forest; upstream StackedEnsemble scores on the level-one frame
+        # too: hex/ensemble/StackedEnsemble.java)
+        model.training_metrics = meta.model.training_metrics
         if valid is not None:
             model.validation_metrics = model._make_metrics(valid)
         return model

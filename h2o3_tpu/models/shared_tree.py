@@ -184,7 +184,8 @@ class _StepCfg(NamedTuple):
     dist: str
     mode: str
     max_depth: int
-    mtries: int
+    has_mtries: bool   # the rate itself is TRACED (hp[8]) so DRF and XRT
+    #                    share one program
     no_row_sampling: bool
     has_col_sampling: bool
     has_monotone: bool
@@ -193,17 +194,18 @@ class _StepCfg(NamedTuple):
     hist_method: str = "auto"
     grow_policy: str = "depthwise"   # "lossguide" = xgboost leaf-wise
     max_leaves: int = 0              # lossguide leaf budget (0 = 2^depth)
+    compact_cap: int = 0             # deep-level active-node compaction
 
 
-def _pack_hp(tp, lr, colp) -> "jnp.ndarray":
+def _pack_hp(tp, lr, colp, mtries_rate=0.0) -> "jnp.ndarray":
     """The traced scalar hyperparameters, in a fixed layout:
     [min_rows, min_split_improvement, reg_lambda, reg_alpha, lr,
-    learn_rate_annealing, col_sample_product, max_abs_leaf]."""
+    learn_rate_annealing, col_sample_product, max_abs_leaf, mtries_rate]."""
     cap = float(tp.get("max_abs_leaf", np.inf))
     return jnp.asarray(
         [tp["min_rows"], tp["min_split_improvement"], tp["reg_lambda"],
          tp.get("reg_alpha", 0.0), lr, tp["learn_rate_annealing"], colp,
-         cap if np.isfinite(cap) else 3.4e38],
+         cap if np.isfinite(cap) else 3.4e38, mtries_rate],
         jnp.float32)
 
 
@@ -309,6 +311,13 @@ def _sum_args(*xs):
     return sum(xs[1:], xs[0])
 
 
+@jax.jit
+def _copy_args(*xs):
+    """Device copies (compact-cap chunk snapshot) — module-level so every
+    chunk after the first is a jit dispatch-cache hit."""
+    return tuple(x + 0 for x in xs)
+
+
 def _tree_step_fns(cfg: _StepCfg, cloud):
     """(tree_jit, single_jit) for one step configuration, cached ON the
     cloud instance (keyed by cfg) so a mesh re-init naturally drops stale
@@ -384,7 +393,8 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                 reg_lambda=hp[2], reg_alpha=hp[3], max_abs_leaf=hp[7],
                 **lg_kwargs)
         kwargs = dict(max_depth=cfg.max_depth, nbins=cfg.nbins,
-                      mtries=cfg.mtries, hist_method=cfg.hist_method)
+                      hist_method=cfg.hist_method,
+                      compact_cap=cfg.compact_cap)
         if cloud.size > 1:
             from jax import shard_map
 
@@ -394,6 +404,8 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                 kw = dict(kwargs)
                 if cfg.has_monotone:
                     kw["monotone"] = mono
+                if cfg.has_mtries:
+                    kw["mtries_rate"] = hp[8]
                 return treelib.build_tree(
                     codes, g, h, w, fm, edges, key=key,
                     min_rows=hp[0], min_split_improvement=hp[1],
@@ -401,16 +413,23 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                     axis_name=cloudlib.ROWS_AXIS, **kw,
                 )
 
+            out_specs = (
+                treelib.Tree(P(), P(), P(), P(), P()), rspec, P(), P(),
+            )
+            if cfg.compact_cap:
+                # overflow flag: derived from psum'd histograms, so it is
+                # identical (replicated) on every shard
+                out_specs = out_specs + (P(),)
             fn = shard_map(
                 inner, mesh=cloud.mesh,
                 in_specs=(rspec, rspec, rspec, rspec, P(), P(), P(), P(), P()),
-                out_specs=(
-                    treelib.Tree(P(), P(), P(), P(), P()), rspec, P(), P(),
-                ),
+                out_specs=out_specs,
             )
             return fn(codes, g, h, w, fm, edges, mono, hp, key)
         if cfg.has_monotone:
             kwargs["monotone"] = mono
+        if cfg.has_mtries:
+            kwargs["mtries_rate"] = hp[8]
         return treelib.build_tree(
             codes, g, h, w, fm, edges, key=key, max_abs_leaf=hp[7],
             min_rows=hp[0], min_split_improvement=hp[1],
@@ -440,14 +459,20 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                  ).astype(jnp.float32)
         trs, covs, gains_acc = [], [], jnp.zeros(F, jnp.float32)
         oob_inc = None
+        ov_sum = jnp.int32(0)
         for k in range(K):
             ktree = jax.random.fold_in(ktree, k)
             if g_ext is not None:
                 g, h = g_ext, h_ext
             else:
                 g, h = _grads(margins, y_a, k)
-            tr, leaf_idx, gains, cover = _build_one(
-                codes_a, g, h, wt, fm, edges_a, mono, hp, ktree)
+            if cfg.compact_cap:
+                tr, leaf_idx, gains, cover, ov = _build_one(
+                    codes_a, g, h, wt, fm, edges_a, mono, hp, ktree)
+                ov_sum = ov_sum + ov
+            else:
+                tr, leaf_idx, gains, cover = _build_one(
+                    codes_a, g, h, wt, fm, edges_a, mono, hp, ktree)
             tr = tr._replace(value=tr.value * scale)
             # margins track Σ tree outputs for ALL modes: GBM boosting
             # margins, or DRF leaf-mean sums (÷ntrees at scoring time)
@@ -466,7 +491,8 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
             *[jnp.stack([getattr(t, f) for t in trs]) for f in treelib.Tree._fields]
         )
         covers = jnp.stack(covs)                      # (K, T)
-        return margins, stacked, covers, gains_acc, oob_inc, (1.0 - row_mask)
+        return (margins, stacked, covers, gains_acc, oob_inc,
+                (1.0 - row_mask), ov_sum)
 
     def _pack(stacked, covers):
         """Tree fields + covers → one f32 array (…, T, 6): a single D2H
@@ -485,14 +511,14 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def tree_jit(margins, oob_sum, oob_cnt, codes_a, y_a, w_a, rate_a,
                  edges_a, mono, hp, key, m):
-        margins, stacked, covers, gains, oob_inc, oob_mask = _one_tree(
+        margins, stacked, covers, gains, oob_inc, oob_mask, ov = _one_tree(
             margins, codes_a, y_a, w_a, rate_a, edges_a, mono, hp,
             jax.random.fold_in(key, m), m
         )
         if oob_inc is not None:
             oob_sum = oob_sum + oob_inc
             oob_cnt = oob_cnt + oob_mask
-        return margins, oob_sum, oob_cnt, _pack(stacked, covers), gains
+        return margins, oob_sum, oob_cnt, _pack(stacked, covers), gains, ov
 
     single_jit = jax.jit(
         lambda margins, codes_a, y_a, w_a, rate_a, edges_a, mono, hp, key, m, g_ext, h_ext: (
@@ -946,24 +972,29 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if "max_abs_leafnode_pred" in p else np.inf,
         )
 
+    def _resolved_mtries(self, tp, F, problem) -> int:
+        """DRF's per-split column-sample count (hex/tree/drf/DRF.java
+        _mtry defaults); 0 for non-DRF modes."""
+        if self._mode != "drf":
+            return 0
+        mtries = tp["mtries"]
+        if mtries in (-1, 0):
+            return (max(1, int(np.sqrt(F))) if problem != "regression"
+                    else max(1, F // 3))
+        if mtries == -2:
+            return F
+        return mtries
+
     def _make_step_cfg(self, tp, npad, K, F, nbins, problem, dist) -> _StepCfg:
         """The structural step config, derivable before any device upload —
         built identically by the early warm-up thread and the training path
         so both hit the same cached program."""
-        mtries = tp["mtries"]
-        if self._mode == "drf":
-            if mtries in (-1, 0):
-                mtries = (max(1, int(np.sqrt(F))) if problem != "regression"
-                          else max(1, F // 3))
-            elif mtries == -2:
-                mtries = F
-        else:
-            mtries = 0
+        mtries = self._resolved_mtries(tp, F, problem)
         colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
         return _StepCfg(
             npad=npad, K=K, F=F, nbins=nbins, problem=problem, dist=dist,
             mode=self._mode, max_depth=tp["max_depth"],
-            mtries=mtries,
+            has_mtries=mtries > 0,
             no_row_sampling=(tp["sample_rate"] >= 1.0
                              and not self._parms.get("sample_rate_per_class")),
             has_col_sampling=colp < 1.0,
@@ -980,6 +1011,18 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 "H2O3_HIST_METHOD", tp.get("hist_method", "auto")),
             grow_policy=tp.get("grow_policy", "depthwise"),
             max_leaves=int(tp.get("max_leaves", 0)),
+            # deep trees switch wide levels to active-node compaction
+            # (measured: DRF depth-17 levels carry ~700 live nodes of 131k
+            # heap cells). Off for monotone (needs per-node bounds) and
+            # custom objectives (single-tree path keeps the simple shape);
+            # the driver rebuilds a chunk densely if the cap overflows.
+            compact_cap=(
+                # sanitize: the slot pairing needs an even cap ≥ 2
+                max(2, int(os.environ.get("H2O3_COMPACT_CAP", 4096)) // 2 * 2)
+                if tp["max_depth"] > 12
+                and getattr(self, "_monotone_vec", None) is None
+                and getattr(self, "_objective_fn", None) is None
+                else 0),
         )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
@@ -1187,7 +1230,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # (_npad_floor): the fold then reuses the parent's ALREADY-LOADED
             # executable instead of paying a second compile-cache load for
             # the smaller bucket (~4-10 s through a remote-chip tunnel);
-            # the extra rows are zero-weight no-ops
+            # the extra rows are zero-weight no-ops (deep trees included:
+            # active-node compaction made deep fold compute cheap, so one
+            # shared program beats a second multi-second program load)
             floor = int(self._parms.get("_npad_floor") or 0)
             if floor > npad and floor % max(ndev * 8, 8) == 0:
                 npad = floor
@@ -1232,7 +1277,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         jnp.ones(npad, jnp.float32),                      # rate
                         jnp.zeros((F, nbins - 2), jnp.float32),           # edges
                         jnp.zeros(F, jnp.float32),                        # mono
-                        jnp.zeros(8, jnp.float32),                        # hp
+                        jnp.zeros(9, jnp.float32),                        # hp
                         jax.random.PRNGKey(0),
                         np.int32(0),
                     ]
@@ -1457,7 +1502,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
         _tree_jit, _single_jit = _tree_step_fns(cfg, cloud)
         mono_d = (jnp.asarray(mono_vec) if mono_vec is not None
                   else jnp.zeros(F, jnp.float32))
-        hp_d = _pack_hp(tp, lr, colp)
+        hp_d = _pack_hp(
+            tp, lr, colp,
+            mtries_rate=self._resolved_mtries(tp, F, problem) / max(F, 1))
         if multiproc:
             # small per-call args go in as host numpy (identical on every
             # process ⇒ jit replicates them); locally-committed jnp arrays
@@ -1466,14 +1513,16 @@ class H2OSharedTreeEstimator(H2OEstimator):
             hp_d = np.asarray(hp_d)
             key = np.asarray(key)
 
-        def _train_chunk(margins, oob_sum, oob_cnt, key, m0, nsteps: int):
+        def _train_chunk(margins, oob_sum, oob_cnt, key, m0, nsteps: int,
+                         tree_fn=None):
             """nsteps async per-tree dispatches (NOT lax.scan: a scan body
             defeats XLA's onehot→reduction fusion and materializes the
             (rows × nodes·bins) one-hot in HBM, ~300× slower; sequential
             cached-jit enqueues pipeline on device with ~µs host overhead)."""
-            packed_list, gains_list = [], []
+            tree_fn = tree_fn or _tree_jit
+            packed_list, gains_list, ov_list = [], [], []
             for i in range(nsteps):
-                margins, oob_sum, oob_cnt, packed, gains = _tree_jit(
+                margins, oob_sum, oob_cnt, packed, gains, ov = tree_fn(
                     margins, oob_sum, oob_cnt, codes_d, y_d, w_d, rate_d,
                     edges_d, mono_d, hp_d, key, np.int32(m0 + i)
                 )
@@ -1481,6 +1530,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 cloudlib.collective_fence(margins)
                 packed_list.append(packed)
                 gains_list.append(gains)
+                ov_list.append(ov)
             # jitted combine only on multi-host meshes (eager stack/sum
             # would reject process-spanning arrays there). Single-process
             # stays EAGER: a jitted multi-arg combine has been observed to
@@ -1488,9 +1538,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # XLA:CPU thunk pool and deadlock the all-reduce rendezvous.
             if distdata.multiprocess():
                 return (margins, oob_sum, oob_cnt,
-                        _stack_args(*packed_list), _sum_args(*gains_list))
+                        _stack_args(*packed_list), _sum_args(*gains_list),
+                        _sum_args(*ov_list))
             return (margins, oob_sum, oob_cnt,
-                    jnp.stack(packed_list), sum(gains_list))
+                    jnp.stack(packed_list), sum(gains_list), sum(ov_list))
 
         # chunking: one device dispatch per `chunk` trees (remote dispatch
         # latency amortization); scoring/stopping checks at chunk boundaries
@@ -1580,9 +1631,27 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 packed = packed[None]
                 nsteps = 1
             else:
-                margins, oob_sum, oob_cnt, packed, gains = _train_chunk(
-                    margins, oob_sum, oob_cnt, key, m, nsteps=nsteps
-                )
+                if cfg.compact_cap:
+                    # snapshot the mutable (donated) state: if any tree in
+                    # the chunk overflows the compact-slot cap, the chunk is
+                    # rebuilt DENSELY from here — exactness is never traded
+                    snap = _copy_args(margins, oob_sum, oob_cnt)
+                margins, oob_sum, oob_cnt, packed, gains, ov = \
+                    _train_chunk(margins, oob_sum, oob_cnt, key, m,
+                                 nsteps=nsteps)
+                if cfg.compact_cap and int(np.asarray(ov)) > 0:
+                    from ..runtime.log import Log
+
+                    Log.warn(
+                        f"tree chunk at m={m}: compact-node cap "
+                        f"{cfg.compact_cap} overflowed — rebuilding the "
+                        "chunk with dense levels")
+                    dense_jit, _ = _tree_step_fns(
+                        cfg._replace(compact_cap=0), cloud)
+                    margins, oob_sum, oob_cnt = snap
+                    margins, oob_sum, oob_cnt, packed, gains, _ = \
+                        _train_chunk(margins, oob_sum, oob_cnt, key, m,
+                                     nsteps=nsteps, tree_fn=dense_jit)
             # chunks stay on device until the post-loop bulk D2H (sync
             # transfers through the tunnel cost ~seconds each), unless the
             # accumulated forest would blow the HBM budget

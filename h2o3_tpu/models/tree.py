@@ -101,6 +101,7 @@ def value_at(table: jax.Array, idx: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=(
         "max_depth", "nbins", "hist_method", "axis_name", "mtries",
+        "compact_cap",
     ),
 )
 def build_tree(
@@ -119,14 +120,20 @@ def build_tree(
     hist_method: str = "auto",
     axis_name: Optional[str] = None,
     mtries: int = 0,
+    mtries_rate=None,  # traced per-node column keep-probability; when set,
+    #                    overrides the static mtries/F so DRF and XRT (and
+    #                    every mtries value) share ONE compiled program
     key: Optional[jax.Array] = None,
     monotone: Optional[jax.Array] = None,  # (F,) ∈ {-1,0,1}
     max_abs_leaf=None,  # traced scalar: |leaf value| cap (GBM
     #                     max_abs_leafnode_pred / xgboost max_delta_step)
+    compact_cap: int = 0,
 ):
     """Build one tree; returns (Tree, final_leaf_heap_idx (N,),
     gain_per_feature (F,), cover (T,) — Σ training row weights per heap node,
     recorded for path-dependent TreeSHAP (hex/genmodel TreeSHAP node weights).
+    With compact_cap > 0, a 5th element is returned: an i32 overflow flag
+    (see below).
 
     mtries > 0 samples ~mtries of F features per node per level (DRF's
     per-split column sampling, `hex/tree/drf/DRF.java` _mtry) — bernoulli
@@ -136,6 +143,16 @@ def build_tree(
     TRACED, not static: one compiled program serves every model that shares
     the structural config (shapes, depth, bins) — grids / CV / AutoML vary
     these scalars freely without recompiling.
+
+    compact_cap > 0 switches levels wider than the cap to ACTIVE-NODE
+    COMPACTION (DHistogram's allocate-only-active-nodes semantics, made
+    static-shaped): deep levels track at most `compact_cap` live nodes in
+    compact slots instead of materializing 2^d × F × B histograms that are
+    overwhelmingly empty (measured: DRF depth-17 levels carry ~700 active
+    nodes of 131k heap cells). Exactness is preserved: if the live-node
+    count ever exceeds the cap, the returned overflow flag is nonzero and
+    the caller must rebuild densely (the driver does). Requires
+    monotone=None.
     """
     N, F = codes.shape
     T = heap_size(max_depth)
@@ -157,8 +174,21 @@ def build_tree(
     lo_lvl = jnp.full(1, -BIG)
     hi_lvl = jnp.full(1, BIG)
 
+    # first level handled by active-node compaction (0 = never)
+    d_switch = max_depth
+    if compact_cap:
+        if monotone is not None:
+            raise ValueError("compact_cap requires monotone=None")
+        for _d in range(max_depth):
+            if 2 ** _d > compact_cap:
+                d_switch = _d
+                break
+    # per-row frozen leaf id (absolute heap node) — maintained only when the
+    # compact phase can run, since compaction stops flowing dead rows left
+    row_leaf = jnp.zeros(N, jnp.int32) if d_switch < max_depth else None
+
     hist_prev = None
-    for d in range(max_depth):
+    for d in range(min(d_switch, max_depth)):
         L = 2 ** d
         base = L - 1                        # heap offset of this level
         if d == 0:
@@ -233,11 +263,12 @@ def build_tree(
                           lo_lvl[:, None, None], hi_lvl[:, None, None])
             mc = monotone[None, :, None]
             ok = ok & ((mc == 0) | (mc * (vR - vL) >= 0))
-        if mtries > 0:
+        if mtries > 0 or mtries_rate is not None:
             key, sub = jax.random.split(key)
+            rate = mtries_rate if mtries_rate is not None else (mtries / F)
             # per-(node,feature) bernoulli keep with the same node psum'd RNG
             # on every host (key is replicated) so partitions stay consistent
-            keep = jax.random.uniform(sub, (L, F)) < (mtries / F)
+            keep = jax.random.uniform(sub, (L, F)) < rate
             keep = keep.at[:, 0].set(keep[:, 0] | ~keep.any(axis=1))  # >=1 kept
             ok = ok & keep[:, :, None]
         gain = jnp.where(ok, gain, -jnp.inf)
@@ -272,6 +303,8 @@ def build_tree(
         rcode = _row_feature_value(codes, rf)
         go_right = (rcode > rb) & rs
         idx = 2 * idx + go_right.astype(jnp.int32)
+        if row_leaf is not None:
+            row_leaf = jnp.where(rs, (2 ** (d + 1) - 1) + idx, row_leaf)
         active = jnp.repeat(do_split, 2)
 
         if monotone is not None:
@@ -294,37 +327,195 @@ def build_tree(
             lo_lvl = jnp.stack([lo_left, lo_right], axis=1).reshape(2 * L)
             hi_lvl = jnp.stack([hi_left, hi_right], axis=1).reshape(2 * L)
 
-    # final level values from exact per-cell totals. For small heaps the
-    # f32 one-hot matmul (MXU) beats segment_sum's sorted scatter ~3×;
-    # arithmetic stays f32 either way, only the reduction tree differs.
-    Lf = 2 ** max_depth
-    basef = Lf - 1
-    if Lf <= 2 * _ONEHOT_LOOKUP_MAX:
-        oh = (idx[:, None] == jnp.arange(Lf, dtype=jnp.int32)[None, :]
-              ).astype(jnp.float32)
-        vals = jnp.stack([w, g * w, h * w])                      # (3, N)
-        # Precision.HIGHEST: TPU's default matmul truncates f32 operands to
-        # bf16, which would round the per-leaf g/h sums (leaf values)
-        tot = jnp.dot(vals, oh, preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST).T
-    else:
-        vals = jnp.stack([w, g * w, h * w], axis=1)
-        tot = jax.ops.segment_sum(vals, idx, num_segments=Lf)    # (Lf, 3)
+    if d_switch >= max_depth:
+        # pure dense build: final level values from exact per-cell totals.
+        # For small heaps the f32 one-hot matmul (MXU) beats segment_sum's
+        # sorted scatter ~3×; arithmetic stays f32 either way, only the
+        # reduction tree differs.
+        Lf = 2 ** max_depth
+        basef = Lf - 1
+        if Lf <= 2 * _ONEHOT_LOOKUP_MAX:
+            oh = (idx[:, None] == jnp.arange(Lf, dtype=jnp.int32)[None, :]
+                  ).astype(jnp.float32)
+            vals = jnp.stack([w, g * w, h * w])                  # (3, N)
+            # Precision.HIGHEST: TPU's default matmul truncates f32 operands
+            # to bf16, which would round the per-leaf g/h sums (leaf values)
+            tot = jnp.dot(vals, oh, preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST).T
+        else:
+            vals = jnp.stack([w, g * w, h * w], axis=1)
+            tot = jax.ops.segment_sum(vals, idx, num_segments=Lf)  # (Lf, 3)
+        if axis_name is not None:
+            tot = jax.lax.psum(tot, axis_name)
+        gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
+        leaf_val = (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
+        if max_abs_leaf is not None:
+            leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf)
+        if monotone is not None:
+            leaf_val = jnp.clip(leaf_val, lo_lvl, hi_lvl)
+        value_a = value_a.at[basef:].set(leaf_val)
+        cover_a = cover_a.at[basef:].set(tot[:, 0].astype(jnp.float32))
+        out = (
+            Tree(feat_a, bin_a, thr_a, split_a, value_a),
+            idx + basef,
+            gain_per_feature,
+            cover_a,
+        )
+        if compact_cap:
+            return out + (jnp.int32(0),)
+        return out
+
+    # ---- compact phase: levels d_switch..max_depth with ≤ CAP live slots --
+    CAP = compact_cap
+    M = CAP // 2
+    if 2 * M != CAP:
+        raise ValueError("compact_cap must be even (slot pairs)")
+    overflow = jnp.int32(0)
+    L_t = 2 ** d_switch
+    act_i = active.astype(jnp.int32)
+    overflow += (act_i.sum() > CAP).astype(jnp.int32)
+    sid_nodes = jnp.where(active, jnp.minimum(jnp.cumsum(act_i) - 1, CAP),
+                          CAP)                                    # (L_t,)
+    row_slot = sid_nodes[idx]                                     # (N,)
+    slot_node = jnp.full(CAP + 1, -1, jnp.int32).at[sid_nodes].set(
+        jnp.where(active, jnp.arange(L_t, dtype=jnp.int32), -1))
+    # transition histogram: one fresh pass in slot space (no subtraction
+    # available across the dense/compact boundary)
+    slot_hist = build_histograms(
+        codes, row_slot, g, h, w * (row_slot < CAP).astype(w.dtype),
+        CAP + 1, nbins, method=hist_method, axis_name=axis_name)
+
+    pad_edges_c = jnp.concatenate(
+        [edges.astype(jnp.float32), jnp.full((F, 1), jnp.inf, jnp.float32)],
+        axis=1)
+    slot_iota = jnp.arange(CAP + 1, dtype=jnp.int32)
+
+    for d in range(d_switch, max_depth):
+        base = 2 ** d - 1
+        valid = (slot_node >= 0) & (slot_iota < CAP)
+        wsum = slot_hist[..., 0].sum(axis=2)[:, 0]
+        gsum = slot_hist[..., 1].sum(axis=2)[:, 0]
+        hsum = slot_hist[..., 2].sum(axis=2)[:, 0]
+        gthr = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - reg_alpha, 0.0)
+        node_val = (-gthr / (hsum + reg_lambda + 1e-12)).astype(jnp.float32)
+        if max_abs_leaf is not None:
+            node_val = jnp.clip(node_val, -max_abs_leaf, max_abs_leaf)
+        abs_node = jnp.where(valid, base + slot_node, T)   # T drops
+        value_a = value_a.at[abs_node].set(
+            jnp.where(valid, node_val, 0.0), mode="drop")
+        cover_a = cover_a.at[abs_node].set(
+            jnp.where(valid, wsum.astype(jnp.float32), 0.0), mode="drop")
+
+        # split search over live slots (same math as the dense level)
+        cw = jnp.cumsum(slot_hist[..., 0], axis=2)
+        cg = jnp.cumsum(slot_hist[..., 1], axis=2)
+        ch = jnp.cumsum(slot_hist[..., 2], axis=2)
+        GL, HL, WL = cg, ch, cw
+        G = gsum[:, None, None]
+        H = hsum[:, None, None]
+        W = wsum[:, None, None]
+        GR, HR, WR = G - GL, H - HL, W - WL
+        tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
+        GLt, GRt, Gt = tl1(GL), tl1(GR), tl1(G)
+        gain = (GLt * GLt / (HL + reg_lambda)
+                + GRt * GRt / (HR + reg_lambda)
+                - Gt * Gt / (H + reg_lambda))
+        ok = (WL >= min_rows) & (WR >= min_rows)
+        ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)
+        ok = ok & (feat_mask[None, :, None] > 0)
+        ok = ok & valid[:, None, None]
+        if mtries > 0 or mtries_rate is not None:
+            key, sub = jax.random.split(key)
+            rate = mtries_rate if mtries_rate is not None else (mtries / F)
+            keep = jax.random.uniform(sub, (CAP + 1, F)) < rate
+            keep = keep.at[:, 0].set(keep[:, 0] | ~keep.any(axis=1))
+            ok = ok & keep[:, :, None]
+        gain = jnp.where(ok, gain, -jnp.inf)
+        flat = gain.reshape(CAP + 1, F * nbins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // nbins).astype(jnp.int32)
+        bb = (best % nbins).astype(jnp.int32)
+        do = best_gain > jnp.maximum(min_split_improvement, 1e-10)
+        gain_per_feature = gain_per_feature + jax.ops.segment_sum(
+            jnp.where(do, best_gain, 0.0).astype(jnp.float32), bf,
+            num_segments=F)
+        bthr = pad_edges_c[bf, jnp.minimum(bb, nbins - 2)]
+        feat_a = feat_a.at[abs_node].set(
+            jnp.where(valid & do, bf, 0), mode="drop")
+        bin_a = bin_a.at[abs_node].set(
+            jnp.where(valid & do, bb, 0), mode="drop")
+        thr_a = thr_a.at[abs_node].set(
+            jnp.where(valid & do, bthr, 0.0), mode="drop")
+        split_a = split_a.at[abs_node].set(valid & do, mode="drop")
+
+        # partition rows (plain gathers: CAP-wide tables, N small)
+        do = do & valid
+        rs_do = do[row_slot]
+        bf_r = bf[row_slot]
+        bb_r = bb[row_slot]
+        rcode = _row_feature_value(codes, bf_r)
+        go_right = (rcode > bb_r) & rs_do
+        child_local = 2 * slot_node[row_slot] + go_right.astype(jnp.int32)
+        row_leaf = jnp.where(rs_do, (2 ** (d + 1) - 1) + child_local,
+                             row_leaf)
+
+        # child slot assignment: split parents ranked, children interleaved
+        do_i = do.astype(jnp.int32)
+        rank = jnp.minimum(jnp.cumsum(do_i) - 1, M - 1)
+        overflow += (do_i.sum() > M).astype(jnp.int32)
+        new_row_slot = jnp.where(
+            rs_do, 2 * rank[row_slot] + go_right.astype(jnp.int32), CAP)
+
+        tgt = jnp.where(do, rank, M)                      # (CAP+1,) ∈ [0,M]
+        pr = jnp.full(M + 1, CAP, jnp.int32).at[tgt].set(
+            jnp.where(do, slot_iota, CAP))
+        par_node = jnp.where(pr < CAP,
+                             slot_node[jnp.minimum(pr, CAP)], -1)  # (M+1,)
+        kids = jnp.stack([2 * par_node, 2 * par_node + 1], axis=1
+                         ).reshape(2 * (M + 1))
+        kids = jnp.where(kids < 0, -1, kids)
+        new_slot_node = jnp.concatenate(
+            [kids[:CAP], jnp.full(1, -1, jnp.int32)])
+
+        # child histograms: LEFT from one masked pass in parent-slot space,
+        # RIGHT by parent-minus-left (the sibling-subtraction trick)
+        wl = w * ((~go_right) & rs_do).astype(w.dtype)
+        hl = build_histograms(codes, row_slot, g, h, wl, CAP + 1, nbins,
+                              method=hist_method, axis_name=axis_name)
+        prc = jnp.minimum(pr, CAP)
+        hl_p = hl[prc]
+        hp_p = slot_hist[prc]
+        pair = jnp.stack([hl_p, hp_p - hl_p], axis=1
+                         ).reshape((2 * (M + 1),) + hl.shape[1:])
+        slot_hist = jnp.concatenate(
+            [pair[:CAP], jnp.zeros((1,) + hl.shape[1:], hl.dtype)])
+        slot_node = new_slot_node
+        row_slot = new_row_slot
+
+    # final level: exact per-slot totals (dead rows sit in the trash slot)
+    basef = 2 ** max_depth - 1
+    valid = (slot_node >= 0) & (slot_iota < CAP)
+    vals = jnp.stack([w, g * w, h * w], axis=1)
+    tot = jax.ops.segment_sum(vals, row_slot, num_segments=CAP + 1)
     if axis_name is not None:
         tot = jax.lax.psum(tot, axis_name)
-    gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
+    gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(
+        jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
     leaf_val = (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
     if max_abs_leaf is not None:
         leaf_val = jnp.clip(leaf_val, -max_abs_leaf, max_abs_leaf)
-    if monotone is not None:
-        leaf_val = jnp.clip(leaf_val, lo_lvl, hi_lvl)
-    value_a = value_a.at[basef:].set(leaf_val)
-    cover_a = cover_a.at[basef:].set(tot[:, 0].astype(jnp.float32))
+    abs_node = jnp.where(valid, basef + slot_node, T)
+    value_a = value_a.at[abs_node].set(
+        jnp.where(valid, leaf_val, 0.0), mode="drop")
+    cover_a = cover_a.at[abs_node].set(
+        jnp.where(valid, tot[:, 0].astype(jnp.float32), 0.0), mode="drop")
     return (
         Tree(feat_a, bin_a, thr_a, split_a, value_a),
-        idx + basef,
+        row_leaf,
         gain_per_feature,
         cover_a,
+        overflow,
     )
 
 

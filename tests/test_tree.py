@@ -131,3 +131,79 @@ def test_pack_roundtrip(cloud1):
     assert _pack_bits_for(33, 4096) == 6
     assert _pack_bits_for(65, 4096) == 0
     assert _pack_bits_for(21, 4098) == 0  # 4098 % 8 != 0 (and % 4 != 0)
+
+
+def test_compact_matches_dense(cloud1):
+    """Active-node compaction (compact_cap) must reproduce the dense build
+    EXACTLY on reachable nodes, and flag overflow instead of truncating
+    when the cap is too small."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.frame.binning import build_bins
+    from h2o3_tpu.models import tree as treelib
+
+    rng = np.random.default_rng(0)
+    N, F, B, D = 20000, 8, 16, 8
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] + 0.3 * rng.normal(size=N) > 0
+         ).astype(np.float32)
+    bm = build_bins(X, nbins=B)
+    g = jnp.asarray(0.5 - y)
+    h = jnp.full(N, 0.25, jnp.float32)
+    edges = np.full((F, B - 2), np.inf, np.float32)
+    for j, e in enumerate(bm.edges):
+        edges[j, : len(e)] = e
+    args = (jnp.asarray(bm.codes), g, h, jnp.ones(N, jnp.float32),
+            jnp.ones(F, jnp.float32), jnp.asarray(edges))
+    kw = dict(max_depth=D, nbins=B, min_rows=2.0, key=jax.random.PRNGKey(7))
+    td, lid, gd, cd = treelib.build_tree(*args, **kw)
+    tc, lic, gc, cc, ov = treelib.build_tree(*args, compact_cap=256, **kw)
+    assert int(ov) == 0
+    iss = np.asarray(td.is_split)
+    reach = np.zeros(len(iss), bool)
+    reach[0] = True
+    for n in range(len(reach) // 2):
+        if reach[n] and iss[n]:
+            reach[2 * n + 1] = reach[2 * n + 2] = True
+    for name in ("feat", "bin", "is_split"):
+        a = np.asarray(getattr(td, name))
+        b = np.asarray(getattr(tc, name))
+        np.testing.assert_array_equal(a[reach], b[reach])
+    np.testing.assert_allclose(np.asarray(td.value)[reach],
+                               np.asarray(tc.value)[reach],
+                               rtol=2e-4, atol=1e-5)
+    # per-row scores identical (leaf ids differ in representation only:
+    # dense returns deepest-cell ids, compact returns frozen node ids)
+    pd_ = np.asarray(treelib.value_at(td.value, lid))
+    pc_ = np.asarray(treelib.value_at(tc.value, lic))
+    np.testing.assert_allclose(pd_, pc_, rtol=2e-4, atol=1e-5)
+    # a cap that is too small must raise the overflow flag
+    *_, ov2 = treelib.build_tree(*args, compact_cap=4, **kw)
+    assert int(ov2) > 0
+
+
+def test_compact_with_mtries_rate(cloud1):
+    """Traced mtries_rate engages per-node column sampling in both the
+    dense and compact phases without recompilation per rate."""
+    import jax
+    import jax.numpy as jnp
+
+    from h2o3_tpu.frame.binning import build_bins
+    from h2o3_tpu.models import tree as treelib
+
+    rng = np.random.default_rng(1)
+    N, F, B, D = 5000, 6, 16, 7
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bm = build_bins(X, nbins=B)
+    edges = np.full((F, B - 2), np.inf, np.float32)
+    for j, e in enumerate(bm.edges):
+        edges[j, : len(e)] = e
+    args = (jnp.asarray(bm.codes), jnp.asarray(0.5 - y),
+            jnp.full(N, 0.25, jnp.float32), jnp.ones(N, jnp.float32),
+            jnp.ones(F, jnp.float32), jnp.asarray(edges))
+    t1, *_ , ov = treelib.build_tree(
+        *args, max_depth=D, nbins=B, min_rows=2.0, compact_cap=64,
+        mtries_rate=jnp.float32(0.5), key=jax.random.PRNGKey(3))
+    assert int(np.asarray(t1.is_split).sum()) > 0
