@@ -1,14 +1,14 @@
 """Flow — the built-in web UI, served at `/flow/`.
 
 Reference parity: `h2o-web/` (H2O Flow, the CoffeeScript notebook UI served
-by the JVM at `/flow/index.html`). This is a deliberately small single-page
-analog covering Flow's operational core — cloud status, frames (with column
+by the JVM at `/flow/index.html`). A deliberately small single-page analog
+covering Flow's operational core — cloud status, frames (with column
 summaries), models (metrics, variable importances), jobs, grids, AutoML
-leaderboards, and a Rapids cell — all driven by the same `/3` + `/99` REST
-routes the Python client uses. The notebook/cell system and plotting of the
-original are out of scope; parity here means "a browser on the cluster can
-inspect and drive it", which is what the reference's own docs position Flow
-for.
+leaderboards, a Rapids cell — plus the NOTEBOOK: an editable list of
+Rapids/plot cells with per-cell outputs, runnable top to bottom, and
+save/load of named flows through `/99/Flows` (the reference persists
+`.flow` documents the same way). Plot cells render a column histogram as
+inline SVG from `(hist (cols <frame> [i]) 20)`.
 """
 
 FLOW_HTML = """<!DOCTYPE html>
@@ -43,8 +43,10 @@ FLOW_HTML = """<!DOCTYPE html>
 <nav id="tabs"></nav>
 <main id="view">loading…</main>
 <script>
-const TABS = ["Frames", "Models", "Jobs", "Grids", "AutoML", "Rapids"];
+const TABS = ["Frames", "Models", "Jobs", "Grids", "AutoML", "Rapids",
+              "Notebook"];
 let active = "Frames";
+let cells = [{type: "rapids", src: "(nrow frame)", out: ""}];
 const esc = (v) => String(v).replace(/[&<>"']/g,
   (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 async function api(path, opts) {
@@ -101,7 +103,109 @@ const views = {
     return "<h3>Rapids</h3><textarea id='ast' rows='3'>(nrow frame)</textarea>" +
       "<br><button onclick='runRapids()'>run</button><pre id='rout'></pre>";
   },
+  async Notebook() {
+    let html = "<h3>Notebook</h3><p class='muted'>cells run top to bottom; " +
+      "plot cells take <code>&lt;frame-key&gt; &lt;column-index&gt;</code>" +
+      "</p><div>" +
+      "<input id='flowname' placeholder='flow name'> " +
+      "<button onclick='saveFlow()'>save</button> " +
+      "<button onclick='loadFlow()'>load</button> " +
+      "<button onclick='listFlows()'>list</button> " +
+      "<button onclick='runAll()'>run all</button> " +
+      "<span id='flowmsg' class='muted'></span></div><div id='cells'></div>" +
+      "<button onclick='addCell(\\"rapids\\")'>+ rapids cell</button> " +
+      "<button onclick='addCell(\\"plot\\")'>+ plot cell</button>";
+    setTimeout(renderCells, 0);
+    return html;
+  },
 };
+function renderCells() {
+  const el = document.getElementById("cells");
+  if (!el) return;
+  el.innerHTML = cells.map((c, i) =>
+    `<div style="border:1px solid var(--line);border-radius:4px;` +
+    `padding:8px;margin:8px 0">` +
+    `<span class='muted'>[${i}] ${c.type}</span> ` +
+    `<button onclick='runCell(${i})'>run</button> ` +
+    `<button onclick='delCell(${i})'>delete</button>` +
+    `<textarea rows='2' oninput='cells[${i}].src=this.value'>` +
+    `${esc(c.src)}</textarea><div id='cellout${i}'>${c.out || ""}</div>` +
+    `</div>`).join("");
+}
+function addCell(type) {
+  cells.push({type, src: type === "plot" ? "frame 0" : "(nrow frame)",
+              out: ""});
+  renderCells();
+}
+function delCell(i) { cells.splice(i, 1); renderCells(); }
+function svgHist(counts, edges) {
+  const W = 420, H = 120, n = counts.length;
+  const mx = Math.max(...counts, 1);
+  const bars = counts.map((c, i) => {
+    const h = Math.round((c / mx) * (H - 10));
+    const x = Math.round(i * (W / n));
+    return `<rect x="${x}" y="${H - h}" width="${Math.max(W / n - 1, 1)}"` +
+      ` height="${h}" fill="#1565c0"></rect>`;
+  }).join("");
+  return `<svg width="${W}" height="${H}">${bars}</svg>`;
+}
+async function runCell(i) {
+  const c = cells[i];
+  const out = document.getElementById("cellout" + i);
+  try {
+    if (c.type === "plot") {
+      const parts = c.src.trim().split(/\\s+/);
+      const ast = `(hist (cols ${parts[0]} [${parts[1] || 0}]) 20)`;
+      const r = await api("/99/Rapids", { method: "POST",
+        headers: {"Content-Type": "application/json"},
+        body: JSON.stringify({ ast }) });
+      const cols = r.columns ||
+        (r.frames && r.frames[0] && r.frames[0].columns) || [];
+      const counts = (cols.find(x => /count/i.test(x.label)) || cols[1]
+                      || {data: []}).data || [];
+      c.out = svgHist(counts.map(Number), []);
+    } else {
+      const r = await api("/99/Rapids", { method: "POST",
+        headers: {"Content-Type": "application/json"},
+        body: JSON.stringify({ ast: c.src }) });
+      c.out = "<pre>" + esc(JSON.stringify(r, null, 2).slice(0, 4000)) +
+              "</pre>";
+    }
+  } catch (e) { c.out = `<p class='err'>${esc(e.message)}</p>`; }
+  if (out) out.innerHTML = c.out;
+}
+async function runAll() {
+  for (let i = 0; i < cells.length; i++) await runCell(i);
+}
+async function saveFlow() {
+  const name = document.getElementById("flowname").value;
+  const msg = document.getElementById("flowmsg");
+  try {
+    await api("/99/Flows", { method: "POST",
+      headers: {"Content-Type": "application/json"},
+      body: JSON.stringify({ name,
+        cells: cells.map(c => ({type: c.type, src: c.src})) }) });
+    msg.textContent = "saved " + name;
+  } catch (e) { msg.textContent = "save failed: " + e.message; }
+}
+async function loadFlow() {
+  const name = document.getElementById("flowname").value;
+  const msg = document.getElementById("flowmsg");
+  try {
+    const r = await api("/99/Flows/" + encodeURIComponent(name));
+    cells = (r.cells || []).map(c => ({...c, out: ""}));
+    renderCells();
+    msg.textContent = "loaded " + name;
+  } catch (e) { msg.textContent = "load failed: " + e.message; }
+}
+async function listFlows() {
+  const msg = document.getElementById("flowmsg");
+  try {
+    const r = await api("/99/Flows");
+    msg.textContent = "flows: " +
+      (r.flows.map(f => f.name).join(", ") || "(none)");
+  } catch (e) { msg.textContent = e.message; }
+}
 async function frameSummary() {
   const k = document.getElementById("fkey").value;
   try {

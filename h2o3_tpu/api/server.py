@@ -136,6 +136,10 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/99/Leaderboards/([^/]+)$", "leaderboard_get"),
         ("POST", r"^/3/Recovery$", "recovery"),
         ("POST", r"^/3/Shutdown$", "shutdown"),
+        ("GET", r"^/99/Flows$", "flows_list"),
+        ("POST", r"^/99/Flows$", "flow_save"),
+        ("GET", r"^/99/Flows/([^/]+)$", "flow_load"),
+        ("DELETE", r"^/99/Flows/([^/]+)$", "flow_delete"),
         ("GET", r"^/3/Tree$", "tree"),
         ("GET", r"^/3/ModelMetrics$", "model_metrics_list"),
         ("GET", r"^/99/Typeahead/files$", "typeahead"),
@@ -425,6 +429,58 @@ class _Handler(BaseHTTPRequestHandler):
 
         threading.Thread(target=run, daemon=True).start()
         self._send(dict(job=dict(key=dict(name=job.dest), status=job.status)))
+
+    # -- saved flows (h2o-web Flow notebooks: save/load named cell lists) ---
+    @staticmethod
+    def _flows_dir():
+        d = os.environ.get("H2O3_FLOWS_DIR") or os.path.join(
+            os.path.expanduser("~"), ".h2o3tpu_flows")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @staticmethod
+    def _flow_path(name):
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", name)[:128]
+        if not safe:
+            raise ValueError("flow name required")
+        return os.path.join(_Handler._flows_dir(), safe + ".flow.json")
+
+    def h_flows_list(self):
+        d = self._flows_dir()
+        out = []
+        for f in sorted(os.listdir(d)):
+            if f.endswith(".flow.json"):
+                out.append(dict(name=f[: -len(".flow.json")],
+                                modified=os.path.getmtime(
+                                    os.path.join(d, f))))
+        self._send(dict(flows=out))
+
+    def h_flow_save(self):
+        p = self._params()
+        name = p.get("name")
+        cells = p.get("cells")
+        if isinstance(cells, str):
+            cells = json.loads(cells)
+        if not isinstance(cells, list):
+            raise ValueError("cells must be a list of {type, src}")
+        path = self._flow_path(str(name or ""))
+        with open(path, "w") as f:
+            json.dump(dict(name=name, cells=cells), f)
+        self._send(dict(name=name, saved=True, cells=len(cells)))
+
+    def h_flow_load(self, name):
+        path = self._flow_path(name)
+        if not os.path.exists(path):
+            raise KeyError(name)
+        with open(path) as f:
+            self._send(json.load(f))
+
+    def h_flow_delete(self, name):
+        path = self._flow_path(name)
+        if not os.path.exists(path):
+            raise KeyError(name)
+        os.remove(path)
+        self._send(dict(name=name, deleted=True))
 
     def h_tree(self):
         """`GET /3/Tree` — fetch one tree of a tree model (TreeV3 /

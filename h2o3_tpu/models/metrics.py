@@ -213,6 +213,107 @@ class ModelMetricsBinomial(ModelMetricsBase):
             _roc=(fpr, tpr),
         )
 
+    @staticmethod
+    def from_binned(qs: np.ndarray, npos: np.ndarray, nneg: np.ndarray,
+                    nll_sum: float, sq_sum: float) -> "ModelMetricsBinomial":
+        """Metrics from a 400-bin score histogram — `hex/AUC2.java`'s exact
+        design: every statistic (AUC/pr-AUC/max-F1/CM/gains) derives from
+        per-threshold-bin (pos, neg) counts, so only ~KBs ever leave the
+        device. The AUC is the binned trapezoid, which IS the reference's
+        reported AUC semantics (AUC2 sweeps its 400 bins the same way)."""
+        qs = np.asarray(qs, np.float64)
+        npos = np.asarray(npos, np.float64)
+        nneg = np.asarray(nneg, np.float64)
+        # merge bins with duplicate thresholds (host roc_curve_binned
+        # np.unique semantics: ties collapse into one bin)
+        uq, inv = np.unique(qs, return_inverse=True)
+        npos_m = np.zeros(len(uq) + 1)
+        nneg_m = np.zeros(len(uq) + 1)
+        # bin b of searchsorted(qs,...) maps to searchsorted(uq,...) bins
+        edge_map = np.searchsorted(uq, qs, side="left")
+        full_map = np.concatenate([edge_map, [len(uq)]])
+        np.add.at(npos_m, full_map, npos)
+        np.add.at(nneg_m, full_map, nneg)
+        npos, nneg, qs = npos_m, nneg_m, uq
+        P = float(npos.sum())
+        Ntot = float(nneg.sum())
+        n = P + Ntot
+        tp = np.cumsum(npos[::-1])[::-1]
+        fp = np.cumsum(nneg[::-1])[::-1]
+        tpr = tp / max(P, 1e-12)
+        fpr = fp / max(Ntot, 1e-12)
+        order = np.argsort(fpr)
+        auc = float(np.trapezoid(
+            np.r_[0.0, tpr[order], 1.0], np.r_[0.0, fpr[order], 1.0]))
+        prec = tp / np.maximum(tp + fp, 1e-12)
+        rec = tpr
+        f1s = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+        bi = int(np.argmax(f1s))
+        thr = float(qs[min(bi, len(qs) - 1)]) if len(qs) else 0.5
+        # confusion at the max-F1 threshold straight from the sweep counts
+        tp_, fp_ = float(tp[bi]), float(fp[bi])
+        fn_, tn_ = P - tp_, Ntot - fp_
+        cm = np.asarray([[tn_, fp_], [fn_, tp_]])
+        err0 = fp_ / max(tn_ + fp_, 1e-12)
+        err1 = fn_ / max(tp_ + fn_, 1e-12)
+        oi = np.argsort(rec)
+        pr_auc = (float(np.trapezoid(prec[oi], rec[oi]))
+                  if len(rec) > 1 else float("nan"))
+        # gains/lift from the bin counts (16 cumulative-count groups)
+        glt = []
+        tot = npos + nneg
+        cum_rows = np.cumsum(tot[::-1])[::-1]          # rows scored >= bin
+        cum_pos = tp
+        prev_rows = prev_pos = 0.0
+        for gidx in range(1, 17):
+            target = n * gidx / 16.0
+            # the group boundary may fall INSIDE a tied-score block (bins
+            # cannot split ties); split the block fractionally, assuming a
+            # uniform positive rate within it — the expectation of the
+            # exact-sort table's arbitrary tie ordering
+            sel = int(np.searchsorted(-cum_rows, -target, side="left"))
+            b = min(max(sel - 1, 0), len(tot) - 1)
+            if cum_rows[b] < target and b > 0:
+                b -= 1
+            rows_above = float(cum_rows[b + 1]) if b + 1 < len(tot) else 0.0
+            pos_above = float(cum_pos[b + 1]) if b + 1 < len(tot) else 0.0
+            blk_rows = max(float(cum_rows[b]) - rows_above, 1e-12)
+            blk_pos = float(cum_pos[b]) - pos_above
+            f = min(max((target - rows_above) / blk_rows, 0.0), 1.0)
+            rows = target
+            pos = pos_above + f * blk_pos
+            frac = rows / max(n, 1e-12)
+            capture = pos / max(P, 1e-12)
+            g_rows = max(rows - prev_rows, 0.0)
+            g_pos = max(pos - prev_pos, 0.0)
+            g_cap = g_pos / max(P, 1e-12)
+            g_frac = g_rows / max(n, 1e-12)
+            cum_lift = capture / max(frac, 1e-12)
+            lift = g_cap / max(g_frac, 1e-12)
+            glt.append(dict(
+                group=gidx, cumulative_data_fraction=frac,
+                lower_threshold=float(qs[min(b, len(qs) - 1)]) if len(qs)
+                else 0.0,
+                lift=lift, cumulative_lift=cum_lift,
+                response_rate=g_pos / max(g_rows, 1e-12),
+                cumulative_response_rate=pos / max(rows, 1e-12),
+                capture_rate=g_cap, cumulative_capture_rate=capture,
+                gain=100.0 * (lift - 1.0),
+                cumulative_gain=100.0 * (cum_lift - 1.0),
+            ))
+            prev_rows, prev_pos = rows, pos
+        mse = sq_sum / max(n, 1e-12)
+        return ModelMetricsBinomial(
+            mse=mse, rmse=float(np.sqrt(mse)), nobs=int(n),
+            auc=auc, pr_auc=pr_auc, logloss=nll_sum / max(n, 1e-12),
+            gini=2 * auc - 1,
+            mean_per_class_error=(err0 + err1) / 2, f1=float(f1s[bi]),
+            accuracy=(tp_ + tn_) / max(n, 1e-12),
+            confusion_matrix=cm, threshold=thr,
+            gains_lift_table=glt,
+            _roc=(fpr, tpr),
+        )
+
 
 @dataclass
 class ModelMetricsMultinomial(ModelMetricsBase):

@@ -77,11 +77,40 @@ from .model_base import DataInfo, H2OEstimator, H2OModel, ScoreKeeper, response_
 _predict_codes_jit = jax.jit(treelib.predict_codes, static_argnames=("max_depth",))
 
 
+@functools.partial(jax.jit, static_argnames=("n", "nbins"))
+def _binom_binned_stats(margins, y_d, n: int, nbins: int = 400):
+    """AUC2-style 400-bin score histogram ON DEVICE (hex/AUC2.java): the
+    quantile edges, per-bin (pos, neg) counts and the logloss/mse sums are
+    the only things that cross the wire (~KBs instead of the 4·n-byte
+    margin pull + a host rank sort)."""
+    p = jax.nn.sigmoid(margins[:n, 0])
+    y = y_d[:n, 0]
+    qs = jnp.quantile(p, jnp.linspace(0.0, 1.0, nbins))
+    bins = jnp.searchsorted(qs, p, side="left")
+    npos = jax.ops.segment_sum(y, bins, num_segments=nbins + 1)
+    nneg = jax.ops.segment_sum(1.0 - y, bins, num_segments=nbins + 1)
+    pc = jnp.clip(p, 1e-15, 1 - 1e-15)
+    nll = -jnp.sum(jnp.where(y > 0.5, jnp.log(pc), jnp.log(1.0 - pc)))
+    sq = jnp.sum((p - y) ** 2)
+    return qs, npos, nneg, nll, sq
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_forest_codes_jit(forest, codes, max_depth: int):
     """Σ over a stacked forest of per-row leaf values on binned codes."""
     per_tree = jax.vmap(lambda t: treelib.predict_codes(t, codes, max_depth))(forest)
     return per_tree.sum(axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",),
+                   donate_argnums=(2,))
+def _margin_ffwd_jit(forest, codes, margins, k, max_depth: int):
+    """Checkpoint fast-forward: add a restored class-k forest's leaf sums
+    to the margins in ONE program (works on process-spanning arrays, where
+    the eager .at add would be rejected)."""
+    per_tree = jax.vmap(
+        lambda t: treelib.predict_codes(t, codes, max_depth))(forest)
+    return margins.at[:, k].add(per_tree.sum(axis=0))
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",),
@@ -1077,11 +1106,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # multi-host cloud: this process holds its ingest shard; global
             # facts come from collectives. Features outside the v1 envelope
             # fail loudly rather than silently training on local-only stats.
+            # the one remaining v1 restriction: custom objectives run user
+            # host code on what would be process-spanning arrays (e.g. the
+            # lambdarank pass builds per-query host structures) — cannot be
+            # made cloud-size-agnostic without constraining the user API
             unsupported = [
-                ("checkpoint", self._parms.get("checkpoint") is not None),
                 ("custom objective",
                  getattr(self, "_objective_fn", None) is not None),
-                ("calibrate_model", bool(self._parms.get("calibrate_model"))),
             ]
             bad = [name for name, cond in unsupported if cond]
             if bad:
@@ -1392,26 +1423,54 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # split bins stay aligned with the restored trees
             bm = pm.bm
             nbins = bm.nbins
-            codes_d = jnp.asarray(padr(bin_apply(bm, X)))
             edges_np = np.full((F, nbins - 2), np.inf, np.float32)
             for j, e in enumerate(bm.edges):
                 edges_np[j, : min(len(e), nbins - 2)] = e[: nbins - 2]
-            edges_d = jnp.asarray(edges_np)
             n_prior = pm.ntrees_built
             f0 = np.asarray(pm.f0).reshape(-1).astype(np.float32)
-            margins = jnp.broadcast_to(jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
             prior_stacked = list(pm.forest)
-            for k in range(K):
-                vsum = _predict_forest_codes_jit(
-                    jax.tree.map(jnp.asarray, pm.forest[k]), codes_d, tp["max_depth"]
-                )
-                margins = margins.at[:, k].add(vsum)
-            if offset is not None:
-                margins = margins + jnp.asarray(padr(offset))[:, None]
-            if ndev > 1:
-                codes_d = jax.device_put(codes_d, cloud.row_sharding())
-                edges_d = jax.device_put(edges_d, cloud.replicated())
-                margins = jax.device_put(margins, cloud.row_sharding())
+            prior_replicated: List = []   # reused by the valid fast-forward
+            if multiproc:
+                # every rank restored the SAME artifact (the model object the
+                # user passed exists identically on each process); codes are
+                # this rank's shard, the forest is replicated, margins fast-
+                # forward inside jit programs
+                codes_d = distdata.global_row_array(
+                    padr(bin_apply(bm, X)), quota, cloud)
+                edges_d = distdata.replicated_array(edges_np, cloud)
+                rs_m = cloud.row_sharding()
+                margins = jax.jit(
+                    lambda f: jnp.broadcast_to(
+                        f[None, :], (npad, K)).astype(jnp.float32),
+                    out_shardings=rs_m)(f0)
+                for k in range(K):
+                    forest_k = jax.tree.map(
+                        lambda a: distdata.replicated_array(
+                            np.asarray(a), cloud), pm.forest[k])
+                    prior_replicated.append(forest_k)
+                    margins = _margin_ffwd_jit(
+                        forest_k, codes_d, margins, jnp.int32(k),
+                        tp["max_depth"])
+                if offset is not None:
+                    off_g = distdata.global_row_array(padr(offset), quota,
+                                                      cloud)
+                    margins = jax.jit(lambda m, o: m + o[:, None],
+                                      out_shardings=rs_m)(margins, off_g)
+            else:
+                codes_d = jnp.asarray(padr(bin_apply(bm, X)))
+                edges_d = jnp.asarray(edges_np)
+                margins = jnp.broadcast_to(
+                    jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
+                for k in range(K):
+                    margins = _margin_ffwd_jit(
+                        jax.tree.map(jnp.asarray, pm.forest[k]), codes_d,
+                        margins, jnp.int32(k), tp["max_depth"])
+                if offset is not None:
+                    margins = margins + jnp.asarray(padr(offset))[:, None]
+                if ndev > 1:
+                    codes_d = jax.device_put(codes_d, cloud.row_sharding())
+                    edges_d = jax.device_put(edges_d, cloud.replicated())
+                    margins = jax.device_put(margins, cloud.row_sharding())
 
         # validation margins tracked incrementally per tree (the Score pass of
         # SharedTree.Driver on the validation frame) — early stopping uses the
@@ -1452,11 +1511,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     (n_v, K)).astype(jnp.float32)
             if n_prior:
                 for k in range(K):
-                    vsum = _predict_forest_codes_jit(
-                        jax.tree.map(jnp.asarray, prior_stacked[k]), codes_v,
-                        tp["max_depth"],
-                    )
-                    margins_v = margins_v.at[:, k].add(vsum)
+                    forest_k = (prior_replicated[k] if multiproc else
+                                jax.tree.map(jnp.asarray, prior_stacked[k]))
+                    margins_v = _margin_ffwd_jit(
+                        forest_k, codes_v, margins_v, jnp.int32(k),
+                        tp["max_depth"])
             if self._parms.get("offset_column") and self._parms["offset_column"] in valid.names:
                 off_v = valid.vec(self._parms["offset_column"]).numeric_np().astype(np.float32)
                 if multiproc:
@@ -1844,11 +1903,22 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # training metrics straight from the final margins (already on device)
         # instead of a fresh forest re-predict — saves transfers + a compile
         _ph.mark("forest_unpack")
+        device_auc = (not multiproc and problem == "binomial"
+                      and dist == "bernoulli" and self._mode == "gbm")
+        if device_auc:
+            # binomial GBM/XGB: the whole training-metric reduction runs on
+            # device (AUC2 binned design) — no margin D2H, no host rank sort
+            qs_b, npos_b, nneg_b, nll_b, sq_b = _binom_binned_stats(
+                margins, y_d, n)
+            model.training_metrics = ModelMetricsBinomial.from_binned(
+                np.asarray(qs_b), np.asarray(npos_b), np.asarray(nneg_b),
+                float(nll_b), float(sq_b))
+            _ph.mark("training_metrics")
         if multiproc:
             # this process's real rows (training metrics are local-shard on
             # a multi-host cloud; the forest itself is identical everywhere)
             margins_np = distdata.local_shard(margins)[:n].astype(np.float64)
-        else:
+        elif not device_auc:
             margins_np = np.asarray(margins[:n]).astype(np.float64)
         _ph.mark("margins_D2H")
         if self._mode == "drf" and row_sampled and n_prior > 0:
@@ -1878,10 +1948,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
             probs_tr = self._probs_from_margins(
                 problem, dist, oob_mean * max(model.ntrees_built, 1),
                 model.ntrees_built)
-        else:
+        elif not device_auc:
             probs_tr = self._probs_from_margins(problem, dist, margins_np,
                                                 model.ntrees_built)
-        model.training_metrics = _metrics_for(problem, train.vec(y), probs_tr)
+        if not device_auc:
+            model.training_metrics = _metrics_for(problem, train.vec(y),
+                                                  probs_tr)
         _ph.mark("training_metrics")
         if valid is not None:
             if valid_state is not None and self._mode != "drf":
@@ -1913,8 +1985,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                 model._offset_of(calib))[:, 1]
         ycal = np.asarray(calib.vec(model.y).data, np.float64)
         method = str(self._parms.get("calibration_method", "AUTO"))
+        multiproc = distdata.multiprocess()
         if method in ("AUTO", "PlattScaling"):
-            # 1-D logistic regression y ~ a·logit(p) + b via Newton
+            # 1-D logistic regression y ~ a·logit(p) + b via Newton. On a
+            # multi-process cloud each rank holds its calibration shard;
+            # gradient and Hessian are row sums, so one global_sum per
+            # Newton step makes every rank converge to the SAME (a, b)
             z = np.log(np.clip(p1, 1e-12, 1 - 1e-12)
                        / np.clip(1 - p1, 1e-12, 1 - 1e-12))
             X = np.column_stack([z, np.ones_like(z)])
@@ -1924,6 +2000,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 Wd = np.clip(mu * (1 - mu), 1e-10, None)
                 grad = X.T @ (ycal - mu)
                 Hm = (X * Wd[:, None]).T @ X
+                if multiproc:
+                    packed = distdata.global_sum(
+                        np.concatenate([grad, Hm.ravel()]))
+                    grad, Hm = packed[:2], packed[2:].reshape(2, 2)
                 step = np.linalg.solve(Hm + 1e-9 * np.eye(2), grad)
                 ab = ab + step
                 if np.max(np.abs(step)) < 1e-10:
@@ -1939,6 +2019,19 @@ class H2OSharedTreeEstimator(H2OEstimator):
         if method == "IsotonicRegression":
             from .isotonic import pav
 
+            if multiproc:
+                # PAV needs the globally sorted sequence — allgather the
+                # (p, y) pairs as raw bytes (per-rank lengths differ;
+                # calibration frames are holdout-sized, and the reference's
+                # Isotonic calibration also centralizes them)
+                p1 = np.concatenate([
+                    np.frombuffer(b, np.float64) for b in
+                    distdata.allgather_bytes(
+                        np.ascontiguousarray(p1, np.float64).tobytes())])
+                ycal = np.concatenate([
+                    np.frombuffer(b, np.float64) for b in
+                    distdata.allgather_bytes(
+                        np.ascontiguousarray(ycal, np.float64).tobytes())])
             tx, ty = pav(p1, ycal, np.ones_like(ycal))
             return lambda p: np.interp(p, tx, ty)
         raise ValueError(f"unknown calibration_method {method!r}")

@@ -128,20 +128,35 @@ _training_lock = threading.RLock()
 
 def training_guard():
     """Context manager serializing whole training jobs across threads on
-    multi-device CPU meshes.
+    multi-device CPU meshes and on MULTI-PROCESS clouds of any backend.
 
     `collective_fence` keeps at most one collective executable in flight
     *within* a training loop, but two REST-spawned jobs (grid + AutoML, or
     two concurrent model builds) interleave dispatches from separate
-    threads, recreating the XLA:CPU thunk-pool deadlock it exists to avoid.
-    On TPU (streams serialize) or single-device clouds this returns a no-op
-    context so concurrent jobs still overlap host-side work."""
+    threads:
+
+    * on a multi-device XLA:CPU mesh that recreates the thunk-pool
+      rendezvous deadlock the fence exists to avoid;
+    * on a multi-HOST cloud (TPU pod over ICI/DCN included) collective
+      launch order must be identical on every rank. This lock serializes
+      jobs WITHIN each process; it cannot order jobs ACROSS ranks — that
+      is the SPMD contract: every rank runs the same driver script, so
+      jobs are submitted in the same program order everywhere (the
+      reference demands the same: every node must see the same job
+      submissions). Submitting jobs to different ranks from independent
+      sources concurrently is unsupported and would deadlock with or
+      without this lock; docs/distributed.md spells this out.
+
+    Single-process single-backend TPU (streams serialize, no cross-rank
+    ordering to break) returns a no-op context so concurrent jobs still
+    overlap host-side work."""
     import contextlib
 
     import jax
 
     c = _cloud
-    if c is not None and c.size > 1 and jax.default_backend() == "cpu":
+    if c is not None and c.size > 1 and (
+            jax.default_backend() == "cpu" or jax.process_count() > 1):
         return _training_lock
     return contextlib.nullcontext()
 

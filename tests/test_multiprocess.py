@@ -485,3 +485,107 @@ def test_dl_early_stop_two_process(tmp_path, cloud1):
     got = np.load(out)
     assert int(got["events"]) >= 2          # scored more than once
     assert float(got["auc"]) > 0.8          # actually learned
+
+
+CKPT_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+g1 = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=5)
+g1.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+g2 = H2OGradientBoostingEstimator(ntrees=16, max_depth=3, seed=5,
+                                  checkpoint=g1)
+g2.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    t = g2.model.forest[0]
+    np.savez({out!r}, ntrees=g2.model.ntrees_built,
+             feat=np.asarray(t.feat),
+             auc=float(g2.model.training_metrics.auc))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+CALIB_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+ca = h2o.import_file({ccsv!r})
+ca["y"] = ca["y"].asfactor()
+g = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=5,
+                                 calibrate_model=True,
+                                 calibration_frame=ca)
+g.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+pf = g.predict(fr)
+import jax
+if jax.process_index() == 0:
+    cal = np.asarray(pf.vec("cal_p1").numeric_np()) \
+        if "cal_p1" in pf.names else np.asarray(pf.vec("1").numeric_np())
+    np.savez({out!r}, cal=cal[:50])
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_gbm_checkpoint_two_process(tmp_path, cloud1):
+    """checkpoint continuation on a 2-process cloud: the continued forest
+    must match the single-process continuation (same edges, same key
+    stream from tree index n_prior)."""
+    p = str(tmp_path / "ck.csv")
+    _write_gbm_csv(p, n=2500)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    r1 = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=5)
+    r1.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+    r2 = H2OGradientBoostingEstimator(ntrees=16, max_depth=3, seed=5,
+                                      checkpoint=r1)
+    r2.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+
+    out = str(tmp_path / "ck2.npz")
+    run_workers(2, CKPT_BODY.format(csv=p, out=out))
+    got = np.load(out)
+    assert int(got["ntrees"]) == r2.model.ntrees_built == 16
+    rt = np.asarray(r2.model.forest[0].feat)
+    assert (got["feat"] == rt).mean() > 0.98
+    assert float(got["auc"]) == pytest.approx(
+        float(r2.model.training_metrics.auc), abs=0.02)
+
+
+def test_gbm_calibrate_two_process(tmp_path, cloud1):
+    """calibrate_model on a 2-process cloud: the Platt coefficients come
+    from globally-summed Newton steps, so calibrated probabilities match
+    the single-process fit."""
+    p = str(tmp_path / "cal.csv")
+    pc = str(tmp_path / "calf.csv")
+    _write_gbm_csv(p, n=2500)
+    _write_gbm_csv(pc, n=800, seed=31)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    ca = h2o.import_file(pc)
+    ca["y"] = ca["y"].asfactor()
+    ref = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=5,
+                                       calibrate_model=True,
+                                       calibration_frame=ca)
+    ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
+              training_frame=fr)
+    pref = ref.predict(fr)
+    col = "cal_p1" if "cal_p1" in pref.names else "1"
+    ref_cal = np.asarray(pref.vec(col).numeric_np())[:50]
+
+    out = str(tmp_path / "cal2.npz")
+    run_workers(2, CALIB_BODY.format(csv=p, ccsv=pc, out=out))
+    got = np.load(out)["cal"]
+    np.testing.assert_allclose(got, ref_cal, rtol=5e-3, atol=5e-3)

@@ -442,3 +442,40 @@ def test_auth_token():
             assert "models" in json.loads(r.read())
     finally:
         srv.stop()
+
+
+def test_flows_save_load_roundtrip(server, tmp_path, monkeypatch):
+    """`/99/Flows` — the notebook save/load surface (h2o-web .flow docs)."""
+    monkeypatch.setenv("H2O3_FLOWS_DIR", str(tmp_path / "flows"))
+    srv, _ = server
+    cells = [{"type": "rapids", "src": "(nrow x)"},
+             {"type": "plot", "src": "fr 0"}]
+    out = _post_json(srv, "/99/Flows", {"name": "myflow", "cells": cells})
+    assert out["saved"] and out["cells"] == 2
+    lst = _get(srv, "/99/Flows")["flows"]
+    assert any(f["name"] == "myflow" for f in lst)
+    got = _get(srv, "/99/Flows/myflow")
+    assert got["cells"] == cells
+    # delete
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/99/Flows/myflow", method="DELETE")
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read())["deleted"]
+    with pytest.raises(urllib.error.HTTPError):
+        _get(srv, "/99/Flows/myflow")
+
+
+def _post_json(srv, path, obj):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_flow_ui_has_notebook(server):
+    srv, _ = server
+    with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/flow/") as r:
+        html = r.read().decode()
+    assert "Notebook" in html and "saveFlow" in html and "svgHist" in html
