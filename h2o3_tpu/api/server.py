@@ -144,6 +144,8 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/ModelMetrics$", "model_metrics_list"),
         ("GET", r"^/99/Typeahead/files$", "typeahead"),
         ("GET", r"^/3/WaterMeterCpuTicks/(\d+)$", "water_meter"),
+        ("GET", r"^/3/NetworkTest$", "network_test"),
+        ("POST", r"^/3/GarbageCollect$", "garbage_collect"),
     ]
 
     def log_message(self, fmt, *args):  # route access logs into our Log
@@ -299,8 +301,19 @@ class _Handler(BaseHTTPRequestHandler):
                         destination_frame=dict(name=fr.key)))
 
     def h_frames_list(self):
+        """`GET /3/Frames[?offset=&limit=]` — paginated like the reference's
+        FramesHandler (water/api/FramesHandler list pagination)."""
+        p = self._params()
+        offset = int(p.get("offset", 0) or 0)
+        limit = int(p.get("limit", 0) or 0)
         frames = [DKV.get(k) for k in DKV.keys(Frame)]
-        self._send(dict(frames=[dict(frame_id=dict(name=f.key), rows=f.nrow,
+        total = len(frames)
+        if offset:
+            frames = frames[offset:]
+        if limit:
+            frames = frames[:limit]
+        self._send(dict(total_frames=total, offset=offset,
+                        frames=[dict(frame_id=dict(name=f.key), rows=f.nrow,
                                      columns=f.ncol) for f in frames]))
 
     def h_frame_get(self, key):
@@ -550,6 +563,39 @@ class _Handler(BaseHTTPRequestHandler):
         except OSError:
             pass
         self._send(dict(src=src, matches=matches, limit=limit))
+
+    def h_network_test(self):
+        """`GET /3/NetworkTest` — transport microbenchmark (water/api
+        NetworkTestHandler analog). The reference measures node↔node RPC;
+        the TPU framework's data plane is the host↔device link, so this
+        times H2D+D2H round-trips per payload size (warm-up first — the
+        first shape pays an XLA compile, which is not bandwidth). No
+        collectives run here: a REST request reaches ONE rank, and a
+        single-rank collective would hang the cloud (docs/distributed.md,
+        concurrent-jobs section)."""
+        import time as _t
+
+        import jax
+
+        results = []
+        for size in (1 << 10, 1 << 16, 1 << 20):
+            payload = np.zeros(size, np.uint8)
+            dev = jax.device_put(payload)          # warm-up: compile + path
+            np.asarray(dev)
+            t0 = _t.time()
+            dev = jax.device_put(payload)
+            np.asarray(dev)                        # forces the D2H
+            dt = max(_t.time() - t0, 1e-9)
+            results.append(dict(bytes=size, seconds=dt,
+                                mbytes_per_sec=2 * size / dt / 1e6))
+        self._send(dict(nodes=jax.process_count(), results=results))
+
+    def h_garbage_collect(self):
+        """`POST /3/GarbageCollect` (water/api GarbageCollectHandler)."""
+        import gc
+
+        collected = gc.collect()
+        self._send(dict(collected=collected, dkv=DKV.stats()))
 
     def h_water_meter(self, nodeidx):
         """`GET /3/WaterMeterCpuTicks/{node}` — per-cpu tick counters

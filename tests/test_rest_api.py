@@ -479,3 +479,24 @@ def test_flow_ui_has_notebook(server):
     with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/flow/") as r:
         html = r.read().decode()
     assert "Notebook" in html and "saveFlow" in html and "svgHist" in html
+
+
+def test_frames_pagination(server):
+    srv, _ = server
+    all_f = _get(srv, "/3/Frames")
+    assert "total_frames" in all_f
+    if all_f["total_frames"] >= 2:
+        page = _get(srv, "/3/Frames?offset=1&limit=1")
+        assert len(page["frames"]) == 1
+        assert page["offset"] == 1
+
+
+def test_network_test_and_gc(server):
+    srv, _ = server
+    nt = _get(srv, "/3/NetworkTest")
+    assert nt["results"] and all(r["mbytes_per_sec"] > 0 for r in nt["results"])
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/3/GarbageCollect", data=b"")
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    assert "collected" in out and "dkv" in out
