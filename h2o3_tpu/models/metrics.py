@@ -226,7 +226,7 @@ class ModelMetricsBinomial(ModelMetricsBase):
         nneg = np.asarray(nneg, np.float64)
         # merge bins with duplicate thresholds (host roc_curve_binned
         # np.unique semantics: ties collapse into one bin)
-        uq, inv = np.unique(qs, return_inverse=True)
+        uq = np.unique(qs)
         npos_m = np.zeros(len(uq) + 1)
         nneg_m = np.zeros(len(uq) + 1)
         # bin b of searchsorted(qs,...) maps to searchsorted(uq,...) bins
