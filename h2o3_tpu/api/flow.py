@@ -158,7 +158,7 @@ async function runCell(i) {
       const ast = `(hist (cols ${parts[0]} [${parts[1] || 0}]) 20)`;
       const r = await api("/99/Rapids", { method: "POST",
         headers: {"Content-Type": "application/json"},
-        body: JSON.stringify({ ast }) });
+        body: JSON.stringify({ ast, rows: 64 }) });
       const cols = r.columns ||
         (r.frames && r.frames[0] && r.frames[0].columns) || [];
       const counts = (cols.find(x => /count/i.test(x.label)) || cols[1]

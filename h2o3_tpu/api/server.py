@@ -304,8 +304,8 @@ class _Handler(BaseHTTPRequestHandler):
         """`GET /3/Frames[?offset=&limit=]` — paginated like the reference's
         FramesHandler (water/api/FramesHandler list pagination)."""
         p = self._params()
-        offset = int(p.get("offset", 0) or 0)
-        limit = int(p.get("limit", 0) or 0)
+        offset = max(0, int(p.get("offset", 0) or 0))
+        limit = max(0, int(p.get("limit", 0) or 0))
         frames = [DKV.get(k) for k in DKV.keys(Frame)]
         total = len(frames)
         if offset:
@@ -453,10 +453,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _flow_path(name):
-        safe = re.sub(r"[^A-Za-z0-9._-]", "_", name)[:128]
-        if not safe:
+        # Distinct names must map to distinct files: substituting disallowed
+        # characters would collide "my flow" with "my_flow" and silently
+        # overwrite, so reject instead (400 via ValueError).
+        if not name:
             raise ValueError("flow name required")
-        return os.path.join(_Handler._flows_dir(), safe + ".flow.json")
+        if len(name) > 128 or re.search(r"[^A-Za-z0-9._-]", name):
+            raise ValueError(
+                "flow name must match [A-Za-z0-9._-]{1,128}: %r" % name)
+        return os.path.join(_Handler._flows_dir(), name + ".flow.json")
 
     def h_flows_list(self):
         d = self._flows_dir()
@@ -674,8 +679,12 @@ class _Handler(BaseHTTPRequestHandler):
             if not getattr(res, "key", None):
                 res.key = f"rapids_{id(res)}"
             DKV.put(res.key, res)
+            # `rows` lets callers (e.g. Flow plot cells reading all hist
+            # bins) ask for more than the 10-row preview; capped at 10k.
+            rows = p.get("rows")
+            rows = 10 if rows in (None, "") else min(max(0, int(rows)), 10_000)
             self._send(dict(key=dict(name=res.key),
-                            **_frame_summary(res)))
+                            **_frame_summary(res, rows=rows)))
         elif isinstance(res, (int, float)):
             self._send(dict(scalar=res))
         else:

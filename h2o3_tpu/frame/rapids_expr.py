@@ -541,8 +541,17 @@ class RapidsSession:
             import zoneinfo
 
             tz = zoneinfo.ZoneInfo(_TIME_ZONE[0])
+            if len(a) != 7:
+                raise ValueError(
+                    "moment expects 7 args (yr mo dy hr mi se ms), got %d"
+                    % len(a))
             cols = [(np.asarray(v._col0()) if isinstance(v, Frame)
                      else None) for v in a[:7]]
+            lens = {len(c) for c in cols if c is not None}
+            if len(lens) > 1:
+                raise ValueError(
+                    "moment column args must have equal lengths, got %s"
+                    % sorted(lens))
             nrow = max((len(c) for c in cols if c is not None), default=1)
             vals = [(c if c is not None
                      else np.full(nrow, float(a[i])))

@@ -500,3 +500,34 @@ def test_network_test_and_gc(server):
     with urllib.request.urlopen(req) as r:
         out = json.loads(r.read())
     assert "collected" in out and "dkv" in out
+
+
+def test_frames_pagination_negative_clamped(server):
+    """Negative offset/limit must not tail-slice (ADVICE r03)."""
+    srv, _ = server
+    all_f = _get(srv, "/3/Frames")
+    page = _get(srv, "/3/Frames?offset=-1&limit=-5")
+    assert page["offset"] == 0
+    assert len(page["frames"]) == len(all_f["frames"])
+
+
+def test_flow_name_with_disallowed_chars_rejected(server, tmp_path,
+                                                  monkeypatch):
+    """'my flow' and 'my_flow' must not collide on one file (ADVICE r03)."""
+    monkeypatch.setenv("H2O3_FLOWS_DIR", str(tmp_path / "flows"))
+    srv, _ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(srv, "/99/Flows",
+                   {"name": "my flow", "cells": []})
+    assert e.value.code == 400
+
+
+def test_rapids_rows_param_returns_all_hist_bins(server):
+    """Flow plot cells read every hist bin via rows= (ADVICE r03 medium)."""
+    srv, csv = server
+    imp = _post(srv, "/3/ImportFiles", path=csv)
+    key = imp["destination_frames"][0]
+    out = _post_json(srv, "/99/Rapids",
+                     {"ast": f"(hist (cols {key} [0]) 20)", "rows": 64})
+    counts = next(c for c in out["columns"] if "count" in c["label"].lower())
+    assert len(counts["data"]) == 20  # all 20 bins, not the 10-row preview
