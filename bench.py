@@ -151,6 +151,38 @@ def bench_xgb_rank():
             {"ndcg10": round(float(ndcg), 5)})
 
 
+def bench_score():
+    """Deep-forest scoring on a FRESH frame (VERDICT r03 #1): DRF 50 trees
+    depth-20 on 50k rows, then warm `model_performance(new_frame)` — the
+    path that taxes AutoML leaderboard_frame, calibration, and REST
+    Predictions. Uses the fused subtree-fetch scorer (models/tree.py
+    `predict_forest_fused`)."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 50_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 50))
+    import time as _t
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.drf import H2ORandomForestEstimator
+
+    X, y = make_higgs_like(n_rows, n_feat=12)
+    d = {f"f{i}": X[:, i] for i in range(12)}
+    d["label"] = y.astype(int).astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"label": "enum"})
+    drf = H2ORandomForestEstimator(ntrees=ntrees, max_depth=20, seed=1)
+    drf.train(y="label", training_frame=fr)
+    Xs, ys = make_higgs_like(n_rows, n_feat=12, seed=7)
+    ds = {f"f{i}": Xs[:, i] for i in range(12)}
+    ds["label"] = ys.astype(int).astype(str)
+    frs = h2o.H2OFrame_from_python(ds, column_types={"label": "enum"})
+    perf = drf.model_performance(frs)      # first call: table build + compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = _t.time()
+        perf = drf.model_performance(frs)
+        best = min(best, _t.time() - t0)
+    return (f"drf_score_{n_rows//1000}k_{ntrees}t_d20_wall_s", best,
+            {"auc": round(float(perf.auc()), 5)})
+
+
 def bench_automl():
     """AutoML leaderboard (BASELINE.json config 5)."""
     n_rows = int(os.environ.get("BENCH_ROWS", 50_000))
@@ -186,6 +218,8 @@ R02_BASELINE = {
     "mnist_dl_60k_samples_per_s": 15850.0,
     "mslr_xgb_rank_200k_50trees_wall_s": 19.0,
     "automl_50k_8models_wall_s": 215.0,
+    # r03 per-level walk scorer on the same model/frame (BASELINE.md round-4)
+    "drf_score_50k_50t_d20_wall_s": 3.55,
 }
 
 # The remote-chip tunnel adds ±40% wall-time noise and its compile server
@@ -206,7 +240,8 @@ def main():
 
     config = os.environ.get("BENCH_CONFIG", "gbm")
     fn = {"gbm": bench_gbm, "glm": bench_glm, "dl": bench_dl,
-          "xgb_rank": bench_xgb_rank, "automl": bench_automl}[config]
+          "xgb_rank": bench_xgb_rank, "automl": bench_automl,
+          "score": bench_score}[config]
     repeats = int(os.environ.get("BENCH_REPEATS",
                                  DEFAULT_REPEATS.get(config, 1)))
     runs = []

@@ -673,16 +673,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def h_rapids(self):
         p = self._params()
+        # `rows` lets callers (e.g. Flow plot cells reading all hist bins)
+        # ask for more than the 10-row preview; capped at 10k. Parsed BEFORE
+        # evaluation so a malformed value cannot leak a computed frame into
+        # DKV on the 400 path.
+        rows = p.get("rows")
+        rows = 10 if rows in (None, "") else min(max(0, int(rows)), 10_000)
         sess = RapidsSession(DKV)
         res = sess.execute(p["ast"])
         if isinstance(res, Frame):
             if not getattr(res, "key", None):
                 res.key = f"rapids_{id(res)}"
             DKV.put(res.key, res)
-            # `rows` lets callers (e.g. Flow plot cells reading all hist
-            # bins) ask for more than the 10-row preview; capped at 10k.
-            rows = p.get("rows")
-            rows = 10 if rows in (None, "") else min(max(0, int(rows)), 10_000)
             self._send(dict(key=dict(name=res.key),
                             **_frame_summary(res, rows=rows)))
         elif isinstance(res, (int, float)):

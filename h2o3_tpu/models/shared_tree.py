@@ -634,6 +634,8 @@ class SharedTreeModel(H2OModel):
     @forest.setter
     def forest(self, v):
         self._forest = v
+        self.__dict__.pop("_padded_forests", None)
+        self.__dict__.pop("_score_tables", None)
 
     @property
     def covers(self):
@@ -651,6 +653,7 @@ class SharedTreeModel(H2OModel):
             self._materialize_host_forest()
             self._packed_dev = None
             self.__dict__.pop("_padded_forests", None)
+            self.__dict__.pop("_score_tables", None)
 
     def _materialize_host_forest(self):
         """The deferred forest D2H: one bulk transfer, then host slicing."""
@@ -749,13 +752,36 @@ class SharedTreeModel(H2OModel):
             return self._K_packed
         return len(self.forest)
 
+    def _score_table(self, k: int):
+        """Fused-scorer pack for class-k forest (treelib.build_score_table),
+        cached beside `_padded_forests` — scoring fresh frames is the hot
+        path for model_performance / AutoML leaderboard_frame / REST
+        Predictions, and the pack build (~150 ms) amortizes across them."""
+        cache = self.__dict__.setdefault("_score_tables", {})
+        if k not in cache:
+            cache[k] = treelib.build_score_table_jit(
+                self._padded_forest(k), max_depth=self.max_depth)
+            # the padded Tree slices are dead weight once the score pack
+            # exists (fused is the default path); drop them so deep-forest
+            # HBM peaks don't stack pack + padded forest + score table.
+            # `_padded_forest` rebuilds on demand for the walk fallback /
+            # tree-API consumers.
+            self.__dict__.get("_padded_forests", {}).pop(k, None)
+        return cache[k]
+
     # margin(s) on raw feature matrix
     def _margins(self, X: np.ndarray) -> np.ndarray:
         Xj = jnp.asarray(X, jnp.float32)
+        fused = os.environ.get("H2O3_FOREST_SCORER", "fused") != "walk"
         outs = []
         for k in range(self._n_class_forests):
-            s = treelib.predict_forest_raw(self._padded_forest(k), Xj,
-                                           self.max_depth)
+            if fused:
+                walk, value = self._score_table(k)
+                s = treelib.predict_forest_fused(walk, value, Xj,
+                                                 self.max_depth)
+            else:
+                s = treelib.predict_forest_raw(self._padded_forest(k), Xj,
+                                               self.max_depth)
             f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[k]
             outs.append(np.asarray(s, np.float64) + f0k)
         return np.column_stack(outs)

@@ -756,6 +756,134 @@ def stack_trees(trees) -> Tree:
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def predict_forest_raw(forest: Tree, X: jax.Array, max_depth: int) -> jax.Array:
     """Σ over trees of leaf values — (N,) or (ntrees, N) summed. The scoring
-    analog of `hex/Model.score0` / `BigScore` MRTask (hex/Model.java)."""
+    analog of `hex/Model.score0` / `BigScore` MRTask (hex/Model.java).
+
+    Reference walk, one gather-round per level. The production scoring path
+    is `build_score_table` + `predict_forest_fused` below (~10× faster on
+    deep forests); this stays as the oracle the fused path is tested
+    against."""
     per_tree = jax.vmap(lambda t: predict_raw(t, X, max_depth))(forest)
     return per_tree.sum(axis=0)
+
+
+# ---- fused forest scoring: subtree-fetch walk ---------------------------
+#
+# The per-level walk above issues one random gather per level per tree; on
+# TPU a gather costs ~13 ns per gathered ROW regardless of row width (the
+# payload rides the same HBM fetch), so a depth-20 forest pays 21
+# gather-rounds where 5 would do. The fused scorer restructures the tree
+# into per-round "subtree rows": one 128-lane row holds the (feat, split,
+# thr) records of a node's next _SCORE_K levels (2^K-1 records × 2 f32),
+# so each fetch round descends K levels using only in-register one-hot
+# selects between fetches. A depth-20 walk = 4 subtree fetches + 1 leaf
+# value gather (measured 386 ms vs 4379 ms for 64 trees × 50k rows on
+# TPU v5e; depth-5: 118 ms vs 615 ms).
+#
+# Scoring analog of `hex/genmodel/algos/tree/SharedTreeMojoModel.scoreTree`
+# / `hex/Model.java` BigScore — redesigned for TPU memory semantics.
+
+_SCORE_K = 5                 # levels per fetch round: 2*(2^5-1)=62 ≤ 64 lanes
+_SCORE_W2 = 64               # f32 lanes per anchor block
+_SCORE_FOLD = 2              # anchor blocks per 128-lane row (8,128 tiling)
+_XV_ONEHOT_MAX = 128         # one-hot X-value fetch only for F ≤ this
+
+
+def score_round_meta(max_depth: int):
+    """Static round plan: (base_level, levels_this_round, row_offset)."""
+    meta, base, row_off = [], 0, 0
+    while base < max_depth:
+        k = min(_SCORE_K, max_depth - base)
+        A = 2 ** base
+        meta.append((base, k, row_off))
+        row_off += (A + _SCORE_FOLD - 1) // _SCORE_FOLD
+        base += k
+    return tuple(meta), row_off
+
+
+def build_score_table(forest: Tree, max_depth: int):
+    """Heap forest → (walk, value): walk (nt, ROWS, 128) f32 subtree rows,
+    value (nt, T) f32 leaf values. Jittable; one-time per model, cache the
+    result. Minor dim is exactly 128 lanes so the (8,128) device tiling
+    adds no padding (a (T, 6) minor dim would pad 21×)."""
+    feat = jnp.asarray(forest.feat)
+    nt, T = feat.shape
+    enc = feat.astype(jnp.float32) * 2.0 + forest.is_split.astype(jnp.float32)
+    thr = forest.thr.astype(jnp.float32)
+    meta, _ = score_round_meta(max_depth)
+    if not meta:                              # depth-0 stumps: root value only
+        return jnp.zeros((nt, 1, _SCORE_FOLD * _SCORE_W2), jnp.float32), \
+            forest.value.astype(jnp.float32)
+    rows = []
+    for (base, k, _row_off) in meta:
+        A = 2 ** base
+        recs = []
+        for level in range(k):
+            lo = 2 ** (base + level) - 1
+            cnt = 2 ** level
+            e = jax.lax.dynamic_slice_in_dim(enc, lo, A * cnt, 1)
+            t = jax.lax.dynamic_slice_in_dim(thr, lo, A * cnt, 1)
+            recs.append(jnp.stack([e.reshape(nt, A, cnt),
+                                   t.reshape(nt, A, cnt)],
+                                  axis=-1).reshape(nt, A, 2 * cnt))
+        blk = jnp.concatenate(recs, axis=-1)          # (nt, A, 2*(2^k-1))
+        pad = _SCORE_W2 - blk.shape[-1]
+        if pad:
+            blk = jnp.pad(blk, ((0, 0), (0, 0), (0, pad)))
+        if A % _SCORE_FOLD:
+            blk = jnp.pad(blk, ((0, 0), (0, _SCORE_FOLD - A % _SCORE_FOLD),
+                                (0, 0)))
+        rows.append(blk.reshape(nt, -1, _SCORE_FOLD * _SCORE_W2))
+    walk = jnp.concatenate(rows, axis=1)
+    return walk, forest.value.astype(jnp.float32)
+
+
+build_score_table_jit = jax.jit(build_score_table,
+                                static_argnames=("max_depth",))
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_forest_fused(walk: jax.Array, value: jax.Array, X: jax.Array,
+                         max_depth: int) -> jax.Array:
+    """Σ over trees of leaf values from a `build_score_table` pack.
+    Matches `predict_forest_raw` (incl. NaN → right) to reduction-order
+    rounding."""
+    nt = walk.shape[0]
+    N, F = X.shape
+    node = jnp.zeros((nt, N), jnp.int32)
+    fi = jnp.arange(F, dtype=jnp.int32)
+    Xb = X[None]
+    X_flat = X.reshape(-1)
+    row_iota = jnp.arange(N, dtype=jnp.int32)[None, :]
+    meta, _ = score_round_meta(max_depth)
+    for (base, k, row_off) in meta:
+        lvl_base = 2 ** base - 1
+        a = jnp.clip(node - lvl_base, 0, 2 ** base - 1)
+        # a row is live in this round iff its node reached level `base`
+        # (rows frozen at shallower leaves keep node < lvl_base forever)
+        active = node >= lvl_base
+        ridx = (a >> 1) + row_off
+        frow = jnp.take_along_axis(walk, ridx[:, :, None], axis=1)
+        blk01 = jnp.where(((a & 1) == 1)[..., None],
+                          frow[..., _SCORE_W2:], frow[..., :_SCORE_W2])
+        rel = jnp.zeros_like(node)
+        for level in range(k):
+            cnt = 2 ** level
+            rbase = 2 * (cnt - 1)
+            blk = blk01[..., rbase: rbase + 2 * cnt].reshape(nt, N, cnt, 2)
+            oh = rel[..., None] == jnp.arange(cnt, dtype=jnp.int32)
+            e = jnp.where(oh, blk[..., 0], 0.0).sum(-1)
+            t = jnp.where(oh, blk[..., 1], 0.0).sum(-1)
+            ei = e.astype(jnp.int32)
+            sp = (ei & 1) == 1
+            f = ei >> 1
+            if F <= _XV_ONEHOT_MAX:
+                xv = jnp.where(f[..., None] == fi, Xb, 0.0).sum(-1)
+            else:
+                xv = jnp.take(X_flat, row_iota * F + f, mode="clip")
+            right = (jnp.isnan(xv) | (xv > t)).astype(jnp.int32)
+            go = active & sp
+            node = jnp.where(go, 2 * node + 1 + right, node)
+            rel = jnp.where(go, 2 * rel + right, rel)
+            active = go
+    v = jnp.take_along_axis(value, node, axis=1)
+    return v.sum(axis=0)

@@ -1,0 +1,113 @@
+"""Fused forest scoring (`build_score_table` + `predict_forest_fused`)
+must match the reference per-level walk (`predict_forest_raw`) bit-for-bit
+up to reduction-order rounding, across depths, raggedness, NaNs, and the
+large-F gather fallback. Scoring analog of the in-cluster ≡ MOJO parity
+tests upstream keeps for `SharedTreeMojoModel.scoreTree`."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from h2o3_tpu.models import tree as treelib
+
+
+def _random_forest(rng, nt, depth, F, frac_leaf=0.15):
+    T = treelib.heap_size(depth)
+    feat = rng.integers(0, F, size=(nt, T)).astype(np.int32)
+    thr = rng.normal(size=(nt, T)).astype(np.float32)
+    issp = np.zeros((nt, T), bool)
+    issp[:, : 2 ** depth - 1] = True
+    issp[rng.random((nt, T)) < frac_leaf] = False
+    val = (rng.normal(size=(nt, T)) * 0.1).astype(np.float32)
+    return treelib.Tree(jnp.asarray(feat), jnp.asarray(feat),
+                        jnp.asarray(thr), jnp.asarray(issp),
+                        jnp.asarray(val))
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4, 5, 6, 8, 11])
+def test_fused_matches_walk(depth):
+    rng = np.random.default_rng(depth)
+    F = 7
+    forest = _random_forest(rng, nt=6, depth=depth, F=F)
+    X = rng.normal(size=(257, F)).astype(np.float32)
+    X[rng.random(X.shape) < 0.05] = np.nan
+    Xj = jnp.asarray(X)
+    ref = np.asarray(treelib.predict_forest_raw(forest, Xj, depth))
+    walk, value = treelib.build_score_table_jit(forest, max_depth=depth)
+    out = np.asarray(treelib.predict_forest_fused(walk, value, Xj, depth))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
+
+
+def test_fused_large_f_gather_fallback():
+    """F > _XV_ONEHOT_MAX exercises the flat-gather X fetch branch."""
+    rng = np.random.default_rng(0)
+    F = treelib._XV_ONEHOT_MAX + 5
+    forest = _random_forest(rng, nt=3, depth=4, F=F)
+    X = rng.normal(size=(64, F)).astype(np.float32)
+    X[rng.random(X.shape) < 0.05] = np.nan
+    Xj = jnp.asarray(X)
+    ref = np.asarray(treelib.predict_forest_raw(forest, Xj, 4))
+    walk, value = treelib.build_score_table_jit(forest, max_depth=4)
+    out = np.asarray(treelib.predict_forest_fused(walk, value, Xj, 4))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
+
+
+def test_fused_depth_zero_stumps():
+    rng = np.random.default_rng(1)
+    T = 1
+    forest = treelib.Tree(jnp.zeros((4, T), jnp.int32),
+                          jnp.zeros((4, T), jnp.int32),
+                          jnp.zeros((4, T), jnp.float32),
+                          jnp.zeros((4, T), bool),
+                          jnp.asarray(rng.normal(size=(4, T)),
+                                      jnp.float32))
+    X = jnp.asarray(rng.normal(size=(10, 3)), jnp.float32)
+    ref = np.asarray(treelib.predict_forest_raw(forest, X, 0))
+    walk, value = treelib.build_score_table_jit(forest, max_depth=0)
+    out = np.asarray(treelib.predict_forest_fused(walk, value, X, 0))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-6)
+
+
+def test_fused_padded_zero_trees():
+    """Zero-padded trees (pow2 tree-count bucketing) contribute exactly 0."""
+    rng = np.random.default_rng(2)
+    forest = _random_forest(rng, nt=5, depth=3, F=4)
+    zpad = treelib.Tree(*[jnp.concatenate(
+        [np.asarray(f), np.zeros((3,) + np.asarray(f).shape[1:],
+                                 np.asarray(f).dtype)], axis=0)
+        for f in forest])
+    X = jnp.asarray(rng.normal(size=(50, 4)), jnp.float32)
+    ref = np.asarray(treelib.predict_forest_raw(forest, X, 3))
+    walk, value = treelib.build_score_table_jit(zpad, max_depth=3)
+    out = np.asarray(treelib.predict_forest_fused(walk, value, X, 3))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-6)
+
+
+def test_model_margins_fused_equals_walk(cloud1, monkeypatch, tmp_path):
+    """End-to-end: a trained GBM scores a FRESH frame identically through
+    the fused scorer and the reference walk."""
+    import h2o3_tpu as h2o
+    from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(3)
+    n = 400
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    csv = tmp_path / "t.csv"
+    with open(csv, "w") as f:
+        f.write("a,b,c,d,y\n")
+        for i in range(n):
+            f.write(",".join(f"{v:.5f}" for v in X[i]) + f",{y[i]}\n")
+    fr = h2o.import_file(str(csv))
+    fr["y"] = fr["y"].asfactor()
+    m = H2OGradientBoostingEstimator(ntrees=8, max_depth=4, seed=1)
+    m.train(x=["a", "b", "c", "d"], y="y", training_frame=fr)
+    Xnew = rng.normal(size=(97, 4)).astype(np.float32)
+    Xnew[rng.random(Xnew.shape) < 0.05] = np.nan
+    mb = m._model
+    monkeypatch.setenv("H2O3_FOREST_SCORER", "walk")
+    ref = mb._margins(Xnew)
+    mb.__dict__.pop("_score_tables", None)
+    monkeypatch.setenv("H2O3_FOREST_SCORER", "fused")
+    out = mb._margins(Xnew)
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
