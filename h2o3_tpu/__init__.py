@@ -37,12 +37,35 @@ __version__ = "0.1.0"
 
 from .runtime.dkv import DKV as _DKV  # the keyed store (water/DKV.java)
 from .runtime.log import Log as _Log
+from . import client  # remote-attach REST client (h2o-py H2OConnection)
 
 
 def init(url=None, ip=None, port=None, nthreads=-1, max_mem_size=None,
          strict_version_check=False, **kw):
-    """`h2o.init()` — form the local cloud (mesh over visible devices)."""
+    """`h2o.init()` — form the local cloud (mesh over visible devices), or,
+    with `url=`/`ip=`/`port=`, attach to a RUNNING server as a thin REST
+    client (h2o-py/h2o/h2o.py `init` → `H2OConnection.open`). An explicit
+    endpoint that is unreachable raises — no silent local fallback."""
+    if url is not None or ip is not None or port is not None:
+        return client.connect(url=url, ip=ip, port=port,
+                              token=kw.get("token"),
+                              verbose=kw.get("verbose", True))
     return _mesh.init()
+
+
+def connect(url=None, ip=None, port=None, **kw):
+    """`h2o.connect(url=)` — attach to a running server by URL; with no
+    endpoint, form the local in-process cloud (h2o-py parity)."""
+    if url is not None or ip is not None or port is not None:
+        return client.connect(url=url, ip=ip, port=port,
+                              token=kw.get("token"),
+                              verbose=kw.get("verbose", True))
+    return init()
+
+
+def connection():
+    """The active remote connection, or None when in-process."""
+    return client.current_connection()
 
 
 def cluster():
@@ -58,17 +81,21 @@ def cluster():
     return _ClusterInfo()
 
 
-def connect(**kw):
-    return init()
-
-
 def shutdown(prompt=False):
+    if client.current_connection() is not None:
+        client.disconnect()
+        return
     _mesh.reset()
     _DKV.clear()
 
 
 def import_file(path: str, destination_frame=None, header=0, sep=None,
-                col_names=None, col_types=None, **kw) -> Frame:
+                col_names=None, col_types=None, **kw):
+    conn = client.current_connection()
+    if conn is not None:
+        return conn.import_file(path, destination_frame=destination_frame,
+                                sep=sep, col_names=col_names,
+                                col_types=col_types)
     fr = _import_file(
         path,
         sep=sep,
@@ -83,14 +110,48 @@ def import_file(path: str, destination_frame=None, header=0, sep=None,
     return fr
 
 
-upload_file = import_file
+def upload_file(path: str, destination_frame=None, sep=None, col_names=None,
+                col_types=None, **kw):
+    conn = client.current_connection()
+    if conn is not None:
+        # client-side bytes travel to the server (PostFile + Parse)
+        return conn.upload_file(path, destination_frame=destination_frame,
+                                sep=sep, col_names=col_names,
+                                col_types=col_types)
+    return import_file(path, destination_frame=destination_frame, sep=sep,
+                       col_names=col_names, col_types=col_types, **kw)
 
 
-def H2OFrame_from_python(data, column_types=None, column_names=None) -> Frame:
-    return Frame(data, column_names=column_names, column_types=column_types)
+def H2OFrame_from_python(data, column_types=None, column_names=None):
+    conn = client.current_connection()
+    if conn is None:
+        return Frame(data, column_names=column_names,
+                     column_types=column_types)
+    # connected: python data belongs ON the server (h2o-py H2OFrame(obj)
+    # uploads to the cluster). Serialize through the local Frame builder
+    # (type inference, NA handling), ship CSV bytes, parse with the
+    # inferred/requested types; the local temporary never enters the DKV.
+    import io
+
+    fr = Frame(data, column_names=column_names, column_types=column_types)
+    _DKV.remove(fr.key)
+    buf = io.StringIO()
+    cols = fr.as_data_frame(use_pandas=False)
+    buf.write(",".join(fr.names) + "\n")
+    mats = [cols[n] for n in fr.names]
+    for i in range(fr.nrow):
+        buf.write(",".join(
+            "" if v is None or (isinstance(v, float) and np.isnan(v))
+            else str(v) for v in (m[i] for m in mats)) + "\n")
+    types = [fr.vec(n).type for n in fr.names]
+    return conn.upload_bytes(buf.getvalue().encode(), "pyframe.csv",
+                             col_names=list(fr.names), col_types=types)
 
 
-def get_frame(key: str) -> Frame:
+def get_frame(key: str):
+    conn = client.current_connection()
+    if conn is not None:
+        return conn.get_frame(key)
     fr = _DKV.get(key)
     if not isinstance(fr, Frame):
         raise KeyError(key)
@@ -179,7 +240,13 @@ def export_file(frame: Frame, path: str, force: bool = False, sep: str = ",",
 
 
 def get_model(model_id: str):
-    """`h2o.get_model` — fetch a trained model from the DKV by id."""
+    """`h2o.get_model` — fetch a trained model from the DKV by id (or from
+    the attached server when connected remotely)."""
+    conn = client.current_connection()
+    if conn is not None:
+        m = client.RemoteModel(conn, model_id)
+        m._json()          # 404 now, not on first use
+        return m
     m = _DKV.get(model_id)
     if m is None:
         raise KeyError(model_id)
@@ -303,7 +370,11 @@ def interaction(data: Frame, factors, pairwise: bool, max_factors: int,
 
 
 def rapids(expr: str):
-    """`h2o.rapids` — evaluate a Rapids sexpr against the DKV."""
+    """`h2o.rapids` — evaluate a Rapids sexpr against the DKV (routed over
+    `/99/Rapids` when attached to a remote server)."""
+    conn = client.current_connection()
+    if conn is not None:
+        return conn.rapids(expr)
     from .frame.rapids_expr import RapidsSession
 
     return RapidsSession(_DKV).execute(expr)

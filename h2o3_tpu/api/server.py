@@ -262,10 +262,13 @@ class _Handler(BaseHTTPRequestHandler):
                                       value=h2o3_tpu.__version__)]))
 
     def h_import_files(self):
-        import h2o3_tpu as h2o
+        # the internal parser, NOT h2o.import_file: the package-level surface
+        # routes to an attached remote server, and a process acting as BOTH
+        # server and client (notebook + local server) must not loop back
+        from ..frame.parse import import_file as _parse_import
 
         p = self._params()
-        fr = h2o.import_file(p["path"])
+        fr = _parse_import(p["path"])
         DKV.put(fr.key, fr)
         self._send(dict(destination_frames=[fr.key], fails=[], dels=[]))
 
@@ -286,13 +289,33 @@ class _Handler(BaseHTTPRequestHandler):
         ))
 
     def h_parse(self):
-        import h2o3_tpu as h2o
+        from ..frame.parse import import_file as _parse_import
 
         p = self._params()
         paths = p.get("source_frames")
         if isinstance(paths, str):
             paths = json.loads(paths) if paths.startswith("[") else [paths]
-        fr = h2o.import_file(paths[0].strip('"'))
+        # ParseSetup-style overrides (water/parser ParseSetupV3 fields):
+        # separator/column_names/column_types ride the Parse request so
+        # remote clients get the same parse control as in-process callers
+        sep = p.get("separator") or None
+        if isinstance(sep, str) and sep.isdigit():
+            sep = chr(int(sep))                # upstream sends a byte value
+        col_names = p.get("column_names")
+        if isinstance(col_names, str):
+            col_names = json.loads(col_names)
+        col_types = p.get("column_types")
+        if isinstance(col_types, str):
+            col_types = json.loads(col_types)
+        if isinstance(col_types, list):
+            # ParseV3 sends types positionally; the parser wants name→type
+            names_for_types = col_names
+            if not names_for_types:
+                probe = _parse_import(paths[0].strip('"'), sep=sep)
+                names_for_types = probe.names
+            col_types = dict(zip(names_for_types, col_types))
+        fr = _parse_import(paths[0].strip('"'), sep=sep,
+                           col_names=col_names, col_types=col_types)
         dest = p.get("destination_frame")
         if dest:
             fr.key = dest
@@ -354,7 +377,11 @@ class _Handler(BaseHTTPRequestHandler):
         import h2o3_tpu as h2o
 
         p = self._params()
-        m = h2o.get_model(model_id)
+        # DKV directly, NOT h2o.get_model: the package surface routes to an
+        # attached remote connection (server+client in one process)
+        m = DKV.get(model_id)
+        if m is None:
+            raise KeyError(model_id)
         path = h2o.save_model(m, p.get("dir") or ".",
                               force=self._flag(p, "force"))
         self._send(dict(path=path))

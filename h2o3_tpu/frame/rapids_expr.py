@@ -291,7 +291,14 @@ class RapidsSession:
             return val  # prim name or bare symbol
         # call
         op = val[0][1] if val[0][0] == "sym" else self._eval(val[0])
-        args = [self._eval(a) for a in val[1:]]
+        if op in ("assign", "tmp=", "rm") and val[1:] and val[1][0] == "sym":
+            # the TARGET key is a literal, never resolved: `(assign rt ...)`
+            # must rebind "rt" even when "rt" already names a frame (AstAssign
+            # destination-key semantics; evaluating it would store under the
+            # old frame's repr and leave the stale binding live)
+            args = [val[1][1]] + [self._eval(a) for a in val[2:]]
+        else:
+            args = [self._eval(a) for a in val[1:]]
         return self._apply(op, args)
 
     # -- prims ---------------------------------------------------------------

@@ -638,6 +638,14 @@ class H2OEstimator:
             raise ValueError("training_frame is required")
         if self._is_supervised() and y is None:
             raise ValueError(f"{self.algo}: response column y is required")
+        if getattr(training_frame, "_is_remote", False):
+            # the frame lives on an attached server: train over REST and
+            # bind a RemoteModel — the delegation surface below then works
+            # unchanged (h2o-py estimator_base semantics). Dispatch AFTER
+            # the client-side arg validation so bad calls raise locally.
+            from ..client import remote_train
+
+            return remote_train(self, x, y, training_frame, validation_frame)
         ignored = set(self._parms.get("ignored_columns") or [])
         if x is None:
             x = [

@@ -1,0 +1,193 @@
+"""Remote-attach client e2e (VERDICT r03 #3): the server runs in a
+SEPARATE process; the client connects by URL only and round-trips
+upload → munge → train → predict → metrics without touching any
+in-process state. Reference: `h2o-py/h2o/backend/connection.py` —
+upstream's client is fundamentally a REST client."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.client import (H2OConnectionError, H2OServerError,
+                             RemoteFrame, RemoteModel)
+from h2o3_tpu.runtime.dkv import DKV
+
+_SERVER_SRC = """
+import sys, time
+from h2o3_tpu.api.server import start_server
+import h2o3_tpu as h2o
+h2o.init()
+srv = start_server(port=0, auth_token={token!r})
+print(srv.port, flush=True)
+time.sleep(600)
+"""
+
+
+@pytest.fixture(scope="module")
+def remote_server():
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SRC.format(token=None)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True)
+    try:
+        port = int(proc.stdout.readline())
+        yield f"http://127.0.0.1:{port}"
+    finally:
+        proc.kill()
+        proc.wait()
+    h2o.shutdown()
+
+
+@pytest.fixture()
+def csvfile(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    p = tmp_path / "remote.csv"
+    with open(p, "w") as f:
+        f.write("a,b,c,y\n")
+        for i in range(n):
+            f.write(",".join(f"{v:.4f}" for v in X[i]) + f",{y[i]}\n")
+    return str(p)
+
+
+def test_connect_unreachable_raises():
+    with pytest.raises(H2OConnectionError):
+        h2o.connect(url="http://127.0.0.1:9", verbose=False)
+    assert h2o.connection() is None
+
+
+def test_remote_roundtrip_train_predict_metrics(remote_server, csvfile):
+    conn = h2o.connect(url=remote_server)
+    try:
+        assert h2o.connection() is conn
+        local_keys_before = set(DKV.keys())
+
+        # upload: client-side bytes travel over PostFile + Parse
+        fr = h2o.upload_file(csvfile, destination_frame="remote_train")
+        assert isinstance(fr, RemoteFrame)
+        assert fr.shape == (400, 4)
+        assert fr.names == ["a", "b", "c", "y"]
+
+        # munge: asfactor through Rapids assigns
+        fr["y"] = fr["y"].asfactor()
+        assert fr.types["y"] == "enum"
+
+        # train through /3/ModelBuilders + /3/Jobs polling — the NORMAL
+        # estimator surface, no in-process code path
+        from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+
+        m = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+        m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+        assert isinstance(m._model, RemoteModel)
+        assert m.auc() > 0.8
+        assert m.model_id.startswith("gbm")
+
+        # predict on the server; fetch the head through the client
+        pred = m.predict(fr)
+        assert isinstance(pred, RemoteFrame)
+        assert pred.names[0] == "predict"
+        assert pred.nrow == 400
+
+        # fresh-frame metrics via /3/ModelMetrics
+        perf = m.model_performance(fr)
+        assert perf.auc() > 0.8
+
+        # h2o.get_model round-trips by id
+        again = h2o.get_model(m.model_id)
+        assert isinstance(again, RemoteModel)
+        assert again.algo == "gbm"
+
+        # nothing leaked into THIS process's DKV
+        assert set(DKV.keys()) == local_keys_before
+    finally:
+        h2o.shutdown()   # disconnect; later tests are in-process again
+    assert h2o.connection() is None
+
+
+def test_remote_import_server_side_path(remote_server, csvfile):
+    h2o.init(url=remote_server)
+    try:
+        fr = h2o.import_file(csvfile)   # path resolved ON the server
+        assert isinstance(fr, RemoteFrame)
+        assert fr.nrow == 400
+        cols = fr[["a", "b"]]
+        assert cols.ncol == 2
+        fr.delete()
+        with pytest.raises(H2OServerError):
+            h2o.get_frame(fr.key)
+    finally:
+        h2o.shutdown()
+
+
+def test_remote_auth_token(csvfile):
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SRC.format(token="sekrit")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True)
+    try:
+        url = f"http://127.0.0.1:{int(proc.stdout.readline())}"
+        # /3/Cloud stays open for discovery, so connect() itself succeeds;
+        # every OTHER route 401s without the bearer token
+        conn = h2o.connect(url=url, verbose=False)
+        with pytest.raises(H2OServerError) as e:
+            conn.get("/3/Models")
+        assert e.value.status == 401
+        conn = h2o.connect(url=url, token="sekrit", verbose=False)
+        assert "models" in conn.get("/3/Models")
+    finally:
+        proc.kill()
+        proc.wait()
+        h2o.shutdown()
+
+
+def test_remote_train_validates_args_locally(remote_server, csvfile):
+    """Bad train() calls raise client-side (ValueError), not as a FAILED
+    server job surfacing RuntimeError."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+
+        fr = h2o.upload_file(csvfile)
+        with pytest.raises(ValueError, match="response column"):
+            H2OGradientBoostingEstimator(ntrees=2).train(training_frame=fr)
+    finally:
+        h2o.shutdown()
+
+
+def test_remote_frame_from_python_and_parse_options(remote_server, tmp_path):
+    """H2OFrame_from_python uploads to the server when connected; parse
+    options (sep/col_types) ride /3/Parse instead of being dropped."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        fr = h2o.H2OFrame_from_python(
+            {"a": [1.0, 2.0, 3.0], "lab": ["x", "y", "x"]},
+            column_types={"lab": "enum"})
+        assert isinstance(fr, RemoteFrame)
+        assert fr.nrow == 3 and fr.types["lab"] == "enum"
+
+        ssv = tmp_path / "t.ssv"
+        ssv.write_text("a;b\n1;2\n3;4\n")
+        fr2 = h2o.import_file(str(ssv), sep=";")
+        assert fr2.names == ["a", "b"] and fr2.ncol == 2
+
+        # local validation_frame with remote training_frame raises loudly
+        from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+        from h2o3_tpu.frame.frame import Frame
+        import numpy as np
+
+        with pytest.raises(TypeError, match="RemoteFrame"):
+            est = H2OGradientBoostingEstimator(ntrees=2)
+            est.train(y="lab", training_frame=fr,
+                      validation_frame=Frame.from_numpy(
+                          np.zeros((3, 2)), names=["a", "b"]))
+    finally:
+        h2o.shutdown()
