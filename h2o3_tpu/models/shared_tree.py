@@ -131,6 +131,64 @@ def _valid_margin_update(packed, codes_v, margins_v, k, max_depth: int):
     return margins_v.at[:, k].add(per_tree.sum(axis=0))
 
 
+# ---- DART dropout boosting (xgboost booster=dart; dart.cc) --------------
+#
+# Dropout granularity is a boosting ROUND: all K class trees of a round
+# drop together, with one scale per round on the STORED (learn-rate-folded)
+# leaf contributions. Commit normalization per xgboost docs: with k rounds
+# dropped and learning rate lr, "tree" scales dropped rounds by k/(k+lr)
+# and the new round by 1/(k+lr); "forest" scales both by 1/(1+lr).
+# Scales are tracked host-side and baked into the packed leaf values after
+# the loop, so scoring / MOJO / TreeSHAP see ordinary trees.
+
+
+def _round_contribs(pk, codes, max_depth: int):
+    """One packed round (K, T, C) → (N, K) leaf contributions on codes."""
+    K = pk.shape[0]
+    cs = []
+    for k in range(K):
+        t = treelib.Tree(pk[k, :, 0].astype(jnp.int32),
+                         pk[k, :, 1].astype(jnp.int32),
+                         pk[k, :, 2], pk[k, :, 3] > 0.5, pk[k, :, 4])
+        cs.append(treelib.predict_codes(t, codes, max_depth))
+    return jnp.stack(cs, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _dart_drop_sum_jit(chunks, scales, codes, max_depth: int):
+    """Σ over selected rounds of scale·leaf values → (N, K) margin mass.
+    `chunks` is a pow2-padded TUPLE of (1, K, T, C) round packs — selected
+    host-side so the work is O(dropped), and concatenated INSIDE the jit so
+    process-spanning (multi-host) arrays are handled; zero-scale pad
+    entries contribute exactly 0."""
+    packed_sel = jnp.concatenate(chunks, axis=0)
+    return jax.vmap(
+        lambda pk, s: s * _round_contribs(pk, codes, max_depth)
+    )(packed_sel, scales).sum(axis=0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _dart_sub_jit(margins, dsum):
+    return margins - dsum
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("max_depth",))
+def _dart_fix_jit(margins, packed_new, dsum, codes, c_coef, d_coef,
+                  max_depth: int):
+    """margins + c_coef·(new round's contribution) + d_coef·dsum — the
+    post-step normalization (coefficients differ between the training
+    margins, which already had dsum subtracted, and validation margins,
+    which did not)."""
+    c_new = _round_contribs(packed_new[0], codes, max_depth)
+    return margins + c_coef * c_new + d_coef * dsum
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _dart_scale_jit(pk, s):
+    return pk.at[..., 4].multiply(s)
+
+
 def probs_from_margins(mode, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:
     """margins → predictions, shared by train-time scoring and model.predict
     (single source of truth for the per-mode link mapping)."""
@@ -1634,7 +1692,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
             custom_obj is not None
             or bool(self._parms.get("score_each_iteration"))
         )
-        if need_host_each:
+        dart = tp.get("dart")
+        if need_host_each or dart:
             chunk = 1
         elif score_interval:
             chunk = score_interval
@@ -1704,8 +1763,41 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 packed_host.append(np.asarray(pk))
             packed_chunks.clear()
             dev_bytes = 0
+        # DART per-round state: one stored-contribution scale per committed
+        # round (host floats), a dedicated RNG (deterministic from seed)
+        dart_scales: List[float] = []
+        dart_rng = np.random.default_rng(
+            (int(self._parms["_actual_seed"]) + 7919) & 0x7FFFFFFF)
         while m < ntrees_target:
             nsteps = min(chunk, ntrees_target - m)
+            drop_idx = ()
+            dsum = dsum_v = None
+            if dart and m > 0 and dart_rng.random() >= dart["skip_drop"]:
+                mask = dart_rng.random(m) < dart["rate_drop"]
+                if dart["one_drop"] and not mask.any():
+                    mask[int(dart_rng.integers(0, m))] = True
+                drop_idx = tuple(int(i) for i in np.nonzero(mask)[0])
+            if drop_idx:
+                # margins_eff = margins − Σ dropped rounds (this run's
+                # rounds only; a checkpointed prior forest stays frozen).
+                # Host-side selection of the dropped round packs keeps the
+                # device work O(dropped); padded to pow2 (zero scales) to
+                # bound program variants.
+                nb = 1 << (len(drop_idx) - 1).bit_length()
+                sel_chunks = tuple(packed_chunks[i] for i in drop_idx)
+                sel_chunks += (packed_chunks[drop_idx[0]],) * (
+                    nb - len(drop_idx))
+                sc = np.zeros(nb, np.float32)
+                sc[: len(drop_idx)] = [dart_scales[i] for i in drop_idx]
+                sc_d = jnp.asarray(sc)
+                dsum = _dart_drop_sum_jit(sel_chunks, sc_d, codes_d,
+                                          tp["max_depth"])
+                margins = _dart_sub_jit(margins, dsum)
+                cloudlib.collective_fence(margins)
+                if valid_state is not None:
+                    dsum_v = _dart_drop_sum_jit(sel_chunks, sc_d,
+                                                valid_state[0],
+                                                tp["max_depth"])
             if custom_obj is not None:
                 g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
                 margins, packed, gains = _single_jit(
@@ -1743,7 +1835,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
             packed_chunks.append(packed)
             gains_chunks.append(gains)
             dev_bytes += int(np.prod(packed.shape)) * 4
-            if dev_bytes > _PACK_BUDGET:
+            if dev_bytes > _PACK_BUDGET and not dart:
+                # dart never flushes: dropout selection needs every prior
+                # round on device (dart forests are shallow/small)
                 _flush_packed()
             if valid_state is not None:
                 for k in range(K):
@@ -1751,6 +1845,31 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         packed, valid_state[0], valid_state[2],
                         jnp.int32(k), tp["max_depth"])
                 cloudlib.collective_fence(valid_state[2])
+            if dart:
+                k_d = len(drop_idx)
+                if k_d:
+                    lr = tp["learn_rate"]
+                    if dart["normalize_type"] == "forest":
+                        fd = fn = 1.0 / (1.0 + lr)
+                    else:                      # "tree"
+                        fd = k_d / (k_d + lr)
+                        fn = 1.0 / (k_d + lr)
+                    margins = _dart_fix_jit(
+                        margins, packed, dsum, codes_d,
+                        jnp.float32(fn - 1.0), jnp.float32(fd),
+                        tp["max_depth"])
+                    cloudlib.collective_fence(margins)
+                    if valid_state is not None:
+                        valid_state[2] = _dart_fix_jit(
+                            valid_state[2], packed, dsum_v, valid_state[0],
+                            jnp.float32(fn - 1.0), jnp.float32(fd - 1.0),
+                            tp["max_depth"])
+                        cloudlib.collective_fence(valid_state[2])
+                    for i in drop_idx:
+                        dart_scales[i] *= fd
+                    dart_scales.append(fn)
+                else:
+                    dart_scales.append(1.0)
             if _PROFILE:
                 _ph.mark(f"chunk_{m}_{nsteps}trees", sync=margins)
             m += nsteps
@@ -1811,6 +1930,16 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     break
             if self.job:
                 self.job.update(built / max(ntrees_target, 1))
+
+        if dart:
+            # bake the per-round DART scales into the stored leaf values so
+            # scoring / MOJO / TreeSHAP see ordinary trees (xgboost keeps a
+            # parallel weight_drop vector; baking is equivalent and keeps
+            # every downstream surface unchanged)
+            for i, s in enumerate(dart_scales[: len(packed_chunks)]):
+                if s != 1.0:
+                    packed_chunks[i] = _dart_scale_jit(packed_chunks[i],
+                                                       jnp.float32(s))
 
         # ---- forest stays ON DEVICE; host materialization is lazy --------
         # Deep heaps are big (depth-18 ⇒ 12.6 MB/tree) and a remote-chip

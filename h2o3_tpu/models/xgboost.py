@@ -104,6 +104,14 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
             max_abs_leaf=min(
                 float(p.get("max_abs_leafnode_pred", 0) or 0) or np.inf,
                 float(p.get("max_delta_step", 0) or 0) or np.inf),
+            # DART dropout boosting (h2o-ext-xgboost booster=dart
+            # passthrough; xgboost dart.cc). Dropout granularity here is a
+            # boosting ROUND (all K class trees of the round together).
+            dart=(dict(rate_drop=float(p.get("rate_drop", 0) or 0),
+                       one_drop=bool(p.get("one_drop", False)),
+                       skip_drop=float(p.get("skip_drop", 0) or 0),
+                       normalize_type=str(p.get("normalize_type", "tree")))
+                  if str(p.get("booster", "gbtree")) == "dart" else None),
         )
 
     def _check_params(self):
@@ -112,17 +120,22 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
         createParamsMap); training something silently different is worse
         than failing."""
         p = self._parms
-        if str(p.get("booster", "gbtree")) == "dart":
-            raise ValueError(
-                "booster='dart' (DART dropout boosting) is not implemented "
-                "by tree_method=tpu_hist; use booster='gbtree'")
+        booster = str(p.get("booster", "gbtree"))
+        if booster not in ("gbtree", "dart"):
+            raise ValueError(f"booster={booster!r}: expected 'gbtree' or "
+                             "'dart' (gblinear is not a tree booster)")
         for k in ("rate_drop", "skip_drop"):
-            if float(p.get(k, 0) or 0) != 0.0:
-                raise ValueError(f"{k} is a DART parameter; booster='dart' "
-                                 "is not implemented by tpu_hist")
-        if bool(p.get("one_drop", False)):
-            raise ValueError("one_drop is a DART parameter; booster='dart' "
-                             "is not implemented by tpu_hist")
+            v = float(p.get(k, 0) or 0)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{k}={v}: must be in [0, 1]")
+            if v != 0.0 and booster != "dart":
+                raise ValueError(f"{k} is a DART parameter; set "
+                                 "booster='dart' to use it")
+        if bool(p.get("one_drop", False)) and booster != "dart":
+            raise ValueError("one_drop is a DART parameter; set "
+                             "booster='dart' to use it")
+        if str(p.get("normalize_type", "tree")) not in ("tree", "forest"):
+            raise ValueError("normalize_type must be 'tree' or 'forest'")
         gp = str(p.get("grow_policy", "depthwise"))
         if gp not in ("depthwise", "lossguide"):
             raise ValueError(f"grow_policy={gp!r}: expected 'depthwise' or "

@@ -589,3 +589,44 @@ def test_gbm_calibrate_two_process(tmp_path, cloud1):
     run_workers(2, CALIB_BODY.format(csv=p, ccsv=pc, out=out))
     got = np.load(out)["cal"]
     np.testing.assert_allclose(got, ref_cal, rtol=5e-3, atol=5e-3)
+
+
+DART_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+g = H2OXGBoostEstimator(booster="dart", rate_drop=0.3, one_drop=True,
+                        ntrees=8, max_depth=3, seed=5)
+g.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    np.savez({out!r}, auc=float(g.model.training_metrics.auc))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_dart_multiprocess_trains(tmp_path, cloud1):
+    """DART's drop/commit round adjustments (jit-concatenated chunk
+    selection) must run on a 2-process cloud; the dropout path is
+    host-RNG-deterministic so the AUC matches single-process closely."""
+    p = str(tmp_path / "dart.csv")
+    _write_gbm_csv(p)
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+
+    fr = h2o.import_file(p)
+    fr["y"] = fr["y"].asfactor()
+    ref = H2OXGBoostEstimator(booster="dart", rate_drop=0.3, one_drop=True,
+                              ntrees=8, max_depth=3, seed=5)
+    ref.train(x=[f"x{i}" for i in range(6)] + ["c"], y="y",
+              training_frame=fr)
+
+    out = str(tmp_path / "dart2.npz")
+    run_workers(2, DART_BODY.format(csv=p, out=out))
+    got = np.load(out)
+    assert float(got["auc"]) == pytest.approx(
+        float(ref.model.training_metrics.auc), abs=2e-3)
