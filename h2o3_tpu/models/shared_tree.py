@@ -1188,20 +1188,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
         col_ranges = None
         if multiproc:
             # multi-host cloud: this process holds its ingest shard; global
-            # facts come from collectives. Features outside the v1 envelope
-            # fail loudly rather than silently training on local-only stats.
-            # the one remaining v1 restriction: custom objectives run user
-            # host code on what would be process-spanning arrays (e.g. the
-            # lambdarank pass builds per-query host structures) — cannot be
-            # made cloud-size-agnostic without constraining the user API
-            unsupported = [
-                ("custom objective",
-                 getattr(self, "_objective_fn", None) is not None),
-            ]
-            bad = [name for name, cond in unsupported if cond]
-            if bad:
-                raise ValueError(
-                    f"not yet supported on multi-process clouds: {bad}")
+            # facts come from collectives. The full tree feature envelope is
+            # cloud-size-agnostic (custom objectives included — they run on
+            # globally-gathered rows, see the contract at the custom_obj
+            # branch below and docs/distributed.md).
             with np.errstate(all="ignore"):
                 lmin = np.nanmin(np.where(np.isnan(X), np.inf, X), axis=0)
                 lmax = np.nanmax(np.where(np.isnan(X), -np.inf, X), axis=0)
@@ -1763,6 +1753,20 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 packed_host.append(np.asarray(pk))
             packed_chunks.clear()
             dev_bytes = 0
+        # custom objective on a multi-process cloud: the gathered-global
+        # response and this rank's row offset are loop-invariant
+        _y_glob_d = None
+        _row_off = 0
+        _row_counts = None
+        if custom_obj is not None and multiproc:
+            import jax as _jax
+
+            y_loc = distdata.to_local(y_d)[:n]
+            y_loc = (y_loc[:, 0] if y_loc.ndim == 2 else y_loc)
+            _y_glob_d = jnp.asarray(
+                distdata.allgather_rows(np.asarray(y_loc, np.float32)))
+            _row_counts = distdata.row_counts(n)
+            _row_off = int(_row_counts[: _jax.process_index()].sum())
         # DART per-round state: one stored-contribution scale per committed
         # round (host floats), a dedicated RNG (deterministic from seed)
         dart_scales: List[float] = []
@@ -1799,7 +1803,33 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                                 valid_state[0],
                                                 tp["max_depth"])
             if custom_obj is not None:
-                g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
+                # Custom-objective contract (cloud-size-agnostic, the
+                # reference's MRTask stance for hex/tree/SharedTree.java):
+                # the objective sees the GLOBAL rows in global row order —
+                # margin vector in, (g, h) vectors out, all length
+                # N_global. On multi-process clouds the driver gathers the
+                # margins host-side (N·4 bytes per rank per round), every
+                # rank runs the objective on identical inputs, and each
+                # rank scatters back its own row range. Per-query host
+                # structures (lambdarank) therefore see whole queries even
+                # when they span ingest-shard boundaries.
+                if multiproc:
+                    m_loc = distdata.to_local(margins)[:n]
+                    m_loc = (m_loc[:, 0] if m_loc.ndim == 2
+                             else m_loc).astype(np.float32)
+                    # fixed-size gather: ONE collective per round (counts
+                    # are loop-invariant, gathered once above)
+                    m_glob = distdata.allgather_rows_padded(
+                        m_loc, quota, _row_counts)
+                    g_g, h_g = custom_obj(jnp.asarray(m_glob), _y_glob_d)
+                    g_g = np.asarray(g_g)[_row_off: _row_off + n]
+                    h_g = np.asarray(h_g)[_row_off: _row_off + n]
+                    g_ext = distdata.global_row_array(
+                        padr(g_g.astype(np.float32)), quota, cloud)
+                    h_ext = distdata.global_row_array(
+                        padr(h_g.astype(np.float32)), quota, cloud)
+                else:
+                    g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
                 margins, packed, gains = _single_jit(
                     margins, codes_d, y_d, w_d, rate_d, edges_d, mono_d,
                     hp_d, key, jnp.int32(m), g_ext, h_ext

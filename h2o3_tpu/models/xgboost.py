@@ -166,32 +166,46 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
                 raise ValueError(
                     f"objective={obj!r} needs group_column (qid); {gcol!r} not in frame"
                 )
-            qid = train.vec(gcol).numeric_np().astype(np.int64)
+            from ..parallel import distdata
+
+            # the objective contract is GLOBAL rows in global order: on a
+            # multi-process cloud, gather qid/rel once so query groups that
+            # span ingest-shard boundaries stay whole (upstream rabit gets
+            # this for free from its single DMatrix; here the gather is the
+            # equivalent one-time cost)
+            qid = distdata.allgather_rows(
+                train.vec(gcol).numeric_np().astype(np.int64))
+            rel = distdata.allgather_rows(
+                train.vec(y).numeric_np().astype(np.float64))
             x = [n for n in x if n != gcol]
             self._objective_fn = _make_lambdarank(
-                qid, train.vec(y).numeric_np(), int(self._parms.get("ndcg_k", 10))
-            )
+                qid, rel, int(self._parms.get("ndcg_k", 10)))
             try:
                 model = super()._fit(x, y, train, valid)
             finally:
                 self._objective_fn = None
-            # NDCG as the headline metric for ranking models
-            scores = model._margins(model._matrix(train))[:, 0]
+            # NDCG as the headline metric for ranking models (global rows)
+            scores = distdata.allgather_rows(
+                model._margins(model._matrix(train))[:, 0])
             model.training_metrics.description = (
                 f"NDCG@{self._parms.get('ndcg_k', 10)}="
-                f"{ndcg_at_k(train.vec(y).numeric_np(), scores, qid, int(self._parms.get('ndcg_k', 10))):.5f}"
+                f"{ndcg_at_k(rel, scores, qid, int(self._parms.get('ndcg_k', 10))):.5f}"
             )
             return model
         return super()._fit(x, y, train, valid)
 
     def ndcg(self, frame: Frame, k: Optional[int] = None) -> float:
+        from ..parallel import distdata
+
         gcol = self._parms.get("group_column") or "qid"
-        qid = frame.vec(gcol).numeric_np().astype(np.int64)
-        scores = self.model._margins(self.model._matrix(frame))[:, 0]
-        return ndcg_at_k(
-            frame.vec(self.model.y).numeric_np(), scores, qid,
-            k or int(self._parms.get("ndcg_k", 10)),
-        )
+        qid = distdata.allgather_rows(
+            frame.vec(gcol).numeric_np().astype(np.int64))
+        rel = distdata.allgather_rows(
+            frame.vec(self.model.y).numeric_np().astype(np.float64))
+        scores = distdata.allgather_rows(
+            self.model._margins(self.model._matrix(frame))[:, 0])
+        return ndcg_at_k(rel, scores, qid,
+                         k or int(self._parms.get("ndcg_k", 10)))
 
 
 def _make_lambdarank(qid: np.ndarray, rel: np.ndarray, k: int):

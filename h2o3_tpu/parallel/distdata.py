@@ -74,6 +74,57 @@ def global_sum(arr: np.ndarray) -> np.ndarray:
     return allgather_host(np.asarray(arr)).sum(axis=0)
 
 
+def allgather_rows(local: np.ndarray) -> np.ndarray:
+    """Rank-order concatenation of every process's local rows — the GLOBAL
+    row order (ingest shards are contiguous byte ranges assigned in rank
+    order, `frame/distributed_parse.py`). Ranks may hold different row
+    counts; byte transport keeps dtypes exact."""
+    a = np.ascontiguousarray(local)
+    if not multiprocess():
+        return a
+    blobs = allgather_bytes(a.tobytes())
+    trail = a.shape[1:]
+    return np.concatenate([
+        np.frombuffer(b, a.dtype).reshape((-1,) + trail) for b in blobs])
+
+
+def allgather_rows_padded(local: np.ndarray, quota: int,
+                          counts: np.ndarray) -> np.ndarray:
+    """Global row-order concat with ONE fixed-size collective: each rank
+    pads its rows to `quota` (loop-invariant), gathers (nproc, quota, ...),
+    and trims per the known per-rank `counts`. Use for per-round gathers
+    where `allgather_rows`'s variable-length byte transport would pay two
+    collectives per call. Float64 inputs are rejected (the device gather
+    would truncate them — use allgather_rows for exact f64)."""
+    a = np.ascontiguousarray(local)
+    if a.dtype == np.float64:
+        raise TypeError("allgather_rows_padded is f32/int transport; "
+                        "use allgather_rows for exact f64")
+    if not multiprocess():
+        return a
+    pad = quota - a.shape[0]
+    if pad > 0:
+        a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+    out = allgather_host(a)                      # (nproc, quota, ...)
+    return np.concatenate([out[r, : int(counts[r])]
+                           for r in range(len(counts))])
+
+
+def row_counts(n_local: int) -> np.ndarray:
+    """Per-rank local row counts in rank order (one-time collective);
+    pair with `allgather_rows_padded`."""
+    return allgather_host(np.asarray([n_local], np.int64)).reshape(-1)
+
+
+def row_offset(n_local: int) -> int:
+    """This process's first-row index in the global row order."""
+    import jax
+
+    if not multiprocess():
+        return 0
+    return int(row_counts(n_local)[: jax.process_index()].sum())
+
+
 def global_minmax(local_min: np.ndarray, local_max: np.ndarray):
     """Per-column global (min, max) from per-process locals (NaN-safe: a
     process with no finite values contributes ±inf)."""
