@@ -80,8 +80,104 @@ def _model_payload(model) -> Dict[str, Any]:
         from .models.isolation_forest import IsolationForestModel
         from .models.kmeans import KMeansModel
         from .models.pca import PCAModel
+        from .models.extended_isolation_forest import \
+            ExtendedIsolationForestModel
+        from .models.ensemble import StackedEnsembleModel
+        from .models.word2vec import Word2VecModel
+        from .models.glrm import GLRMModel
+        from .models.targetencoder import TargetEncoderModel
+        from .models.rulefit import RuleFitModel
+        from .models.coxph import CoxPHModel
+        from .models.naive_bayes import NaiveBayesModel
+        from .models.isotonic import IsotonicRegressionModel
+        from .models.svd import SVDModel
 
-        if isinstance(model, IsolationForestModel):
+        if isinstance(model, ExtendedIsolationForestModel):
+            meta.update(kind="eif", depth=model.depth,
+                        sample_size=model.sample_size,
+                        dinfo=_dinfo_meta(model.dinfo))
+            arrays["eif_dirs"] = np.asarray(model.dirs, np.float32)
+            arrays["eif_thrs"] = np.asarray(model.thrs, np.float32)
+            arrays["eif_splits"] = np.asarray(model.splits, bool)
+            arrays["eif_counts"] = np.asarray(model.counts, np.float64)
+        elif isinstance(model, StackedEnsembleModel):
+            # recursive artifact: every base model + the metalearner ride
+            # along as child payloads (hex/genmodel StackedEnsembleMojoModel)
+            meta.update(kind="stackedensemble", problem=model.problem,
+                        nclass=model.nclass, domain=model.domain,
+                        n_base=len(model.base_models))
+            children = {
+                f"base{i}": _model_payload(bm.model)
+                for i, bm in enumerate(model.base_models)
+            }
+            children["meta"] = _model_payload(model.meta.model)
+            return {"meta": meta, "arrays": arrays, "children": children}
+        elif isinstance(model, Word2VecModel):
+            meta.update(kind="word2vec", dim=int(model.vectors.shape[1]))
+            arrays["w2v_vectors"] = np.asarray(model.vectors, np.float32)
+            arrays["w2v_vocab"] = np.asarray(model.vocab, dtype="U")
+        elif isinstance(model, GLRMModel):
+            meta.update(kind="glrm", k=model.k,
+                        dinfo=_dinfo_meta(model.dinfo))
+            arrays["glrm_y"] = np.asarray(model.Y, np.float64)
+            if model.dinfo.means is not None:
+                arrays["means"] = model.dinfo.means
+                arrays["stds"] = model.dinfo.stds
+        elif isinstance(model, TargetEncoderModel):
+            te_cols = []
+            for i, (col, (dom, sums, cnts, _folds)) in enumerate(
+                    model.encodings.items()):
+                te_cols.append({"col": col, "domain": list(dom)})
+                arrays[f"te{i}_sums"] = np.asarray(sums, np.float64)
+                arrays[f"te{i}_cnts"] = np.asarray(cnts, np.float64)
+            meta.update(kind="targetencoder", te_cols=te_cols,
+                        prior=float(model.prior),
+                        blending=bool(model.blending),
+                        te_k=float(model.k), te_f=float(model.f))
+        elif isinstance(model, RuleFitModel):
+            meta.update(
+                kind="rulefit",
+                rules=[[[str(f), float(t), bool(rt)] for (f, t, rt) in r.conds]
+                       for r in model.rules],
+                lin_cols=list(model.lin_cols),
+                lin_stats={c: [float(v) for v in model.lin_stats[c]]
+                           for c in model.lin_cols},
+            )
+            return {"meta": meta, "arrays": arrays,
+                    "children": {"glm": _model_payload(model._glm.model)}}
+        elif isinstance(model, CoxPHModel):
+            meta.update(kind="coxph", dinfo=_dinfo_meta(model.dinfo))
+            arrays["beta"] = np.asarray(model.beta, np.float64)
+            if model.dinfo.means is not None:
+                arrays["means"] = model.dinfo.means
+                arrays["stds"] = model.dinfo.stds
+        elif isinstance(model, NaiveBayesModel):
+            nb_spec = []
+            for name, knd in model.spec:
+                ent = {"name": name, "kind": knd}
+                if knd == "num":
+                    arrays[f"nb_num_{name}"] = np.asarray(
+                        model.num_stats[name], np.float64)
+                else:
+                    probs, dom = model.cat_tables[name]
+                    ent["domain"] = list(dom)
+                    arrays[f"nb_cat_{name}"] = np.asarray(probs, np.float64)
+                nb_spec.append(ent)
+            meta.update(kind="naivebayes", domain=model.domain,
+                        nb_spec=nb_spec)
+            arrays["nb_priors"] = np.asarray(model.priors, np.float64)
+        elif isinstance(model, IsotonicRegressionModel):
+            meta.update(kind="isotonic", out_of_bounds=model.out_of_bounds)
+            arrays["iso_tx"] = np.asarray(model.thresholds_x, np.float64)
+            arrays["iso_ty"] = np.asarray(model.thresholds_y, np.float64)
+        elif isinstance(model, SVDModel):
+            meta.update(kind="svd", dinfo=_dinfo_meta(model.dinfo))
+            arrays["svd_d"] = np.asarray(model.d, np.float64)
+            arrays["svd_v"] = np.asarray(model.v, np.float64)
+            if model.dinfo.means is not None:
+                arrays["means"] = model.dinfo.means
+                arrays["stds"] = model.dinfo.stds
+        elif isinstance(model, IsolationForestModel):
             meta.update(kind="isoforest", sample_size=model.sample_size,
                         max_depth=model.max_depth, ntrees=len(model.trees))
             arrays["if_feat"] = np.stack([t[0] for t in model.trees]).astype(np.int32)
@@ -104,7 +200,15 @@ def _model_payload(model) -> Dict[str, Any]:
                 arrays["stds"] = model.dinfo.stds
             meta["dinfo"] = _dinfo_meta(model.dinfo)
         else:
-            raise TypeError(f"cannot export model of type {type(model).__name__}")
+            # Ratified cuts (documented in README "Intentional cuts" +
+            # docs/mojo.md): Aggregator (produces a frame, no row scorer),
+            # UpliftDRF, PSVM, GAM/ANOVAGLM/ModelSelection (in-cluster
+            # scoring only for now) — every other predict()-bearing model
+            # kind exports.
+            raise TypeError(
+                f"cannot export model of type {type(model).__name__}: "
+                "not a MOJO-exportable kind (see docs/mojo.md for the "
+                "export matrix and ratified cuts)")
     return {"meta": meta, "arrays": arrays}
 
 
@@ -131,18 +235,49 @@ def save_model(est_or_model, path: str = ".", filename: Optional[str] = None,
     if os.path.exists(out) and not force:
         raise FileExistsError(f"{out} exists; pass force=True")
     with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("model.json", json.dumps(payload["meta"]))
-        buf = io.BytesIO()
-        np.savez(buf, **payload["arrays"])
-        z.writestr("arrays.npz", buf.getvalue())
+        _write_payload(z, "", payload)
     return out
+
+
+def _write_payload(z: "zipfile.ZipFile", prefix: str, payload: Dict) -> None:
+    """One payload (meta + arrays [+ children, recursively]) under a zip
+    prefix — the nested-directory MOJO convention (`models/` sub-entries in
+    hex/genmodel StackedEnsembleMojoModel)."""
+    z.writestr(prefix + "model.json", json.dumps(payload["meta"]))
+    buf = io.BytesIO()
+    np.savez(buf, **payload["arrays"])
+    z.writestr(prefix + "arrays.npz", buf.getvalue())
+    for name, child in (payload.get("children") or {}).items():
+        _write_payload(z, f"{prefix}{name}/", child)
+
+
+def _read_payload(z: "zipfile.ZipFile", prefix: str,
+                  names: List[str]) -> "MojoScorer":
+    meta = json.loads(z.read(prefix + "model.json"))
+    arrays = dict(np.load(io.BytesIO(z.read(prefix + "arrays.npz"))))
+    kids = sorted({
+        n[len(prefix):].split("/", 1)[0]
+        for n in names
+        if n.startswith(prefix) and "/" in n[len(prefix):]
+    })
+    children = {k: _read_payload(z, f"{prefix}{k}/", names) for k in kids}
+    return MojoScorer(meta, arrays, children=children or None)
 
 
 def load_model(path: str) -> "MojoScorer":
     with zipfile.ZipFile(path) as z:
-        meta = json.loads(z.read("model.json"))
-        arrays = dict(np.load(io.BytesIO(z.read("arrays.npz"))))
-    return MojoScorer(meta, arrays)
+        return _read_payload(z, "", z.namelist())
+
+
+def _remap_codes(codes: np.ndarray, vdom, dom) -> np.ndarray:
+    """Align enum codes from a scoring frame's domain to the stored
+    training domain (-1 = unseen level) — one implementation for every
+    scorer kind."""
+    if vdom != dom and vdom:
+        lookup = {d: i for i, d in enumerate(dom)}
+        remap = np.asarray([lookup.get(d, -1) for d in vdom], np.int64)
+        codes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+    return codes
 
 
 class MojoScorer:
@@ -151,9 +286,11 @@ class MojoScorer:
     predict() accepts a Frame or a numpy matrix in training-column order and
     returns the same columns the in-cluster scorer produces."""
 
-    def __init__(self, meta: Dict, arrays: Dict[str, np.ndarray]):
+    def __init__(self, meta: Dict, arrays: Dict[str, np.ndarray],
+                 children: Optional[Dict[str, "MojoScorer"]] = None):
         self.meta = meta
         self.arrays = arrays
+        self.children = children or {}
         self.algo = meta["algo"]
         self.x = meta["x"]
         self.y = meta["y"]
@@ -229,11 +366,7 @@ class MojoScorer:
                 c = np.where(np.isnan(raw), di["col_means"].get(n, 0.0), raw)
                 cols.append(c[:, None])
             else:
-                if vdom != dom and vdom:
-                    remap = np.asarray(
-                        [dom.index(d) if d in dom else -1 for d in vdom], np.int64
-                    )
-                    codes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+                codes = _remap_codes(codes, vdom, dom)
                 K = len(dom)
                 oh = np.zeros((len(codes), K))
                 valid = codes >= 0
@@ -385,4 +518,278 @@ class MojoScorer:
             if meta["distribution"] in ("poisson", "gamma", "tweedie"):
                 out = np.exp(out)
             return Frame.from_dict({"predict": out})
+        if kind == "eif":
+            X = self._expand_dinfo(data)
+            depth = meta["depth"]
+            dirs = self.arrays["eif_dirs"]
+            thrs = self.arrays["eif_thrs"]
+            splits = self.arrays["eif_splits"]
+            counts = self.arrays["eif_counts"]
+            N = X.shape[0]
+            pls = []
+            for t in range(dirs.shape[0]):
+                idx = np.zeros(N, np.int64)
+                depth_stop = np.full(N, float(depth))
+                stop_node = np.zeros(N, np.int64)
+                live = np.ones(N, bool)
+                for d in range(depth):
+                    node = 2 ** d - 1 + idx
+                    s = splits[t][node]
+                    proj = np.sum(X * dirs[t][node], axis=1)
+                    stopping = live & ~s
+                    depth_stop[stopping] = d
+                    stop_node[stopping] = node[stopping]
+                    live &= s
+                    go_right = live & (proj > thrs[t][node])
+                    idx = np.where(live, 2 * idx + go_right.astype(np.int64),
+                                   idx)
+                stop_node = np.where(live, 2 ** depth - 1 + idx, stop_node)
+                nleaf = counts[t][stop_node]
+                credit = np.where(
+                    nleaf > 1.5,
+                    2.0 * (np.log(np.maximum(nleaf - 1.0, 1.0)) + 0.5772156649)
+                    - 2.0 * (nleaf - 1.0) / np.maximum(nleaf, 1.0),
+                    0.0)
+                pls.append(depth_stop + credit)
+            mean_length = np.mean(pls, axis=0)
+            S = max(meta["sample_size"], 2.0)
+            cS = (2.0 * (np.log(S - 1.0) + 0.5772156649)
+                  - 2.0 * (S - 1.0) / S)
+            score = 2.0 ** (-mean_length / cS)
+            return Frame.from_dict({"anomaly_score": score,
+                                    "mean_length": mean_length})
+        if kind == "stackedensemble":
+            lvl1 = {}
+            problem = meta["problem"]
+            for i in range(meta["n_base"]):
+                base = self.children[f"base{i}"]
+                pf = base.predict(data)
+                bdom = base.meta.get("domain")
+                if problem == "multinomial":
+                    for k2, cls in enumerate(bdom):
+                        lvl1[f"m{i}_p{k2}"] = pf.vec(str(cls)).numeric_np()
+                elif problem == "binomial":
+                    lvl1[f"m{i}"] = pf.vec(str(bdom[1])).numeric_np()
+                else:
+                    lvl1[f"m{i}"] = pf.vec("predict").numeric_np()
+            return self.children["meta"].predict(Frame.from_dict(lvl1))
+        if kind == "word2vec":
+            return self.transform(data)
+        if kind == "glrm":
+            X = self._glrm_project(data)
+            R = X @ self.arrays["glrm_y"]
+            names = meta["dinfo"]["coef_names"]
+            return Frame.from_dict(
+                {f"reconstr_{names[j]}": R[:, j] for j in range(R.shape[1])})
+        if kind == "targetencoder":
+            out = {n: v for n, v in zip(data.names, data.vecs())}
+            for i, ent in enumerate(meta["te_cols"]):
+                col, dom = ent["col"], ent["domain"]
+                if col not in data.names:
+                    continue
+                v = data.vec(col)
+                codes = (np.asarray(v.data) if v.type == "enum"
+                         else v.numeric_np().astype(np.int64))
+                if v.type == "enum":
+                    codes = _remap_codes(codes, v.domain, dom)
+                sums = self.arrays[f"te{i}_sums"]
+                cnts = self.arrays[f"te{i}_cnts"]
+                prior = meta["prior"]
+                enc = np.full(len(codes), prior)
+                ok = (codes >= 0) & (codes < len(sums))
+                ci = np.maximum(codes, 0)
+                s, c = sums[ci], cnts[ci]
+                if meta["blending"]:
+                    # exactly TargetEncoderModel._blend: mean is s/max(c,ε)
+                    # (0.0 for empty levels — NOT the prior)
+                    with np.errstate(over="ignore"):
+                        lam = 1.0 / (1.0 + np.exp(
+                            -(c - meta["te_k"]) / max(meta["te_f"], 1e-12)))
+                    e = lam * (s / np.maximum(c, 1e-12)) + (1 - lam) * prior
+                else:
+                    e = np.where(c > 0, s / np.maximum(c, 1e-12), prior)
+                enc[ok] = e[ok]
+                from .frame.vec import Vec
+
+                out[f"{col}_te"] = Vec(enc.astype(np.float32), "real")
+            return Frame(out)
+        if kind == "rulefit":
+            cols = [data.vec(n).numeric_np() for n in self.x]
+            X = (np.column_stack(cols) if cols
+                 else np.zeros((data.nrow, 0)))
+            col_of = {n: i for i, n in enumerate(self.x)}
+            d = {}
+            for i, conds in enumerate(meta["rules"]):
+                m = np.ones(X.shape[0], bool)
+                for fname, thr, right in conds:
+                    col = X[:, col_of[fname]]
+                    if right:
+                        m &= np.isnan(col) | (col > thr)
+                    else:
+                        m &= ~np.isnan(col) & (col <= thr)
+                d[f"rule_{i}"] = m.astype(np.float64)
+            for c in meta["lin_cols"]:
+                lo, hi, sd = meta["lin_stats"][c]
+                col = np.clip(np.nan_to_num(data.vec(c).numeric_np()), lo, hi)
+                d[f"linear.{c}"] = 0.4 * col / max(sd, 1e-12)
+            return self.children["glm"].predict(Frame.from_dict(d))
+        if kind == "coxph":
+            X = self._expand_dinfo(data)
+            return Frame.from_dict({"lp": X @ self.arrays["beta"]})
+        if kind == "naivebayes":
+            n = data.nrow
+            priors = self.arrays["nb_priors"]
+            K = len(priors)
+            logp = np.tile(np.log(priors)[None, :], (n, 1))
+            for ent in meta["nb_spec"]:
+                name = ent["name"]
+                v = data.vec(name)
+                if ent["kind"] == "num":
+                    col = v.numeric_np()
+                    st = self.arrays[f"nb_num_{name}"]
+                    mean, sd = st[:, 0], st[:, 1]
+                    valid = ~np.isnan(col)
+                    ll = (-0.5 * np.log(2 * np.pi * sd[None, :] ** 2)
+                          - 0.5 * ((np.where(valid, col, 0.0)[:, None]
+                                    - mean[None, :]) / sd[None, :]) ** 2)
+                    logp += np.where(valid[:, None], ll, 0.0)
+                else:
+                    probs = self.arrays[f"nb_cat_{name}"]
+                    dom = ent["domain"]
+                    codes = _remap_codes(np.asarray(v.data), v.domain, dom)
+                    valid = codes >= 0
+                    ll = np.log(probs[:, np.maximum(codes, 0)]).T
+                    logp += np.where(valid[:, None], ll, 0.0)
+            mshift = logp - logp.max(axis=1, keepdims=True)
+            probs2 = np.exp(mshift) / np.exp(mshift).sum(axis=1,
+                                                         keepdims=True)
+            dom = meta["domain"]
+            lab = probs2.argmax(axis=1)
+            d = {"predict": np.asarray(dom, dtype=object)[lab]}
+            for i, cls in enumerate(dom):
+                d[str(cls)] = probs2[:, i]
+            return Frame.from_dict(d, column_types={"predict": "enum"})
+        if kind == "isotonic":
+            xname = self.x if isinstance(self.x, str) else self.x[0]
+            col = data.vec(xname).numeric_np()
+            tx, ty = self.arrays["iso_tx"], self.arrays["iso_ty"]
+            p = np.interp(col, tx, ty)
+            if meta["out_of_bounds"].lower() == "na":
+                p = np.where((col < tx[0]) | (col > tx[-1]), np.nan, p)
+            p = np.where(np.isnan(col), np.nan, p)
+            return Frame.from_dict({"predict": p})
+        if kind == "svd":
+            X = self._expand_dinfo(data)
+            scores = (X @ self.arrays["svd_v"]
+                      ) / np.maximum(self.arrays["svd_d"][None, :], 1e-300)
+            return Frame.from_dict(
+                {f"u{i+1}": scores[:, i] for i in range(scores.shape[1])})
         raise ValueError(f"unknown artifact kind {kind!r}")
+
+    # -- non-predict scoring surfaces ---------------------------------------
+    def _glrm_project(self, data) -> np.ndarray:
+        """GLRM row loadings for new data — `_expand` keeps NaNs so the
+        observation mask survives (GLRMModel._project semantics)."""
+        from .frame.frame import Frame
+
+        di = self.meta["dinfo"]
+        cols = []
+        for knd, n, dom in di["spec"]:
+            v = data.vec(n)
+            if knd == "num":
+                cols.append(v.numeric_np()[:, None])
+            else:
+                codes = _remap_codes(np.asarray(v.data), v.domain, dom)
+                K = len(dom)
+                oh = np.zeros((len(codes), K))
+                valid = codes >= 0
+                oh[np.nonzero(valid)[0], codes[valid]] = 1.0
+                if not di["use_all"] and K > 0:
+                    oh = oh[:, 1:]
+                cols.append(oh)
+        A = np.concatenate(cols, axis=1)
+        if "means" in self.arrays:
+            A = (A - self.arrays["means"]) / self.arrays["stds"]
+        Y = self.arrays["glrm_y"]
+        k = Y.shape[0]
+        mask = ~np.isnan(A)
+        A0 = np.nan_to_num(A, nan=0.0)
+        lam = 1e-6
+        Xn = np.zeros((A.shape[0], k))
+        YT = Y.T
+        for i in range(A.shape[0]):
+            m = mask[i]
+            G = YT[m].T @ YT[m] + lam * np.eye(k)
+            Xn[i] = np.linalg.solve(G, YT[m].T @ A0[i, m])
+        return Xn
+
+    def transform(self, data, aggregate_method: str = "NONE"):
+        """word2vec words→vectors / glrm archetype loadings / targetencoder
+        column appends — the model-side `transform` surfaces, offline."""
+        from .frame.frame import Frame
+
+        kind = self.meta["kind"]
+        if kind == "glrm":
+            Xn = self._glrm_project(data)
+            return Frame.from_dict(
+                {f"Arch{j+1}": Xn[:, j] for j in range(Xn.shape[1])})
+        if kind == "targetencoder":
+            return self.predict(data)
+        if kind != "word2vec":
+            raise ValueError(f"transform is not defined for kind {kind!r}")
+        vecs, vocab, index = self._w2v()
+        col = data.vecs()[0]
+        words = (col.to_numpy() if col.type == "string" else np.asarray(
+            [col.domain[c] if c >= 0 else None
+             for c in np.asarray(col.data)], dtype=object))
+        dim = vecs.shape[1]
+        if aggregate_method.upper() == "NONE":
+            out = np.full((len(words), dim), np.nan)
+            for i, w in enumerate(words):
+                if w is not None and w in index:
+                    out[i] = vecs[index[w]]
+            return Frame.from_dict(
+                {f"C{j+1}": out[:, j] for j in range(dim)})
+        sents, cur = [], []
+        for w in words:
+            if w is None:
+                sents.append(cur)
+                cur = []
+            else:
+                cur.append(w)
+        sents.append(cur)
+        rows = []
+        for s in sents:
+            hit = [vecs[index[w]] for w in s if w in index]
+            rows.append(np.mean(hit, axis=0) if hit
+                        else np.full(dim, np.nan))
+        out = np.stack(rows)
+        return Frame.from_dict({f"C{j+1}": out[:, j] for j in range(dim)})
+
+    def _w2v(self):
+        """(vectors, vocab, word→index) decoded once per scorer — the
+        convert-once convention of `_native_forest`."""
+        if "_w2v_cache" not in self.__dict__:
+            vocab = [str(w) for w in self.arrays["w2v_vocab"]]
+            self._w2v_cache = (self.arrays["w2v_vectors"], vocab,
+                               {w: i for i, w in enumerate(vocab)})
+        return self._w2v_cache
+
+    def find_synonyms(self, word: str, count: int = 20) -> Dict[str, float]:
+        if self.meta["kind"] != "word2vec":
+            raise ValueError("find_synonyms requires a word2vec artifact")
+        vecs, vocab, index = self._w2v()
+        if word not in index:
+            return {}
+        v = vecs[index[word]]
+        norms = (np.linalg.norm(vecs, axis=1)
+                 * max(np.linalg.norm(v), 1e-12))
+        sims = vecs @ v / np.maximum(norms, 1e-12)
+        out = {}
+        for i in np.argsort(-sims):
+            if vocab[i] == word:
+                continue
+            out[vocab[i]] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
