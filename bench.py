@@ -107,9 +107,13 @@ def bench_dl():
     import h2o3_tpu as h2o
     from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
 
-    X = rng.random((n_rows, 784)).astype(np.float32)
+    # MNIST is uint8 pixel intensities — integer-valued features, like the
+    # real benchmark input (the DL path ships them over the tunnel at
+    # 1 byte/value, the C1Chunk-compression analog)
+    X = np.floor(rng.random((n_rows, 784)) * 256).astype(np.float32)
     proto = rng.normal(size=(10, 784)).astype(np.float32)
-    y = (X @ proto.T + 0.5 * rng.normal(size=(n_rows, 10))).argmax(axis=1)
+    y = ((X / 255.0) @ proto.T
+         + 0.5 * rng.normal(size=(n_rows, 10))).argmax(axis=1)
     d = {f"p{i}": X[:, i] for i in range(784)}
     d["label"] = y.astype(str)
     fr = h2o.H2OFrame_from_python(d, column_types={"label": "enum"})

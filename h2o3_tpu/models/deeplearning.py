@@ -240,8 +240,28 @@ class H2ODeepLearningEstimator(H2OEstimator):
             standardize=bool(p.get("standardize", True)),
             use_all_factor_levels=bool(p.get("use_all_factor_levels", True)),
         )
-        X = dinfo.fit_transform(train)
-        n, nfeat = X.shape
+        _max_runtime = float(p.get("max_runtime_secs", 0) or 0)
+        multiproc = distdata.multiprocess()
+        cloud = cloudlib.cloud()
+        # ONE scan-path decision reused by the design-matrix choice and the
+        # training loop below (a second copy of this predicate diverging
+        # would read X_dev_pre=None inside the loop)
+        use_scan = not (_max_runtime > 0) or multiproc
+        if use_scan and not multiproc and cloud.size == 1:
+            # device-resident training path: build the design matrix ON
+            # device from compact columns (small-range integer features
+            # travel as 1–2 bytes/value — MNIST-style pixel data is 4×
+            # fewer tunnel bytes than the dense f32 upload, losslessly).
+            # Single-device only: a multi-device mesh needs the
+            # shard-straight-from-host upload so no unsharded intermediate
+            # lands on device 0.
+            X = None
+            X_dev_pre = dinfo.device_design(train, fit=True)
+            n, nfeat = train.nrow, int(X_dev_pre.shape[1])
+        else:
+            X = dinfo.fit_transform(train)
+            n, nfeat = X.shape
+            X_dev_pre = None
         hidden = list(p.get("hidden") or [200, 200])
         activation = p.get("activation", "Rectifier")
         if activation not in ACTIVATIONS:
@@ -264,8 +284,6 @@ class H2ODeepLearningEstimator(H2OEstimator):
             else np.ones(n)
         ).astype(np.float32)
 
-        cloud = cloudlib.cloud()
-        multiproc = distdata.multiprocess()
         if multiproc:
             # early stopping / time budget use a global any-rank-stops vote
             # at every scoring event, so host control flow stays aligned
@@ -315,8 +333,12 @@ class H2ODeepLearningEstimator(H2OEstimator):
                 loss = loss + l1 * sum(jnp.sum(jnp.abs(W)) for W, _ in params)
             return loss
 
-        # ADADELTA state: (E[g²], E[Δ²]) per tensor (Neurons ADADELTA impl)
-        if adaptive:
+        # ADADELTA state: (E[g²], E[Δ²]) per tensor (Neurons ADADELTA impl).
+        # Only the per-batch (max_runtime) path uses the structured layout;
+        # the scan path carries the fused flat state (oflat below).
+        if use_scan:
+            opt_state = None
+        elif adaptive:
             opt_state = [
                 (jnp.zeros_like(W), jnp.zeros_like(W), jnp.zeros_like(b), jnp.zeros_like(b))
                 for W, b in params
@@ -362,9 +384,77 @@ class H2ODeepLearningEstimator(H2OEstimator):
             grads = jax.grad(loss_fn)(params, xb, yb, wb, key)
             return _update(params, opt_state, grads, it)
 
+        # ---- flat-parameter scan path ----------------------------------
+        # The per-tensor optimizer updates are ~200 tiny elementwise ops
+        # per step; inside lax.scan that op overhead dominates a small
+        # MLP's step time (~430 µs/step measured). Flattening params and
+        # optimizer state into single vectors fuses ADADELTA into a
+        # handful of full-vector ops — identical math, elementwise either
+        # way. The flat layout exists only inside the scan; boundaries
+        # (scoring, model export) see the per-layer (W, b) list.
+        _seg_shapes = []
+        _seg_offs = []
+        _off = 0
+        for W0, b0 in params:                 # actual shapes (maxout widens)
+            for t in (W0, b0):
+                _seg_shapes.append(tuple(t.shape))
+                _seg_offs.append(_off)
+                _off += int(np.prod(t.shape))
+        _flat_n = _off
+
+        def _flatten(ps):
+            return jnp.concatenate([jnp.ravel(t) for W, b in ps
+                                    for t in (W, b)])
+
+        def _unflatten(v):
+            out = []
+            for i in range(0, len(_seg_shapes), 2):
+                W = jax.lax.dynamic_slice(
+                    v, (_seg_offs[i],),
+                    (int(np.prod(_seg_shapes[i])),)).reshape(_seg_shapes[i])
+                b = jax.lax.dynamic_slice(
+                    v, (_seg_offs[i + 1],),
+                    (int(np.prod(_seg_shapes[i + 1])),)
+                ).reshape(_seg_shapes[i + 1])
+                out.append((W, b))
+            return out
+
+        def _clamp_w2(v):
+            """Per-layer max_w2 column-norm clamp on the flat vector
+            (only traced when the non-default max_w2 is set)."""
+            for i in range(0, len(_seg_shapes), 2):
+                shp = _seg_shapes[i]
+                W = jax.lax.dynamic_slice(
+                    v, (_seg_offs[i],), (int(np.prod(shp)),)).reshape(shp)
+                norms = jnp.sum(W * W, axis=0, keepdims=True)
+                scale = jnp.sqrt(jnp.minimum(
+                    max_w2 / jnp.maximum(norms, 1e-12), 1.0))
+                v = jax.lax.dynamic_update_slice(
+                    v, (W * scale).ravel(), (_seg_offs[i],))
+            return v
+
+        def _flat_update(pv, ov, gv, it):
+            if adaptive:
+                eg2, ed2 = ov
+                eg2 = rho * eg2 + (1 - rho) * gv * gv
+                d = -jnp.sqrt(ed2 + eps) / jnp.sqrt(eg2 + eps) * gv
+                ed2 = rho * ed2 + (1 - rho) * d * d
+                pv = pv + d
+                if np.isfinite(max_w2):
+                    pv = _clamp_w2(pv)
+                return pv, (eg2, ed2)
+            rate = rate0 / (1.0 + rate_annealing * it)
+            mom = jnp.minimum(
+                mom_start + (mom_stable - mom_start) * it / mom_ramp,
+                jnp.maximum(mom_stable, mom_start),
+            ) if mom_ramp > 0 else mom_stable
+            (vel,) = ov
+            vel = mom * vel - rate * gv
+            return pv + vel, (vel,)
+
         @functools.partial(jax.jit, donate_argnums=(0, 1),
                            static_argnames=("nsteps",))
-        def train_chunk(params, opt_state, X_d, y_d, w_d, key, it0, nsteps):
+        def train_chunk(pflat, oflat, X_d, y_d, w_d, key, it0, nsteps):
             """nsteps minibatch updates as ONE device program (lax.scan):
             the training set lives in HBM; one random permutation per chunk
             re-batches it into (nsteps, batch, ·) slices that scan consumes
@@ -384,16 +474,19 @@ class H2ODeepLearningEstimator(H2OEstimator):
                   w_d[sel].reshape(nsteps, batch),
                   jax.random.split(kdrop, nsteps))
 
-            def body(carry, xb_yb_wb_k):
-                params, opt_state, it = carry
-                xb, yb, wb, k = xb_yb_wb_k
-                grads = jax.grad(loss_fn)(params, xb, yb, wb, k)
-                params, opt_state = _update(params, opt_state, grads, it)
-                return (params, opt_state, it + 1.0), None
+            def flat_loss(pv, xb, yb, wb, k):
+                return loss_fn(_unflatten(pv), xb, yb, wb, k)
 
-            (params, opt_state, _), _ = jax.lax.scan(
-                body, (params, opt_state, jnp.float32(it0)), xs)
-            return params, opt_state
+            def body(carry, xb_yb_wb_k):
+                pv, ov, it = carry
+                xb, yb, wb, k = xb_yb_wb_k
+                gv = jax.grad(flat_loss)(pv, xb, yb, wb, k)
+                pv, ov = _flat_update(pv, ov, gv, it)
+                return (pv, ov, it + 1.0), None
+
+            (pflat, oflat, _), _ = jax.lax.scan(
+                body, (pflat, oflat, jnp.float32(it0)), xs)
+            return pflat, oflat
 
         # sync-DP: batches row-sharded over the mesh; params replicated —
         # XLA inserts the gradient psum (the Hogwild replacement)
@@ -415,7 +508,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
         next_score = score_every
         history: List[Dict] = []
         t0 = time.time()
-        max_runtime = float(p.get("max_runtime_secs", 0) or 0)
+        max_runtime = _max_runtime
         model = DeepLearningModel(self, x, y, dinfo, problem, nclass, domain,
                                   params, activation, dist)
         # device-resident fast path: data in HBM (row-sharded on a mesh),
@@ -426,7 +519,6 @@ class H2ODeepLearningEstimator(H2OEstimator):
         # per-batch path would draw rank-divergent local batches; there the
         # scan path stays and the budget is checked (with the clock-
         # consensus vote) at scoring boundaries instead.
-        use_scan = not (max_runtime and max_runtime > 0) or multiproc
         if use_scan:
             if multiproc:
                 # each process contributes its ingest shard; zero-weight
@@ -444,7 +536,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
                 y_dev = jax.device_put(yarr, rs)
                 w_dev = jax.device_put(w, rs)
             else:
-                X_dev = jnp.asarray(X)
+                X_dev = X_dev_pre
                 y_dev = jnp.asarray(yarr)
                 w_dev = jnp.asarray(w)
             # scoring reuses the HBM copy — except on a multi-process mesh,
@@ -458,18 +550,24 @@ class H2ODeepLearningEstimator(H2OEstimator):
         # discount the zero-weight slots so `epochs` counts REAL samples
         real_frac = (n_global / float(X_dev.shape[0])
                      if use_scan and multiproc else 1.0)
+        if use_scan:
+            pflat = _flatten(params)
+            oflat = (tuple(jnp.zeros(_flat_n, jnp.float32)
+                           for _ in range(2)) if adaptive
+                     else (jnp.zeros(_flat_n, jnp.float32),))
+        _score_time = 0.0
         while seen < total:
             if use_scan:
                 upto = min(next_score, total)
                 eff_batch = max(batch * real_frac, 1e-9)
                 steps = max(1, -(-int(upto - seen) // int(max(eff_batch, 1))))
                 key, sub = jax.random.split(key)
-                params, opt_state = train_chunk(
-                    params, opt_state, X_dev, y_dev, w_dev, sub,
+                pflat, oflat = train_chunk(
+                    pflat, oflat, X_dev, y_dev, w_dev, sub,
                     float(it), int(steps))
                 # CPU mesh: serialize collective executables (see
                 # parallel.mesh.collective_fence)
-                cloudlib.collective_fence(params[0][0])
+                cloudlib.collective_fence(pflat)
                 seen += max(int(steps * eff_batch), 1)
                 it += steps
             else:
@@ -487,6 +585,23 @@ class H2ODeepLearningEstimator(H2OEstimator):
                 it += 1
             if seen >= next_score or seen >= total:
                 next_score += score_every
+                # train_samples_per_iteration=-2 (auto-tune): cap the wall
+                # share spent scoring at score_duty_cycle, like the
+                # reference's computeSamplesPerIteration duty-cycle target.
+                # Early stopping keeps every event (scoring IS its signal),
+                # as does the final event and score_each_iteration.
+                if (seen < total and stopper is None and tspi == -2
+                        and not max_runtime and not multiproc
+                        and not p.get("score_each_iteration")
+                        and _score_time > float(
+                            p.get("score_duty_cycle", 0.1) or 0.1)
+                        * max(time.time() - t0, 1e-9)):
+                    if self.job:
+                        self.job.update(min(seen / total, 1.0))
+                    continue
+                _t_sc = time.time()
+                if use_scan:
+                    params = _unflatten(pflat)
                 model.net_params = params
                 sm = model._make_metrics(train, X_pre=X_score)
                 ev = {
@@ -507,6 +622,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
                     # collective programs aligned across processes
                     stop = float(distdata.global_sum(
                         np.asarray([1.0 if stop else 0.0]))[0]) > 0
+                _score_time += time.time() - _t_sc
                 if stop:
                     break
             if max_runtime:
@@ -519,6 +635,8 @@ class H2ODeepLearningEstimator(H2OEstimator):
             if self.job:
                 self.job.update(min(seen / total, 1.0))
 
+        if use_scan:
+            params = _unflatten(pflat)
         model.net_params = params
         model.scoring_history = history
         model.training_metrics = model._make_metrics(train, X_pre=X_score)
