@@ -187,6 +187,90 @@ def bench_score():
             {"auc": round(float(perf.auc()), 5)})
 
 
+_SCALING_CHILD = r"""
+import json, os, time, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", {nd})
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+sys.path.insert(0, {repo!r})
+from h2o3_tpu.frame.binning import build_bins
+from h2o3_tpu.models import tree as treelib
+from h2o3_tpu.parallel import mesh as cloudlib
+
+nd = {nd}
+cloud = cloudlib.init(jax.devices()[:nd])
+rng = np.random.default_rng(0)
+N, F, B, D = {rows}, 28, 64, 6
+X = rng.normal(size=(N, F)).astype(np.float32)
+y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+bm = build_bins(X, nbins=B)
+edges = np.full((F, B - 2), np.inf, np.float32)
+for j, e in enumerate(bm.edges):
+    edges[j, : len(e)] = e
+rspec = P(cloudlib.ROWS_AXIS)
+codes = jax.device_put(jnp.asarray(bm.codes), cloud.row_sharding())
+yj = jax.device_put(jnp.asarray(y), cloud.row_sharding())
+margin = jax.device_put(jnp.zeros(N, jnp.float32), cloud.row_sharding())
+edges_j = jax.device_put(jnp.asarray(edges), cloud.replicated())
+
+def train_step(codes, margin, y, edges):
+    p = jax.nn.sigmoid(margin)
+    g, h = p - y, p * (1 - p)
+    tree, leaf_idx, _, _ = treelib.build_tree(
+        codes, g, h, jnp.ones_like(g), jnp.ones(F, jnp.float32), edges,
+        max_depth=D, nbins=B, min_rows=1.0, axis_name=cloudlib.ROWS_AXIS)
+    return margin + 0.1 * tree.value[leaf_idx]
+
+fn = jax.jit(shard_map(train_step, mesh=cloud.mesh,
+                       in_specs=(rspec, rspec, rspec, P()),
+                       out_specs=rspec))
+m = fn(codes, margin, yj, edges_j)
+jax.block_until_ready(m)            # compile absorb (real barrier on CPU)
+reps = {reps}
+t0 = time.perf_counter()
+for _ in range(reps):
+    m = fn(codes, m, yj, edges_j)
+jax.block_until_ready(m)
+print(json.dumps(dict(nd=nd, step_ms=(time.perf_counter() - t0) / reps * 1e3)))
+"""
+
+
+def bench_scaling():
+    """1/2/4/8-virtual-device scaling curve (VERDICT r03 #8 — the
+    BASELINE.json "1→8 host" metric's measurable analog here): the
+    flagship GBM tree-build step over a row-sharded CPU mesh at FIXED
+    global rows. The virtual devices share one host's cores, so the curve
+    bounds collective/sharding overhead rather than demonstrating chip
+    speedup — bit-identity across cloud sizes is pinned separately by
+    tests/test_multiprocess.py."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    rows = int(os.environ.get("BENCH_ROWS", 131_072))
+    reps = int(os.environ.get("BENCH_REPEATS_STEPS", 5))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    times = {}
+    for nd in (1, 2, 4, 8):
+        src = _SCALING_CHILD.format(nd=nd, rows=rows, reps=reps, repo=repo)
+        out = subprocess.run([_sys.executable, "-c", src], env=env,
+                             capture_output=True, text=True, timeout=1200)
+        line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+        if not line:
+            raise RuntimeError(f"scaling child nd={nd} failed: {out.stderr[-2000:]}")
+        times[nd] = _json.loads(line[-1])["step_ms"]
+    ratio = times[1] / max(times[8], 1e-9)
+    return ("scaling_1to8dev_step_speedup", ratio,
+            {"step_ms": {str(k): round(v, 1) for k, v in times.items()},
+             "rows": rows, "unit_override": "x"})
+
+
 def bench_automl():
     """AutoML leaderboard (BASELINE.json config 5)."""
     n_rows = int(os.environ.get("BENCH_ROWS", 50_000))
@@ -230,10 +314,18 @@ R02_BASELINE = {
 # randomly evicts cached executables; a single run measures the weather,
 # not the machine. Repeat each wall-clock config and report the BEST run
 # (first run also absorbs executable deserialization for later ones).
-DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2}
+DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
+                   "scaling": 1}
 
 
 def main():
+    config = os.environ.get("BENCH_CONFIG", "gbm")
+    if config == "scaling":
+        # the curve runs in CPU subprocesses; keep the parent off the
+        # (possibly unavailable) TPU backend entirely
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import jax
 
     # env vars alone do not engage the persistent cache under the remote-TPU
@@ -241,18 +333,17 @@ def main():
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-    config = os.environ.get("BENCH_CONFIG", "gbm")
     fn = {"gbm": bench_gbm, "glm": bench_glm, "dl": bench_dl,
           "xgb_rank": bench_xgb_rank, "automl": bench_automl,
-          "score": bench_score}[config]
+          "score": bench_score, "scaling": bench_scaling}[config]
     repeats = int(os.environ.get("BENCH_REPEATS",
                                  DEFAULT_REPEATS.get(config, 1)))
     runs = []
     for _ in range(max(repeats, 1)):
         runs.append(fn())
     metric = runs[0][0]
-    higher_better = metric.endswith("samples_per_s")
+    higher_better = (metric.endswith("samples_per_s")
+                     or metric.endswith("speedup"))
     values = [r[1] for r in runs]
     best_i = (max if higher_better else min)(
         range(len(values)), key=lambda i: values[i])

@@ -49,7 +49,10 @@ def init(url=None, ip=None, port=None, nthreads=-1, max_mem_size=None,
     if url is not None or ip is not None or port is not None:
         return client.connect(url=url, ip=ip, port=port,
                               token=kw.get("token"),
-                              verbose=kw.get("verbose", True))
+                              verbose=kw.get("verbose", True),
+                              verify_ssl=kw.get(
+                                  "verify_ssl",
+                                  kw.get("verify_ssl_certificates", True)))
     return _mesh.init()
 
 
@@ -59,7 +62,10 @@ def connect(url=None, ip=None, port=None, **kw):
     if url is not None or ip is not None or port is not None:
         return client.connect(url=url, ip=ip, port=port,
                               token=kw.get("token"),
-                              verbose=kw.get("verbose", True))
+                              verbose=kw.get("verbose", True),
+                              verify_ssl=kw.get(
+                                  "verify_ssl",
+                                  kw.get("verify_ssl_certificates", True)))
     return init()
 
 
@@ -131,20 +137,12 @@ def H2OFrame_from_python(data, column_types=None, column_names=None):
     # uploads to the cluster). Serialize through the local Frame builder
     # (type inference, NA handling), ship CSV bytes, parse with the
     # inferred/requested types; the local temporary never enters the DKV.
-    import io
+    from .frame.frame import frame_to_csv
 
     fr = Frame(data, column_names=column_names, column_types=column_types)
     _DKV.remove(fr.key)
-    buf = io.StringIO()
-    cols = fr.as_data_frame(use_pandas=False)
-    buf.write(",".join(fr.names) + "\n")
-    mats = [cols[n] for n in fr.names]
-    for i in range(fr.nrow):
-        buf.write(",".join(
-            "" if v is None or (isinstance(v, float) and np.isnan(v))
-            else str(v) for v in (m[i] for m in mats)) + "\n")
     types = [fr.vec(n).type for n in fr.names]
-    return conn.upload_bytes(buf.getvalue().encode(), "pyframe.csv",
+    return conn.upload_bytes(frame_to_csv(fr).encode(), "pyframe.csv",
                              col_names=list(fr.names), col_types=types)
 
 

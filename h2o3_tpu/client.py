@@ -53,7 +53,7 @@ def current_connection() -> Optional["H2OConnection"]:
 
 def connect(url: Optional[str] = None, ip: Optional[str] = None,
             port: Optional[int] = None, token: Optional[str] = None,
-            verbose: bool = True) -> "H2OConnection":
+            verbose: bool = True, verify_ssl: bool = True) -> "H2OConnection":
     """Attach to a running server and make it the process-wide connection
     (`h2o.connect` — h2o-py/h2o/h2o.py)."""
     global _CURRENT
@@ -61,7 +61,7 @@ def connect(url: Optional[str] = None, ip: Optional[str] = None,
         if ip is None and port is None:
             raise ValueError("connect() needs url= or ip=/port=")
         url = f"http://{ip or '127.0.0.1'}:{port or 54321}"
-    conn = H2OConnection(url, token=token)
+    conn = H2OConnection(url, token=token, verify_ssl=verify_ssl)
     info = conn.cluster_info()          # raises H2OConnectionError if dead
     if verbose:
         print(f"Connected to {url} — cloud "
@@ -79,10 +79,17 @@ class H2OConnection:
     """One server endpoint + auth. All verbs funnel through `request`."""
 
     def __init__(self, url: str, token: Optional[str] = None,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, verify_ssl: bool = True):
         self.url = url.rstrip("/")
         self.token = token or os.environ.get("H2O3_AUTH_TOKEN")
         self.timeout = timeout
+        self._ssl_ctx = None
+        if url.startswith("https") and not verify_ssl:
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
 
     # -- plumbing -----------------------------------------------------------
     def request(self, method: str, path: str,
@@ -109,7 +116,8 @@ class H2OConnection:
         req = urllib.request.Request(url, data=data, headers=headers,
                                      method=method)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl_ctx) as r:
                 body = r.read()
         except urllib.error.HTTPError as e:
             try:

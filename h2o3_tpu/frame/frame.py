@@ -801,3 +801,22 @@ class Frame:
 
     def __repr__(self):
         return f"Frame({self.nrow}x{self.ncol} {list(self.types.items())[:6]}...)"
+
+
+def frame_to_csv(fr: "Frame") -> str:
+    """Frame → CSV text with proper quoting — ONE serializer shared by
+    `/3/DownloadDataset` and the remote client's upload path (divergent
+    copies would produce CSV round-trip asymmetry)."""
+    import csv as _csv
+    import io
+
+    buf = io.StringIO()
+    w = _csv.writer(buf)
+    w.writerow(fr.names)
+    cols = fr.as_data_frame(use_pandas=False)
+    mats = [cols[n] for n in fr.names]
+    for i in range(fr.nrow):
+        w.writerow([
+            "" if v is None or (isinstance(v, float) and np.isnan(v))
+            else v for v in (m[i] for m in mats)])
+    return buf.getvalue()
