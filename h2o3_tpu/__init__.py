@@ -40,6 +40,14 @@ from .runtime.log import Log as _Log
 from . import client  # remote-attach REST client (h2o-py H2OConnection)
 
 
+def _conn_kwargs(kw):
+    """Shared connect-kwarg normalization (h2o-py spells the TLS opt-out
+    `verify_ssl_certificates`)."""
+    return dict(token=kw.get("token"), verbose=kw.get("verbose", True),
+                verify_ssl=kw.get("verify_ssl",
+                                  kw.get("verify_ssl_certificates", True)))
+
+
 def init(url=None, ip=None, port=None, nthreads=-1, max_mem_size=None,
          strict_version_check=False, **kw):
     """`h2o.init()` — form the local cloud (mesh over visible devices), or,
@@ -47,12 +55,7 @@ def init(url=None, ip=None, port=None, nthreads=-1, max_mem_size=None,
     client (h2o-py/h2o/h2o.py `init` → `H2OConnection.open`). An explicit
     endpoint that is unreachable raises — no silent local fallback."""
     if url is not None or ip is not None or port is not None:
-        return client.connect(url=url, ip=ip, port=port,
-                              token=kw.get("token"),
-                              verbose=kw.get("verbose", True),
-                              verify_ssl=kw.get(
-                                  "verify_ssl",
-                                  kw.get("verify_ssl_certificates", True)))
+        return client.connect(url=url, ip=ip, port=port, **_conn_kwargs(kw))
     return _mesh.init()
 
 
@@ -60,12 +63,7 @@ def connect(url=None, ip=None, port=None, **kw):
     """`h2o.connect(url=)` — attach to a running server by URL; with no
     endpoint, form the local in-process cloud (h2o-py parity)."""
     if url is not None or ip is not None or port is not None:
-        return client.connect(url=url, ip=ip, port=port,
-                              token=kw.get("token"),
-                              verbose=kw.get("verbose", True),
-                              verify_ssl=kw.get(
-                                  "verify_ssl",
-                                  kw.get("verify_ssl_certificates", True)))
+        return client.connect(url=url, ip=ip, port=port, **_conn_kwargs(kw))
     return init()
 
 

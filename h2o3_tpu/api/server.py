@@ -103,6 +103,7 @@ class _PayloadTooLarge(Exception):
 class _Handler(BaseHTTPRequestHandler):
     server_version = "h2o3tpu"
     protocol_version = "HTTP/1.1"
+    timeout = 120          # bounds slow-loris reads AND deferred TLS handshakes
 
     # route table (method, regex) → handler name — RequestServer.register
     ROUTES = [
@@ -1076,9 +1077,6 @@ class _Handler(BaseHTTPRequestHandler):
 
     def h_download_dataset(self):
         """`GET /3/DownloadDataset?frame_id=` — stream a frame as CSV."""
-        import csv as _csv
-        import io
-
         p = self._params()
         key = p.get("frame_id")
         fr = DKV.get(key)
@@ -1171,11 +1169,12 @@ class _Handler(BaseHTTPRequestHandler):
             fin = a[~np.isnan(a)]
             if fin.size:
                 cnt, edges = np.histogram(fin, bins=20)
+                srt = np.sort(fin)
                 out.update(
                     mean=float(fin.mean()), sigma=float(fin.std()),
-                    mins=[float(x) for x in np.sort(fin)[:5]],
-                    maxs=[float(x) for x in np.sort(fin)[-5:][::-1]],
-                    percentiles=[float(np.percentile(fin, q)) for q in
+                    mins=[float(x) for x in srt[:5]],
+                    maxs=[float(x) for x in srt[-5:][::-1]],
+                    percentiles=[float(np.percentile(srt, q)) for q in
                                  (1, 10, 25, 50, 75, 90, 99)],
                     histogram_bins=[int(c) for c in cnt],
                     histogram_base=float(edges[0]),
@@ -1212,8 +1211,14 @@ class H2OApiServer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(ssl_certfile, ssl_keyfile)
-            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
-                                                server_side=True)
+            # handshake in the per-request thread, NOT the accept loop: a
+            # client that trickles its ClientHello must not block accept()
+            # for everyone else (do_handshake_on_connect=False defers the
+            # handshake to the first read, which runs in the handler
+            # thread; the handler timeout below bounds it)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
             self.scheme = "https"
         self.port = self.httpd.server_address[1]
         self.host = host

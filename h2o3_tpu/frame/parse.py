@@ -99,12 +99,23 @@ def parse_csv(
 
 def _split_lines(lines: List[str], sep: str, ncol: int) -> List[np.ndarray]:
     """Shared line splitter for the python tokenize paths (whole-file and
-    distributed byte-range) — one place for quoting/strip semantics."""
+    distributed byte-range) — one place for quoting/strip semantics.
+    Lines containing a double quote take the RFC-4180 csv reader (so
+    quoted cells may hold the separator — what `frame_to_csv` emits);
+    everything else keeps the fast plain split."""
+    import csv as _csv
+
     cols: List[list] = [[] for _ in range(ncol)]
     for ln in lines:
-        parts = ln.split(sep)
+        if '"' in ln:
+            # the csv reader dequotes; don't strip again (a cell's CONTENT
+            # may legitimately start or end with a quote)
+            parts = [p.strip() for p in next(_csv.reader([ln],
+                                                         delimiter=sep))]
+        else:
+            parts = [p.strip().strip('"') for p in ln.split(sep)]
         for c in range(ncol):
-            cols[c].append(parts[c].strip().strip('"') if c < len(parts) else "")
+            cols[c].append(parts[c] if c < len(parts) else "")
     return [np.asarray(c, dtype=object) for c in cols]
 
 

@@ -107,6 +107,78 @@ def _levenshtein(a: str, b: str) -> float:
     return float(prev[lb])
 
 
+def _lcs_distance(a: str, b: str) -> float:
+    """LongestCommonSubsequenceDistance (commons-text): |a|+|b| − 2·|LCS|."""
+    la, lb = len(a), len(b)
+    if la == 0 or lb == 0:
+        return float(la + lb)
+    prev = [0] * (lb + 1)
+    for i in range(1, la + 1):
+        cur = [0] * (lb + 1)
+        ca = a[i - 1]
+        for j in range(1, lb + 1):
+            cur[j] = (prev[j - 1] + 1 if ca == b[j - 1]
+                      else max(prev[j], cur[j - 1]))
+        prev = cur
+    return float(la + lb - 2 * prev[lb])
+
+
+def _qgram_distance(a: str, b: str, q: int = 2) -> float:
+    """Ukkonen q-gram distance (q=2): Σ_g |count_a(g) − count_b(g)| over
+    the union of q-gram profiles; strings shorter than q compare by their
+    full text."""
+    if a == b:
+        return 0.0
+    if len(a) < q or len(b) < q:
+        return float((a != b) * max(1, abs(len(a) - len(b)) or 1))
+    from collections import Counter
+
+    pa = Counter(a[i:i + q] for i in range(len(a) - q + 1))
+    pb = Counter(b[i:i + q] for i in range(len(b) - q + 1))
+    return float(sum(abs(pa[g] - pb[g]) for g in pa.keys() | pb.keys()))
+
+
+def _jaccard_distance(a: str, b: str) -> float:
+    """Jaccard DISTANCE over character sets (commons-text
+    JaccardDistance): 1 − |A∩B| / |A∪B|."""
+    sa, sb = set(a), set(b)
+    if not sa and not sb:
+        return 0.0
+    return 1.0 - len(sa & sb) / len(sa | sb)
+
+
+_SOUNDEX_MAP = {**{c: d for cs, d in (
+    ("BFPV", "1"), ("CGJKQSXZ", "2"), ("DT", "3"),
+    ("L", "4"), ("MN", "5"), ("R", "6")) for c in cs}}
+
+
+def _soundex(s: str) -> str:
+    """American Soundex code (commons-codec Soundex): letter + 3 digits."""
+    letters = [c for c in s.upper() if c.isalpha()]
+    if not letters:
+        return ""
+    out = letters[0]
+    last = _SOUNDEX_MAP.get(letters[0], "")
+    for c in letters[1:]:
+        d = _SOUNDEX_MAP.get(c, "")
+        if d and d != last:
+            out += d
+            if len(out) == 4:
+                break
+        if c not in "HW":       # H/W are transparent for adjacency
+            last = d
+    return (out + "000")[:4]
+
+
+def _soundex_diff(a: str, b: str) -> float:
+    """commons-codec `SoundexUtils.difference`: number of agreeing
+    positions of the two 4-character codes (0..4)."""
+    ca, cb = _soundex(a), _soundex(b)
+    if not ca or not cb:
+        return 0.0
+    return float(sum(x == y for x, y in zip(ca, cb)))
+
+
 def _jaro_winkler(a: str, b: str) -> float:
     """Jaro-Winkler SIMILARITY in [0,1] (Apache commons-text semantics)."""
     if a == b:
@@ -508,10 +580,14 @@ class RapidsSession:
             # edit count, "jw" the Jaro-Winkler similarity
             measure = str(a[2]).lower() if len(a) > 2 else "lv"
             cmp_empty = _truthy(a[3] if len(a) > 3 else None, default=True)
-            fn = {"lv": _levenshtein, "jw": _jaro_winkler}.get(measure)
+            fn = {"lv": _levenshtein, "jw": _jaro_winkler,
+                  "lcs": _lcs_distance, "qgram": _qgram_distance,
+                  "jaccard": _jaccard_distance,
+                  "soundex": _soundex_diff}.get(measure)
             if fn is None:
-                raise ValueError(f"strDistance measure {measure!r}: only "
-                                 "'lv' and 'jw' are implemented")
+                raise ValueError(
+                    f"strDistance measure {measure!r}: expected one of "
+                    "lv, lcs, qgram, jaccard, jw, soundex")
             xs = a[0]._string_rows()
             ys = a[1]._string_rows()
             if len(xs) != len(ys):

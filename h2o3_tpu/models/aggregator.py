@@ -121,6 +121,9 @@ class H2OAggregatorEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> AggregatorModel:
+        from .model_base import warn_host_solver
+
+        warn_host_solver('aggregator', train.nrow, 200000)
         p = self._parms
         transform = p.get("transform", "NORMALIZE")
         dinfo = DataInfo(train, x, standardize=transform != "NONE",

@@ -177,6 +177,9 @@ class H2OCoxProportionalHazardsEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> CoxPHModel:
+        from .model_base import warn_host_solver
+
+        warn_host_solver('coxph', train.nrow, 500000)
         p = self._parms
         stop_col = p.get("stop_column")
         if stop_col is None:

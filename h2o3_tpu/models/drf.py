@@ -6,8 +6,9 @@ Reference parity: `h2o-algos/src/main/java/hex/tree/drf/DRF.java` /
 `histogram_type=Random` (`ai.h2o.automl` XRT step). Estimator surface:
 `h2o-py/h2o/estimators/random_forest.py`.
 
-Round-1 note: training metrics are in-bag (the reference reports OOB);
-OOB scoring is tracked for a follow-up round.
+Training metrics are OOB (out-of-bag prediction sums/counts accumulated
+per row during the forest build — `shared_tree.py` oob_sum/oob_cnt), as
+the reference reports; see `tests/test_gbm.py::test_drf_oob_training_metrics`.
 """
 
 from __future__ import annotations

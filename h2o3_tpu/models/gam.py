@@ -127,6 +127,9 @@ class H2OGeneralizedAdditiveEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> GAMModel:
+        from .model_base import warn_host_solver
+
+        warn_host_solver('gam', train.nrow, 500000)
         p = self._parms
         gam_cols: List[str] = list(p.get("gam_columns") or [])
         if not gam_cols:

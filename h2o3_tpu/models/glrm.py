@@ -100,6 +100,9 @@ class H2OGeneralizedLowRankEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> GLRMModel:
+        from .model_base import warn_host_solver
+
+        warn_host_solver('glrm', train.nrow, 200000)
         p = self._parms
         seed = p["_actual_seed"]
         k = int(p.get("k", 1))

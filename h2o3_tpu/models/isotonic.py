@@ -99,6 +99,9 @@ class H2OIsotonicRegressionEstimator(H2OEstimator):
     _param_defaults = dict(out_of_bounds="NA", custom_metric_func=None)
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]):
+        from .model_base import warn_host_solver
+
+        warn_host_solver('isotonicregression', train.nrow, 2000000)
         if len(x) != 1:
             raise ValueError("isotonicregression expects exactly one feature column")
         xn = x[0]

@@ -917,6 +917,20 @@ class H2OEstimator:
         return self.model.model_id
 
 
+def warn_host_solver(algo: str, n_rows: int, bound: int = 500_000) -> None:
+    """Long-tail algorithms solve host-side in numpy (documented in
+    docs/architecture.md §"Host-side solvers"): correct at their usual
+    scale, but a big frame deserves a loud heads-up rather than a silent
+    slow fit."""
+    if n_rows > bound:
+        from ..runtime.log import Log
+
+        Log.warn(
+            f"{algo}: {n_rows} rows exceed the ~{bound} row envelope of "
+            "this host-side (numpy) solver; expect host memory/time to "
+            "scale accordingly (docs/architecture.md)")
+
+
 def _is_const(v: Vec) -> bool:
     if v.type == "string":
         return False

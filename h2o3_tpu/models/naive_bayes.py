@@ -101,6 +101,9 @@ class H2ONaiveBayesEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> NaiveBayesModel:
+        from .model_base import warn_host_solver
+
+        warn_host_solver('naivebayes', train.nrow, 2000000)
         p = self._parms
         yvec = train.vec(y)
         problem, K, domain = response_info(yvec)

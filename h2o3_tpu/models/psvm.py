@@ -90,6 +90,9 @@ class H2OSupportVectorMachineEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> PSVMModel:
+        from .model_base import warn_host_solver
+
+        warn_host_solver('psvm', train.nrow, 100000)
         import optax
 
         p = self._parms

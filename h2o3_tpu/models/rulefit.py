@@ -155,6 +155,9 @@ class H2ORuleFitEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> RuleFitModel:
+        from .model_base import warn_host_solver
+
+        warn_host_solver('rulefit', train.nrow, 500000)
         p = self._parms
         yvec = train.vec(y)
         problem, nclass, domain = response_info(yvec)

@@ -206,3 +206,25 @@ def test_apply_comparison_and_save_force(tmp_path, cloud1):
     with pytest.raises(FileExistsError):
         h2o.save_model(km, str(tmp_path))
     h2o.save_model(km, str(tmp_path), force=True)
+
+
+def test_csv_roundtrip_quoted_cells(tmp_path, cloud1):
+    """frame_to_csv emits RFC-4180 quoting; the parser must read it back
+    (quoted cells may contain the separator)."""
+    import h2o3_tpu as h2o
+    from h2o3_tpu.frame.frame import frame_to_csv
+
+    fr = h2o.H2OFrame_from_python(
+        {"s": np.asarray(["a,b", 'say "hi"', "plain"], dtype=object),
+         "x": [1.5, 2.5, 3.5]})
+    text = frame_to_csv(fr)
+    p = tmp_path / "q.csv"
+    p.write_text(text)
+    back = h2o.import_file(str(p))
+    assert back.nrow == 3 and back.ncol == 2
+    v = back.vec("s")
+    vals = [v.domain[c] if v.type == "enum" else c
+            for c in (np.asarray(v.data) if v.type == "enum"
+                      else v.to_numpy())]
+    assert vals[0] == "a,b" and vals[1] == 'say "hi"' 
+    np.testing.assert_allclose(back.vec("x").numeric_np(), [1.5, 2.5, 3.5])

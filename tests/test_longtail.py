@@ -81,3 +81,17 @@ def test_glrm_low_rank_recovery_and_impute(cloud1):
     assert np.median(err) < 0.2
     arch = glrm.model.archetypes()
     assert arch.shape == (k, p)
+
+
+def test_host_solver_size_guard_warns(cloud1, monkeypatch):
+    """Long-tail host-numpy fits warn loudly past their documented row
+    envelope (docs/architecture.md 'Host-side solvers')."""
+    from h2o3_tpu.models.model_base import warn_host_solver
+    from h2o3_tpu.runtime.log import Log
+
+    seen = []
+    monkeypatch.setattr(Log, "warn", staticmethod(seen.append))
+    warn_host_solver("coxph", 100, bound=500_000)
+    assert not seen
+    warn_host_solver("coxph", 600_000, bound=500_000)
+    assert seen and "host-side" in seen[0]

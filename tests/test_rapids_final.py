@@ -162,3 +162,28 @@ def test_setproperty_setlevel_append(cloud1):
     assert out.names == ["a", "seven"]
     np.testing.assert_array_equal(
         np.asarray(out.vec("seven").numeric_np()), [7.0, 7.0])
+
+
+def test_str_distance_all_six_measures(cloud1):
+    """strDistance 6/6 (AstStrDistance over the Apache measures) —
+    round-4 completion of the r03 inventory gap."""
+    a = _fr(s=np.asarray(["kitten", "robert", "night"], dtype=object))
+    b = _fr(s=np.asarray(["sitting", "rupert", "nacht"], dtype=object))
+    # lcs: |a|+|b| - 2*LCS ; LCS(kitten, sitting) = "ittn" (4)
+    got = _col(h2o.rapids(f'(strDistance {a.key} {b.key} "lcs" TRUE)'))
+    assert got[0] == 6.0 + 7.0 - 2 * 4.0
+    # qgram: bigram profile L1 distance
+    got = _col(h2o.rapids(f'(strDistance {a.key} {b.key} "qgram" TRUE)'))
+    assert got[0] > 0 and np.isfinite(got).all()
+    ident = _fr(s=np.asarray(["abc"], dtype=object))
+    same = _col(h2o.rapids(
+        f'(strDistance {ident.key} {ident.key} "qgram" TRUE)'))
+    assert same[0] == 0.0
+    # jaccard: 1 - |chars∩|/|chars∪|
+    got = _col(h2o.rapids(f'(strDistance {a.key} {b.key} "jaccard" TRUE)'))
+    assert 0.0 < got[0] < 1.0
+    # soundex: robert/rupert encode identically (R163) -> 4 agreeing chars
+    got = _col(h2o.rapids(f'(strDistance {a.key} {b.key} "soundex" TRUE)'))
+    assert got[1] == 4.0
+    with pytest.raises(Exception):
+        h2o.rapids(f'(strDistance {a.key} {b.key} "bogus" TRUE)')
