@@ -814,6 +814,17 @@ def frame_to_csv(fr: "Frame") -> str:
     w = _csv.writer(buf)
     w.writerow(fr.names)
     cols = fr.as_data_frame(use_pandas=False)
+    for n in fr.names:
+        col = cols[n]
+        if len(col) and isinstance(col[0], str) and any(
+                isinstance(v, str) and ("\n" in v or "\r" in v)
+                for v in col):
+            # the parser (and the distributed byte-range splitter — like
+            # the reference's) is line-oriented: a quoted embedded newline
+            # cannot round-trip, so refuse loudly instead of corrupting
+            raise ValueError(
+                f"column {n!r} contains embedded newlines; CSV "
+                "serialization is line-oriented (strip them first)")
     mats = [cols[n] for n in fr.names]
     for i in range(fr.nrow):
         w.writerow([

@@ -108,10 +108,11 @@ def _split_lines(lines: List[str], sep: str, ncol: int) -> List[np.ndarray]:
     cols: List[list] = [[] for _ in range(ncol)]
     for ln in lines:
         if '"' in ln:
-            # the csv reader dequotes; don't strip again (a cell's CONTENT
-            # may legitimately start or end with a quote)
-            parts = [p.strip() for p in next(_csv.reader([ln],
-                                                         delimiter=sep))]
+            # the csv reader dequotes; don't strip OR re-strip quotes —
+            # quoting exists precisely to preserve edge whitespace and
+            # literal quote characters (numeric conversion downstream
+            # tolerates surrounding spaces on the rare mixed lines)
+            parts = next(_csv.reader([ln], delimiter=sep))
         else:
             parts = [p.strip().strip('"') for p in ln.split(sep)]
         for c in range(ncol):

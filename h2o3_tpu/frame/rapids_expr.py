@@ -130,7 +130,7 @@ def _qgram_distance(a: str, b: str, q: int = 2) -> float:
     if a == b:
         return 0.0
     if len(a) < q or len(b) < q:
-        return float((a != b) * max(1, abs(len(a) - len(b)) or 1))
+        return float(max(1, abs(len(a) - len(b))))   # a != b here
     from collections import Counter
 
     pa = Counter(a[i:i + q] for i in range(len(a) - q + 1))

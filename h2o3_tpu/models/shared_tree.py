@@ -77,21 +77,30 @@ from .model_base import DataInfo, H2OEstimator, H2OModel, ScoreKeeper, response_
 _predict_codes_jit = jax.jit(treelib.predict_codes, static_argnames=("max_depth",))
 
 
-@functools.partial(jax.jit, static_argnames=("n", "nbins"))
-def _binom_binned_stats(margins, y_d, n: int, nbins: int = 400):
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def _binom_binned_stats(margins, y_d, n, nbins: int = 400):
     """AUC2-style 400-bin score histogram ON DEVICE (hex/AUC2.java): the
     quantile edges, per-bin (pos, neg) counts and the logloss/mse sums are
     the only things that cross the wire (~KBs instead of the 4·n-byte
-    margin pull + a host rank sort)."""
-    p = jax.nn.sigmoid(margins[:n, 0])
-    y = y_d[:n, 0]
-    qs = jnp.quantile(p, jnp.linspace(0.0, 1.0, nbins))
+    margin pull + a host rank sort).
+
+    `n` is TRACED (pad rows masked out), so CV folds padded to the parent
+    frame's row shape reuse ONE compiled program instead of recompiling
+    per fold row count (cold-start tax, VERDICT r03 #2)."""
+    valid = jnp.arange(margins.shape[0]) < n
+    p = jax.nn.sigmoid(margins[:, 0])
+    y = y_d[:, 0]
+    qs = jnp.nanquantile(jnp.where(valid, p, jnp.nan),
+                         jnp.linspace(0.0, 1.0, nbins))
     bins = jnp.searchsorted(qs, p, side="left")
-    npos = jax.ops.segment_sum(y, bins, num_segments=nbins + 1)
-    nneg = jax.ops.segment_sum(1.0 - y, bins, num_segments=nbins + 1)
+    vf = valid.astype(jnp.float32)
+    npos = jax.ops.segment_sum(y * vf, bins, num_segments=nbins + 1)
+    nneg = jax.ops.segment_sum((1.0 - y) * vf, bins,
+                               num_segments=nbins + 1)
     pc = jnp.clip(p, 1e-15, 1 - 1e-15)
-    nll = -jnp.sum(jnp.where(y > 0.5, jnp.log(pc), jnp.log(1.0 - pc)))
-    sq = jnp.sum((p - y) ** 2)
+    nll = -jnp.sum(jnp.where(valid & (y > 0.5), jnp.log(pc), 0.0)
+                   + jnp.where(valid & (y <= 0.5), jnp.log(1.0 - pc), 0.0))
+    sq = jnp.sum(jnp.where(valid, (p - y) ** 2, 0.0))
     return qs, npos, nneg, nll, sq
 
 
@@ -1402,6 +1411,16 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     # programs dispatch — a CPU mesh deadlocks on two
                     # concurrent collective executables (collective_fence)
                     cloudlib.collective_fence(out[0])
+                    # also pre-load the other per-config program of a cold
+                    # run: the device-side AUC2 training-metrics reduction
+                    # (VERDICT r03 #2 — warm ALL programs of a config, not
+                    # just the first tree program)
+                    if (problem == "binomial" and dist == "bernoulli"
+                            and self._mode == "gbm" and ndev == 1):
+                        _binom_binned_stats(
+                            jnp.zeros((npad, K), jnp.float32),
+                            jnp.zeros((npad, K), jnp.float32),
+                            jnp.int32(npad))
                 except Exception:  # warm-up is advisory; real call reports
                     pass
 
@@ -2094,7 +2113,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # binomial GBM/XGB: the whole training-metric reduction runs on
             # device (AUC2 binned design) — no margin D2H, no host rank sort
             qs_b, npos_b, nneg_b, nll_b, sq_b = _binom_binned_stats(
-                margins, y_d, n)
+                margins, y_d, jnp.int32(n))
             model.training_metrics = ModelMetricsBinomial.from_binned(
                 np.asarray(qs_b), np.asarray(npos_b), np.asarray(nneg_b),
                 float(nll_b), float(sq_b))
