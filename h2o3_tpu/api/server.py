@@ -934,10 +934,19 @@ class _Handler(BaseHTTPRequestHandler):
         max_models = int(p.get("max_models", build.get("max_models", 0)) or 0)
         if max_models:
             kw["max_models"] = max_models
-        max_rt = float(p.get("max_runtime_secs",
-                             build.get("max_runtime_secs", 0)) or 0)
-        if max_rt:
-            kw["max_runtime_secs"] = max_rt
+        # an EXPLICIT 0 means unlimited (the ctor default is 3600) — only
+        # an absent key keeps the default
+        max_rt = p.get("max_runtime_secs", build.get("max_runtime_secs"))
+        if max_rt is not None and str(max_rt) != "":
+            kw["max_runtime_secs"] = float(max_rt)
+        if p.get("sort_metric"):
+            kw["sort_metric"] = str(p["sort_metric"])
+        for lk in ("exclude_algos", "include_algos"):
+            v = p.get(lk, build.get(lk))
+            if isinstance(v, str) and v:
+                v = json.loads(v)
+            if v:
+                kw[lk] = list(v)
         aml = H2OAutoML(**kw)
         import uuid
 
@@ -972,8 +981,10 @@ class _Handler(BaseHTTPRequestHandler):
         rows = ([{k: v for k, v in r.items() if not k.startswith("_")}
                  for r in aml.leaderboard.rows]
                 if aml.leaderboard is not None else [])
+        lbm = (aml.leaderboard.sort_metric
+               if aml.leaderboard is not None else None)
         return dict(project_name=aml.project_name,
-                    leaderboard=dict(rows=rows))
+                    leaderboard=dict(rows=rows, sort_metric=lbm))
 
     def h_automl_get(self, project):
         from ..automl.automl import H2OAutoML

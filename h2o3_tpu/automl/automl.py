@@ -241,10 +241,55 @@ class H2OAutoML:
                 self._build_model(f"{algo}_grid_1_model_{ci + 1}", cls, parms,
                                   x, y, training_frame)
 
+    def _remote_train(self, x, y, training_frame):
+        """AutoML against an attached server: POST `/99/AutoMLBuilder`,
+        poll the job, hydrate leaderboard + leader from `/99/AutoML/{id}`
+        (h2o-py's H2OAutoML is the same REST choreography). The full
+        builder config travels with the request; 0 = unlimited runtime is
+        forwarded EXPLICITLY (the server-side default is 3600 s)."""
+        import json as _json
+        import urllib.parse as _up
+
+        conn = training_frame.conn
+        params = dict(training_frame=training_frame.key, response_column=y,
+                      project_name=self.project_name, seed=self.seed,
+                      nfolds=self.nfolds,
+                      max_runtime_secs=self.max_runtime_secs,
+                      sort_metric=self.sort_metric)
+        if x is not None:
+            params["x"] = _json.dumps(list(x))
+        if self.max_models:
+            params["max_models"] = self.max_models
+        if self.exclude_algos:
+            params["exclude_algos"] = _json.dumps(sorted(self.exclude_algos))
+        if self.include_algos is not None:
+            params["include_algos"] = _json.dumps(sorted(self.include_algos))
+        out = conn.post("/99/AutoMLBuilder", **params)
+        job_key = out["job"]["key"]["name"]
+        conn.wait_for_job(
+            job_key,
+            timeout=(self.max_runtime_secs + 600.0
+                     if self.max_runtime_secs > 0 else 86_400.0))
+        got = conn.get(f"/99/AutoML/{_up.quote(self.project_name, safe='')}")
+        metric = got["leaderboard"].get("sort_metric") or self.sort_metric
+        lb = Leaderboard(metric,
+                         metric in ("auc", "pr_auc", "accuracy", "r2"))
+        lb.rows = got["leaderboard"]["rows"]
+        self.leaderboard = lb
+        self._remote_conn = conn
+        if got.get("leader"):
+            from ..client import RemoteModel
+
+            self.leader = RemoteModel(conn, got["leader"]["name"])
+        self.event_log.events.extend(got.get("event_log") or [])
+        return self
+
     def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
               validation_frame=None, leaderboard_frame=None, blending_frame=None,
               **kw):
         assert training_frame is not None and y is not None
+        if getattr(training_frame, "_is_remote", False):
+            return self._remote_train(x, y, training_frame)
         self._lb_frame = leaderboard_frame
         t0 = time.time()
         problem, nclass, domain = response_info(training_frame.vec(y))
@@ -329,7 +374,16 @@ class H2OAutoML:
             rows = sorted(rows, key=sk)
         for r in rows:
             if algorithm is None or r["algo"].lower() == algorithm.lower():
-                return r["_est"]
+                if "_est" in r:
+                    return r["_est"]
+                # remote-hydrated leaderboard: the server strips private
+                # keys — return a REST-backed model by id instead
+                conn = getattr(self, "_remote_conn", None)
+                if conn is not None:
+                    from ..client import RemoteModel
+
+                    return RemoteModel(conn, r["model_id"])
+                raise KeyError("_est")
         return None
 
     def get_leaderboard(self, extra_columns=None):

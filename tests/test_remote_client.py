@@ -191,3 +191,27 @@ def test_remote_frame_from_python_and_parse_options(remote_server, tmp_path):
                           np.zeros((3, 2)), names=["a", "b"]))
     finally:
         h2o.shutdown()
+
+
+def test_remote_automl_leaderboard(remote_server, csvfile):
+    """AutoML drives /99/AutoMLBuilder + Jobs + /99/AutoML over the wire —
+    the 'leaderboard' leg of the client contract (VERDICT r03 #3)."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        from h2o3_tpu.automl.automl import H2OAutoML
+
+        fr = h2o.upload_file(csvfile, destination_frame="aml_remote")
+        fr["y"] = fr["y"].asfactor()
+        aml = H2OAutoML(max_models=2, seed=1, nfolds=2,
+                        project_name="aml_rc")
+        aml.train(x=["a", "b", "c"], y="y", training_frame=fr)
+        assert aml.leaderboard.rows, "empty remote leaderboard"
+        assert aml.leaderboard.rows[0]["auc"] > 0.7
+        assert aml.leaderboard.sort_metric == "auc"
+        assert isinstance(aml.leader, RemoteModel)
+        best = aml.get_best_model()
+        assert isinstance(best, RemoteModel)
+        pred = aml.predict(fr)
+        assert pred.nrow == 400
+    finally:
+        h2o.shutdown()
