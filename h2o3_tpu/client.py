@@ -457,6 +457,23 @@ class RemoteModel:
         return f"<RemoteModel {self.model_id!r} @ {self.conn.url}>"
 
 
+def encode_nondefault_params(parms: Dict[str, Any], cls) -> Dict[str, Any]:
+    """Wire-encode an estimator param dict: drop Nones/defaults/private
+    keys, JSON-encode containers AND bools (form-encoding a Python bool
+    yields 'True', which json.loads rejects server-side, leaving a truthy
+    string). ONE encoder shared by estimator and grid remote paths."""
+    defaults = {**cls._common_defaults, **cls._param_defaults}
+    out: Dict[str, Any] = {}
+    for k, v in parms.items():
+        if k.startswith("_") or v is None:
+            continue
+        if k in defaults and defaults[k] == v:
+            continue
+        out[k] = (json.dumps(v) if isinstance(v, (list, tuple, dict, bool))
+                  else v)
+    return out
+
+
 def remote_train(est, x: Optional[Sequence], y: Optional[str],
                  training_frame: RemoteFrame,
                  validation_frame: Optional[RemoteFrame] = None):
@@ -471,15 +488,7 @@ def remote_train(est, x: Optional[Sequence], y: Optional[str],
             "validation_frame must be a RemoteFrame on the same server as "
             "training_frame (got a local %s — upload it first)"
             % type(validation_frame).__name__)
-    defaults = {**est._common_defaults, **est._param_defaults}
-    params: Dict[str, Any] = {}
-    for k, v in est._parms.items():
-        if k.startswith("_") or v is None:
-            continue
-        if k in defaults and defaults[k] == v:
-            continue
-        params[k] = json.dumps(v) if isinstance(v, (list, tuple, dict)) \
-            else v
+    params = encode_nondefault_params(est._parms, type(est))
     params["training_frame"] = training_frame.key
     if validation_frame is not None:
         params["validation_frame"] = validation_frame.key

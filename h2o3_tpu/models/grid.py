@@ -148,6 +148,12 @@ class H2OGridSearch:
         return combos
 
     def train(self, x=None, y=None, training_frame: Optional[Frame] = None, **kw):
+        if getattr(training_frame, "_is_remote", False):
+            if kw:
+                raise TypeError(
+                    "remote grid search forwards x/y/training_frame only; "
+                    f"unsupported kwargs for the wire path: {sorted(kw)}")
+            return self._remote_train(x, y, training_frame)
         t0 = time.time()
         budget = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
         for combo in self._combos():
@@ -192,17 +198,64 @@ class H2OGridSearch:
                     pass
         return self
 
+    def _remote_train(self, x, y, training_frame):
+        """Grid search against an attached server — POST `/99/Grid/{algo}`
+        with the hyper space + base params, poll the job, hydrate
+        REST-backed models from `/99/Grids/{id}` (h2o-py's H2OGridSearch
+        REST choreography)."""
+        import json as _json
+        import urllib.parse as _up
+
+        from ..client import RemoteModel
+
+        from ..client import encode_nondefault_params
+
+        conn = training_frame.conn
+        cls = self.model_class
+        params = encode_nondefault_params(self.base_parms, cls)
+        params.update(training_frame=training_frame.key, response_column=y,
+                      grid_id=self.grid_id,
+                      hyper_parameters=_json.dumps(self.hyper_params),
+                      search_criteria=_json.dumps(self.search_criteria))
+        if x is not None:
+            params["x"] = _json.dumps(list(x))
+        out = conn.post(f"/99/Grid/{cls.algo}", **params)
+        budget = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
+        conn.wait_for_job(out["job"]["key"]["name"],
+                          timeout=budget + 600.0 if budget else 86_400.0)
+        got = conn.get(f"/99/Grids/{_up.quote(self.grid_id, safe='')}")
+        self.models = [RemoteModel(conn, d["name"])
+                       for d in got["model_ids"]]
+        self.failed = [{"error": e}
+                       for e in got.get("failure_details", []) if e]
+        return self
+
     # -- h2o-py surface ------------------------------------------------------
     def get_grid(self, sort_by: Optional[str] = None, decreasing: Optional[bool] = None):
         if sort_by:
             if decreasing is None:
                 decreasing = sort_by.lower() in ("auc", "pr_auc", "accuracy", "r2")
-            xval = any(m._parms.get("nfolds", 0) for m in self.models)
+            def _nfolds(m):
+                if getattr(m, "_parms", None) is not None:
+                    return m._parms.get("nfolds", 0)
+                ps = getattr(m, "params", None)   # REST-backed models
+                return (ps or {}).get("nfolds", 0) if isinstance(ps, dict) \
+                    else 0
+
+            xval = any(_nfolds(m) for m in self.models)
 
             def metric(m):
                 try:
-                    return getattr(m, sort_by)(xval=xval) if callable(getattr(m, sort_by, None)) \
-                        else getattr(m.model._m(xval=xval), sort_by)
+                    fn = getattr(m, sort_by, None)
+                    if callable(fn):
+                        return fn(xval=xval)
+                    if hasattr(m, "_m"):       # REST-backed: metrics dict
+                        v = getattr(m._m(xval=xval), sort_by, None)
+                        v = v() if callable(v) else v
+                        if v is None:
+                            v = m._m(xval=xval).get(sort_by)
+                        return float("nan") if v is None else float(v)
+                    return getattr(m.model._m(xval=xval), sort_by)
                 except Exception:
                     return float("nan")
 

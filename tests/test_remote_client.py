@@ -215,3 +215,25 @@ def test_remote_automl_leaderboard(remote_server, csvfile):
         assert pred.nrow == 400
     finally:
         h2o.shutdown()
+
+
+def test_remote_grid_search(remote_server, csvfile):
+    """Grid search over the wire: /99/Grid/{algo} + Jobs + /99/Grids —
+    h2o-py's grid REST choreography."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+        from h2o3_tpu.models.grid import H2OGridSearch
+
+        fr = h2o.upload_file(csvfile, destination_frame="grid_remote")
+        fr["y"] = fr["y"].asfactor()
+        gs = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=4, seed=1),
+                           hyper_params={"max_depth": [2, 4]},
+                           grid_id="rgrid")
+        gs.train(x=["a", "b", "c"], y="y", training_frame=fr)
+        assert len(gs.models) == 2
+        assert all(isinstance(m, RemoteModel) for m in gs.models)
+        gs.get_grid(sort_by="auc")
+        assert gs.models[0].auc() >= gs.models[1].auc()
+    finally:
+        h2o.shutdown()
