@@ -386,6 +386,16 @@ def show_progress():
 
 # model save/load (h2o.save_model / h2o.load_model → /3/Models.bin)
 def save_model(model, path: str = ".", force: bool = False, filename=None) -> str:
+    m = getattr(model, "_model", None) or model
+    if getattr(m, "_is_remote", False):
+        # REST-backed model: the artifact downloads from the server; the
+        # local force= overwrite guard applies identically
+        target = (path if _os.path.splitext(path)[1]
+                  and not _os.path.isdir(path)
+                  else _os.path.join(path, filename or f"{m.model_id}.h2o3"))
+        if _os.path.exists(target) and not force:
+            raise FileExistsError(f"{target} exists; pass force=True")
+        return m.download_mojo(path, filename=filename)
     from .mojo import save_model as _save
 
     return _save(model, path, filename=filename, force=force)

@@ -322,6 +322,36 @@ class RemoteFrame:
                                rows=min(rows, 10_000))
         return out["columns"]
 
+    def as_data_frame(self, use_pandas: bool = True):
+        """Full frame contents via `/3/DownloadDataset` (CSV over the
+        wire), as a pandas DataFrame (default, matching the local Frame
+        and h2o-py) or dict-of-lists."""
+        url = (f"{self.conn.url}/3/DownloadDataset?frame_id="
+               f"{urllib.parse.quote(self.key)}")
+        req = urllib.request.Request(url, headers=(
+            {"Authorization": f"Bearer {self.conn.token}"}
+            if self.conn.token else {}))
+        with urllib.request.urlopen(req, timeout=self.conn.timeout,
+                                    context=self.conn._ssl_ctx) as r:
+            text = r.read().decode()
+        import csv as _csv
+        import io as _io
+
+        rows = list(_csv.reader(_io.StringIO(text)))
+        header, body = rows[0], rows[1:]
+        types = self.types
+        out: Dict[str, list] = {}
+        for j, name in enumerate(header):
+            vals = [r[j] if j < len(r) else "" for r in body]
+            if types.get(name) in ("real", "int", "time"):
+                vals = [float(v) if v != "" else float("nan") for v in vals]
+            out[name] = vals
+        if use_pandas:
+            import pandas as pd
+
+            return pd.DataFrame(out, columns=header)
+        return out
+
     def delete(self) -> None:
         self.conn.delete(f"/3/Frames/{urllib.parse.quote(self.key)}")
 
@@ -449,6 +479,27 @@ class RemoteModel:
             f"/3/ModelMetrics/models/{urllib.parse.quote(self.model_id)}"
             f"/frames/{urllib.parse.quote(test_data.key)}")
         return _RemoteMetrics(out["model_metrics"][0])
+
+    def download_mojo(self, path: str = ".",
+                      filename: Optional[str] = None) -> str:
+        """Fetch the model's MOJO artifact zip from the server
+        (`GET /3/Models/{id}/mojo` — h2o-py `download_mojo`)."""
+        url = (f"{self.conn.url}/3/Models/"
+               f"{urllib.parse.quote(self.model_id)}/mojo")
+        req = urllib.request.Request(url, headers=(
+            {"Authorization": f"Bearer {self.conn.token}"}
+            if self.conn.token else {}))
+        with urllib.request.urlopen(req, timeout=self.conn.timeout,
+                                    context=self.conn._ssl_ctx) as r:
+            blob = r.read()
+        if os.path.isdir(path) or not os.path.splitext(path)[1]:
+            out = os.path.join(path, filename or f"{self.model_id}.h2o3")
+        else:
+            out = path
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "wb") as f:
+            f.write(blob)
+        return out
 
     def delete(self) -> None:
         self.conn.delete(f"/3/Models/{urllib.parse.quote(self.model_id)}")

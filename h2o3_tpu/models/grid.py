@@ -226,7 +226,9 @@ class H2OGridSearch:
         got = conn.get(f"/99/Grids/{_up.quote(self.grid_id, safe='')}")
         self.models = [RemoteModel(conn, d["name"])
                        for d in got["model_ids"]]
-        self.failed = [{"error": e}
+        # combo params are not recoverable over the wire: keep the local
+        # dict shape with an explicit None
+        self.failed = [{"params": None, "error": e}
                        for e in got.get("failure_details", []) if e]
         return self
 
@@ -248,7 +250,8 @@ class H2OGridSearch:
                 try:
                     fn = getattr(m, sort_by, None)
                     if callable(fn):
-                        return fn(xval=xval)
+                        v = fn(xval=xval)
+                        return float("nan") if v is None else float(v)
                     if hasattr(m, "_m"):       # REST-backed: metrics dict
                         v = getattr(m._m(xval=xval), sort_by, None)
                         v = v() if callable(v) else v

@@ -237,3 +237,32 @@ def test_remote_grid_search(remote_server, csvfile):
         assert gs.models[0].auc() >= gs.models[1].auc()
     finally:
         h2o.shutdown()
+
+
+def test_remote_mojo_download_and_frame_pull(remote_server, csvfile,
+                                             tmp_path):
+    """h2o.save_model on a REST-backed model downloads the artifact;
+    RemoteFrame.as_data_frame pulls full contents over DownloadDataset."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+
+        fr = h2o.upload_file(csvfile, destination_frame="dl_remote")
+        fr["y"] = fr["y"].asfactor()
+        m = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+        m.train(x=["a", "b", "c"], y="y", training_frame=fr)
+        path = h2o.save_model(m, str(tmp_path))
+        data = fr.as_data_frame()
+        assert len(data["a"]) == 400 and isinstance(data["a"][0], float)
+    finally:
+        h2o.shutdown()
+    # artifact loads and scores OFFLINE (no connection)
+    scorer = h2o.load_model(path)
+    import numpy as np
+    from h2o3_tpu.frame.frame import Frame
+
+    Xl = Frame.from_dict({"a": np.asarray(data["a"]),
+                          "b": np.asarray(data["b"]),
+                          "c": np.asarray(data["c"])})
+    p1 = scorer.predict(Xl).vec("1").numeric_np()
+    assert np.isfinite(p1).all() and len(p1) == 400
