@@ -253,6 +253,95 @@ def frames():
     return _DKV.keys(Frame)
 
 
+def remove_all(retained=None) -> None:
+    """`h2o.remove_all()` — clear the DKV, optionally keeping some keys
+    (water/api RemoveAllHandler `retained_keys`). Connected remotely, the
+    un-retained form clears the SERVER's DKV (`DELETE /3/DKV`)."""
+    conn = client.current_connection()
+    if conn is not None:
+        if retained:
+            raise NotImplementedError(
+                "remove_all(retained=...) is not supported over a remote "
+                "connection; delete keys individually")
+        conn.delete("/3/DKV")
+        return
+    keep = {getattr(o, "key", None) or getattr(o, "model_id", None) or o
+            for o in (retained or [])}
+    if not keep:
+        _DKV.clear()
+        return
+    for k in list(_DKV.keys()):
+        if k not in keep:
+            _DKV.remove(k)
+
+
+def insert_missing_values(frame: Frame, fraction: float = 0.1,
+                          seed=None) -> Frame:
+    """`h2o.insert_missing_values` — set a random fraction of each
+    column's cells to NA IN PLACE (hex/CreateFrame MissingInserter)."""
+    from .frame.vec import Vec
+
+    if getattr(frame, "_is_remote", False):
+        raise NotImplementedError(
+            "insert_missing_values runs in-process; pull the frame or run "
+            "it server-side")
+    rng = np.random.default_rng(seed)
+    for n in frame.names:
+        v = frame.vec(n)
+        mask = rng.random(frame.nrow) < fraction
+        if v.type in ("real", "int", "time"):
+            a = v.numeric_np().copy()
+            a[mask] = np.nan
+            frame[n] = Vec(a, v.type)       # keep the column's type label
+        elif v.type == "enum":
+            codes = np.asarray(v.data).copy()
+            codes[mask] = -1
+            frame[n] = Vec(codes, "enum", domain=v.domain)
+        elif v.type == "string":
+            strs = np.asarray(v.to_numpy(), dtype=object).copy()
+            strs[mask] = None
+            frame[n] = Vec(None, "string", strings=strs)
+    return frame
+
+
+def get_timezone() -> str:
+    """`h2o.get_timezone` — the cluster datetime parsing zone (read from
+    the SERVER when attached remotely)."""
+    conn = client.current_connection()
+    if conn is not None:
+        return str(conn.rapids("(getTimeZone)").get("string"))
+    from .frame.rapids_expr import _TIME_ZONE
+
+    return _TIME_ZONE[0]
+
+
+def set_timezone(tz: str) -> None:
+    """`h2o.set_timezone` — honored by Rapids moment/asDate; applied on
+    the SERVER when attached remotely (its Rapids session does the date
+    parsing)."""
+    import zoneinfo
+
+    zoneinfo.ZoneInfo(tz)  # validate now, not at first use
+    conn = client.current_connection()
+    if conn is not None:
+        conn.rapids(f'(setTimeZone "{tz}")')
+        return
+    from .frame.rapids_expr import _TIME_ZONE
+
+    _TIME_ZONE[0] = tz
+
+
+def download_csv(data, filename: str) -> str:
+    """`h2o.download_csv` — write a frame as CSV client-side. Remote
+    frames duck-type `frame_to_csv`'s surface (names/nrow/as_data_frame),
+    so local and remote share the ONE serializer (and its guards)."""
+    from .frame.frame import frame_to_csv
+
+    with open(filename, "w") as f:
+        f.write(frame_to_csv(data))
+    return filename
+
+
 def deep_copy(frame: Frame, dest: str) -> Frame:
     """`h2o.deep_copy` — independent copy of a frame's columns."""
     from .frame.vec import Vec

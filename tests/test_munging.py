@@ -279,3 +279,44 @@ def test_rapids_apply_margin1_frame_result(cloud1):
     assert out.shape == (3, 2)
     assert list(np.asarray(out._col0())) == [2.0, 3.0, 4.0]
     assert list(np.asarray(out.vec(out.names[1]).numeric_np())) == [5.0, 6.0, 7.0]
+
+
+def test_package_utilities_round4(cloud1, tmp_path):
+    """h2o.models / remove_all / insert_missing_values / timezone /
+    download_csv — h2o-py package-surface parity."""
+    import h2o3_tpu as h2o
+    from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(0)
+    fr = h2o.H2OFrame_from_python(
+        {"a": rng.normal(size=200),
+         "c": np.asarray([f"k{i%3}" for i in range(200)], dtype=object),
+         "y": (rng.random(200) > 0.5).astype(int).astype(str)},
+        column_types={"y": "enum", "c": "enum"})
+    m = H2OGradientBoostingEstimator(ntrees=2, max_depth=2, seed=1)
+    m.train(x=["a", "c"], y="y", training_frame=fr)
+    assert m.model_id in h2o.ls()
+
+    # timezone validate + roundtrip
+    h2o.set_timezone("America/New_York")
+    assert h2o.get_timezone() == "America/New_York"
+    with pytest.raises(Exception):
+        h2o.set_timezone("Not/AZone")
+    h2o.set_timezone("UTC")
+
+    # missing inserter: both numeric and enum columns gain NAs in place
+    h2o.insert_missing_values(fr, fraction=0.3, seed=7)
+    assert fr.vec("a").nacnt() > 20
+    assert fr.vec("c").nacnt() > 20
+
+    # download_csv writes the full frame
+    p = str(tmp_path / "dl.csv")
+    h2o.download_csv(fr, p)
+    assert open(p).readline().strip() == "a,c,y"
+
+    # remove_all with retention
+    h2o.remove_all(retained=[fr])
+    assert h2o.get_frame(fr.key) is fr
+    h2o.remove_all()
+    with pytest.raises(KeyError):
+        h2o.get_frame(fr.key)
