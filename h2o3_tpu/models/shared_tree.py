@@ -1688,7 +1688,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # stays EAGER: a jitted multi-arg combine has been observed to
             # interleave with in-flight collective tree programs on the
             # XLA:CPU thunk pool and deadlock the all-reduce rendezvous.
-            if distdata.multiprocess():
+            if distdata.multiprocess() or ndev == 1:
+                # single REAL device has no collective programs in flight,
+                # so the jitted combine is safe there too — and it turns
+                # ~2·nsteps eager dispatches per chunk (each paying the
+                # remote-tunnel latency) into three
                 return (margins, oob_sum, oob_cnt,
                         _stack_args(*packed_list), _sum_args(*gains_list),
                         _sum_args(*ov_list))
