@@ -375,6 +375,20 @@ class RapidsSession:
 
     # -- prims ---------------------------------------------------------------
     def _apply(self, op, a: List[Any]):
+        """Prim dispatch with a uniform malformed-call guard: wrong arity
+        or argument kinds surface as the interpreter's IndexError /
+        AttributeError / ZeroDivisionError deep inside a prim — those are
+        USER errors (`water/rapids` raises IllegalArgumentException), so
+        they map to ValueError → HTTP 400, keeping the detail, instead of
+        leaking as 500s (found by fuzzing the `/99/Rapids` surface)."""
+        try:
+            return self._apply_prim(op, a)
+        except (IndexError, AttributeError, ZeroDivisionError) as e:
+            raise ValueError(
+                f"rapids: malformed call to {op!r} with {len(a)} arg(s): "
+                f"{type(e).__name__}: {e}") from e
+
+    def _apply_prim(self, op, a: List[Any]):
         import operator
 
         if callable(op):

@@ -181,3 +181,17 @@ def test_scalar_first_multicolumn(cloud1):
     assert out.ncol == 2
     assert list(_col(out, 0)) == [99.0, 98.0]
     assert list(_col(out, 1)) == [90.0, 80.0]
+
+
+@pytest.mark.parametrize("expr", [
+    "(append)", "(cut)", "(mean)", "(unique)", "(strDistance)",
+    '(unique "x" "y" TRUE)', '(trim "x" TRUE [])',
+    '(+ (hist 1 "x") (is.na -3.5 1 "x"))',
+])
+def test_malformed_rapids_raise_value_error(cloud1, expr):
+    """Wrong arity / argument kinds are USER errors (ValueError → 400),
+    never interpreter-internal 500s — found by fuzzing /99/Rapids."""
+    import h2o3_tpu as h2o
+
+    with pytest.raises((ValueError, TypeError, KeyError)):
+        h2o.rapids(expr)
