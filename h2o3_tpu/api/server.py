@@ -243,6 +243,11 @@ class _Handler(BaseHTTPRequestHandler):
                 except _PayloadTooLarge as e:
                     self._send(dict(__meta=dict(schema_type="H2OError"),
                                     msg=str(e), http_status=413), 413)
+                except FileNotFoundError as e:
+                    # missing server-side paths (ImportFiles, Models.bin,
+                    # flows) are client errors, not server bugs
+                    self._send(dict(__meta=dict(schema_type="H2OError"),
+                                    msg=str(e), http_status=404), 404)
                 except KeyError as e:
                     self._send(dict(__meta=dict(schema_type="H2OError"),
                                     msg=f"not found: {e}",

@@ -343,7 +343,11 @@ class RapidsSession:
 
     # -- evaluation ----------------------------------------------------------
     def execute(self, expr: str):
-        ast, pos = _parse(_tokenize(expr))
+        try:
+            ast, pos = _parse(_tokenize(expr))
+        except (IndexError, ValueError) as e:
+            raise ValueError(
+                f"rapids: cannot parse expression {expr[:80]!r}: {e}") from e
         return self._eval(ast)
 
     def _eval(self, node) -> Any:
