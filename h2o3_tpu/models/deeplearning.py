@@ -262,7 +262,30 @@ class H2ODeepLearningEstimator(H2OEstimator):
             X = dinfo.fit_transform(train)
             n, nfeat = X.shape
             X_dev_pre = None
-        hidden = list(p.get("hidden") or [200, 200])
+        raw_hidden = p.get("hidden")
+        if raw_hidden is not None:
+            raw_hidden = list(raw_hidden)     # materialize once (iterables)
+            if not raw_hidden:
+                raise ValueError("hidden must be a non-empty list of layer "
+                                 "sizes (got [])")
+        hidden = list(raw_hidden if raw_hidden is not None else [200, 200])
+        if any((not float(h).is_integer()) or h < 1 for h in hidden):
+            raise ValueError(
+                f"hidden must be a non-empty list of positive layer sizes, "
+                f"got {raw_hidden}")
+        hidden = [int(h) for h in hidden]
+        if float(p.get("epochs", 10.0)) <= 0:
+            raise ValueError(f"epochs must be > 0, got {p.get('epochs')}")
+        if int(p.get("mini_batch_size", 32)) < 1:
+            raise ValueError("mini_batch_size must be >= 1, got "
+                             f"{p.get('mini_batch_size')}")
+        for k in ("input_dropout_ratio", "rho"):
+            v = p.get(k)
+            if v is not None and not (0.0 <= float(v) < 1.0):
+                raise ValueError(f"{k} must be in [0, 1), got {v}")
+        eps_v = p.get("epsilon")
+        if eps_v is not None and not (0.0 < float(eps_v) <= 1.0):
+            raise ValueError(f"epsilon must be in (0, 1], got {eps_v}")
         activation = p.get("activation", "Rectifier")
         if activation not in ACTIVATIONS:
             raise ValueError(f"activation {activation!r} not in {ACTIVATIONS}")

@@ -439,6 +439,18 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         yvec = train.vec(y)
         problem, nclass, domain = response_info(yvec)
         family = p.get("family", "AUTO")
+        if family not in FAMILIES:
+            raise ValueError(f"family {family!r}: expected one of {FAMILIES}")
+        for av in np.atleast_1d(np.asarray(p.get("alpha")
+                                           if p.get("alpha") is not None
+                                           else 0.5, np.float64)):
+            if not (0.0 <= av <= 1.0):
+                raise ValueError(f"alpha must be in [0, 1], got {av}")
+        lam = p.get("lambda_")
+        if lam is not None:
+            for lv in np.atleast_1d(np.asarray(lam, np.float64)):
+                if lv < 0:
+                    raise ValueError(f"lambda must be >= 0, got {lv}")
         if family == "AUTO":
             family = {"binomial": "binomial", "multinomial": "multinomial"}.get(
                 problem, "gaussian"

@@ -109,6 +109,10 @@ class H2OKMeansEstimator(H2OEstimator):
     )
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> KMeansModel:
+        if int(self._parms.get("k", 1) or 0) < 1:
+            raise ValueError(f"k must be >= 1, got {self._parms.get('k')}")
+        if int(self._parms.get("max_iterations", 10) or 0) < 1:
+            raise ValueError("max_iterations must be >= 1")
         p = self._parms
         seed = p["_actual_seed"]
         k = int(p.get("k", 1))

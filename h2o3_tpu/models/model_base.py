@@ -776,6 +776,9 @@ class H2OEstimator:
             self._parms["_actual_seed"] = seed
 
         nfolds = int(self._parms.get("nfolds") or 0)
+        if nfolds < 0 or nfolds == 1:
+            raise ValueError(
+                f"nfolds must be 0 (no CV) or >= 2, got {nfolds}")
         model = self._fit(x, y, training_frame, validation_frame)
         if nfolds >= 2 and self._is_supervised():
             self._run_cv(model, x, y, training_frame, nfolds)

@@ -1105,6 +1105,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     else max(1, F // 3))
         if mtries == -2:
             return F
+        if mtries > F:
+            raise ValueError(
+                f"mtries={mtries} exceeds the {F} usable feature columns")
         return mtries
 
     def _make_step_cfg(self, tp, npad, K, F, nbins, problem, dist) -> _StepCfg:
@@ -1147,9 +1150,41 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 else 0),
         )
 
+    @staticmethod
+    def _validate_tree_params(tp) -> None:
+        """Value-range validation (hex.ModelBuilder.init / SharedTree
+        checkParams): reject nonsense LOUDLY instead of training a
+        degenerate model — ntrees=0 'trains' to AUC 0.5, sample_rate=2
+        silently clamps, learn_rate<=0 never moves the margin."""
+        def bad(msg):
+            raise ValueError(msg)
+
+        if tp["ntrees"] < 1:
+            bad(f"ntrees must be >= 1, got {tp['ntrees']}")
+        if tp["max_depth"] < 1:
+            bad(f"max_depth must be >= 1, got {tp['max_depth']} "
+                "(0 = unlimited is not supported: the heap tree layout "
+                "needs a finite depth cap)")
+        for k in ("learn_rate", "learn_rate_annealing", "sample_rate",
+                  "col_sample_rate", "col_sample_rate_per_tree"):
+            v = tp.get(k)
+            if v is not None and not (0.0 < v <= 1.0):
+                bad(f"{k} must be in (0, 1], got {v}")
+        if tp["nbins"] < 2:
+            bad(f"nbins must be >= 2, got {tp['nbins']}")
+        if tp["min_rows"] <= 0:
+            bad(f"min_rows must be > 0, got {tp['min_rows']}")
+        if tp.get("min_split_improvement", 0) < 0:
+            bad("min_split_improvement must be >= 0, got "
+                f"{tp['min_split_improvement']}")
+        mt = tp.get("mtries", 0)
+        if mt not in (-2, -1, 0) and mt < 1:
+            bad(f"mtries must be -2, -1, or >= 1, got {mt}")
+
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
         _ph = _Phase()
         tp = self._tree_params()
+        self._validate_tree_params(tp)
         seed = self._parms["_actual_seed"]
         yvec = train.vec(y)
         problem, nclass, domain = response_info(yvec)

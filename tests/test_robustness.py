@@ -123,3 +123,40 @@ def test_mojo_roundtrip_with_enum_and_na(tmp_path, cloud1):
     a = m.predict(fr).vec("1").numeric_np()
     b = sc.predict(fr).vec("1").numeric_np()
     np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+@pytest.mark.parametrize("cls_name,kw", [
+    ("gbm", dict(ntrees=0)), ("gbm", dict(ntrees=-5)),
+    ("gbm", dict(learn_rate=0.0)), ("gbm", dict(learn_rate=-1.0)),
+    ("gbm", dict(sample_rate=0.0)), ("gbm", dict(sample_rate=2.0)),
+    ("gbm", dict(max_depth=0)), ("gbm", dict(nbins=1)),
+    ("gbm", dict(min_rows=-3)), ("gbm", dict(col_sample_rate=0.0)),
+    ("gbm", dict(nfolds=1)), ("gbm", dict(nfolds=-2)),
+    ("drf", dict(mtries=99)),
+    ("glm", dict(family="bogus")), ("glm", dict(alpha=5.0)),
+    ("glm", dict(lambda_=-1.0)),
+    ("dl", dict(hidden=[])), ("dl", dict(hidden=[-5])),
+    ("dl", dict(epochs=-1)), ("dl", dict(mini_batch_size=0)),
+])
+def test_invalid_param_values_raise(cloud1, cls_name, kw):
+    """Value-range validation (hex.ModelBuilder.init): nonsense parameter
+    values raise LOUDLY instead of training degenerate models (found by
+    fuzzing — e.g. ntrees=0 used to 'train' to AUC 0.5)."""
+    import h2o3_tpu as h2o
+    from h2o3_tpu.estimators import (H2OGradientBoostingEstimator,
+                                     H2OGeneralizedLinearEstimator,
+                                     H2ODeepLearningEstimator,
+                                     H2ORandomForestEstimator)
+
+    cls = {"gbm": H2OGradientBoostingEstimator,
+           "drf": H2ORandomForestEstimator,
+           "glm": H2OGeneralizedLinearEstimator,
+           "dl": H2ODeepLearningEstimator}[cls_name]
+    rng = np.random.default_rng(0)
+    fr = h2o.H2OFrame_from_python(
+        {"a": rng.normal(size=80), "b": rng.normal(size=80),
+         "y": (rng.random(80) > 0.5).astype(int).astype(str)},
+        column_types={"y": "enum"})
+    est = cls(**kw, seed=1)   # constructor accepts; TRAIN validates values
+    with pytest.raises(ValueError):
+        est.train(x=["a", "b"], y="y", training_frame=fr)
