@@ -18,6 +18,7 @@ best run is reported, with all runs in the `runs` field.
 import json
 import os
 import sys
+import threading
 import time
 
 # persistent XLA compilation cache: repeat bench runs (fresh processes) skip
@@ -259,11 +260,28 @@ def bench_scaling():
     times = {}
     for nd in (1, 2, 4, 8):
         src = _SCALING_CHILD.format(nd=nd, rows=rows, reps=reps, repo=repo)
-        out = subprocess.run([_sys.executable, "-c", src], env=env,
-                             capture_output=True, text=True, timeout=1200)
-        line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+        # own session + registered pgid so the watchdog can reap the child
+        # instead of orphaning a core-burning subprocess on _exit
+        p = subprocess.Popen([_sys.executable, "-c", src], env=env,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True, start_new_session=True)
+        _LIVE_CHILD_PGIDS.add(p.pid)
+        try:
+            stdout, stderr = p.communicate(timeout=1200)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            p.communicate()
+            raise RuntimeError(f"scaling child nd={nd} timed out") from None
+        finally:
+            _LIVE_CHILD_PGIDS.discard(p.pid)
+        line = [ln for ln in stdout.splitlines() if ln.startswith("{")]
         if not line:
-            raise RuntimeError(f"scaling child nd={nd} failed: {out.stderr[-2000:]}")
+            raise RuntimeError(f"scaling child nd={nd} failed: {stderr[-2000:]}")
         times[nd] = _json.loads(line[-1])["step_ms"]
     ratio = times[1] / max(times[8], 1e-9)
     return ("scaling_1to8dev_step_speedup", ratio,
@@ -318,14 +336,104 @@ DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
                    "scaling": 1}
 
 
+def _probe_accelerator(timeout_s: float):
+    """Fail-fast tunnel liveness check (VERDICT r04 #1b: never hang).
+
+    Backend init runs in a THROWAWAY subprocess under a hard timeout: when
+    the axon tunnel is dead, jax.devices() blocks forever with no timeout of
+    its own, so an in-process probe would become the hang it exists to
+    prevent. Returns (platform, None) on success or (None, reason) on
+    failure — a fast child crash is diagnosed differently from a hang.
+    """
+    import signal
+    import subprocess
+
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    # own session + group-kill: the axon plugin may spawn helper grandchildren
+    # holding the stdout pipe, which would make a plain run(timeout=) block
+    # in the pipe drain even after the direct child is killed
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, start_new_session=True)
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        p.communicate()
+        return None, (f"device init did not answer within {timeout_s:.0f}s "
+                      f"— axon tunnel down?")
+    if p.returncode != 0:
+        tail = " | ".join(err.strip().splitlines()[-3:])
+        return None, f"device init crashed (rc={p.returncode}): {tail}"
+    for ln in out.splitlines():
+        if ln.startswith("PLATFORM="):
+            return ln.split("=", 1)[1], None
+    return None, "device init printed no platform"
+
+
+_EMITTED = threading.Event()
+_EMIT_LOCK = threading.Lock()
+# process groups the watchdog must kill before _exit (scaling-curve children)
+_LIVE_CHILD_PGIDS = set()
+
+
+def _emit(obj) -> None:
+    """Print the single result JSON line exactly once (main vs watchdog)."""
+    with _EMIT_LOCK:
+        if not _EMITTED.is_set():
+            _EMITTED.set()
+            print(json.dumps(obj), flush=True)
+
+
+def _fail_line(config: str, why: str) -> dict:
+    return {"metric": f"{config}_unavailable", "value": 0.0, "unit": "s",
+            "vs_baseline": 0.0, "error": why, "backend": None}
+
+
 def main():
     config = os.environ.get("BENCH_CONFIG", "gbm")
-    if config == "scaling":
-        # the curve runs in CPU subprocesses; keep the parent off the
+    # the watchdog covers the probe too (the probe's own pipe drain can block
+    # if an axon helper grandchild survives): whatever happens below, the
+    # driver gets ONE JSON line instead of rc:124, even if the tunnel flaps
+    # after a healthy probe
+    watchdog_s = float(os.environ.get("BENCH_WATCHDOG_S", 1500))
+
+    def _watchdog():
+        if not _EMITTED.wait(timeout=watchdog_s):
+            _emit(_fail_line(config,
+                             f"bench exceeded {watchdog_s:.0f}s watchdog "
+                             f"(run stalled mid-flight?)"))
+            import signal
+
+            for pgid in list(_LIVE_CHILD_PGIDS):
+                try:
+                    os.killpg(pgid, signal.SIGKILL)
+                except OSError:
+                    pass
+            os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
+    if config == "scaling" or forced:
+        # the scaling curve runs in CPU subprocesses; keep the parent off the
         # (possibly unavailable) TPU backend entirely
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platforms", forced or "cpu")
+    else:
+        # the tunnel to the real chip can die mid-round; a bench that hangs
+        # for the driver's whole budget records nothing. Probe first, emit a
+        # parseable error line and exit fast when the chip is unreachable.
+        probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90))
+        platform, why = _probe_accelerator(probe_s)
+        if platform is None:
+            _emit(_fail_line(config,
+                             f"accelerator unreachable ({why}); set "
+                             f"BENCH_PLATFORM=cpu to force a CPU run"))
+            sys.exit(0)
     import jax
 
     # env vars alone do not engage the persistent cache under the remote-TPU
@@ -339,8 +447,15 @@ def main():
     repeats = int(os.environ.get("BENCH_REPEATS",
                                  DEFAULT_REPEATS.get(config, 1)))
     runs = []
-    for _ in range(max(repeats, 1)):
-        runs.append(fn())
+    try:
+        for _ in range(max(repeats, 1)):
+            runs.append(fn())
+    except Exception as e:  # a mid-run tunnel death raises rather than hangs
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit(_fail_line(config, f"bench raised: {e!r}"))
+        sys.exit(0)
     metric = runs[0][0]
     higher_better = (metric.endswith("samples_per_s")
                      or metric.endswith("speedup"))
@@ -364,7 +479,7 @@ def main():
         "runs": [round(float(v), 3) for v in values],
     }
     result.update({k: v for k, v in extra.items() if v is not None})
-    print(json.dumps(result))
+    _emit(result)
 
 
 if __name__ == "__main__":
