@@ -247,9 +247,12 @@ class DataInfo:
                     mvec = np.where(has_valid, np.nanmean(mat, axis=0), 0.0)
                     svec = np.where(has_valid, np.nanstd(mat, axis=0), 0.0)
                 nvalid = (~nan_mask).sum(axis=0)
+                # isfinite-else-0.0, matching the narrow per-column path:
+                # nan_to_num would map an infinite column mean to ±1.8e308
+                # and diverge the standardization stats by frame width
                 _pre = {nm: (mat[:, j], bool(has_nan_vec[j]),
-                             float(np.nan_to_num(mvec[j])),
-                             float(np.nan_to_num(svec[j])),
+                             float(mvec[j]) if np.isfinite(mvec[j]) else 0.0,
+                             float(svec[j]) if np.isfinite(svec[j]) else 0.0,
                              int(nvalid[j]))
                         for j, nm in enumerate(_num_cols)}
             else:
@@ -386,8 +389,12 @@ class DataInfo:
         s_a = (jnp.asarray(self.stds, jnp.float32)
                if self.standardize and self.stds is not None
                else jnp.ones(0, jnp.float32))
-        return fn(jnp.asarray(packs[0]), jnp.asarray(packs[1]),
-                  jnp.asarray(packs[2]), jnp.asarray(cats_a), m_a, s_a)
+        from ..runtime import phases as _phases
+
+        return _phases.accounted_h2d(
+            lambda: fn(jnp.asarray(packs[0]), jnp.asarray(packs[1]),
+                       jnp.asarray(packs[2]), jnp.asarray(cats_a), m_a, s_a),
+            sum(p.nbytes for p in packs) + cats_a.nbytes)
 
     def _expand(self, frame: Frame, fit: bool) -> np.ndarray:
         cols = []

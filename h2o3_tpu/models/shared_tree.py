@@ -23,6 +23,8 @@ from typing import Dict, List, NamedTuple, Optional, Sequence
 
 _PROFILE = bool(os.environ.get("H2O3_PROFILE"))
 
+from ..runtime import phases as _phases_acct
+
 
 class _Phase:
     """Env-gated phase timer (H2O3_PROFILE=1) — the `water.util.Timer`
@@ -30,14 +32,18 @@ class _Phase:
 
     def __init__(self):
         self.t = time.time()
+        self._comp0 = _phases_acct.totals(_phases_acct.COMPILE_KEYS)
 
     def mark(self, name, sync=None):
         """Record a phase boundary into /3/Timeline (always); under
-        H2O3_PROFILE=1 additionally device-sync first and print, so the
-        recorded seconds are execution (not dispatch) time."""
+        H2O3_PROFILE=1 or H2O3_PHASE_ACCOUNTING=1 additionally device-sync
+        first, so the recorded seconds are execution (not dispatch) time.
+        Boundaries also feed runtime.phases so bench.py can decompose
+        wall-clock into {h2d, compute, d2h, ...} buckets."""
         from ..runtime.timeline import Timeline
 
-        synced = _PROFILE and sync is not None
+        _phases = _phases_acct
+        synced = (_PROFILE or _phases.ENABLED) and sync is not None
         if synced:
             # fetch one element: through a remote-device tunnel,
             # block_until_ready can return before the computation lands —
@@ -53,6 +59,12 @@ class _Phase:
             print(f"[h2o3-profile] {name}: {now - self.t:.3f}s", flush=True)
         Timeline.record("train_phase", name, secs=round(now - self.t, 4),
                         synced=synced)
+        # compile/trace time inside this interval is already accounted by
+        # the monitoring listener; subtract it so cold-run compute buckets
+        # hold execution time, not compilation
+        comp = _phases.totals(_phases.COMPILE_KEYS)
+        _phases.add_mark(name, max(now - self.t - (comp - self._comp0), 0.0))
+        self._comp0 = comp
         self.t = now
 
 import jax
@@ -1486,6 +1498,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 margins = jax.jit(lambda m, o: m + o[:, None],
                                   out_shardings=rs_m)(margins, off_g)
         else:
+            from ..runtime import phases as _phases_mod
+
             codes_p = padr(bm.codes)
             pack_bits = (_pack_bits_for(nbins, codes_p.shape[0])
                          if codes_p.dtype == np.uint8 else 0)
@@ -1493,16 +1507,20 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 # sub-byte packing: the bin-code matrix is the biggest fixed
                 # H2D cost (~6 MB/s tunnel) — ship 4/5/6-bit codes (half to
                 # 3/4 of the bytes) and widen on device with a tiny program
-                codes_d = _unpack_device(
-                    jnp.asarray(_pack_host(codes_p, pack_bits)), pack_bits)
+                packed = _pack_host(codes_p, pack_bits)
+                _phases_mod.add("h2d", 0.0, packed.nbytes)
+                codes_d = _unpack_device(jnp.asarray(packed), pack_bits)
             else:
+                _phases_mod.add("h2d", 0.0, codes_p.nbytes)
                 codes_d = jnp.asarray(codes_p)
             if yk.size and bool(np.all((yk >= 0) & (yk <= 255)
                                        & (yk == np.floor(yk)))):
                 # integer-ish response (class indicators, counts): ship uint8
                 # through the tunnel (4× smaller) and widen on device
+                _phases_mod.add("h2d", 0.0, npad)
                 y_d = jnp.asarray(padr(yk.astype(np.uint8))).astype(jnp.float32)
             else:
+                _phases_mod.add("h2d", 0.0, 4 * npad)
                 y_d = jnp.asarray(padr(yk))
             if np.all(w == 1.0):
                 # trivial weights: build on device (zero-weight padded tail)
@@ -1510,7 +1528,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 w_d = jnp.ones(npad, jnp.float32).at[n:].set(0.0) if pad else (
                     jnp.ones(npad, jnp.float32))
             else:
+                _phases_mod.add("h2d", 0.0, 4 * npad)
                 w_d = jnp.asarray(padr(w))
+            _phases_mod.add("h2d", 0.0, edges.nbytes)
             edges_d = jnp.asarray(edges)
 
             if ndev > 1:
@@ -1958,7 +1978,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     dart_scales.append(fn)
                 else:
                     dart_scales.append(1.0)
-            if _PROFILE:
+            if _PROFILE or _phases_acct.ENABLED:
+                # synced boundary: without it the compute bucket would time
+                # async dispatch, not execution, and overstate throughput
                 _ph.mark(f"chunk_{m}_{nsteps}trees", sync=margins)
             m += nsteps
             built = m
