@@ -96,7 +96,12 @@ class H2OConnection:
                 params: Optional[Dict[str, Any]] = None,
                 json_body: Optional[Dict[str, Any]] = None,
                 data: Optional[bytes] = None,
-                content_type: Optional[str] = None) -> Dict:
+                content_type: Optional[str] = None,
+                raw: bool = False):
+        """One HTTP round-trip. `raw=True` returns the body bytes verbatim
+        (download routes: DownloadDataset, MOJO zips) — same auth headers
+        and error mapping as JSON requests, so a 401/404/500 raises
+        H2OServerError/H2OConnectionError instead of a bare urllib error."""
         url = self.url + path
         headers = {}
         if self.token:
@@ -128,6 +133,8 @@ class H2OConnection:
         except (urllib.error.URLError, OSError) as e:
             raise H2OConnectionError(
                 f"cannot reach {self.url}: {e}") from None
+        if raw:
+            return body
         return json.loads(body) if body else {}
 
     # NB: the route argument is positional-only so request params named
@@ -326,14 +333,9 @@ class RemoteFrame:
         """Full frame contents via `/3/DownloadDataset` (CSV over the
         wire), as a pandas DataFrame (default, matching the local Frame
         and h2o-py) or dict-of-lists."""
-        url = (f"{self.conn.url}/3/DownloadDataset?frame_id="
-               f"{urllib.parse.quote(self.key)}")
-        req = urllib.request.Request(url, headers=(
-            {"Authorization": f"Bearer {self.conn.token}"}
-            if self.conn.token else {}))
-        with urllib.request.urlopen(req, timeout=self.conn.timeout,
-                                    context=self.conn._ssl_ctx) as r:
-            text = r.read().decode()
+        text = self.conn.request(
+            "GET", f"/3/DownloadDataset?frame_id="
+                   f"{urllib.parse.quote(self.key)}", raw=True).decode()
         import csv as _csv
         import io as _io
 
@@ -484,14 +486,9 @@ class RemoteModel:
                       filename: Optional[str] = None) -> str:
         """Fetch the model's MOJO artifact zip from the server
         (`GET /3/Models/{id}/mojo` — h2o-py `download_mojo`)."""
-        url = (f"{self.conn.url}/3/Models/"
-               f"{urllib.parse.quote(self.model_id)}/mojo")
-        req = urllib.request.Request(url, headers=(
-            {"Authorization": f"Bearer {self.conn.token}"}
-            if self.conn.token else {}))
-        with urllib.request.urlopen(req, timeout=self.conn.timeout,
-                                    context=self.conn._ssl_ctx) as r:
-            blob = r.read()
+        blob = self.conn.request(
+            "GET", f"/3/Models/{urllib.parse.quote(self.model_id)}/mojo",
+            raw=True)
         if os.path.isdir(path) or not os.path.splitext(path)[1]:
             out = os.path.join(path, filename or f"{self.model_id}.h2o3")
         else:
@@ -518,7 +515,11 @@ def encode_nondefault_params(parms: Dict[str, Any], cls) -> Dict[str, Any]:
     for k, v in parms.items():
         if k.startswith("_") or v is None:
             continue
-        if k in defaults and defaults[k] == v:
+        # bool-aware equality: Python conflates 1==True / 0==False, which
+        # would silently drop an explicitly-set int param whose default is
+        # a bool (or vice versa) from the wire request
+        if (k in defaults and defaults[k] == v
+                and isinstance(v, bool) == isinstance(defaults[k], bool)):
             continue
         out[k] = (json.dumps(v) if isinstance(v, (list, tuple, dict, bool))
                   else v)

@@ -816,7 +816,7 @@ def frame_to_csv(fr: "Frame") -> str:
     cols = fr.as_data_frame(use_pandas=False)
     for n in fr.names:
         col = cols[n]
-        if len(col) and isinstance(col[0], str) and any(
+        if len(col) and any(
                 isinstance(v, str) and ("\n" in v or "\r" in v)
                 for v in col):
             # the parser (and the distributed byte-range splitter — like
