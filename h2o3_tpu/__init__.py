@@ -255,15 +255,20 @@ def frames():
 
 def remove_all(retained=None) -> None:
     """`h2o.remove_all()` — clear the DKV, optionally keeping some keys
-    (water/api RemoveAllHandler `retained_keys`). Connected remotely, the
-    un-retained form clears the SERVER's DKV (`DELETE /3/DKV`)."""
+    (water/api RemoveAllHandler `retained_keys`). Connected remotely this
+    clears the SERVER's DKV (`DELETE /3/DKV`), passing the retained keys
+    through."""
     conn = client.current_connection()
     if conn is not None:
-        if retained:
-            raise NotImplementedError(
-                "remove_all(retained=...) is not supported over a remote "
-                "connection; delete keys individually")
-        conn.delete("/3/DKV")
+        names = [getattr(o, "key", None) or getattr(o, "model_id", None)
+                 or str(o) for o in (retained or [])]
+        if names:
+            import json as _json
+
+            conn.request("DELETE", "/3/DKV",
+                         params={"retained_keys": _json.dumps(names)})
+        else:
+            conn.delete("/3/DKV")
         return
     keep = {getattr(o, "key", None) or getattr(o, "model_id", None) or o
             for o in (retained or [])}
@@ -278,13 +283,15 @@ def remove_all(retained=None) -> None:
 def insert_missing_values(frame: Frame, fraction: float = 0.1,
                           seed=None) -> Frame:
     """`h2o.insert_missing_values` — set a random fraction of each
-    column's cells to NA IN PLACE (hex/CreateFrame MissingInserter)."""
+    column's cells to NA IN PLACE (hex/CreateFrame MissingInserter). For a
+    remote frame this runs server-side via `POST /3/MissingInserter`."""
     from .frame.vec import Vec
 
     if getattr(frame, "_is_remote", False):
-        raise NotImplementedError(
-            "insert_missing_values runs in-process; pull the frame or run "
-            "it server-side")
+        frame.conn.post("/3/MissingInserter", dataset=frame.key,
+                        fraction=fraction, seed=seed)
+        frame._cached = None
+        return frame
     rng = np.random.default_rng(seed)
     for n in frame.names:
         v = frame.vec(n)
@@ -366,9 +373,38 @@ def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
                  integer_range: int = 100, missing_fraction: float = 0.0,
                  has_response: bool = False, response_factors: int = 2,
                  seed: Optional[int] = None, frame_id: Optional[str] = None,
-                 ) -> Frame:
+                 ):
     """`h2o.create_frame` — random synthetic frame (water/api CreateFrame),
-    the generator many reference pyunits build fixtures with."""
+    the generator many reference pyunits build fixtures with. Connected
+    remotely the frame is generated ON the server (`POST /3/CreateFrame`)."""
+    conn = client.current_connection()
+    if conn is not None:
+        out = conn.post(
+            "/3/CreateFrame", rows=rows, cols=cols,
+            randomize=int(randomize), real_fraction=real_fraction,
+            categorical_fraction=categorical_fraction,
+            integer_fraction=integer_fraction,
+            binary_fraction=binary_fraction, factors=factors,
+            real_range=real_range, integer_range=integer_range,
+            missing_fraction=missing_fraction,
+            has_response=int(has_response),
+            response_factors=response_factors, seed=seed, dest=frame_id)
+        return client.RemoteFrame(conn, out["destination_frame"]["name"])
+    return _create_frame_local(
+        rows, cols, randomize, real_fraction, categorical_fraction,
+        integer_fraction, binary_fraction, factors, real_range,
+        integer_range, missing_fraction, has_response, response_factors,
+        seed, frame_id)
+
+
+def _create_frame_local(rows, cols, randomize, real_fraction,
+                        categorical_fraction, integer_fraction,
+                        binary_fraction, factors, real_range, integer_range,
+                        missing_fraction, has_response, response_factors,
+                        seed, frame_id) -> Frame:
+    """In-process generator core — what the server's /3/CreateFrame handler
+    calls (never routes, so a process acting as both client and server
+    can't loop back through its own connection)."""
     rng = np.random.default_rng(seed if seed is not None else 42)
     rf = 0.5 if real_fraction is None else real_fraction
     cf = 0.2 if categorical_fraction is None else categorical_fraction
@@ -419,11 +455,32 @@ def create_frame(rows: int = 10000, cols: int = 10, randomize: bool = True,
     return fr
 
 
-def interaction(data: Frame, factors, pairwise: bool, max_factors: int,
-                min_occurrence: int, destination_frame: Optional[str] = None) -> Frame:
+def interaction(data, factors, pairwise: bool, max_factors: int,
+                min_occurrence: int, destination_frame: Optional[str] = None):
     """`h2o.interaction` — interaction columns between categorical factors
     (hex/Interaction.java): combined levels, capped at max_factors most
-    frequent (others pooled as 'other'), levels under min_occurrence dropped."""
+    frequent (others pooled as 'other'), levels under min_occurrence
+    dropped. For a remote frame this runs server-side
+    (`POST /3/Interaction`)."""
+    if getattr(data, "_is_remote", False):
+        import json as _json
+
+        # factors go over verbatim (ints included) — the server-side core
+        # does the int→name mapping, so no metadata round-trip here
+        out = data.conn.post(
+            "/3/Interaction", source_frame=data.key,
+            factor_columns=_json.dumps(list(factors)),
+            pairwise=int(pairwise), max_factors=max_factors,
+            min_occurrence=min_occurrence, dest=destination_frame)
+        return client.RemoteFrame(data.conn,
+                                  out["destination_frame"]["name"])
+    return _interaction_local(data, factors, pairwise, max_factors,
+                              min_occurrence, destination_frame)
+
+
+def _interaction_local(data: Frame, factors, pairwise, max_factors,
+                       min_occurrence, destination_frame=None) -> Frame:
+    """In-process core — what the server's /3/Interaction handler calls."""
     from .frame.vec import Vec
 
     facs = [data.names[f] if isinstance(f, int) else f for f in factors]
@@ -452,6 +509,17 @@ def interaction(data: Frame, factors, pairwise: bool, max_factors: int,
     fr = Frame(out, key=destination_frame)
     _DKV.put(fr.key, fr)
     return fr
+
+
+def batch():
+    """`with h2o.batch():` — defer remote munging ops and ship them as one
+    multi-statement Rapids program (see H2OConnection.batch). Requires an
+    active remote connection."""
+    conn = client.current_connection()
+    if conn is None:
+        raise client.H2OConnectionError(
+            "h2o.batch() needs an active remote connection (h2o.connect)")
+    return conn.batch()
 
 
 def rapids(expr: str):

@@ -166,6 +166,9 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Ping$", "ping"),
         ("GET", r"^/3/Frames/([^/]+)/columns/([^/]+)/summary$",
          "column_summary"),
+        ("POST", r"^/3/CreateFrame$", "create_frame"),
+        ("POST", r"^/3/Interaction$", "interaction"),
+        ("POST", r"^/3/MissingInserter$", "missing_inserter"),
     ]
 
     def log_message(self, fmt, *args):  # route access logs into our Log
@@ -1142,10 +1145,105 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(session_key=sid))
 
     def h_remove_all(self):
-        """`DELETE /3/DKV` — h2o.remove_all (RemoveAllHandler)."""
-        n = len(DKV.keys())
-        DKV.clear()
-        self._send(dict(removed=n))
+        """`DELETE /3/DKV[?retained_keys=[...]]` — h2o.remove_all
+        (RemoveAllHandler `retained_keys`): clear the DKV, keeping any
+        listed keys."""
+        p = self._params()
+        retained = p.get("retained_keys")
+        if isinstance(retained, str):
+            retained = json.loads(retained) if retained else []
+        keep = set(retained or [])
+        keys = DKV.keys()
+        if not keep:
+            n = len(keys)
+            DKV.clear()
+        else:
+            n = 0
+            for k in list(keys):
+                if k not in keep:
+                    DKV.remove(k)
+                    n += 1
+        self._send(dict(removed=n, retained=sorted(keep)))
+
+    @staticmethod
+    def _opt(p, k, cast, dflt):
+        """Optional request param: cast when present, default otherwise."""
+        v = p.get(k)
+        return dflt if v in (None, "") else cast(v)
+
+    @staticmethod
+    def _opt_bool(p, k, dflt=False):
+        v = p.get(k)
+        if v in (None, ""):
+            return dflt
+        return str(v).lower() in ("1", "true", "yes")
+
+    def h_create_frame(self):
+        """`POST /3/CreateFrame` — server-side synthetic frame generator
+        (water/api CreateFrameHandler → hex/createframe); the REST face of
+        `h2o.create_frame`."""
+        import h2o3_tpu as _pkg
+
+        p = self._params()
+        _f = lambda k, cast, dflt: self._opt(p, k, cast, dflt)  # noqa: E731
+        _b = lambda k, dflt: self._opt_bool(p, k, dflt)         # noqa: E731
+
+        fr = _pkg._create_frame_local(
+            rows=_f("rows", int, 10000), cols=_f("cols", int, 10),
+            randomize=_b("randomize", True),
+            real_fraction=_f("real_fraction", float, None),
+            categorical_fraction=_f("categorical_fraction", float, None),
+            integer_fraction=_f("integer_fraction", float, None),
+            binary_fraction=_f("binary_fraction", float, None),
+            factors=_f("factors", int, 5),
+            real_range=_f("real_range", float, 100.0),
+            integer_range=_f("integer_range", int, 100),
+            missing_fraction=_f("missing_fraction", float, 0.0),
+            has_response=_b("has_response", False),
+            response_factors=_f("response_factors", int, 2),
+            seed=_f("seed", int, None),
+            frame_id=p.get("dest") or p.get("frame_id") or None)
+        self._send(dict(job=dict(status="DONE"),
+                        destination_frame=dict(name=fr.key),
+                        rows=fr.nrow, cols=fr.ncol))
+
+    def h_interaction(self):
+        """`POST /3/Interaction` — pairwise/combined factor-interaction
+        columns (water/api InteractionHandler → hex/Interaction.java)."""
+        import h2o3_tpu as _pkg
+
+        p = self._params()
+        fr = DKV.get(p.get("source_frame") or p.get("dataset"))
+        if not isinstance(fr, Frame):
+            raise KeyError(p.get("source_frame") or p.get("dataset"))
+        factors = p.get("factor_columns") or p.get("factors") or "[]"
+        if isinstance(factors, str):
+            factors = json.loads(factors)
+        out = _pkg._interaction_local(
+            fr, factors,
+            pairwise=self._opt_bool(p, "pairwise"),
+            max_factors=int(p.get("max_factors", 100)),
+            min_occurrence=int(p.get("min_occurrence", 1)),
+            destination_frame=p.get("dest") or None)
+        self._send(dict(job=dict(status="DONE"),
+                        destination_frame=dict(name=out.key),
+                        cols=out.ncol))
+
+    def h_missing_inserter(self):
+        """`POST /3/MissingInserter` — set a random fraction of a frame's
+        cells to NA in place (water/api MissingInserterHandler); the REST
+        face of `h2o.insert_missing_values`."""
+        from .. import insert_missing_values as _imv
+
+        p = self._params()
+        fr = DKV.get(p.get("dataset"))
+        if not isinstance(fr, Frame):
+            raise KeyError(p.get("dataset"))
+        seed = p.get("seed")
+        _imv(fr, fraction=float(p.get("fraction", 0.1)),
+             seed=None if seed in (None, "") else int(seed))
+        self._send(dict(job=dict(status="DONE"),
+                        frame_id=dict(name=fr.key)))
 
     def h_remove_key(self, key):
         DKV.remove(key)

@@ -83,6 +83,7 @@ class H2OConnection:
         self.url = url.rstrip("/")
         self.token = token or os.environ.get("H2O3_AUTH_TOKEN")
         self.timeout = timeout
+        self._batch: Optional[List[str]] = None   # pending Rapids assigns
         self._ssl_ctx = None
         if url.startswith("https") and not verify_ssl:
             import ssl
@@ -102,6 +103,9 @@ class H2OConnection:
         (download routes: DownloadDataset, MOJO zips) — same auth headers
         and error mapping as JSON requests, so a 401/404/500 raises
         H2OServerError/H2OConnectionError instead of a bare urllib error."""
+        # any real round-trip first lands pending batched munging assigns —
+        # reads and training must see the chain's results
+        self._flush_batch()
         url = self.url + path
         headers = {}
         if self.token:
@@ -210,10 +214,55 @@ class H2OConnection:
         return fr
 
     def rapids(self, ast: str, rows: Optional[int] = None) -> Dict:
+        if (self._batch is not None and rows is None
+                and ast.lstrip().startswith(("(assign ", "(rm "))):
+            # inside a batch() block: defer munging assigns/removes — ship
+            # later as ONE program. Value-returning expressions (scalars,
+            # getTimeZone, ...) still execute eagerly: their caller needs
+            # the result now.
+            self._batch.append(ast)
+            return {}
         body: Dict[str, Any] = {"ast": ast}
         if rows is not None:
             body["rows"] = rows
         return self.request("POST", "/99/Rapids", json_body=body)
+
+    def _flush_batch(self) -> None:
+        if not self._batch:
+            return
+        program = "\n".join(self._batch)
+        self._batch.clear()   # before the POST: request() re-enters here
+        self.request("POST", "/99/Rapids", json_body={"ast": program})
+
+    def batch(self):
+        """Deferred-munging context: inside `with conn.batch():` every
+        Rapids assign a RemoteFrame op posts is buffered and shipped as one
+        multi-statement program at the first read (or block exit) — a
+        chained N-op munge costs ~1 round-trip instead of N (upstream's
+        lazy ExprNode DAG collapses chains the same way;
+        `water/rapids/Session.java` executes them sequentially)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = self._batch
+            self._batch = []
+            try:
+                yield self
+                self._flush_batch()
+            except BaseException:
+                # land whatever the block chained before the error so
+                # already-returned RemoteFrame handles stay valid; if the
+                # flush itself fails, the original exception wins
+                try:
+                    self._flush_batch()
+                except Exception:
+                    pass
+                raise
+            finally:
+                self._batch = prev
+
+        return _ctx()
 
     # -- jobs ---------------------------------------------------------------
     def wait_for_job(self, job_key: str, poll: float = 0.2,

@@ -343,12 +343,26 @@ class RapidsSession:
 
     # -- evaluation ----------------------------------------------------------
     def execute(self, expr: str):
+        """Evaluate a Rapids program: one or MORE top-level sexprs (the
+        batch-munging envelope — a remote client ships a whole chain of
+        assigns in one POST, `water/rapids/Session` sequential-expression
+        semantics). Returns the last statement's value."""
         try:
-            ast, pos = _parse(_tokenize(expr))
+            tokens = _tokenize(expr)
+            asts = []
+            pos = 0
+            while pos < len(tokens):
+                ast, pos = _parse(tokens, pos)
+                asts.append(ast)
         except (IndexError, ValueError) as e:
             raise ValueError(
                 f"rapids: cannot parse expression {expr[:80]!r}: {e}") from e
-        return self._eval(ast)
+        if not asts:
+            raise ValueError("rapids: empty program")
+        out = None
+        for ast in asts:
+            out = self._eval(ast)
+        return out
 
     def _eval(self, node) -> Any:
         kind, val = node

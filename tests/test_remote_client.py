@@ -266,3 +266,94 @@ def test_remote_mojo_download_and_frame_pull(remote_server, csvfile,
                           "c": np.asarray(data["c"])})
     p1 = scorer.predict(Xl).vec("1").numeric_np()
     assert np.isfinite(p1).all() and len(p1) == 400
+
+
+def test_remote_create_frame_interaction_missing_inserter(remote_server):
+    """VERDICT r04 #3: the functional route tail — synthetic frame
+    generation (/3/CreateFrame), factor interactions (/3/Interaction) and
+    NA insertion (/3/MissingInserter) all run SERVER-side, driven through
+    the same package surface that works in-process."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        fr = h2o.create_frame(rows=300, cols=6, categorical_fraction=0.5,
+                              integer_fraction=0.25, real_fraction=0.25,
+                              factors=4, seed=7, frame_id="synth_remote")
+        assert isinstance(fr, RemoteFrame)
+        assert fr.shape == (300, 6)
+        cat_cols = [n for n in fr.names if fr.types.get(n) == "enum"]
+        assert len(cat_cols) >= 2, fr.types
+
+        inter = h2o.interaction(fr, factors=cat_cols[:2], pairwise=True,
+                                max_factors=100, min_occurrence=1,
+                                destination_frame="synth_inter")
+        assert isinstance(inter, RemoteFrame)
+        assert inter.shape[0] == 300 and inter.shape[1] == 1
+        assert inter.types[inter.names[0]] == "enum"
+
+        # MissingInserter mutates the server-side frame in place
+        num_col = next(n for n in fr.names if fr.types.get(n) != "enum")
+        before = fr.as_data_frame(use_pandas=False)[num_col]
+        h2o.insert_missing_values(fr, fraction=0.5, seed=1)
+        after = fr.as_data_frame(use_pandas=False)[num_col]
+        import math
+
+        n_na = sum(1 for v in after if isinstance(v, float) and math.isnan(v))
+        assert n_na > sum(1 for v in before
+                          if isinstance(v, float) and math.isnan(v))
+        assert 0.3 < n_na / 300 < 0.7
+    finally:
+        h2o.shutdown()
+
+
+def test_remote_remove_all_retained(remote_server):
+    """`h2o.remove_all(retained=[...])` over a connection clears the
+    server DKV except the listed keys (RemoveAllHandler retained_keys)."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        a = h2o.create_frame(rows=50, cols=2, seed=1, frame_id="keepme")
+        h2o.create_frame(rows=50, cols=2, seed=2, frame_id="dropme")
+        h2o.remove_all(retained=[a])
+        keys = [f["frame_id"]["name"] if isinstance(f.get("frame_id"), dict)
+                else f.get("frame_id")
+                for f in h2o.connection().get("/3/Frames")["frames"]]
+        assert "keepme" in keys and "dropme" not in keys
+    finally:
+        h2o.shutdown()
+
+
+def test_remote_batch_munging_round_trips(remote_server, csvfile):
+    """VERDICT r04 #7: a chained 10-op munge inside `with h2o.batch():`
+    reaches the server as ONE multi-statement Rapids POST (plus one read),
+    instead of 10 eager round-trips."""
+    conn = h2o.connect(url=remote_server, verbose=False)
+    try:
+        fr = h2o.upload_file(csvfile, destination_frame="batch_src")
+        calls = []
+        orig = type(conn).request
+
+        def counting(self, method, path, *a, **kw):
+            calls.append((method, path))
+            return orig(self, method, path, *a, **kw)
+
+        type(conn).request = counting
+        try:
+            with h2o.batch():
+                g = fr["a"]                 # slice + 10 chained derivations
+                for _ in range(5):
+                    g = g.asfactor()
+                    g = g.asnumeric()
+                nrows = g.nrow              # first read flushes the chain
+            during = list(calls)
+        finally:
+            type(conn).request = orig
+        assert nrows == 400
+        rapids_posts = [c for c in during if c[1] == "/99/Rapids"]
+        assert len(rapids_posts) == 1, during
+        # 1 source-metadata read (fr["a"] name lookup) + 1 flush + 1 final
+        # read — the 11 chained derivations themselves cost zero trips
+        assert len(during) <= 3, during
+        # the chain's final key really exists server-side with full contents
+        data = g.as_data_frame(use_pandas=False)
+        assert list(data) == ["a"] and len(data["a"]) == 400
+    finally:
+        h2o.shutdown()
