@@ -262,6 +262,32 @@ def _solve_penalized(gram, xy, lam, alpha, n_obs, intercept_idx, beta0,
     return b
 
 
+def attach_linear_artifacts(model: "GLMModel", train, valid, Xd,
+                            cloud_size: int, n: int) -> "GLMModel":
+    """Training/validation metrics + |coefficient| varimp for a fitted
+    linear model — shared by GLM and the XGBoost gblinear booster.
+
+    Reuses the training design matrix already in HBM for training metrics —
+    single-device only: a row-sharded Xd may span non-addressable devices
+    (multi-host mesh) and padded tail rows would corrupt metrics."""
+    model.training_metrics = model._make_metrics(
+        train, Xd=Xd if (cloud_size == 1 and int(Xd.shape[0]) == n) else None)
+    if valid is not None:
+        model.validation_metrics = model._make_metrics(valid)
+    # GLM varimp = |standardized coefficient| magnitudes
+    beta = model.beta
+    b = np.asarray(beta if model.family != "multinomial"
+                   else np.abs(beta).mean(axis=0))
+    mags = np.abs(b[:-1])
+    if mags.sum() > 0:
+        order = np.argsort(-mags)
+        model.varimp_table = [
+            (model.dinfo.coef_names[i], float(mags[i]),
+             float(mags[i] / mags.max()), float(mags[i] / mags.sum()))
+            for i in order if mags[i] > 0]
+    return model
+
+
 class GLMModel(H2OModel):
     algo = "glm"
 
@@ -609,25 +635,7 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         model = GLMModel(self, x, y, dinfo, family, beta, domain,
                          lambda_best=lam_best, stderr=stderr, full_path=full_path)
         model.covmat = cov  # (p+1)² dispersion-scaled covariance (p-values)
-        # reuse the training design matrix already in HBM — single-device
-        # only: a row-sharded Xd may span non-addressable devices (multi-
-        # host mesh) and padded tail rows would corrupt metrics
-        model.training_metrics = model._make_metrics(
-            train,
-            Xd=Xd if (cloud.size == 1 and int(Xd.shape[0]) == n) else None)
-        if valid is not None:
-            model.validation_metrics = model._make_metrics(valid)
-        # GLM varimp = |standardized coefficient| (GLMModel standardized coef magnitudes)
-        b = np.asarray(beta if family != "multinomial" else np.abs(beta).mean(axis=0))
-        mags = np.abs(b[:-1])
-        if mags.sum() > 0:
-            order = np.argsort(-mags)
-            model.varimp_table = [
-                (dinfo.coef_names[i], float(mags[i]), float(mags[i] / mags.max()),
-                 float(mags[i] / mags.sum()))
-                for i in order if mags[i] > 0
-            ]
-        return model
+        return attach_linear_artifacts(model, train, valid, Xd, cloud.size, n)
 
     def _irls(self, Xd, yd, wd, family, lam, alpha, max_iter, beta_eps, tweedie_p):
         pdim = Xd.shape[1]

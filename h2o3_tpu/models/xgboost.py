@@ -29,8 +29,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..frame.frame import Frame
+from .glm import GLMModel as _GLMModelBase
 from .metrics import ndcg_at_k
 from .shared_tree import H2OSharedTreeEstimator, SharedTreeModel
+
+
+class _GBLinearModel(_GLMModelBase):
+    """gblinear's fitted model: a GLMModel (it IS a generalized linear
+    model — same scoring, coef tables, metrics) under the xgboost algo
+    identity, so model ids and summaries say what trained it."""
+
+    algo = "xgboost"
 
 
 class H2OXGBoostEstimator(H2OSharedTreeEstimator):
@@ -121,9 +130,21 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
         than failing."""
         p = self._parms
         booster = str(p.get("booster", "gbtree"))
-        if booster not in ("gbtree", "dart"):
-            raise ValueError(f"booster={booster!r}: expected 'gbtree' or "
-                             "'dart' (gblinear is not a tree booster)")
+        if booster not in ("gbtree", "dart", "gblinear"):
+            raise ValueError(f"booster={booster!r}: expected 'gbtree', "
+                             "'dart', or 'gblinear'")
+        if booster == "gblinear":
+            obj = p.get("objective")
+            if obj and str(obj).startswith("rank"):
+                raise ValueError(
+                    f"objective={obj!r} is not supported with "
+                    "booster='gblinear' (lambdarank needs trees)")
+            dist = str(p.get("distribution", "AUTO"))
+            if dist not in ("AUTO", "gaussian", "bernoulli", "multinomial"):
+                raise ValueError(
+                    f"distribution={dist!r} with booster='gblinear': only "
+                    "AUTO/gaussian/bernoulli/multinomial links are "
+                    "implemented for the linear booster")
         for k in ("rate_drop", "skip_drop"):
             v = float(p.get(k, 0) or 0)
             if not 0.0 <= v <= 1.0:
@@ -157,8 +178,10 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
             raise ValueError("max_leaves needs grow_policy='lossguide' "
                              "(depthwise growth is bounded by max_depth)")
 
-    def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
+    def _fit(self, x, y, train: Frame, valid: Optional[Frame]):
         self._check_params()
+        if str(self._parms.get("booster", "gbtree")) == "gblinear":
+            return self._fit_gblinear(x, y, train, valid)
         obj = self._parms.get("objective")
         if obj and str(obj).startswith("rank"):
             gcol = self._parms.get("group_column") or "qid"
@@ -194,6 +217,96 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
             return model
         return super()._fit(x, y, train, valid)
 
+    def _fit_gblinear(self, x, y, train: Frame, valid: Optional[Frame]):
+        """`booster="gblinear"` — the linear booster (upstream
+        h2o-ext-xgboost passes it through to xgboost's `gblinear` with the
+        shotgun/coordinate updater; `xgboost/src/linear/updater_shotgun.cc`
+        CoordinateDelta).
+
+        TPU-first: instead of per-coordinate sequential updates, each
+        boosting round is ONE Jacobi ("shotgun") pass — two MXU matmuls
+        (Xᵀg and (X∘X)ᵀh) produce every coordinate's gradient/hessian sums
+        against the current margin, the elastic-net delta (reg_lambda L2,
+        reg_alpha soft-threshold, xgboost's CoordinateDelta formula) is
+        applied to all weights at once, damped by eta. All rounds run in a
+        single jitted lax.scan. The learned coefficients are wrapped in a
+        GLMModel, which reuses the GLM scoring/metrics/coef surface — a
+        gblinear model IS a (boosted) generalized linear model."""
+        from ..parallel import distdata
+        from ..parallel import mesh as cloudlib
+        from .glm import GLMModel
+        from .model_base import DataInfo, response_info
+
+        p = self._parms
+        yvec = train.vec(y)
+        problem, nclass, domain = response_info(yvec)
+        family = {"binomial": "binomial",
+                  "multinomial": "multinomial"}.get(problem, "gaussian")
+        rounds = int(p.get("ntrees", 50))
+        eta = float(p.get("eta") if p.get("eta") is not None
+                    else p.get("learn_rate", 0.3) or 0.3)
+        lam = float(p.get("reg_lambda", 1.0))
+        alpha = float(p.get("reg_alpha", 0.0))
+
+        dinfo = DataInfo(train, x, standardize=False)
+        n = train.nrow
+        w = (train.vec(p["weights_column"]).numeric_np()
+             if p.get("weights_column") else np.ones(n)).astype(np.float32)
+        if family == "binomial":
+            yarr = (np.asarray(yvec.data, np.float32)
+                    if yvec.type == "enum"
+                    else yvec.numeric_np().astype(np.float32))
+        elif family == "multinomial":
+            yarr = np.asarray(yvec.data, np.float32)
+        else:
+            yarr = yvec.numeric_np().astype(np.float32)
+
+        cloud = cloudlib.cloud()
+        if distdata.multiprocess():
+            # same global-row ingest as GLM: every rank contributes its
+            # shard; the jitted scan over global sharded arrays makes XLA
+            # insert the cross-host reductions
+            X = dinfo.fit_transform(train)
+            Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
+            quota = distdata.local_quota(n)
+            Xd = distdata.global_row_array(Xi.astype(np.float32), quota, cloud)
+            yd = distdata.global_row_array(yarr, quota, cloud)
+            wd = distdata.global_row_array(w, quota, cloud)
+        elif cloud.size > 1 and n >= cloud.size:
+            X = dinfo.fit_transform(train)
+            Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
+            npad = cloudlib.pad_to_multiple(n, cloud.size)
+            padn = npad - n
+            rs = cloud.row_sharding()
+            Xd = jax.device_put(jnp.asarray(np.concatenate(
+                [Xi, np.zeros((padn, Xi.shape[1]), np.float32)])), rs)
+            yd = jax.device_put(jnp.asarray(np.concatenate(
+                [yarr, np.zeros(padn, np.float32)])), rs)
+            wd = jax.device_put(jnp.asarray(np.concatenate(
+                [w, np.zeros(padn, np.float32)])), rs)
+        else:
+            Xd = dinfo.device_design(train, fit=True, add_intercept=True)
+            yd, wd = jnp.asarray(yarr), jnp.asarray(w)
+
+        K = nclass if family == "multinomial" else 1
+        W = _gblinear_train(Xd, yd, wd, family=family, n_class=K,
+                            rounds=rounds, eta=eta, lam=lam, alpha=alpha)
+        beta = (np.asarray(W, np.float64) if family == "multinomial"
+                else np.asarray(W[0], np.float64))
+
+        from .glm import attach_linear_artifacts
+
+        model = _GBLinearModel(self, x, y, dinfo, family, beta, domain,
+                               lambda_best=lam)
+        return attach_linear_artifacts(model, train, valid, Xd, cloud.size, n)
+
+    def _cv_predict(self, model, frame: Frame) -> np.ndarray:
+        from .glm import GLMModel
+
+        if isinstance(model, GLMModel):       # gblinear fold models
+            return model._score(frame)
+        return super()._cv_predict(model, frame)
+
     def ndcg(self, frame: Frame, k: Optional[int] = None) -> float:
         from ..parallel import distdata
 
@@ -206,6 +319,58 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
             self.model._margins(self.model._matrix(frame))[:, 0])
         return ndcg_at_k(rel, scores, qid,
                          k or int(self._parms.get("ndcg_k", 10)))
+
+
+@functools.partial(jax.jit, static_argnames=("family", "n_class", "rounds"))
+def _gblinear_train(Xd, yd, wd, *, family: str, n_class: int, rounds: int,
+                    eta: float, lam: float, alpha: float):
+    """All gblinear boosting rounds as one jitted lax.scan.
+
+    Per round: margins via one (n,p)×(p,K) matmul, per-row (g, h) from the
+    family's link, coordinate gradient/hessian sums via Xᵀg and (X∘X)ᵀh,
+    then xgboost's CoordinateDelta (elastic net + clamp-at-zero crossing)
+    applied Jacobi-style to every weight, damped by eta. The intercept
+    (last design column) is unregularized, like xgboost's bias updater.
+    HIGHEST precision keeps the f32 sums exact (TPU matmuls default to
+    bf16 operands)."""
+    pdim = Xd.shape[1]
+    hi = jax.lax.Precision.HIGHEST
+    X2 = Xd * Xd
+    is_bias = jnp.zeros(pdim, jnp.float32).at[pdim - 1].set(1.0)
+    lam_v = lam * (1.0 - is_bias)[:, None]          # (p, 1) broadcast over K
+    alpha_v = alpha * (1.0 - is_bias)[:, None]
+    onehot = (jax.nn.one_hot(yd.astype(jnp.int32), n_class, dtype=jnp.float32)
+              if family == "multinomial" else None)
+
+    def one_round(Wt, _):
+        # Wt: (p, K) — transposed so the coord axis is leading
+        margin = jnp.matmul(Xd, Wt, precision=hi)   # (n, K)
+        if family == "binomial":
+            mu = jax.nn.sigmoid(margin[:, 0])
+            g = ((mu - yd) * wd)[:, None]
+            h = (mu * (1 - mu) * wd)[:, None]
+        elif family == "multinomial":
+            pr = jax.nn.softmax(margin, axis=1)
+            g = (pr - onehot) * wd[:, None]
+            # xgboost multiclass_obj: h = 2·p·(1−p)
+            h = 2.0 * pr * (1 - pr) * wd[:, None]
+        else:
+            g = ((margin[:, 0] - yd) * wd)[:, None]
+            h = wd[:, None]
+        G = jnp.matmul(Xd.T, g, precision=hi)       # (p, K)
+        H = jnp.matmul(X2.T, h, precision=hi)
+        gl2 = G + lam_v * Wt
+        denom = H + lam_v
+        tmp = Wt - gl2 / denom
+        dw = jnp.where(tmp >= 0,
+                       jnp.maximum(-(gl2 + alpha_v) / denom, -Wt),
+                       jnp.minimum(-(gl2 - alpha_v) / denom, -Wt))
+        dw = jnp.where(H < 1e-5, 0.0, dw)           # xgboost's hess guard
+        return Wt + eta * dw, None
+
+    W0 = jnp.zeros((pdim, n_class), jnp.float32)
+    Wt, _ = jax.lax.scan(one_round, W0, None, length=rounds)
+    return Wt.T                                     # (K, p)
 
 
 def _make_lambdarank(qid: np.ndarray, rel: np.ndarray, k: int):
