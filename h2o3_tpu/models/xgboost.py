@@ -234,7 +234,7 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
         gblinear model IS a (boosted) generalized linear model."""
         from ..parallel import distdata
         from ..parallel import mesh as cloudlib
-        from .glm import GLMModel
+        from .glm import attach_linear_artifacts
         from .model_base import DataInfo, response_info
 
         p = self._parms
@@ -242,6 +242,17 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
         problem, nclass, domain = response_info(yvec)
         family = {"binomial": "binomial",
                   "multinomial": "multinomial"}.get(problem, "gaussian")
+        dist = str(p.get("distribution", "AUTO"))
+        if dist != "AUTO":
+            # an explicitly requested link must MATCH the response type —
+            # silently training a different family is worse than failing
+            want = {"bernoulli": "binomial", "multinomial": "multinomial",
+                    "gaussian": "gaussian"}[dist]
+            if want != family:
+                raise ValueError(
+                    f"distribution={dist!r} is inconsistent with the "
+                    f"response ({problem}, which implies {family}); drop "
+                    "the distribution parameter or fix the response type")
         rounds = int(p.get("ntrees", 50))
         eta = float(p.get("eta") if p.get("eta") is not None
                     else p.get("learn_rate", 0.3) or 0.3)
@@ -294,16 +305,12 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
         beta = (np.asarray(W, np.float64) if family == "multinomial"
                 else np.asarray(W[0], np.float64))
 
-        from .glm import attach_linear_artifacts
-
         model = _GBLinearModel(self, x, y, dinfo, family, beta, domain,
                                lambda_best=lam)
         return attach_linear_artifacts(model, train, valid, Xd, cloud.size, n)
 
     def _cv_predict(self, model, frame: Frame) -> np.ndarray:
-        from .glm import GLMModel
-
-        if isinstance(model, GLMModel):       # gblinear fold models
+        if isinstance(model, _GLMModelBase):  # gblinear fold models
             return model._score(frame)
         return super()._cv_predict(model, frame)
 

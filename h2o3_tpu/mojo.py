@@ -199,17 +199,52 @@ def _model_payload(model) -> Dict[str, Any]:
                 arrays["means"] = model.dinfo.means
                 arrays["stds"] = model.dinfo.stds
             meta["dinfo"] = _dinfo_meta(model.dinfo)
+        elif _is_gam(model):
+            # first-class GAM artifact (hex/genmodel/algos/gam/): the spline
+            # basis (knots + centering) rides along, so offline predict ≡
+            # in-cluster on NEW data — not just the inner GLM
+            meta.update(kind="gam", family=model.family, domain=model.domain,
+                        dinfo=_dinfo_meta(model.dinfo),
+                        gam_cols=[c for c, _, _ in model.gam_spec])
+            arrays["beta"] = np.asarray(model.beta, np.float64)
+            for i, (_col, knots, center) in enumerate(model.gam_spec):
+                arrays[f"gam{i}_knots"] = np.asarray(knots, np.float64)
+                arrays[f"gam{i}_center"] = np.asarray(center, np.float64)
+            if model.dinfo.means is not None:
+                arrays["means"] = model.dinfo.means
+                arrays["stds"] = model.dinfo.stds
+        elif _is_uplift(model):
+            # UpliftDRF artifact (upstream genmodel gained uplift scoring):
+            # one forest whose leaves hold treatment−control differences
+            meta.update(kind="uplift", max_depth=model.max_depth,
+                        ntrees=model.ntrees_built,
+                        feature_domains=model.bm.domains,
+                        treatment_col=model.treatment_col)
+            for field in ("feat", "bin", "thr", "is_split", "value"):
+                arrays[f"uforest_{field}"] = np.asarray(
+                    getattr(model.forest, field))
         else:
             # Ratified cuts (documented in README "Intentional cuts" +
             # docs/mojo.md): Aggregator (produces a frame, no row scorer),
-            # UpliftDRF, PSVM, GAM/ANOVAGLM/ModelSelection (in-cluster
-            # scoring only for now) — every other predict()-bearing model
-            # kind exports.
+            # PSVM, ANOVAGLM/ModelSelection (in-cluster scoring only for
+            # now) — every other predict()-bearing model kind exports.
             raise TypeError(
                 f"cannot export model of type {type(model).__name__}: "
                 "not a MOJO-exportable kind (see docs/mojo.md for the "
                 "export matrix and ratified cuts)")
     return {"meta": meta, "arrays": arrays}
+
+
+def _is_gam(model) -> bool:
+    from .models.gam import GAMModel
+
+    return isinstance(model, GAMModel)
+
+
+def _is_uplift(model) -> bool:
+    from .models.uplift import UpliftRandomForestModel
+
+    return isinstance(model, UpliftRandomForestModel)
 
 
 def _dinfo_meta(dinfo) -> Dict:
@@ -321,29 +356,36 @@ class MojoScorer:
             return X
         return np.asarray(data, np.float64)
 
-    def _tree_scores(self, X: np.ndarray) -> np.ndarray:
+    @staticmethod
+    def _score_one_forest(feat, thr, split, value, D: int,
+                          X: np.ndarray) -> np.ndarray:
+        """Summed leaf values of one stacked forest over raw feature rows —
+        native C++ traversal (mojo_scorer.cpp) with a numpy fallback."""
         from .native import loader as native_loader
 
+        total = native_loader.score_forest(feat, thr, split, value, D, X)
+        if total is None:
+            ntrees = feat.shape[0]
+            total = np.zeros(X.shape[0])
+            for t in range(ntrees):
+                node = np.zeros(X.shape[0], np.int64)
+                for _ in range(D):
+                    f = feat[t][node]
+                    s = split[t][node]
+                    xv = X[np.arange(X.shape[0]), f]
+                    right = np.isnan(xv) | (xv > thr[t][node])
+                    child = 2 * node + 1 + (right & s).astype(np.int64)
+                    node = np.where(s, child, node)
+                total += value[t][node]
+        return total
+
+    def _tree_scores(self, X: np.ndarray) -> np.ndarray:
         meta = self.meta
         D = meta["max_depth"]
         outs = []
         for k in range(meta["n_forests"]):
             feat, thr, split, value = self._native_forest(k)
-            # native C++ traversal (mojo_scorer.cpp) — numpy fallback below
-            total = native_loader.score_forest(feat, thr, split, value, D, X)
-            if total is None:
-                ntrees = feat.shape[0]
-                total = np.zeros(X.shape[0])
-                for t in range(ntrees):
-                    node = np.zeros(X.shape[0], np.int64)
-                    for _ in range(D):
-                        f = feat[t][node]
-                        s = split[t][node]
-                        xv = X[np.arange(X.shape[0]), f]
-                        right = np.isnan(xv) | (xv > thr[t][node])
-                        child = 2 * node + 1 + (right & s).astype(np.int64)
-                        node = np.where(s, child, node)
-                    total += value[t][node]
+            total = self._score_one_forest(feat, thr, split, value, D, X)
             f0 = meta["f0"]
             f0k = f0[k] if isinstance(f0, list) else f0
             outs.append(total + (f0k if meta["mode"] != "drf" else 0.0))
@@ -469,6 +511,44 @@ class MojoScorer:
             if fam in ("poisson", "gamma", "tweedie"):
                 eta = np.exp(eta)
             return Frame.from_dict({"predict": eta})
+        if kind == "gam":
+            from .ops.splines import spline_basis
+
+            parts = []
+            if meta["dinfo"]["spec"]:
+                parts.append(self._expand_dinfo(data))
+            for i, col in enumerate(meta["gam_cols"]):
+                raw = np.nan_to_num(data.vec(col).numeric_np())
+                B = (spline_basis(raw, self.arrays[f"gam{i}_knots"])
+                     - self.arrays[f"gam{i}_center"])
+                parts.append(B)
+            X = np.concatenate(parts, axis=1)
+            eta = (np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
+                   @ self.arrays["beta"])
+            fam = meta["family"]
+            if fam == "binomial":
+                p1 = 1 / (1 + np.exp(-np.clip(eta, -500, 500)))
+                dom = meta["domain"]
+                return Frame.from_dict({
+                    "predict": np.asarray(dom, dtype=object)[
+                        (p1 > 0.5).astype(int)],
+                    str(dom[0]): 1 - p1, str(dom[1]): p1,
+                }, column_types={"predict": "enum"})
+            if fam in ("poisson", "gamma", "tweedie"):
+                eta = np.exp(eta)
+            return Frame.from_dict({"predict": eta})
+        if kind == "uplift":
+            X = self._matrix(data)
+            feat = np.ascontiguousarray(self.arrays["uforest_feat"], np.int32)
+            thr = np.ascontiguousarray(self.arrays["uforest_thr"], np.float32)
+            split = np.ascontiguousarray(
+                self.arrays["uforest_is_split"]).astype(np.uint8)
+            value = np.ascontiguousarray(
+                self.arrays["uforest_value"], np.float32)
+            total = self._score_one_forest(feat, thr, split, value,
+                                           meta["max_depth"], X)
+            return Frame.from_dict(
+                {"uplift_predict": total / max(meta["ntrees"], 1)})
         if kind == "isoforest":
             from .models.isolation_forest import anomaly_scores, forest_path_lengths
 
