@@ -544,35 +544,50 @@ class H2ODeepLearningEstimator(H2OEstimator):
         # consensus vote) at scoring boundaries instead.
         if use_scan:
             if multiproc:
-                # each process contributes its ingest shard; zero-weight
-                # padding balances unequal byte ranges (loss is Σw-normalized
-                # so padded rows are exact no-ops)
+                # each process contributes its ingest shard as COMPACT
+                # packs (uint8/int16 integer columns, int32 codes) expanded
+                # on device — the same byte-compressed transfer the single-
+                # chip path gets; zero-weight padding balances unequal byte
+                # ranges (loss is Σw-normalized so padded rows are exact
+                # no-ops). Stats were fitted by fit_transform's global
+                # collectives, so the design ≡ the dense f32 upload.
                 quota = distdata.local_quota(n)
-                X_dev = distdata.global_row_array(X, quota, cloud)
+                X_dev = dinfo.device_design(train, fit=False, cloud=cloud,
+                                            quota=quota)
                 y_dev = distdata.global_row_array(yarr, quota, cloud)
                 w_dev = distdata.global_row_array(w, quota, cloud)
             elif rs is not None:
                 # shard straight from host — an unsharded intermediate on
                 # device 0 would defeat row sharding for data that only
-                # fits when split across the mesh
-                X_dev = jax.device_put(X, rs)
-                y_dev = jax.device_put(yarr, rs)
-                w_dev = jax.device_put(w, rs)
+                # fits when split across the mesh; rows pad to the mesh
+                # multiple with zero weight
+                quota = cloudlib.pad_to_multiple(n, cloud.size)
+                X_dev = dinfo.device_design(train, fit=False, cloud=cloud,
+                                            quota=quota)
+                y_dev = distdata.global_row_array(yarr, quota, cloud)
+                w_dev = distdata.global_row_array(w, quota, cloud)
             else:
                 X_dev = X_dev_pre
                 y_dev = jnp.asarray(yarr)
                 w_dev = jnp.asarray(w)
             # scoring reuses the HBM copy — except on a multi-process mesh,
-            # where fetching a cross-process-sharded eager result raises
-            X_score = X_dev if jax.process_count() == 1 else None
+            # where fetching a cross-process-sharded eager result raises.
+            # Quota-padded rows would corrupt training metrics, so scoring
+            # gets a one-time device-side slice of the real rows.
+            if jax.process_count() != 1:
+                X_score = None
+            elif int(X_dev.shape[0]) == n:
+                X_score = X_dev
+            else:
+                X_score = X_dev[:n]
         else:
             # max_runtime path: no persistent device copy; scoring falls
             # back to the transient per-event transform
             X_score = None
-        # on a multi-host mesh the permutation covers padded rows too —
-        # discount the zero-weight slots so `epochs` counts REAL samples
-        real_frac = (n_global / float(X_dev.shape[0])
-                     if use_scan and multiproc else 1.0)
+        # mesh/quota padding adds zero-weight rows the permutation covers
+        # too — discount them so `epochs` counts REAL samples (1.0 when
+        # unpadded)
+        real_frac = (n_global / float(X_dev.shape[0]) if use_scan else 1.0)
         if use_scan:
             pflat = _flatten(params)
             oflat = (tuple(jnp.zeros(_flat_n, jnp.float32)

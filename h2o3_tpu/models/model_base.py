@@ -214,7 +214,8 @@ class DataInfo:
         return np.nan_to_num(X, nan=0.0).astype(np.float32)
 
     def device_design(self, frame: Frame, fit: bool,
-                      add_intercept: bool = False):
+                      add_intercept: bool = False, cloud=None,
+                      quota: Optional[int] = None):
         """Expanded design matrix built ON DEVICE from compact columns.
 
         Semantically identical to fit_transform/transform (same one-hot
@@ -223,7 +224,16 @@ class DataInfo:
         compact representation (numeric f32 + categorical int32 codes,
         ~P_cat× smaller than the dense one-hot), and the expansion runs as
         one compiled program. This is what makes wide-categorical GLM
-        viable through a remote-chip tunnel."""
+        viable through a remote-chip tunnel.
+
+        With `cloud` (a mesh of >1 devices, possibly multi-process) the
+        compact packs are assembled as ROW-SHARDED global arrays (padded to
+        `quota` rows per process) and expanded in place, so multi-device
+        meshes get the same byte-compressed transfer as a single chip —
+        no dense f32 upload and no unsharded intermediate on device 0.
+        Requires fitted stats (fit=False; call fit_transform first — its
+        global-moment collectives keep standardization identical to the
+        dense path on every cloud size)."""
         import jax
         import jax.numpy as jnp
 
@@ -350,22 +360,42 @@ class DataInfo:
             lo, hi = (0.0, 255.0) if g == 0 else (-32768.0, 32767.0)
             return bool(lo <= c.min() and c.max() <= hi)
 
-        if fit:
-            num_group = []
+        def _local_groups():
+            out = []
             for c in nums:
-                if _fits_group(c, 0):
-                    g = 0
-                elif _fits_group(c, 1):
-                    g = 1
-                else:
-                    g = 2
-                num_group.append(g)
+                out.append(0 if _fits_group(c, 0)
+                           else 1 if _fits_group(c, 1) else 2)
+            return out
+
+        from ..parallel import distdata
+
+        multiproc = cloud is not None and distdata.multiprocess()
+        if fit:
+            num_group = _local_groups()
             self._transfer_groups = list(num_group)
         else:
             stored = getattr(self, "_transfer_groups", None)
-            if stored is not None and len(stored) == len(nums) and all(
-                    _fits_group(c, g) for c, g in zip(nums, stored)):
+            ok = bool(stored is not None and len(stored) == len(nums) and all(
+                _fits_group(c, g) for c, g in zip(nums, stored)))
+            if multiproc:
+                # pack layout is part of the compiled program: every rank
+                # must make the SAME stored-vs-fallback decision
+                ok = bool(distdata.allgather_host(
+                    np.asarray([ok], np.int32)).all())
+            if ok:
                 num_group = stored
+            elif cloud is not None:
+                # sharded ingest with no (usable) fit-time decision: decide
+                # now, globally — per-rank data ranges differ, so take the
+                # widest group each column needs anywhere
+                num_group = _local_groups()
+                if multiproc:
+                    num_group = list(distdata.allgather_host(
+                        np.asarray(num_group, np.int32)
+                    ).reshape(-1, len(num_group)).max(axis=0)) if nums else []
+                    num_group = [int(g) for g in num_group]
+                if stored is None:
+                    self._transfer_groups = list(num_group)
             else:
                 num_group = [2] * len(nums)
         groups = ([], [], [])                 # uint8, int16, f32
@@ -383,18 +413,37 @@ class DataInfo:
                self.use_all, self.standardize and self.means is not None,
                add_intercept)
         fn = _device_expand_fn(sig)
-        m_a = (jnp.asarray(self.means, jnp.float32)
+        m_h = (np.asarray(self.means, np.float32)
                if self.standardize and self.means is not None
-               else jnp.zeros(0, jnp.float32))
-        s_a = (jnp.asarray(self.stds, jnp.float32)
+               else np.zeros(0, np.float32))
+        s_h = (np.asarray(self.stds, np.float32)
                if self.standardize and self.stds is not None
-               else jnp.ones(0, jnp.float32))
+               else np.ones(0, np.float32))
         from ..runtime import phases as _phases
 
+        nbytes = sum(p.nbytes for p in packs) + cats_a.nbytes
+        if cloud is not None and (cloud.size > 1 or multiproc):
+            from ..parallel import mesh as cloudlib
+
+            if quota is None:
+                # every rank must agree on the padded per-process rows
+                quota = (distdata.local_quota(n) if multiproc
+                         else cloudlib.pad_to_multiple(n, cloud.size))
+            m_r = distdata.replicated_array(m_h, cloud)
+            s_r = distdata.replicated_array(s_h, cloud)
+
+            def _sharded():
+                gp = [distdata.global_row_array(pk, quota, cloud)
+                      for pk in packs]
+                gc = distdata.global_row_array(cats_a, quota, cloud)
+                return fn(gp[0], gp[1], gp[2], gc, m_r, s_r)
+
+            return _phases.accounted_h2d(_sharded, nbytes)
         return _phases.accounted_h2d(
             lambda: fn(jnp.asarray(packs[0]), jnp.asarray(packs[1]),
-                       jnp.asarray(packs[2]), jnp.asarray(cats_a), m_a, s_a),
-            sum(p.nbytes for p in packs) + cats_a.nbytes)
+                       jnp.asarray(packs[2]), jnp.asarray(cats_a),
+                       jnp.asarray(m_h), jnp.asarray(s_h)),
+            nbytes)
 
     def _expand(self, frame: Frame, fit: bool) -> np.ndarray:
         cols = []

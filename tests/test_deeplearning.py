@@ -100,3 +100,28 @@ def test_dl_autoencoder_anomaly(cloud1):
     assert len(set(top) & set(range(5))) >= 4
     rec = ae.predict(fr)
     assert rec.ncol == 6 and rec.names[0].startswith("reconstr_")
+
+
+def test_dl_trains_on_mesh_with_padding(cloud8):
+    """Single-process 8-device mesh: the scan path ingests byte-compressed
+    sharded packs with quota padding (n not divisible by 8) and still
+    learns; padded zero-weight rows must not distort the fit."""
+    import numpy as np
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+
+    rng = np.random.default_rng(0)
+    n = 1999                                # forces tail padding
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    d = {f"f{i}": X[:, i] for i in range(5)}
+    d["y"] = y.astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    est = H2ODeepLearningEstimator(hidden=[16], epochs=8, seed=1,
+                                   mini_batch_size=64)
+    est.train(x=[f"f{i}" for i in range(5)], y="y", training_frame=fr)
+    assert float(est.auc()) > 0.85
+    pred = est.predict(fr)
+    assert pred.nrow == n
+    assert np.isfinite(pred.vec("1").numeric_np()).all()

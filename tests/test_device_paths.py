@@ -145,3 +145,35 @@ def test_glm_device_lambda_path_matches_host():
             Xd, jnp.asarray(yd), jnp.asarray(wd), "binomial", float(lam_i),
             0.5, 50, 1e-4, 1.5, np.zeros(Xd.shape[1], np.float64))
         np.testing.assert_allclose(beta_dev, beta_host, atol=5e-3)
+
+
+def test_device_design_sharded_mesh_matches_dense(cloud8):
+    """Single-process multi-device mesh: device_design(cloud=) produces the
+    row-sharded byte-compressed design, equal to the dense f32 path, with
+    zero-padded quota rows at the tail (VERDICT r04 #4)."""
+    import numpy as np
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.model_base import DataInfo
+    from h2o3_tpu.parallel import mesh as cloudlib
+
+    rng = np.random.default_rng(3)
+    n = 500                                  # NOT divisible by 8 → padding
+    d = {
+        "a": rng.integers(0, 200, n).astype(np.float64),       # uint8 group
+        "b": rng.integers(-1000, 1000, n).astype(np.float64),  # int16 group
+        "f": rng.normal(size=n),                               # f32 group
+        "c": np.asarray([f"k{v}" for v in rng.integers(0, 4, n)],
+                        dtype=object),
+    }
+    fr = h2o.H2OFrame_from_python(d, column_types={"c": "enum"})
+    dinfo = DataInfo(fr, ["a", "b", "f", "c"], standardize=True)
+    X = dinfo.fit_transform(fr)
+    Xd = dinfo.device_design(fr, fit=False, cloud=cloud8)
+    assert dinfo._transfer_groups == [0, 1, 2]
+    quota = cloudlib.pad_to_multiple(n, cloud8.size)
+    assert int(Xd.shape[0]) == quota
+    got = np.asarray(Xd)
+    np.testing.assert_allclose(got[:n], X, rtol=1e-5, atol=1e-5)
+    # sharding really is by rows over the mesh
+    assert len(Xd.sharding.device_set) == cloud8.size

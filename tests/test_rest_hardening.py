@@ -69,7 +69,7 @@ def test_validate_parameters(server):
     assert "bogus_knob" in bad["messages"][0]["message"]
     # value-level validation reaches the estimator's _check_params
     bad2 = _post(srv, "/3/ModelBuilders/xgboost/parameters",
-                 booster="gblinear")
+                 booster="gbforest")
     assert bad2["error_count"] == 1
 
 
