@@ -169,6 +169,19 @@ class _Handler(BaseHTTPRequestHandler):
         ("POST", r"^/3/CreateFrame$", "create_frame"),
         ("POST", r"^/3/Interaction$", "interaction"),
         ("POST", r"^/3/MissingInserter$", "missing_inserter"),
+        ("GET", r"^/3/ModelBuilders$", "builders_list"),
+        ("POST", r"^/3/Jobs/([^/]+)/cancel$", "job_cancel"),
+        ("GET", r"^/3/Frames/([^/]+)/columns$", "frame_columns"),
+        ("GET", r"^/3/Frames/([^/]+)/columns/([^/]+)/domain$",
+         "column_domain"),
+        ("POST", r"^/3/Tabulate$", "tabulate"),
+        ("GET", r"^/3/JStack$", "jstack"),
+        ("POST", r"^/3/PartialDependence$", "pdp"),
+        ("GET", r"^/3/PartialDependence/([^/]+)$", "pdp_get"),
+        ("GET", r"^/3/Word2VecSynonyms$", "w2v_synonyms"),
+        ("POST", r"^/3/Word2VecTransform$", "w2v_transform"),
+        ("GET", r"^/3/Metadata/endpoints$", "metadata_endpoints"),
+        ("POST", r"^/3/UnlockKeys$", "unlock_keys"),
     ]
 
     def log_message(self, fmt, *args):  # route access logs into our Log
@@ -392,10 +405,35 @@ class _Handler(BaseHTTPRequestHandler):
                                      columns=f.ncol) for f in frames]))
 
     def h_frame_get(self, key):
+        """`GET /3/Frames/{id}[?row_offset=&row_count=]` — summary, plus a
+        data page when row_count is given (FramesHandler.fetch paging)."""
         fr = DKV.get(key)
         if not isinstance(fr, Frame):
             raise KeyError(key)
-        self._send(dict(frames=[_frame_summary(fr)]))
+        p = self._params()
+        summ = _frame_summary(fr)
+        if p.get("row_count") not in (None, ""):
+            off = max(int(p.get("row_offset", 0)), 0)
+            cnt = min(int(p["row_count"]), 10_000)   # bulk = DownloadDataset
+            summ["row_offset"] = off
+            summ["row_count"] = cnt
+            for cmeta in summ["columns"]:
+                v = fr.vec(cmeta["label"])
+                if v.type == "enum":
+                    dom = np.asarray((v.domain or []) + [None], dtype=object)
+                    vals = dom[np.asarray(v.data[off:off + cnt], np.int64)]
+                    cmeta["data"] = [None if x is None else str(x)
+                                     for x in vals]
+                elif v.type == "string":
+                    vals = np.asarray(v.to_numpy(), dtype=object)[
+                        off:off + cnt]
+                    cmeta["data"] = [None if x is None else str(x)
+                                     for x in vals]
+                else:
+                    a = v.numeric_np()[off:off + cnt]
+                    cmeta["data"] = [None if np.isnan(x) else float(x)
+                                     for x in a]
+        self._send(dict(frames=[summ]))
 
     h_frame_summary = h_frame_get
 
@@ -502,8 +540,12 @@ class _Handler(BaseHTTPRequestHandler):
                   description=f"{algo} train").start()
         job.result = None  # model key once DONE (the job's `dest` is stable)
         DKV.put(job.dest, job)
+        # the estimator adopts THIS job, so /3/Jobs progress and
+        # DELETE /3/Jobs/{id} cancellation act on the run itself
+        est._external_job = job
 
         def run():
+            from ..models.model_base import JobCancelled
             from ..parallel import mesh
 
             try:
@@ -514,6 +556,8 @@ class _Handler(BaseHTTPRequestHandler):
                 DKV.put(m.model_id, m)
                 job.result = m.model_id
                 job.done()
+            except JobCancelled:
+                Log.info(f"train {algo}: cancelled")   # status already set
             except Exception as e:
                 Log.err(f"train {algo}: {e}")
                 job.status = "FAILED"
@@ -1228,6 +1272,211 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(job=dict(status="DONE"),
                         destination_frame=dict(name=out.key),
                         cols=out.ncol))
+
+    def h_builders_list(self):
+        """`GET /3/ModelBuilders` — every registered algorithm + its
+        parameter schema (ModelBuildersHandler.list; h2o-py algo
+        discovery)."""
+        reg = schemas.algo_registry()
+        self._send(dict(model_builders={
+            algo: dict(algo=algo, visibility="Stable",
+                       can_build=["Supervised" if getattr(
+                           cls, "supervised", True) else "Unsupervised"])
+            for algo, cls in sorted(reg.items())}))
+
+    def h_job_cancel(self, key):
+        """`POST /3/Jobs/{id}/cancel` — request cancellation; the training
+        driver honors it at its next scoring boundary (water.Job.stop)."""
+        job = DKV.get(key)
+        if not isinstance(job, Job):
+            raise KeyError(key)
+        job.cancel()
+        self._send(dict(job=dict(key=dict(name=key), status=job.status,
+                                 cancel_requested=job.cancel_requested)))
+
+    def h_frame_columns(self, key):
+        """`GET /3/Frames/{id}/columns` — column labels/types page
+        (FramesHandler.columns)."""
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise KeyError(key)
+        p = self._params()
+        off = int(p.get("column_offset", 0))
+        cnt = int(p.get("column_count", -1))
+        names = fr.names[off:] if cnt < 0 else fr.names[off:off + cnt]
+        self._send(dict(
+            frame_id=dict(name=key), num_columns=fr.ncol,
+            column_offset=off,
+            columns=[dict(label=n, type=fr.vec(n).type) for n in names]))
+
+    def h_column_domain(self, key, col):
+        """`GET /3/Frames/{id}/columns/{col}/domain` — categorical levels
+        (FramesHandler.columnDomain)."""
+        fr = DKV.get(key)
+        if not isinstance(fr, Frame):
+            raise KeyError(key)
+        if col not in fr.names:
+            raise KeyError(col)
+        v = fr.vec(col)
+        dom = list(v.domain or [])
+        self._send(dict(domain=[dom], map=list(range(len(dom)))))
+
+    def h_tabulate(self):
+        """`POST /3/Tabulate` — co-occurrence counts + mean response of a
+        predictor × response column pair, binned (hex/Tabulate.java; the
+        Flow 'tabulate' cell)."""
+        p = self._params()
+        fr = DKV.get(p.get("dataset"))
+        if not isinstance(fr, Frame):
+            raise KeyError(p.get("dataset"))
+        pred, resp = p.get("predictor"), p.get("response")
+        for c in (pred, resp):
+            if c not in fr.names:
+                raise KeyError(c)
+        nbins_p = int(p.get("nbins_predictor", 20))
+        nbins_r = int(p.get("nbins_response", 10))
+        w = (fr.vec(p["weight"]).numeric_np().astype(np.float64)
+             if p.get("weight") and p["weight"] in fr.names
+             else np.ones(fr.nrow))
+        w = np.nan_to_num(w, nan=0.0)   # NA-weight rows drop out, not NaN-ify
+
+        def _codes(col, nbins):
+            v = fr.vec(col)
+            if v.type == "enum":
+                labels = list(v.domain or [])
+                return np.asarray(v.data, np.int64), labels
+            a = v.numeric_np().astype(np.float64)
+            fin = a[~np.isnan(a)]
+            lo, hi = (float(fin.min()), float(fin.max())) if fin.size else (0, 1)
+            span = max(hi - lo, 1e-12)
+            c = np.clip(((a - lo) / span * nbins).astype(np.int64),
+                        0, nbins - 1)
+            c = np.where(np.isnan(a), -1, c)
+            edges = [lo + span * i / nbins for i in range(nbins)]
+            return c, [f"[{e:.4g},{lo + span * (i + 1) / nbins:.4g})"
+                       for i, e in enumerate(edges)]
+
+        cp, lp = _codes(pred, nbins_p)
+        cr, lr = _codes(resp, nbins_r)
+        ok = (cp >= 0) & (cr >= 0)
+        counts = np.zeros((len(lp), len(lr)))
+        np.add.at(counts, (cp[ok], cr[ok]), w[ok])
+        # numeric_np maps enum NA codes (-1) to NaN, so NA responses are
+        # excluded below instead of dragging bin means negative
+        rnum = fr.vec(resp).numeric_np().astype(np.float64)
+        rsum = np.zeros(len(lp))
+        rcnt = np.zeros(len(lp))
+        okr = (cp >= 0) & ~np.isnan(rnum)
+        np.add.at(rsum, cp[okr], (rnum * w)[okr])
+        np.add.at(rcnt, cp[okr], w[okr])
+        with np.errstate(invalid="ignore"):
+            rmean = np.where(rcnt > 0, rsum / np.maximum(rcnt, 1e-300),
+                             np.nan)
+        self._send(dict(
+            predictor=pred, response=resp,
+            predictor_labels=lp, response_labels=lr,
+            count_table=[[float(x) for x in row] for row in counts],
+            response_table=[None if np.isnan(m) else float(m)
+                            for m in rmean]))
+
+    def h_jstack(self):
+        """`GET /3/JStack` — stack-trace samples of every live thread
+        (water/api JStackHandler → util/JStack)."""
+        from ..runtime.profiler import stack_samples
+
+        self._send(dict(traces=stack_samples()))
+
+    def h_pdp(self):
+        """`POST /3/PartialDependence` — partial-dependence tables for a
+        model × frame (hex/PartialDependence.java; h2o-py partial_plot's
+        REST face). Computed synchronously, stored under a key for
+        GET /3/PartialDependence/{id}."""
+        import uuid
+
+        p = self._params()
+        model = DKV.get(p.get("model_id"))
+        fr = DKV.get(p.get("frame_id"))
+        if model is None:
+            raise KeyError(p.get("model_id"))
+        if not isinstance(fr, Frame):
+            raise KeyError(p.get("frame_id"))
+        cols = p.get("cols")
+        if isinstance(cols, str):
+            cols = json.loads(cols)
+        if isinstance(cols, str):       # a bare JSON string names ONE column
+            cols = [cols]
+        tables = model.partial_plot(
+            fr, cols=cols, nbins=int(p.get("nbins", 20)),
+            include_na=str(p.get("include_na", "")).lower()
+            in ("1", "true"))
+
+        def _cell(x):
+            # np.float32 is not a `float` — go through float() so every
+            # numeric NaN (any width) becomes JSON null, never a NaN token
+            if isinstance(x, str) or x is None:
+                return x
+            xf = float(x)
+            return None if np.isnan(xf) else xf
+
+        out = [{c: [_cell(x) for x in t.vec(c).to_numpy()]
+                for c in t.names} for t in tables]
+        key = p.get("destination_key") or f"pdp_{uuid.uuid4().hex[:8]}"
+        DKV.put(key, dict(type="pdp", cols=list(cols),
+                          partial_dependence_data=out))
+        self._send(dict(destination_key=dict(name=key), cols=list(cols),
+                        partial_dependence_data=out))
+
+    def h_pdp_get(self, key):
+        obj = DKV.get(key)
+        if not isinstance(obj, dict) or obj.get("type") != "pdp":
+            raise KeyError(key)
+        self._send(dict(destination_key=dict(name=key),
+                        cols=obj["cols"],
+                        partial_dependence_data=obj[
+                            "partial_dependence_data"]))
+
+    def h_w2v_synonyms(self):
+        """`GET /3/Word2VecSynonyms?model=&word=&count=` —
+        Word2VecHandler.findSynonyms."""
+        p = self._params()
+        model = DKV.get(p.get("model"))
+        if model is None or not hasattr(model, "find_synonyms"):
+            raise KeyError(p.get("model"))
+        syn = model.find_synonyms(str(p.get("word", "")),
+                                  int(p.get("count", 20)))
+        self._send(dict(synonyms=list(syn.keys()),
+                        scores=[float(v) for v in syn.values()]))
+
+    def h_w2v_transform(self):
+        """`POST /3/Word2VecTransform?model=&words_frame=&aggregate_method=`
+        — Word2VecHandler.transform: embed a words column."""
+        p = self._params()
+        model = DKV.get(p.get("model"))
+        fr = DKV.get(p.get("words_frame"))
+        if model is None or not hasattr(model, "transform"):
+            raise KeyError(p.get("model"))
+        if not isinstance(fr, Frame):
+            raise KeyError(p.get("words_frame"))
+        out = model.transform(
+            fr, aggregate_method=str(p.get("aggregate_method", "NONE")))
+        DKV.put(out.key, out)
+        self._send(dict(vectors_frame=dict(name=out.key),
+                        cols=out.ncol, rows=out.nrow))
+
+    def h_metadata_endpoints(self):
+        """`GET /3/Metadata/endpoints` — the live route table
+        (MetadataHandler.listRoutes)."""
+        self._send(dict(routes=[
+            dict(http_method=m, url_pattern=rx, handler=h)
+            for m, rx, h in self.ROUTES]))
+
+    def h_unlock_keys(self):
+        """`POST /3/UnlockKeys` — upstream force-unlocks wedged key locks
+        (UnlockKeysHandler). This DKV has no lock table by design (pytree
+        values, functional updates), so there is never anything to unlock —
+        the route answers honestly for client compatibility."""
+        self._send(dict(unlocked=0,
+                        note="DKV is lock-free by design; nothing to unlock"))
 
     def h_missing_inserter(self):
         """`POST /3/MissingInserter` — set a random fraction of a frame's

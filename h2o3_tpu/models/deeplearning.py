@@ -595,6 +595,10 @@ class H2ODeepLearningEstimator(H2OEstimator):
                      else (jnp.zeros(_flat_n, jnp.float32),))
         _score_time = 0.0
         while seen < total:
+            # REST job cancellation (single-process: a per-rank host
+            # decision would diverge a multi-process cloud)
+            if self.job is not None and jax.process_count() == 1:
+                self.job.check_cancelled()
             if use_scan:
                 upto = min(next_score, total)
                 eff_batch = max(batch * real_frac, 1e-9)

@@ -86,6 +86,12 @@ def _device_expand_fn(sig):
     return jax.jit(expand)
 
 
+class JobCancelled(RuntimeError):
+    """Raised inside a training driver when its Job was cancelled
+    (`water.Job.JobCancelledException` — cancellation takes effect at the
+    driver's next safe point, a scoring boundary)."""
+
+
 @dataclass
 class Job:
     """`water.Job` — progress/cancel tracking for a training run."""
@@ -97,6 +103,7 @@ class Job:
     progress: float = 0.0
     status: str = "CREATED"  # CREATED/RUNNING/DONE/FAILED/CANCELLED
     warnings: List[str] = field(default_factory=list)
+    cancel_requested: bool = False
 
     def start(self):
         self.start_time = time.time()
@@ -105,6 +112,19 @@ class Job:
 
     def update(self, progress: float):
         self.progress = float(progress)
+
+    def cancel(self):
+        """Request cancellation (`DELETE /3/Jobs/{id}` / Job.stop): takes
+        effect at the driver's next safe point."""
+        if self.status in ("CREATED", "RUNNING"):
+            self.cancel_requested = True
+
+    def check_cancelled(self):
+        """Driver-side safe point: finalize + raise if a cancel is pending."""
+        if self.cancel_requested and self.status == "RUNNING":
+            self.status = "CANCELLED"
+            self.end_time = time.time()
+            raise JobCancelled(self.dest)
 
     def done(self):
         self.end_time = time.time()
@@ -822,8 +842,14 @@ class H2OEstimator:
                 if nav.any():
                     validation_frame = validation_frame.take(np.nonzero(~nav)[0])
 
-        self.job = Job(dest=f"{self.algo}_{next(_model_counter)}",
-                       description=f"{self.algo} train").start()
+        # a REST-created Job (h_train) rides through so /3/Jobs progress and
+        # cancellation act on THE job driving this estimator
+        ext = getattr(self, "_external_job", None)
+        self.job = ext if ext is not None else Job(
+            dest=f"{self.algo}_{next(_model_counter)}",
+            description=f"{self.algo} train")
+        if self.job.status == "CREATED":
+            self.job.start()
         t0 = time.time()
         seed = int(self._parms.get("seed", -1))
         if seed in (-1, None):
@@ -839,11 +865,15 @@ class H2OEstimator:
         if nfolds >= 2 and self._is_supervised():
             self._run_cv(model, x, y, training_frame, nfolds)
         model.run_time = time.time() - t0
-        self.job.done()
         self._model = model
         from ..runtime.dkv import DKV
 
         DKV.put(model.model_id, model)  # h2o.get_model / h2o.models surface
+        # result before done(): a REST poller that sees DONE must be able to
+        # fetch the model that instant (h_train's thread sets result later,
+        # which would leave a 404 window)
+        self.job.result = model.model_id
+        self.job.done()
         ckpt_dir = self._parms.get("export_checkpoints_dir")
         if ckpt_dir:
             # auto-export the finished model (Model export_checkpoints_dir)

@@ -1990,6 +1990,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 or self._parms.get("score_each_iteration")
                 or (stopper is not None and not score_interval)
             )
+            # REST job cancellation takes effect at scoring boundaries —
+            # single-process only (a per-rank host decision would diverge a
+            # multi-process cloud)
+            if (self.job is not None and jax.process_count() == 1):
+                self.job.check_cancelled()
             if do_score:
                 if self._mode == "drf" and row_sampled and n_prior == 0:
                     # score on OOB predictions (DRF scoring history is OOB)
