@@ -116,6 +116,35 @@ def _binom_binned_stats(margins, y_d, n, nbins: int = 400):
     return qs, npos, nneg, nll, sq
 
 
+@functools.partial(jax.jit, static_argnames=("mode", "problem", "dist"))
+def _event_loss_device(margins, y_d, valid, inv_ntrees, mode: str,
+                       problem: str, dist: str):
+    """Scoring-event mean loss ON DEVICE: ONE scalar is the only D2H — the
+    host path pulled the full margin matrix (4·n·K bytes) through the
+    tunnel per event. The link mapping is _margins_to_preds (the same
+    source model.predict uses); `inv_ntrees` is traced so every event of a
+    fit reuses ONE compiled program. On a multi-process cloud the inputs
+    are global sharded arrays, so the mean comes back global and
+    replicated — no separate host collective needed. Clips use 1e-7 (the
+    f64 path's 1e-15 rounds to exactly 0/1 in f32, which would turn a
+    saturated probability into an inf logloss)."""
+    vf = valid.astype(jnp.float32)
+    cnt = jnp.maximum(jnp.sum(vf), 1e-12)
+    probs = _margins_to_preds(mode, problem, dist, margins, inv_ntrees, jnp)
+    eps = 1e-7
+    if problem == "binomial":
+        pc = jnp.clip(probs[:, 1], eps, 1 - eps)
+        y = y_d[:, 0]
+        nll = -jnp.where(y > 0.5, jnp.log(pc), jnp.log1p(-pc))
+        return jnp.sum(nll * vf) / cnt
+    if problem == "multinomial":
+        pc = jnp.clip(probs, eps, 1.0)
+        nll = -jnp.sum(jnp.log(pc) * y_d, axis=1)
+        return jnp.sum(nll * vf) / cnt
+    sq = (probs[:, 0] - y_d[:, 0]) ** 2
+    return jnp.sum(sq * vf) / cnt
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def _predict_forest_codes_jit(forest, codes, max_depth: int):
     """Σ over a stacked forest of per-row leaf values on binned codes."""
@@ -210,31 +239,39 @@ def _dart_scale_jit(pk, s):
     return pk.at[..., 4].multiply(s)
 
 
-def probs_from_margins(mode, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:
-    """margins → predictions, shared by train-time scoring and model.predict
-    (single source of truth for the per-mode link mapping)."""
+def _margins_to_preds(mode, problem, dist, m, inv_ntrees, xp):
+    """margins → predictions — the ONE per-mode link mapping, parameterized
+    by array module so host scoring (np) and the device event kernel (jnp)
+    cannot diverge. `inv_ntrees` is a scalar (python float on host, traced
+    on device)."""
     if mode == "drf":
         # DRF: leaf values are per-leaf response means; prediction is the
         # forest average (hex/tree/drf/DRFModel.score0 vote averaging)
-        m = m / max(ntrees, 1)
+        m = m * inv_ntrees
         if problem == "binomial":
-            p1 = np.clip(m[:, 0], 0.0, 1.0)
-            return np.column_stack([1 - p1, p1])
+            p1 = xp.clip(m[:, 0], 0.0, 1.0)
+            return xp.stack([1 - p1, p1], axis=1)
         if problem == "multinomial":
-            p = np.clip(m, 0.0, None)
+            p = xp.clip(m, 0.0, None)
             s = p.sum(axis=1, keepdims=True)
-            return np.where(s > 0, p / np.maximum(s, 1e-12), 1.0 / p.shape[1])
+            return xp.where(s > 0, p / xp.maximum(s, 1e-12), 1.0 / m.shape[1])
         return m[:, :1]
     if problem == "binomial":
-        p1 = 1 / (1 + np.exp(-m[:, 0]))
-        return np.column_stack([1 - p1, p1])
+        p1 = 1 / (1 + xp.exp(-m[:, 0]))
+        return xp.stack([1 - p1, p1], axis=1)
     if problem == "multinomial":
-        e = np.exp(m - m.max(axis=1, keepdims=True))
+        e = xp.exp(m - m.max(axis=1, keepdims=True))
         return e / e.sum(axis=1, keepdims=True)
     mm = m[:, 0]
     if dist in ("poisson", "gamma", "tweedie"):
-        return np.exp(mm)[:, None]
+        return xp.exp(mm)[:, None]
     return mm[:, None]
+
+
+def probs_from_margins(mode, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:
+    """Host-side margins → predictions (train-time scoring + model.predict)."""
+    return _margins_to_preds(mode, problem, dist, np.asarray(m),
+                             1.0 / max(ntrees, 1), np)
 
 
 def _metrics_for(problem, yvec, probs):
@@ -1546,6 +1583,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if ndev > 1:
                 margins = jax.device_put(margins, cloud.row_sharding())
 
+        # real-row mask for device-side event metrics (pads excluded); on a
+        # multi-process cloud it is global, so event sums come back global
+        if multiproc:
+            row_mask_d = distdata.global_row_array(
+                np.ones(n, np.float32), quota, cloud)
+        else:
+            row_mask_d = (jnp.arange(npad) < n).astype(jnp.float32)
+
         # checkpoint= continue-training: restore the prior forest and fast-
         # forward margins (SharedTree checkpoint restart — `_parms.checkpoint`
         # compat checks + tree restore in hex/tree/SharedTree.java)
@@ -1645,6 +1690,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 quota_v = distdata.local_quota(n_v)
                 codes_v = distdata.global_row_array(codes_np_v, quota_v,
                                                     cloud)
+                y_dev_v = distdata.global_row_array(ykv, quota_v, cloud)
+                vmask_d = distdata.global_row_array(
+                    np.ones(n_v, np.float32), quota_v, cloud)
                 rs_v = cloud.row_sharding()
                 margins_v = jax.jit(
                     lambda f: jnp.broadcast_to(
@@ -1654,6 +1702,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     out_shardings=rs_v)(np.asarray(f0).reshape(-1))
             else:
                 codes_v = jnp.asarray(codes_np_v)
+                y_dev_v = jnp.asarray(ykv)
+                vmask_d = jnp.ones(n_v, jnp.float32)
                 margins_v = jnp.broadcast_to(
                     jnp.asarray(np.asarray(f0).reshape(-1))[None, :],
                     (n_v, K)).astype(jnp.float32)
@@ -1672,7 +1722,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                         out_shardings=rs_v)(margins_v, off_g)
                 else:
                     margins_v = margins_v + jnp.asarray(off_v)[:, None]
-            valid_state = [codes_v, ykv, margins_v, n_v]
+            # slot 1 deliberately None: the host ykv copy it used to hold is
+            # superseded by the device y_dev_v (slot 4); indices are stable
+            valid_state = [codes_v, None, margins_v, n_v, y_dev_v, vmask_d]
 
         _ph.mark("device_put", sync=codes_d)
         key = jax.random.PRNGKey(seed & 0x7FFFFFFF)
@@ -2009,12 +2061,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                            oob_mean * max(built, 1),
                                            y_d, w_d, n, built + n_prior)
                 else:
-                    ev = self._score_event(problem, dist, margins, y_d, w_d, n, built + n_prior)
+                    ev = self._score_event(problem, dist, margins, y_d, w_d,
+                                           n, built + n_prior,
+                                           row_mask=row_mask_d)
                 if valid_state is not None:
                     vev = self._score_event(
                         problem, dist, valid_state[2],
-                        valid_state[1], None, valid_state[3],
-                        built + n_prior,
+                        valid_state[4], None, valid_state[3],
+                        built + n_prior, row_mask=valid_state[5],
                     )
                     ev.update({f"validation_{k2}": v for k2, v in vev.items()
                                if k2 not in ("number_of_trees", "timestamp")})
@@ -2312,12 +2366,32 @@ class H2OSharedTreeEstimator(H2OEstimator):
             return sm.lower()
         return "logloss" if problem in ("binomial", "multinomial") else "deviance"
 
-    def _score_event(self, problem, dist, margins, y_d, w_d, n, ntrees) -> Dict:
-        """One scoring-history event. On a multi-process cloud, `margins` /
-        `y_d` may be process-spanning arrays and `n` the LOCAL row count:
-        each process computes its local loss pieces and ONE `global_sum`
-        makes the event metrics global (and identical on every rank — the
-        early-stopping decisions that read them therefore agree)."""
+    def _score_event(self, problem, dist, margins, y_d, w_d, n, ntrees,
+                     row_mask=None) -> Dict:
+        """One scoring-history event. With `row_mask` (device real-row
+        mask), the loss sums are computed ON DEVICE and only two scalars
+        cross the wire — at 1M rows the host path's full-margin pull is
+        4·n·K bytes through the tunnel per event. On a multi-process cloud
+        the device inputs are global, so the sums come back global and
+        identical on every rank (the early-stopping decisions that read
+        them therefore agree); the host fallback (OOB means arrive as numpy)
+        reduces with ONE `global_sum` instead."""
+        if row_mask is not None and not isinstance(margins, np.ndarray):
+            val = float(_event_loss_device(
+                margins, y_d, row_mask,
+                jnp.float32(1.0 / max(ntrees, 1)),
+                self._mode, problem, dist))
+            ev: Dict = {"number_of_trees": ntrees, "timestamp": time.time()}
+            if problem in ("binomial", "multinomial"):
+                ev["logloss"] = val
+                ev["training_deviance"] = val
+                if problem == "binomial":
+                    ev["auc"] = float("nan")  # full AUC at final scoring
+            else:
+                ev["deviance"] = val
+                ev["rmse"] = float(np.sqrt(val))
+                ev["training_deviance"] = val
+            return ev
         multiproc = distdata.multiprocess()
         m = distdata.to_local(margins)[:n].astype(np.float64)
         y = distdata.to_local(y_d)[:n].astype(np.float64)
