@@ -24,7 +24,7 @@ import time
 # persistent XLA compilation cache: repeat bench runs (fresh processes) skip
 # the ~20s trace+compile of the per-tree program and measure training itself
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 # per-phase accounting (VERDICT r04 #2): training drivers sync at phase
 # boundaries and record {h2d, compile, deserialize, compute, ...} so the
 # JSON decomposes wall-clock instead of conflating tunnel + compile + MXU
@@ -463,7 +463,7 @@ def main():
 
         cache_dir = tempfile.mkdtemp(prefix="jax_cold_cache_")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     from h2o3_tpu.runtime import phases as _phz
 
     _phz.install_listener()

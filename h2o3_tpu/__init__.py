@@ -22,7 +22,7 @@ if not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         _cache = _os.path.expanduser("~/.cache/h2o3_tpu/jax_cache")
         _os.makedirs(_cache, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     except Exception:
         pass
 

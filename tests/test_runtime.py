@@ -139,3 +139,34 @@ def test_dkv_stats_and_timeline_phases(cloud1):
     # the training driver's cost structure is visible after the fact
     for expected in ("build_bins", "device_put", "training_metrics"):
         assert expected in phases, phases
+
+
+def test_phases_accounting_and_mark_mapping():
+    """runtime.phases: byte/second accumulation, mark→bucket mapping, and
+    compile-time subtraction in accounted_h2d."""
+    import jax.numpy as jnp
+
+    from h2o3_tpu.runtime import phases
+
+    phases.reset()
+    phases.add("h2d", 0.5, 1000)
+    phases.add("h2d", 0.25, 24)
+    phases.add_mark("device_put", 0.1)          # → h2d bucket
+    phases.add_mark("chunk_3_2trees", 0.2)      # → compute
+    phases.add_mark("frame_to_matrix", 0.05)    # → host_prep
+    phases.add_mark("margins_D2H", 0.01)        # → d2h
+    snap = phases.snapshot()
+    assert snap["bytes_h2d"] == 1024
+    assert snap["h2d_s"] == pytest.approx(0.85, abs=1e-6)
+    assert snap["compute_s"] == pytest.approx(0.2)
+    assert snap["host_prep_s"] == pytest.approx(0.05)
+    assert snap["d2h_s"] == pytest.approx(0.01)
+    assert phases.totals(("h2d", "compute")) == pytest.approx(1.05)
+    phases.reset()
+    assert phases.snapshot() == {}
+
+    # accounted_h2d: runs the thunk, books bytes; result passes through
+    out = phases.accounted_h2d(lambda: jnp.arange(8), 32)
+    assert int(out[3]) == 3
+    assert phases.snapshot()["bytes_h2d"] == 32
+    phases.reset()
