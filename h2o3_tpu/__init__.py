@@ -253,6 +253,101 @@ def frames():
     return _DKV.keys(Frame)
 
 
+def as_list(data, use_pandas: bool = False, header: bool = True):
+    """`h2o.as_list` — frame contents as a pandas DataFrame or a list of
+    row lists (header row first when header=True)."""
+    if use_pandas:
+        return data.as_data_frame(use_pandas=True)
+    cols = data.as_data_frame(use_pandas=False)
+    names = list(data.names)
+    rows = [list(r) for r in zip(*(cols[n] for n in names))]
+    return [names] + rows if header else rows
+
+
+def cluster_status() -> None:
+    """`h2o.cluster_status` — print cloud health (h2o-py cluster_status;
+    reads the SERVER's /3/Cloud when connected)."""
+    conn = client.current_connection()
+    if conn is not None:
+        info = conn.cluster_info()
+        print(f"cloud {info.get('cloud_name')!r} v{info.get('version')}: "
+              f"{info.get('cloud_size')} node(s), healthy="
+              f"{info.get('cloud_healthy', True)}")
+        return
+    cluster().show_status()
+
+
+def network_test():
+    """`h2o.network_test` — transport microbenchmark (NetworkTestHandler;
+    here the data plane is the host↔device link). Returns the per-size
+    results table; runs SERVER-side when connected."""
+    conn = client.current_connection()
+    if conn is not None:
+        return conn.get("/3/NetworkTest")["results"]
+    from .runtime.nettest import run_network_test
+
+    return run_network_test()
+
+
+def log_and_echo(message: str = "") -> None:
+    """`h2o.log_and_echo` — drop a marker line into the cluster log
+    (LogAndEchoHandler)."""
+    conn = client.current_connection()
+    if conn is not None:
+        conn.post("/3/LogAndEcho", message=message)
+        return
+    _Log.info(f"[LogAndEcho] {message}")
+
+
+def download_all_logs(dirname: str = ".", filename: Optional[str] = None) -> str:
+    """`h2o.download_all_logs` — write the cluster log ring as a zip
+    (LogsHandler download; the remote form pulls the SERVER's log)."""
+    import io as _io
+    import zipfile as _zip
+
+    conn = client.current_connection()
+    if conn is not None:
+        text = "\n".join(str(ln) for ln in conn.get("/3/Logs")["logs"])
+    else:
+        text = "\n".join(str(ln) for ln in _Log.get_logs())
+    out = _os.path.join(dirname, filename or "h2o3_tpu_logs.zip")
+    _os.makedirs(_os.path.dirname(out) or ".", exist_ok=True)
+    buf = _io.BytesIO()
+    with _zip.ZipFile(buf, "w", _zip.ZIP_DEFLATED) as z:
+        z.writestr("h2o3_tpu.log", text)
+    with open(out, "wb") as f:
+        f.write(buf.getvalue())
+    return out
+
+
+def list_timezones() -> Frame:
+    """`h2o.list_timezones` — one string column of zone names."""
+    import zoneinfo
+
+    names = sorted(zoneinfo.available_timezones())
+    return Frame({"Timezones": np.asarray(names, dtype=object)},
+                 column_types={"Timezones": "string"})
+
+
+def estimate_cluster_mem(ncols: int, nrows: int, num_cols: int = 0,
+                         string_cols: int = 0, cat_cols: int = 0,
+                         time_cols: int = 0, uuid_cols: int = 0) -> float:
+    """`h2o.estimate_cluster_mem` — recommended cluster memory (GB) for a
+    dataset, the reference's rule of thumb: ~4× the in-memory data size,
+    with per-type byte widths (numeric 8 B, categorical 2 B, time 8 B,
+    UUID 16 B, string ~128 B). Unclassified columns count as numeric."""
+    if ncols <= 0 or nrows <= 0:
+        raise ValueError("ncols and nrows must be positive")
+    typed = num_cols + string_cols + cat_cols + time_cols + uuid_cols
+    if typed > ncols:
+        raise ValueError("column type counts exceed ncols")
+    plain = ncols - typed
+    row_bytes = ((num_cols + plain) * 8 + string_cols * 128 + cat_cols * 2
+                 + time_cols * 8 + uuid_cols * 16)
+    gb = nrows * row_bytes / 1e9
+    return round(4 * gb, 3)
+
+
 def remove_all(retained=None) -> None:
     """`h2o.remove_all()` — clear the DKV, optionally keeping some keys
     (water/api RemoveAllHandler `retained_keys`). Connected remotely this
@@ -570,6 +665,18 @@ def download_mojo(model, path: str = ".", **kw) -> str:
 
 def import_mojo(path: str):
     return load_model(path)
+
+
+def save_grid(grid, grid_directory: str,
+              export_cross_validation_predictions: bool = False) -> str:
+    """`h2o.save_grid` — export a trained grid (state + per-model
+    artifacts) so `h2o.load_grid(grid_directory)` restores it."""
+    if export_cross_validation_predictions:
+        raise NotImplementedError(
+            "export_cross_validation_predictions is not part of this "
+            "artifact format (holdout predictions are recomputable from "
+            "the restored models)")
+    return grid.save(grid_directory)
 
 
 def load_grid(grid_file_path: str, grid_id: Optional[str] = None):

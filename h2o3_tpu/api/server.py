@@ -701,22 +701,12 @@ class _Handler(BaseHTTPRequestHandler):
         collectives run here: a REST request reaches ONE rank, and a
         single-rank collective would hang the cloud (docs/distributed.md,
         concurrent-jobs section)."""
-        import time as _t
-
         import jax
 
-        results = []
-        for size in (1 << 10, 1 << 16, 1 << 20):
-            payload = np.zeros(size, np.uint8)
-            dev = jax.device_put(payload)          # warm-up: compile + path
-            np.asarray(dev)
-            t0 = _t.time()
-            dev = jax.device_put(payload)
-            np.asarray(dev)                        # forces the D2H
-            dt = max(_t.time() - t0, 1e-9)
-            results.append(dict(bytes=size, seconds=dt,
-                                mbytes_per_sec=2 * size / dt / 1e6))
-        self._send(dict(nodes=jax.process_count(), results=results))
+        from ..runtime.nettest import run_network_test
+
+        self._send(dict(nodes=jax.process_count(),
+                        results=run_network_test()))
 
     def h_garbage_collect(self):
         """`POST /3/GarbageCollect` (water/api GarbageCollectHandler)."""

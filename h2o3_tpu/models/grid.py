@@ -73,7 +73,11 @@ class H2OGridSearch:
             self.base_parms = {
                 k: v for k, v in model._parms.items() if not k.startswith("_")
             }
-        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        # plain-python values throughout: numpy scalars (np.arange hyper
+        # ranges) would crash every JSON dump of grid state downstream
+        self.hyper_params = {
+            k: [x.item() if isinstance(x, np.generic) else x for x in v]
+            for k, v in hyper_params.items()}
         self.grid_id = grid_id or f"grid_{int(time.time())}"
         self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.recovery_dir = recovery_dir
@@ -177,26 +181,76 @@ class H2OGridSearch:
                 # mark the built model failed, and a combo only counts as
                 # done once its artifact actually exists on disk (else a
                 # resumed grid would skip it with nothing to restore).
-                # Filenames are combo-indexed (NOT model_id, which restarts
-                # per process and would clobber earlier runs' artifacts).
                 try:
-                    from ..mojo import save_model
-
-                    fname = f"{self.grid_id}_combo{len(self._done_combos)}.h2o3"
-                    save_model(est, self.recovery_dir, filename=fname, force=True)
-                    m = est.model
-                    metrics = dict(m.training_metrics._ser()
-                                   if m.training_metrics else {})
-                    if m.cross_validation_metrics is not None:
-                        metrics.update(m.cross_validation_metrics._ser())
-                    metrics = {k: v for k, v in metrics.items()
-                               if isinstance(v, (int, float, str))}
-                    self._done_combos.append(
-                        dict(params=combo, file=fname, metrics=metrics))
+                    self._record_done(est, combo)
                     self._save_state()
                 except (TypeError, OSError):
                     pass
         return self
+
+    def _record_done(self, est, combo) -> None:
+        """Export one built model's artifact into recovery_dir and append
+        its done-combo record. Filenames are combo-indexed (NOT model_id,
+        which restarts per process and would clobber earlier runs')."""
+        from ..mojo import save_model
+
+        fname = f"{self.grid_id}_combo{len(self._done_combos)}.h2o3"
+        save_model(est, self.recovery_dir, filename=fname, force=True)
+        m = est.model
+        metrics = dict(m.training_metrics._ser()
+                       if m.training_metrics else {})
+        if m.cross_validation_metrics is not None:
+            metrics.update(m.cross_validation_metrics._ser())
+        metrics = {k: v for k, v in metrics.items()
+                   if isinstance(v, (int, float, str))}
+        self._done_combos.append(
+            dict(params=combo, file=fname, metrics=metrics))
+
+    def save(self, grid_directory: str) -> str:
+        """Export the trained grid — state file + one artifact per built
+        model — so `h2o.load_grid(grid_directory)` restores it in another
+        process (`h2o.save_grid`; upstream Grid.exportBinary +
+        RecoveryHandler state). Grids trained WITHOUT a recovery_dir are
+        supported: their done-combo records are built here from the live
+        estimators."""
+        import json as _json
+        import os
+
+        prev = self.recovery_dir
+        # artifacts referenced by _done_combos live wherever they were last
+        # exported (recovery_dir during train, or a prior save() target) —
+        # they must travel with the state file that references them
+        src_dir = prev or getattr(self, "_artifact_dir", None)
+        self.recovery_dir = grid_directory
+        try:
+            if src_dir and os.path.abspath(src_dir) != os.path.abspath(
+                    grid_directory):
+                import shutil
+
+                os.makedirs(grid_directory, exist_ok=True)
+                for d in self._done_combos:
+                    src = os.path.join(src_dir, d["file"])
+                    if os.path.exists(src):
+                        shutil.copy2(src, grid_directory)
+            seen = {_json.dumps(d["params"], sort_keys=True)
+                    for d in self._done_combos}
+            for est in self.models:
+                if isinstance(est, _RecoveredModel):
+                    continue            # already in _done_combos
+                combo = getattr(est, "_grid_combo", None)
+                if combo is None:
+                    raise TypeError(
+                        "save_grid: grid model carries no combo record — "
+                        "remotely-trained grids keep their artifacts on the "
+                        "SERVER (download models individually)")
+                if _json.dumps(combo, sort_keys=True) in seen:
+                    continue
+                self._record_done(est, combo)
+            self._save_state()
+            self._artifact_dir = grid_directory
+        finally:
+            self.recovery_dir = prev
+        return grid_directory
 
     def _remote_train(self, x, y, training_frame):
         """Grid search against an attached server — POST `/99/Grid/{algo}`

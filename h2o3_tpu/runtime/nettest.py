@@ -1,0 +1,33 @@
+"""Transport microbenchmark — `water/api/NetworkTestHandler` analog.
+
+The reference measures node↔node RPC; this framework's data plane is the
+host↔device link, so the test times H2D+D2H round-trips per payload size
+(warm-up first — the first shape pays an XLA compile, which is not
+bandwidth). Shared by `GET /3/NetworkTest` and `h2o.network_test()`. No
+collectives run here: invoked from a REST request it reaches ONE rank, and
+a single-rank collective would hang the cloud.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+def run_network_test(sizes=(1 << 10, 1 << 16, 1 << 20)) -> List[Dict]:
+    import jax
+
+    results = []
+    for size in sizes:
+        payload = np.zeros(size, np.uint8)
+        dev = jax.device_put(payload)          # warm-up: compile + path
+        np.asarray(dev)
+        t0 = time.time()
+        dev = jax.device_put(payload)
+        np.asarray(dev)                        # forces the D2H
+        dt = max(time.time() - t0, 1e-9)
+        results.append(dict(bytes=size, seconds=dt,
+                            mbytes_per_sec=2 * size / dt / 1e6))
+    return results

@@ -133,3 +133,98 @@ def test_eif_extension_level_validation(cloud1):
     with pytest.raises(ValueError):
         H2OExtendedIsolationForestEstimator(extension_level=5).train(
             x=["a", "b"], training_frame=fr)
+
+
+def test_save_grid_load_grid_roundtrip(tmp_path, cloud1):
+    """h2o.save_grid on a grid trained WITHOUT recovery_dir exports state +
+    artifacts; h2o.load_grid restores the models and their metrics."""
+    import numpy as np
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    rng = np.random.default_rng(0)
+    n = 800
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    d = {f"c{i}": X[:, i] for i in range(4)}
+    d["y"] = y.astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    gs = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=4, seed=1),
+                       hyper_params={"max_depth": [2, 3]}, grid_id="sg1")
+    gs.train(x=[f"c{i}" for i in range(4)], y="y", training_frame=fr)
+    out = h2o.save_grid(gs, str(tmp_path / "gdir"))
+    g2 = h2o.load_grid(out)
+    assert g2.grid_id == "sg1"
+    assert len(g2.models) == 2
+    # restored models score: predictions finite on the training frame
+    p = g2.models[0].predict(fr)
+    assert np.isfinite(p.vec("1").numeric_np()).all()
+    # a SECOND save to a different dir must carry the artifacts along
+    out2 = h2o.save_grid(gs, str(tmp_path / "gdir2"))
+    g3 = h2o.load_grid(out2)
+    assert len(g3.models) == 2
+
+
+def test_save_grid_numpy_hypers_and_kwargs(tmp_path, cloud1):
+    import numpy as np
+    import pytest
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.estimators import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    rng = np.random.default_rng(1)
+    d = {"a": rng.normal(size=300), "b": rng.normal(size=300),
+         "y": (rng.random(300) > 0.5).astype(int).astype(str)}
+    fr = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    gs = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=2, seed=1),
+                       hyper_params={"max_depth": np.arange(2, 4)},
+                       grid_id="sgnp")
+    gs.train(x=["a", "b"], y="y", training_frame=fr)
+    out = h2o.save_grid(gs, str(tmp_path / "np_gdir"))   # np scalars OK
+    assert len(h2o.load_grid(out).models) == 2
+    with pytest.raises(NotImplementedError):
+        h2o.save_grid(gs, str(tmp_path / "x"),
+                      export_cross_validation_predictions=True)
+
+
+def test_misc_surface_functions(tmp_path, cloud1):
+    """h2o.models/as_list/list_timezones/estimate_cluster_mem/
+    log_and_echo/download_all_logs/network_test/cluster_status parity."""
+    import numpy as np
+    import pytest
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(0)
+    d = {"a": rng.normal(size=200), "y": (rng.random(200) > 0.5).astype(int).astype(str)}
+    fr = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    m = H2OGradientBoostingEstimator(ntrees=2, max_depth=2, seed=1)
+    m.train(y="y", training_frame=fr)
+    assert m.model.model_id in h2o.ls()
+
+    lst = h2o.as_list(fr, header=True)
+    assert lst[0] == ["a", "y"] and len(lst) == 201
+
+    tz = h2o.list_timezones()
+    assert tz.nrow > 100 and "UTC" in set(tz.vec("Timezones").to_numpy())
+
+    gb = h2o.estimate_cluster_mem(ncols=10, nrows=1_000_000)
+    assert gb == pytest.approx(4 * 10 * 8 * 1e6 / 1e9, rel=1e-6)
+    with pytest.raises(ValueError):
+        h2o.estimate_cluster_mem(ncols=2, nrows=10, string_cols=3)
+
+    h2o.log_and_echo("marker-xyz")
+    z = h2o.download_all_logs(str(tmp_path))
+    import zipfile
+
+    with zipfile.ZipFile(z) as zf:
+        text = zf.read("h2o3_tpu.log").decode()
+    assert "marker-xyz" in text
+
+    res = h2o.network_test()
+    assert len(res) == 3 and all(r["mbytes_per_sec"] > 0 for r in res)
+    h2o.cluster_status()        # prints, must not raise
