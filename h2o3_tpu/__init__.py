@@ -667,6 +667,122 @@ def import_mojo(path: str):
     return load_model(path)
 
 
+def api(endpoint: str, data: Optional[dict] = None):
+    """`h2o.api("GET /3/Cloud")` — raw REST call against the attached
+    server (h2o-py's escape hatch for routes without a wrapper)."""
+    conn = client.current_connection()
+    if conn is None:
+        raise client.H2OConnectionError(
+            "h2o.api needs an active remote connection (h2o.connect)")
+    verb, _, path = endpoint.partition(" ")
+    if not path.startswith("/"):
+        raise ValueError(f"endpoint must be 'VERB /path', got {endpoint!r}")
+    return conn.request(verb.upper(), path.strip(), params=data)
+
+
+def download_model(model, path: str = ".", filename: Optional[str] = None) -> str:
+    """`h2o.download_model` — fetch a model's artifact to local disk: a
+    REST-backed model downloads from its server, an in-process model
+    saves directly (one artifact format — MOJO ≡ binary here). Overwrites
+    like h2o-py's download_model does."""
+    return save_model(model, path, filename=filename, force=True)
+
+
+def upload_model(path: str):
+    """`h2o.upload_model` — push a LOCAL artifact to the attached server
+    and load it there (returns the server-side model); in-process this is
+    load_model."""
+    conn = client.current_connection()
+    if conn is None:
+        return load_model(path)
+    import urllib.parse as _up
+
+    with open(path, "rb") as f:
+        body = f.read()
+    up = conn.request(
+        "POST", "/3/PostFile?destination_frame="
+                f"{_up.quote(_os.path.basename(path))}",
+        data=body, content_type="application/octet-stream")
+    # delete_source: the PostFile temp copy has served its purpose once
+    # loaded — without this every upload leaks one zip in the server tmpdir
+    out = conn.post("/99/Models.bin", path=up["destination_frame"],
+                    delete_source=1)
+    return client.RemoteModel(conn, out["models"][0]["model_id"]["name"])
+
+
+# one artifact format: uploading a "MOJO" and a binary model are the same op
+upload_mojo = upload_model
+
+
+def print_mojo(mojo_path: str, format: str = "json"):
+    """`h2o.print_mojo` — human-readable artifact dump: meta + array
+    shapes (and per-forest tree counts for tree kinds). For full tree
+    STRUCTURE use `h2o.tree.H2OTree` on the loaded model
+    (hex/genmodel PrintMojo analog)."""
+    import json as _json
+
+    scorer = load_model(mojo_path)
+    out = {"meta": {k: v for k, v in scorer.meta.items()},
+           "arrays": {k: list(np.asarray(v).shape)
+                      for k, v in scorer.arrays.items()}}
+    if format == "json":
+        return _json.dumps(out, indent=2, default=str)
+    return out
+
+
+def make_metrics(predicted, actuals, domain: Optional[Sequence] = None,
+                 distribution: Optional[str] = None, **kw):
+    """`h2o.make_metrics` — ModelMetrics from prediction and actual
+    columns (water/api MakeMetricsHandler): regression when no domain,
+    binomial for a 2-level domain (predicted = p1 column), multinomial
+    for K levels (predicted = K probability columns)."""
+    from .models.metrics import (ModelMetricsBinomial,
+                                 ModelMetricsMultinomial,
+                                 ModelMetricsRegression)
+
+    def _cols(obj):
+        if isinstance(obj, Frame):
+            return np.column_stack([obj.vec(n).numeric_np()
+                                    for n in obj.names])
+        a = np.asarray(obj, np.float64)
+        return a[:, None] if a.ndim == 1 else a
+
+    pred = _cols(predicted)
+    if isinstance(actuals, Frame):
+        av = actuals.vec(actuals.names[0])
+    else:
+        av = actuals
+    if domain is None:
+        act = (av.numeric_np() if hasattr(av, "numeric_np")
+               else np.asarray(av, np.float64))
+        return ModelMetricsRegression.make(act, pred[:, 0])
+    dom = [str(d) for d in domain]
+    if hasattr(av, "data") and getattr(av, "type", None) == "enum":
+        codes = np.asarray(av.data, np.int64)
+        if av.domain and list(map(str, av.domain)) != dom:
+            lookup = {d: i for i, d in enumerate(dom)}
+            remap = np.asarray([lookup.get(str(d), -1) for d in av.domain])
+            codes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+    else:
+        vals = (av.to_numpy() if hasattr(av, "to_numpy")
+                else np.asarray(av))
+        lookup = {d: i for i, d in enumerate(dom)}
+        codes = np.asarray([lookup.get(str(v), -1) for v in vals], np.int64)
+    if (codes < 0).any():
+        bad = int((codes < 0).sum())
+        raise ValueError(
+            f"make_metrics: {bad} actual value(s) are NA or outside the "
+            f"given domain {dom} — metrics over unmatched rows would be "
+            "silently wrong; clean the actuals or fix the domain")
+    if len(dom) == 2:
+        return ModelMetricsBinomial.make(codes, pred[:, -1])
+    if pred.shape[1] != len(dom):
+        raise ValueError(
+            f"multinomial make_metrics needs {len(dom)} probability "
+            f"columns, got {pred.shape[1]}")
+    return ModelMetricsMultinomial.make(codes, pred)
+
+
 def save_grid(grid, grid_directory: str,
               export_cross_validation_predictions: bool = False) -> str:
     """`h2o.save_grid` — export a trained grid (state + per-model

@@ -28,6 +28,15 @@ def _model_payload(model) -> Dict[str, Any]:
     from .models.glm import GLMModel
     from .models.deeplearning import DeepLearningModel
 
+    if isinstance(model, MojoScorer):
+        # a loaded artifact re-exports losslessly (upload→download
+        # round-trip on a serving cluster): its payload IS its state
+        out: Dict[str, Any] = {"meta": dict(model.meta),
+                               "arrays": dict(model.arrays)}
+        if model.children:
+            out["children"] = {k: _model_payload(c)
+                               for k, c in model.children.items()}
+        return out
     arrays: Dict[str, np.ndarray] = {}
     meta: Dict[str, Any] = {
         "format_version": FORMAT_VERSION,
@@ -327,6 +336,7 @@ class MojoScorer:
         self.arrays = arrays
         self.children = children or {}
         self.algo = meta["algo"]
+        self.model_id = meta.get("model_id", "artifact")
         self.x = meta["x"]
         self.y = meta["y"]
         self._native_forests: Dict[int, tuple] = {}  # k → converted arrays

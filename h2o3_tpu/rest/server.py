@@ -483,7 +483,15 @@ class _Handler(BaseHTTPRequestHandler):
         import h2o3_tpu as h2o
 
         p = self._params()
-        scorer = h2o.load_model(p["dir"] if "dir" in p else p["path"])
+        src = p["dir"] if "dir" in p else p["path"]
+        scorer = h2o.load_model(src)
+        if self._flag(p, "delete_source"):
+            # upload flow: the PostFile temp copy is spent once loaded —
+            # keeping it would leak one zip per upload in the server tmpdir
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
         mid = base = scorer.meta.get("model_id", "loaded_model")
         i = 0
         while DKV.get(mid) is not None:
@@ -735,7 +743,16 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(models=[_model_json(m) for m in models]))
 
     def h_model_get(self, key):
+        from ..mojo import MojoScorer
+
         m = DKV.get(key)
+        if isinstance(m, MojoScorer):
+            # uploaded artifact: reduced schema from its stored metadata
+            self._send(dict(models=[dict(
+                model_id=dict(name=key), algo=m.algo,
+                uploaded_artifact=True, kind=m.meta.get("kind"),
+                response_column_name=m.y, output={})]))
+            return
         if not isinstance(m, H2OModel):
             raise KeyError(key)
         self._send(dict(models=[_model_json(m)]))
@@ -745,9 +762,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict())
 
     def h_predict(self, model_key, frame_key):
+        from ..mojo import MojoScorer
+
         m = DKV.get(model_key)
         fr = DKV.get(frame_key)
-        if not isinstance(m, H2OModel):
+        # uploaded/loaded artifacts (MojoScorer) serve predictions too —
+        # that's the point of h2o.upload_model against a serving cluster
+        if not isinstance(m, (H2OModel, MojoScorer)):
             raise KeyError(model_key)
         if not isinstance(fr, Frame):
             raise KeyError(frame_key)
@@ -757,8 +778,16 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(predictions_frame=dict(name=pred.key)))
 
     def h_model_metrics(self, model_key, frame_key):
+        from ..mojo import MojoScorer
+
         m = DKV.get(model_key)
         fr = DKV.get(frame_key)
+        if isinstance(m, MojoScorer):
+            raise ValueError(
+                f"{model_key!r} is an uploaded artifact (offline scorer): "
+                "server-side metrics need a full model — run "
+                "/3/Predictions and compute metrics from the actuals "
+                "(h2o.make_metrics)")
         if not isinstance(m, H2OModel):
             raise KeyError(model_key)
         if not isinstance(fr, Frame):
@@ -1119,8 +1148,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         from .. import mojo as mojolib
 
+        from ..mojo import MojoScorer
+
         m = DKV.get(model_id)
-        if not isinstance(m, H2OModel):
+        if not isinstance(m, (H2OModel, MojoScorer)):
             raise KeyError(model_id)
         with tempfile.TemporaryDirectory(prefix="h2o3_mojo_") as d:
             path = mojolib.save_model(m, d, force=True)

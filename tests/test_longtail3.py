@@ -228,3 +228,104 @@ def test_misc_surface_functions(tmp_path, cloud1):
     res = h2o.network_test()
     assert len(res) == 3 and all(r["mbytes_per_sec"] > 0 for r in res)
     h2o.cluster_status()        # prints, must not raise
+
+
+def test_model_transfer_and_make_metrics(tmp_path, cloud1):
+    """h2o.download_model/print_mojo/make_metrics in-process parity."""
+    import json
+
+    import numpy as np
+    import pytest
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(2)
+    n = 800
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    d = {f"c{i}": X[:, i] for i in range(3)}
+    d["y"] = y.astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    m = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1)
+    m.train(y="y", training_frame=fr)
+
+    path = h2o.download_model(m, str(tmp_path))
+    dump = json.loads(h2o.print_mojo(path))
+    assert dump["meta"]["kind"] == "tree"
+    assert any(k.startswith("forest0") for k in dump["arrays"])
+
+    # make_metrics(binomial): must agree with the model's own AUC
+    p1 = m.predict(fr)["1"]
+    # predict-frame probabilities vs training-margin metrics: same model,
+    # slightly different float paths — agree to ~1e-3, not bitwise
+    mm = h2o.make_metrics(p1, fr["y"], domain=["0", "1"])
+    assert float(mm.auc) == pytest.approx(float(m.auc()), abs=2e-3)
+    # regression
+    t = X[:, 0] * 2.0
+    mm2 = h2o.make_metrics(t + 0.1, h2o.H2OFrame_from_python({"t": t})["t"])
+    assert float(mm2.rmse) == pytest.approx(0.1, abs=1e-9)
+    # h2o.api without a connection raises cleanly
+    from h2o3_tpu.client import H2OConnectionError
+
+    with pytest.raises(H2OConnectionError):
+        h2o.api("GET /3/Cloud")
+
+
+def test_upload_model_remote(tmp_path):
+    """h2o.upload_model pushes a local artifact to a separate server
+    process; the returned server-side model predicts over the wire."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    import numpy as np
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(3)
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0).astype(int)
+    d = {f"c{i}": X[:, i] for i in range(3)}
+    d["y"] = y.astype(str)
+    fr_local = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    m = H2OGradientBoostingEstimator(ntrees=3, max_depth=2, seed=1)
+    m.train(y="y", training_frame=fr_local)
+    path = h2o.save_model(m, str(tmp_path))
+
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen([sys.executable, "-c", """
+import jax; jax.config.update("jax_platforms", "cpu")
+import time
+from h2o3_tpu.rest.server import start_server
+import h2o3_tpu as h2o
+h2o.init()
+s = start_server(port=0, auth_token=None)
+print(s.port, flush=True)
+time.sleep(600)
+"""], env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        port = int(proc.stdout.readline())
+        h2o.connect(url=f"http://127.0.0.1:{port}", verbose=False)
+        rm = h2o.upload_model(path)
+        fr = h2o.H2OFrame_from_python(
+            {f"c{i}": X[:, i] for i in range(3)})
+        pred = rm.predict(fr)
+        got = np.asarray(pred.as_data_frame(use_pandas=False)["1"])
+        want = m.predict(fr_local).vec("1").numeric_np()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # the uploaded artifact is inspectable and downloads back
+        info = h2o.connection().get(
+            f"/3/Models/{rm.model_id}")["models"][0]
+        assert info["uploaded_artifact"] and info["kind"] == "tree"
+        back = h2o.download_model(rm, str(tmp_path / "back"))
+        p2 = h2o.load_model(back).predict(fr_local).vec("1").numeric_np()
+        np.testing.assert_allclose(p2, want, rtol=1e-5, atol=1e-6)
+    finally:
+        proc.kill()
+        h2o.shutdown()

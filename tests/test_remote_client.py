@@ -19,7 +19,7 @@ from h2o3_tpu.runtime.dkv import DKV
 
 _SERVER_SRC = """
 import sys, time
-from h2o3_tpu.api.server import start_server
+from h2o3_tpu.rest.server import start_server
 import h2o3_tpu as h2o
 h2o.init()
 srv = start_server(port=0, auth_token={token!r})

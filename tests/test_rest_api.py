@@ -14,7 +14,7 @@ import numpy as np
 import pytest
 
 import h2o3_tpu as h2o
-from h2o3_tpu.api import start_server
+from h2o3_tpu.rest import start_server
 from h2o3_tpu.runtime.dkv import DKV
 
 
@@ -422,7 +422,7 @@ def test_auth_token():
     stays open for discovery."""
     import urllib.error
 
-    from h2o3_tpu.api import start_server as _start
+    from h2o3_tpu.rest import start_server as _start
 
     srv = _start(port=0, auth_token="sekrit")
     try:

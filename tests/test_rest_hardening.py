@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 import h2o3_tpu as h2o
-from h2o3_tpu.api.server import start_server
+from h2o3_tpu.rest.server import start_server
 from h2o3_tpu.runtime.dkv import DKV
 
 
