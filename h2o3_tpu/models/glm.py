@@ -36,7 +36,8 @@ from .metrics import (
     ModelMetricsMultinomial,
     ModelMetricsRegression,
 )
-from .model_base import DataInfo, H2OEstimator, H2OModel, response_info
+from .model_base import (SCORE_ROW_BUCKET, DataInfo, H2OEstimator, H2OModel,
+                         response_info)
 
 FAMILIES = (
     "AUTO", "gaussian", "binomial", "quasibinomial", "multinomial",
@@ -388,19 +389,32 @@ class GLMModel(H2OModel):
         HIGHEST matmul precision keeps f32 logits exact (the TPU default
         truncates matmul operands to bf16)."""
         if Xd is None:
-            Xd = self.dinfo.device_design(frame, fit=False, add_intercept=True)
+            # row-bucketed scoring design: CV folds / paged frames of
+            # nearby sizes share one expand + one matmul program. The
+            # result may carry up to 511 PAD ROWS — callers slice to
+            # frame.nrow on the HOST after materializing (a device-side
+            # slice would reintroduce one tiny program per exact size,
+            # defeating the bucket)
+            Xd = self.dinfo.device_design(frame, fit=False,
+                                          add_intercept=True,
+                                          row_bucket=SCORE_ROW_BUCKET)
         beta = jnp.asarray(np.asarray(self.beta, np.float32))
         return jnp.matmul(Xd, beta.T, precision=jax.lax.Precision.HIGHEST)
 
     def _eta(self, frame: Frame, Xd=None) -> np.ndarray:
-        return np.asarray(self._eta_dev(frame, Xd=Xd), np.float64)
+        # host-side slice drops any row-bucket pad (see _eta_dev)
+        return np.asarray(self._eta_dev(frame, Xd=Xd),
+                          np.float64)[: frame.nrow]
 
     def _score(self, frame: Frame, Xd=None) -> np.ndarray:
-        # link inverse applied on device: ONE n-sized transfer per scoring
+        # link inverse applied on device: ONE n-sized transfer per scoring;
+        # the host-side slice drops any row-bucket pad (see _eta_dev)
         eta = self._eta_dev(frame, Xd=Xd)
         if self.family == "multinomial":
-            return np.asarray(jax.nn.softmax(eta, axis=1), np.float64)
-        return np.asarray(_linkinv(self.family, eta), np.float64)
+            return np.asarray(jax.nn.softmax(eta, axis=1),
+                              np.float64)[: frame.nrow]
+        return np.asarray(_linkinv(self.family, eta),
+                          np.float64)[: frame.nrow]
 
     def predict(self, test_data: Frame) -> Frame:
         out = self._score(test_data)

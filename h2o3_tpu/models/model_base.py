@@ -92,6 +92,13 @@ class JobCancelled(RuntimeError):
     driver's next safe point, a scoring boundary)."""
 
 
+# scoring-program row bucket: jitted scorer inputs (tree _margins, GLM
+# scoring design) quantize their row dimension to this multiple so nearby
+# frame sizes share one compiled program (each extra program is a tunnel
+# compile round-trip cold). ONE constant — tree and GLM must bucket alike.
+SCORE_ROW_BUCKET = 512
+
+
 @dataclass
 class Job:
     """`water.Job` — progress/cancel tracking for a training run."""
@@ -235,7 +242,8 @@ class DataInfo:
 
     def device_design(self, frame: Frame, fit: bool,
                       add_intercept: bool = False, cloud=None,
-                      quota: Optional[int] = None):
+                      quota: Optional[int] = None,
+                      row_bucket: int = 0):
         """Expanded design matrix built ON DEVICE from compact columns.
 
         Semantically identical to fit_transform/transform (same one-hot
@@ -439,6 +447,21 @@ class DataInfo:
         s_h = (np.asarray(self.stds, np.float32)
                if self.standardize and self.stds is not None
                else np.ones(0, np.float32))
+        if row_bucket and cloud is None:
+            from ..parallel.mesh import pad_to_multiple
+
+            # quantize the expand program's row dimension: nearby scoring
+            # frame sizes (CV folds, pages) reuse ONE compiled program; the
+            # zero-filled pad rows expand to garbage the CALLER slices off
+            npad_b = pad_to_multiple(n, row_bucket)
+            if npad_b != n:
+                packs = [np.concatenate(
+                    [p, np.zeros((npad_b - n,) + p.shape[1:], p.dtype)])
+                    for p in packs]
+                cats_a = np.concatenate(
+                    [cats_a, np.zeros((npad_b - n, cats_a.shape[1]),
+                                      cats_a.dtype)])
+
         from ..runtime import phases as _phases
 
         nbytes = sum(p.nbytes for p in packs) + cats_a.nbytes

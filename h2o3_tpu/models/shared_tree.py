@@ -83,7 +83,8 @@ from .metrics import (
     ModelMetricsMultinomial,
     ModelMetricsRegression,
 )
-from .model_base import DataInfo, H2OEstimator, H2OModel, ScoreKeeper, response_info
+from .model_base import (SCORE_ROW_BUCKET, DataInfo, H2OEstimator,
+                         H2OModel, ScoreKeeper, response_info)
 
 
 _predict_codes_jit = jax.jit(treelib.predict_codes, static_argnames=("max_depth",))
@@ -887,6 +888,17 @@ class SharedTreeModel(H2OModel):
 
     # margin(s) on raw feature matrix
     def _margins(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        # row-bucket the jitted scorer's input: nearby frame sizes (CV
+        # folds of 2667 vs 2666 rows, paged scoring) land on ONE compiled
+        # program instead of recompiling per exact row count — each extra
+        # program costs a tunnel compile round-trip cold. Zero-filled pad
+        # rows walk the trees harmlessly and are sliced off below.
+        npad = cloudlib.pad_to_multiple(n, SCORE_ROW_BUCKET)
+        if npad != n:
+            X = np.concatenate([np.asarray(X, np.float32),
+                                np.zeros((npad - n, X.shape[1]),
+                                         np.float32)])
         Xj = jnp.asarray(X, jnp.float32)
         fused = os.environ.get("H2O3_FOREST_SCORER", "fused") != "walk"
         outs = []
@@ -899,7 +911,7 @@ class SharedTreeModel(H2OModel):
                 s = treelib.predict_forest_raw(self._padded_forest(k), Xj,
                                                self.max_depth)
             f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[k]
-            outs.append(np.asarray(s, np.float64) + f0k)
+            outs.append(np.asarray(s, np.float64)[:n] + f0k)
         return np.column_stack(outs)
 
     def _score_probs(self, X: np.ndarray, offset: Optional[np.ndarray] = None) -> np.ndarray:
