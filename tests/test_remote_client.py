@@ -357,3 +357,24 @@ def test_remote_batch_munging_round_trips(remote_server, csvfile):
         assert list(data) == ["a"] and len(data["a"]) == 400
     finally:
         h2o.shutdown()
+
+
+def test_remote_batch_flushes_on_exception(remote_server, csvfile):
+    """An exception inside `with h2o.batch():` still lands the assigns
+    already chained, so returned RemoteFrame handles stay valid."""
+    h2o.connect(url=remote_server, verbose=False)
+    try:
+        fr = h2o.upload_file(csvfile, destination_frame="batch_exc")
+        g = None
+        with pytest.raises(RuntimeError, match="boom"):
+            with h2o.batch():
+                g = fr["a"].asfactor()
+                raise RuntimeError("boom")
+        assert g.nrow == 400          # the deferred assign reached the server
+        assert g.types[g.names[0]] == "enum"
+        # and value-returning rapids stayed EAGER inside batch
+        with h2o.batch():
+            out = h2o.rapids("(+ 1 2)")
+        assert out.get("scalar") == 3.0
+    finally:
+        h2o.shutdown()
