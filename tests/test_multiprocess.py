@@ -732,3 +732,50 @@ def test_dl_compressed_sharded_ingest_two_process(tmp_path, cloud1):
                     f"{rng.normal():.6f},k{rng.integers(0, 3)},"
                     f"{rng.integers(0, 2)}\n")
     run_workers(2, DL_COMPRESSED_BODY.format(csv=p))
+
+
+GBLINEAR_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+m = H2OXGBoostEstimator(booster="gblinear", ntrees=200, learn_rate=0.5,
+                        reg_lambda=0.0, reg_alpha=0.0, seed=1)
+m.train(x=[f"x{{i}}" for i in range(4)], y="t", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    c = m.model.coef()
+    np.savez({out!r}, **{{k: float(v) for k, v in c.items()}})
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_gblinear_two_process_matches_single(tmp_path, cloud1):
+    """gblinear's global-row ingest: a 2-process cloud converges to the
+    same coefficients as single-process (the jitted scan's Xᵀg/(X∘X)ᵀh
+    reductions become cross-host collectives via the sharded arrays)."""
+    rng = np.random.default_rng(5)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    t = X @ np.asarray([1.5, -0.5, 0.25, 0.0]) + 0.7
+    p = str(tmp_path / "gbl.csv")
+    with open(p, "w") as f:
+        f.write("x0,x1,x2,x3,t\n")
+        for i in range(n):
+            f.write(",".join(f"{v:.6f}" for v in X[i]) + f",{t[i]:.6f}\n")
+
+    import h2o3_tpu as h2o
+    from h2o3_tpu.models.xgboost import H2OXGBoostEstimator
+
+    fr = h2o.import_file(p)
+    ref = H2OXGBoostEstimator(booster="gblinear", ntrees=200, learn_rate=0.5,
+                              reg_lambda=0.0, reg_alpha=0.0, seed=1)
+    ref.train(x=[f"x{i}" for i in range(4)], y="t", training_frame=fr)
+    want = ref.model.coef()
+
+    out = str(tmp_path / "gbl2.npz")
+    run_workers(2, GBLINEAR_BODY.format(csv=p, out=out))
+    got = np.load(out)
+    for k in want:
+        assert abs(float(got[k]) - want[k]) < 5e-3, (k, float(got[k]), want[k])
