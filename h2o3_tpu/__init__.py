@@ -606,6 +606,11 @@ def _interaction_local(data: Frame, factors, pairwise, max_factors,
     return fr
 
 
+from .explanation import (explain, explain_row,  # noqa: E402,F401
+                          model_correlation_heatmap, pd_multi_plot,
+                          residual_analysis, varimp_heatmap)
+
+
 def batch():
     """`with h2o.batch():` — defer remote munging ops and ship them as one
     multi-statement Rapids program (see H2OConnection.batch). Requires an

@@ -1,0 +1,196 @@
+"""Model-explanation bundle — `h2o-py/h2o/explanation/_explain.py`.
+
+Upstream's `h2o.explain(...)` renders matplotlib figures; this framework is
+headless, so every function here returns the DATA the upstream plots draw —
+Frames/tables you can feed to any plotting stack (the documented deviation:
+explanations are data-first). The building blocks (partial_plot, TreeSHAP
+contributions, permutation/variable importance) are the per-model methods;
+this module is the multi-model orchestration layer on top.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .frame.frame import Frame
+
+
+def _as_models(models) -> List:
+    """Normalize: estimator(s), model(s), or an H2OAutoML → list of models."""
+    from .automl.automl import H2OAutoML
+
+    if isinstance(models, H2OAutoML):
+        if not models.leaderboard or not models.leaderboard.rows:
+            raise ValueError("explain: AutoML has no leaderboard models")
+        ests = [r["_est"] for r in models.leaderboard.rows if "_est" in r]
+        models = ests
+    if not isinstance(models, (list, tuple)):
+        models = [models]
+    out = []
+    for m in models:
+        out.append(getattr(m, "model", None) or m)
+    if not out:
+        raise ValueError("explain: no models given")
+    return out
+
+
+def _n_classes(model) -> int:
+    dom = getattr(model, "domain", None)
+    return len(dom) if dom else 0
+
+
+def _pred_vector(model, frame: Frame) -> np.ndarray:
+    """One comparable score per row: p1 for binomial, the raw prediction
+    for regression (via the model's own _response_column, which knows the
+    column layout incl. calibrated outputs), predicted-class codes for
+    multinomial."""
+    pred = model.predict(frame)
+    if _n_classes(model) > 2:
+        v = pred.vec("predict")
+        return np.asarray(v.data, np.float64)
+    return model._response_column(pred, None)
+
+
+def varimp_heatmap(models) -> Frame:
+    """Feature × model matrix of SCALED variable importances (upstream
+    varimp_heatmap's underlying table): rows union all features, missing
+    entries are 0."""
+    ms = _as_models(models)
+    tables: Dict[str, Dict[str, float]] = {}
+    feats: List[str] = []
+    for m in ms:
+        vt = m.varimp() or []
+        col = {}
+        for row in vt:
+            name, scaled = row[0], float(row[2])
+            col[name] = scaled
+            if name not in feats:
+                feats.append(name)
+        tables[m.model_id] = col
+    d: Dict[str, np.ndarray] = {
+        "feature": np.asarray(feats, dtype=object)}
+    for mid, col in tables.items():
+        d[mid] = np.asarray([col.get(f, 0.0) for f in feats], np.float64)
+    return Frame.from_dict(d, column_types={"feature": "enum"})
+
+
+def model_correlation_heatmap(models, frame: Frame) -> Frame:
+    """Model × model Pearson correlation of predictions on `frame`."""
+    ms = _as_models(models)
+    if len(ms) < 2:
+        raise ValueError("model_correlation_heatmap needs >= 2 models")
+    preds = np.stack([_pred_vector(m, frame) for m in ms])
+    corr = np.corrcoef(preds)
+    ids = [m.model_id for m in ms]
+    d: Dict[str, np.ndarray] = {"model": np.asarray(ids, dtype=object)}
+    for j, mid in enumerate(ids):
+        d[mid] = corr[:, j]
+    return Frame.from_dict(d, column_types={"model": "enum"})
+
+
+def pd_multi_plot(models, frame: Frame, column: str,
+                  nbins: int = 20, target=None) -> Frame:
+    """Partial-dependence of `column` for every model on one shared grid:
+    columns [<column>, <model_id>...] (upstream pd_multi_plot's data)."""
+    ms = _as_models(models)
+    d: Dict[str, np.ndarray] = {}
+    for m in ms:
+        tbl = m.partial_plot(frame, cols=[column], nbins=nbins,
+                             targets=[target] if target else None)[0]
+        if column not in d:
+            v = tbl.vec(column)
+            if v.type == "enum":
+                dom = np.asarray((v.domain or []) + [None], dtype=object)
+                d[column] = dom[np.asarray(v.data, np.int64)]
+            else:
+                d[column] = v.numeric_np()
+        d[m.model_id] = tbl.vec("mean_response").numeric_np()
+    types = ({column: "enum"}
+             if frame.vec(column).type == "enum" else None)
+    return Frame.from_dict(d, column_types=types)
+
+
+def residual_analysis(model, frame: Frame) -> Frame:
+    """Fitted vs residual columns for a REGRESSION model (upstream
+    residual_analysis_plot's data)."""
+    m = getattr(model, "model", None) or model
+    # either signal marks classification: tree models carry `problem`,
+    # GLMs carry `family` — a conjunction would let both slip through
+    if (getattr(m, "problem", None) not in (None, "regression")
+            or getattr(m, "family", None) not in (None, "gaussian",
+                                                  "poisson", "gamma",
+                                                  "tweedie")
+            or _n_classes(m) >= 2):
+        raise ValueError("residual_analysis is for regression models")
+    fitted = _pred_vector(m, frame)
+    actual = frame.vec(m.y).numeric_np().astype(np.float64)
+    return Frame.from_dict({"fitted": fitted,
+                            "residual": actual - fitted})
+
+
+def explain(models, frame: Frame, columns: Optional[Sequence[str]] = None,
+            top_n_features: int = 5) -> Dict:
+    """The explanation bundle (`h2o.explain`): a dict of data tables —
+    'leaderboard' (AutoML input), 'varimp' per model, 'varimp_heatmap' +
+    'model_correlation_heatmap' (≥2 models), and 'pdp' for the top
+    important (or given) columns. Values are Frames/tables, not plots."""
+    from .automl.automl import H2OAutoML
+
+    out: Dict = {}
+    if isinstance(models, H2OAutoML):
+        out["leaderboard"] = models.leaderboard.as_frame()
+    ms = _as_models(models)
+    out["varimp"] = {m.model_id: (m.varimp() or []) for m in ms}
+    if len(ms) >= 2:
+        out["varimp_heatmap"] = varimp_heatmap(ms)
+        out["model_correlation_heatmap"] = model_correlation_heatmap(
+            ms, frame)
+    if columns is None:
+        # top features by scaled importance, restricted to columns present
+        # in the frame — from the first model whose varimp yields any
+        # (a leaderboard-topping StackedEnsemble has none; fall through)
+        columns = []
+        for m in ms:
+            vt = m.varimp() or []
+            cols = [r[0] for r in vt if r[0] in frame.names]
+            if cols:
+                columns = cols[:top_n_features]
+                break
+    # multinomial partial dependence needs an explicit class target
+    # (averaging predicted labels is meaningless — same contract as
+    # partial_plot); pick the last class like upstream's default plots
+    target = (str(ms[0].domain[-1]) if _n_classes(ms[0]) > 2 else None)
+    out["pdp"] = {c: pd_multi_plot(ms, frame, c, target=target)
+                  for c in columns}
+    if target is not None:
+        out["pdp_target"] = target
+    return out
+
+
+def explain_row(models, frame: Frame, row_index: int) -> Dict:
+    """Row-local explanation (`h2o.explain_row`): per-model prediction for
+    the row plus SHAP contributions where the model supports them."""
+    ms = _as_models(models)
+    if not 0 <= row_index < frame.nrow:
+        raise ValueError(f"row_index {row_index} out of range")
+    one = frame.take(np.asarray([row_index]))
+    out: Dict = {"row_index": row_index, "predictions": {},
+                 "contributions": {}}
+    for m in ms:
+        pred = m.predict(one)
+        out["predictions"][m.model_id] = {
+            n: (pred.vec(n).numeric_np()[0]
+                if pred.vec(n).type != "enum"
+                else (pred.vec(n).domain or [None])[
+                    int(np.asarray(pred.vec(n).data)[0])])
+            for n in pred.names}
+        try:
+            contrib = m.predict_contributions(one)
+            out["contributions"][m.model_id] = {
+                n: float(contrib.vec(n).numeric_np()[0])
+                for n in contrib.names}
+        except (AttributeError, ValueError, TypeError):
+            pass  # non-tree models: no TreeSHAP surface
+    return out

@@ -1,0 +1,87 @@
+"""Explanation bundle (`h2o-py/h2o/explanation/_explain.py`) — data-first:
+every function returns the tables upstream's plots draw."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+from h2o3_tpu.estimators import (H2OGradientBoostingEstimator,
+                                 H2OGeneralizedLinearEstimator)
+
+
+@pytest.fixture()
+def models_and_frame(cloud1):
+    rng = np.random.default_rng(0)
+    n = 1500
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    d = {f"c{i}": X[:, i] for i in range(4)}
+    d["y"] = y.astype(str)
+    fr = h2o.H2OFrame_from_python(d, column_types={"y": "enum"})
+    gbm = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=fr)
+    glm = H2OGeneralizedLinearEstimator(family="binomial")
+    glm.train(y="y", training_frame=fr)
+    return [gbm, glm], fr
+
+
+def test_varimp_heatmap(models_and_frame):
+    ms, fr = models_and_frame
+    hm = h2o.varimp_heatmap(ms)
+    assert hm.names[0] == "feature" and hm.ncol == 3
+    feats = [hm.vec("feature").domain[c]
+             for c in np.asarray(hm.vec("feature").data)]
+    assert "c0" in feats
+    # the signal feature dominates for both models
+    for mid in hm.names[1:]:
+        col = hm.vec(mid).numeric_np()
+        assert col[feats.index("c0")] == max(col)
+
+
+def test_model_correlation_heatmap(models_and_frame):
+    ms, fr = models_and_frame
+    cm = h2o.model_correlation_heatmap(ms, fr)
+    assert cm.ncol == 3
+    ids = [cm.vec("model").domain[c]
+           for c in np.asarray(cm.vec("model").data)]
+    # diagonal 1, off-diagonal high (same signal learned)
+    for j, mid in enumerate(ids):
+        col = cm.vec(mid).numeric_np()
+        assert col[j] == pytest.approx(1.0, abs=1e-9)
+        assert all(v > 0.8 for v in col)
+
+
+def test_pd_multi_plot_and_explain(models_and_frame):
+    ms, fr = models_and_frame
+    pd = h2o.pd_multi_plot(ms, fr, "c0")
+    assert pd.names[0] == "c0" and pd.ncol == 3
+    # monotone-ish response in the signal feature for both models
+    for mid in pd.names[1:]:
+        resp = pd.vec(mid).numeric_np()
+        assert resp[-1] > resp[0]
+
+    bundle = h2o.explain(ms, fr)
+    assert set(bundle["varimp"]) == {m.model_id for m in ms}
+    assert "varimp_heatmap" in bundle and "model_correlation_heatmap" in bundle
+    assert "c0" in bundle["pdp"] and bundle["pdp"]["c0"].ncol == 3
+
+
+def test_explain_row_and_residuals(models_and_frame, cloud1):
+    ms, fr = models_and_frame
+    row = h2o.explain_row(ms, fr, 3)
+    assert set(row["predictions"]) == {m.model_id for m in ms}
+    # tree model contributes SHAP, GLM doesn't
+    gbm_id = ms[0].model_id
+    assert gbm_id in row["contributions"]
+    assert "BiasTerm" in row["contributions"][gbm_id]
+
+    # regression residuals
+    rng = np.random.default_rng(1)
+    t = rng.normal(size=500)
+    fr2 = h2o.H2OFrame_from_python(
+        {"a": t, "y": 2 * t + 0.1 * rng.normal(size=500)})
+    reg = H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=1)
+    reg.train(y="y", training_frame=fr2)
+    ra = h2o.residual_analysis(reg, fr2)
+    assert set(ra.names) == {"fitted", "residual"}
+    assert abs(ra.vec("residual").numeric_np().mean()) < 0.2
