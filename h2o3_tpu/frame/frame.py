@@ -561,6 +561,110 @@ class Frame:
             X = X[~np.isnan(X).any(axis=1)]
         return np.corrcoef(X, rowvar=False)
 
+    def _prim(self, op: str, *args):
+        """Delegate an h2o-py Frame convenience to the Rapids interpreter —
+        ONE implementation per op, shared with the `/99/Rapids` surface."""
+        from .rapids_expr import RapidsSession
+
+        return RapidsSession()._apply_prim(op, [self, *args])
+
+    def cumsum(self) -> "Frame":
+        return self._prim("cumsum")
+
+    def cumprod(self) -> "Frame":
+        return self._prim("cumprod")
+
+    def cummin(self) -> "Frame":
+        return self._prim("cummin")
+
+    def cummax(self) -> "Frame":
+        return self._prim("cummax")
+
+    def var(self, na_rm: bool = True):
+        """Sample variance of the single numeric column, or the covariance
+        matrix of the numeric columns (H2OFrame.var)."""
+        num = [v.numeric_np() for v in self._vecs.values()
+               if v.type in ("real", "int")]
+        if not num:
+            raise ValueError("var: frame has no numeric columns")
+        if len(num) == 1:
+            c = num[0]
+            if na_rm:
+                c = c[~np.isnan(c)]
+            return float(np.var(c, ddof=1)) if len(c) > 1 else float("nan")
+        X = np.column_stack(num)
+        if na_rm:
+            X = X[~np.isnan(X).any(axis=1)]
+        return np.cov(X, rowvar=False)
+
+    def kfold_column(self, n_folds: int = 3, seed: int = -1) -> "Frame":
+        """Random fold-index column (H2OFrame.kfold_column)."""
+        return self._prim("kfold_column", n_folds, seed)
+
+    def modulo_kfold_column(self, n_folds: int = 3) -> "Frame":
+        return self._prim("modulo_kfold_column", n_folds)
+
+    def stratified_kfold_column(self, n_folds: int = 3,
+                                seed: int = -1) -> "Frame":
+        """Fold column preserving per-class ratios
+        (H2OFrame.stratified_kfold_column; the response is this frame's
+        single categorical column)."""
+        return self._prim("stratified_kfold_column", n_folds, seed)
+
+    def relevel(self, y: str) -> "Frame":
+        """Make `y` the reference (first) level of this 1-column
+        categorical frame (H2OFrame.relevel)."""
+        return self._prim("relevel", y)
+
+    def difflag1(self) -> "Frame":
+        """First-order difference with a leading NA (H2OFrame.difflag1)."""
+        return self._prim("difflag1")
+
+    def distance(self, y: "Frame", measure: str = "l2") -> "Frame":
+        """Pairwise row distances self × y (H2OFrame.distance:
+        l1/l2/cosine/cosine_sq)."""
+        return self._prim("distance", y, measure)
+
+    def rank_within_group_by(self, group_by_cols, sort_cols,
+                             ascending=None, new_col_name="New_Rank_column",
+                             sort_cols_sorted: bool = False) -> "Frame":
+        """Row rank within groups following a sort order
+        (H2OFrame.rank_within_group_by / AstRankWithinGroupBy)."""
+        def _idx(cols):
+            return [self.names.index(c) if isinstance(c, str) else int(c)
+                    for c in (cols if isinstance(cols, (list, tuple))
+                              else [cols])]
+
+        asc = ([bool(b) for b in ascending]
+               if ascending is not None else [])
+        return self._prim("rank_within_groupby", _idx(group_by_cols),
+                          _idx(sort_cols), asc, new_col_name,
+                          sort_cols_sorted)
+
+    def melt(self, id_vars, value_vars=None, var_name: str = "variable",
+             value_name: str = "value", skipna: bool = False) -> "Frame":
+        """Wide → long (H2OFrame.melt / AstMelt)."""
+        from . import rapids as rapids_ops
+
+        return rapids_ops.melt(self, list(id_vars),
+                               list(value_vars) if value_vars else None,
+                               var_name, value_name, skipna)
+
+    def pivot(self, index: str, column: str, value: str) -> "Frame":
+        """Long → wide (H2OFrame.pivot / AstPivot)."""
+        from . import rapids as rapids_ops
+
+        return rapids_ops.pivot(self, index, column, value)
+
+    def drop_duplicates(self, columns=None, keep: str = "first") -> "Frame":
+        """Rows deduplicated by the given columns (all by default),
+        keeping the first or last occurrence (H2OFrame.drop_duplicates /
+        AstDropDuplicates)."""
+        cols = ([self.names.index(c) if isinstance(c, str) else int(c)
+                 for c in columns] if columns
+                else list(range(self.ncol)))
+        return self._prim("drop_duplicates", cols, keep)
+
     def cut(self, breaks, labels=None, include_lowest: bool = False,
             right: bool = True) -> "Frame":
         """Numeric → categorical binning (H2OFrame.cut / AstCut)."""

@@ -1230,6 +1230,51 @@ class RapidsSession:
             c = a[0]._col0()
             return Frame.from_dict(
                 {"difflag1": np.r_[np.nan, np.diff(c)]})
+        if op == "drop_duplicates":
+            # (drop_duplicates fr [col idx...] keep) — AstDropDuplicates:
+            # rows deduplicated by the key columns, first/last kept
+            fr = a[0]
+            cols = ([int(i) for i in a[1]]
+                    if len(a) > 1 and isinstance(a[1], list) and a[1]
+                    else list(range(fr.ncol)))
+            keep = str(a[2]) if len(a) > 2 else "first"
+            if keep not in ("first", "last"):
+                raise ValueError(
+                    f"drop_duplicates: keep must be 'first' or 'last', "
+                    f"got {keep!r}")
+            vecs = fr.vecs()
+            key_cols = []
+            for i in cols:
+                v = vecs[i]
+                if v.type == "string":
+                    key_cols.append(np.asarray(v.to_numpy(), dtype=object))
+                elif v.type == "enum":
+                    key_cols.append(np.asarray(v.data, np.int64))
+                else:
+                    c = v.numeric_np()
+                    # NaN must equal NaN for dedup; +0.0 folds -0.0 onto 0.0
+                    key_cols.append(np.where(np.isnan(c), np.inf, c) + 0.0)
+            if any(k.dtype == object for k in key_cols):
+                # string keys: tuple-hash pass (no vectorized row-unique
+                # over mixed object dtypes)
+                rows = list(zip(*key_cols))
+                it = (range(fr.nrow - 1, -1, -1) if keep == "last"
+                      else range(fr.nrow))
+                seen = set()
+                kept = []
+                for i in it:
+                    t = rows[i]
+                    if t not in seen:
+                        seen.add(t)
+                        kept.append(i)
+                take = np.asarray(sorted(kept), np.int64)
+            else:
+                keys = np.stack(key_cols, axis=1)
+                arr = keys if keep == "first" else keys[::-1]
+                _, idx = np.unique(arr, axis=0, return_index=True)
+                take = idx if keep == "first" else fr.nrow - 1 - idx
+                take = np.sort(take)
+            return fr.take(take)
         if op == "h2o.fillna":
             fr = a[0]
             method = str(a[1]).lower() if len(a) > 1 else "forward"

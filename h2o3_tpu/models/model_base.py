@@ -884,8 +884,21 @@ class H2OEstimator:
         if nfolds < 0 or nfolds == 1:
             raise ValueError(
                 f"nfolds must be 0 (no CV) or >= 2, got {nfolds}")
+        fold_col = self._parms.get("fold_column")
+        if fold_col and nfolds:
+            raise ValueError(
+                "specify EITHER nfolds OR fold_column, not both "
+                "(hex/ModelBuilder cv_init)")
+        if fold_col and fold_col not in training_frame.names:
+            raise ValueError(f"fold_column {fold_col!r} not in frame")
         model = self._fit(x, y, training_frame, validation_frame)
-        if nfolds >= 2 and self._is_supervised():
+        # a fold_column triggers CV by itself (its folds are the column's
+        # distinct values) — but only for estimators that CAN cross-
+        # validate: TargetEncoder-style builders consume fold_column for
+        # their own leakage handling inside _fit and define no _cv_predict
+        supports_cv = type(self)._cv_predict is not H2OEstimator._cv_predict
+        if ((nfolds >= 2 or (fold_col and supports_cv))
+                and self._is_supervised()):
             self._run_cv(model, x, y, training_frame, nfolds)
         model.run_time = time.time() - t0
         self._model = model

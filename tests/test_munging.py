@@ -320,3 +320,80 @@ def test_package_utilities_round4(cloud1, tmp_path):
     h2o.remove_all()
     with pytest.raises(KeyError):
         h2o.get_frame(fr.key)
+
+
+def test_frame_method_conveniences(cloud1):
+    """h2o-py Frame conveniences delegating to the Rapids prims: cum*,
+    kfold columns, relevel, difflag1, distance, rank_within_group_by,
+    melt/pivot, drop_duplicates, var."""
+    import numpy as np
+
+    from h2o3_tpu.frame.frame import Frame
+
+    fr = Frame.from_dict({"a": np.asarray([1.0, 2.0, 3.0, 4.0])})
+    np.testing.assert_allclose(fr.cumsum().vec("a").numeric_np(),
+                               [1, 3, 6, 10])
+    np.testing.assert_allclose(fr.cumprod().vec("a").numeric_np(),
+                               [1, 2, 6, 24])
+    np.testing.assert_allclose(fr.difflag1().vec("difflag1").numeric_np()[1:],
+                               [1, 1, 1])
+    assert fr.var() == pytest.approx(np.var([1, 2, 3, 4], ddof=1))
+
+    two = Frame.from_dict({"a": np.arange(10.0), "b": np.arange(10.0) * 2})
+    cov = two.var()
+    assert cov.shape == (2, 2) and cov[0, 1] == pytest.approx(2 * cov[0, 0])
+
+    folds = two.kfold_column(n_folds=4, seed=1).vec("fold").numeric_np()
+    assert set(folds) <= {0.0, 1.0, 2.0, 3.0}
+    mod = two.modulo_kfold_column(n_folds=3).vec("fold").numeric_np()
+    np.testing.assert_array_equal(mod, np.arange(10) % 3)
+
+    yfr = Frame.from_dict(
+        {"y": np.asarray(["a", "b"] * 8, dtype=object)},
+        column_types={"y": "enum"})
+    sf = yfr.stratified_kfold_column(n_folds=2, seed=1).vec("fold").numeric_np()
+    # stratified: each class split evenly across folds
+    ya = sf[::2]
+    assert abs((ya == 0).sum() - (ya == 1).sum()) <= 1
+
+    rl = yfr.relevel("b")
+    assert rl.vec("y").domain[0] == "b"
+
+    q = Frame.from_dict({"x": np.asarray([[0.0], [3.0]]).ravel()})
+    r = Frame.from_dict({"x": np.asarray([0.0, 4.0])})
+    dm = r.distance(q, "l2").to_numpy()
+    assert dm.shape == (2, 2)
+    assert dm[1, 0] == pytest.approx(4.0)
+
+    g = Frame.from_dict({"g": np.asarray([1.0, 1, 2, 2]),
+                         "v": np.asarray([5.0, 3, 9, 7])})
+    rk = g.rank_within_group_by("g", "v", new_col_name="rk")
+    rkv = rk.vec("rk").numeric_np()
+    assert sorted(rkv[:2]) == [1, 2] and sorted(rkv[2:]) == [1, 2]
+
+    wide = Frame.from_dict({"id": np.asarray([1.0, 2.0]),
+                            "x": np.asarray([10.0, 20.0]),
+                            "y": np.asarray([30.0, 40.0])})
+    long = wide.melt(["id"])
+    assert long.nrow == 4 and set(long.names) >= {"id", "variable", "value"}
+
+    dup = Frame.from_dict({"k": np.asarray([1.0, 1, 2, 2, 3]),
+                           "v": np.asarray([9.0, 8, 7, 6, 5])})
+    dd = dup.drop_duplicates(columns=["k"], keep="first")
+    assert dd.nrow == 3
+    np.testing.assert_allclose(dd.vec("v").numeric_np(), [9, 7, 5])
+    dl = dup.drop_duplicates(columns=["k"], keep="last")
+    np.testing.assert_allclose(dl.vec("v").numeric_np(), [8, 6, 5])
+
+    # string-keyed dedup (object columns take the tuple-hash path)
+    from h2o3_tpu.frame.vec import Vec
+    sfr = Frame({"s": Vec(None, "string", strings=np.asarray(
+        ["x", "x", "y", "z", "y"], dtype=object)),
+        "v": Vec(np.asarray([1.0, 2, 3, 4, 5]), "real")})
+    sd = sfr.drop_duplicates(columns=["s"])
+    assert sd.nrow == 3
+    np.testing.assert_allclose(sd.vec("v").numeric_np(), [1, 3, 4])
+    # no-numeric var raises; single-numeric respects na_rm
+    import pytest as _pt
+    nfr = Frame.from_dict({"x": np.asarray([1.0, np.nan, 3.0])})
+    assert nfr.var() == _pt.approx(2.0)
