@@ -94,18 +94,19 @@ def shutdown(prompt=False):
 
 
 def import_file(path: str, destination_frame=None, header=0, sep=None,
-                col_names=None, col_types=None, **kw):
+                col_names=None, col_types=None, pattern=None, **kw):
     conn = client.current_connection()
     if conn is not None:
         return conn.import_file(path, destination_frame=destination_frame,
                                 sep=sep, col_names=col_names,
-                                col_types=col_types)
+                                col_types=col_types, pattern=pattern)
     fr = _import_file(
         path,
         sep=sep,
         header=None if header == 0 else bool(header > 0),
         col_names=col_names,
         col_types=col_types,
+        pattern=pattern,
     )
     if destination_frame:
         fr.key = destination_frame

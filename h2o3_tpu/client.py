@@ -170,16 +170,22 @@ class H2OConnection:
 
     def import_file(self, path: str, destination_frame: Optional[str] = None,
                     sep: Optional[str] = None, col_names=None,
-                    col_types=None) -> "RemoteFrame":
+                    col_types=None,
+                    pattern: Optional[str] = None) -> "RemoteFrame":
         """Server-side import: the path is resolved ON the server
         (`/3/ImportFiles`, or `/3/Parse` when parse options are given —
-        ImportFilesHandler / ParseHandler)."""
+        ImportFilesHandler / ParseHandler). `pattern` filters a directory
+        import server-side."""
         opts = self._parse_params(sep, col_names, col_types)
         if opts or destination_frame:
+            if pattern:
+                raise ValueError(
+                    "pattern= cannot be combined with parse options over a "
+                    "connection (the /3/Parse route takes explicit files)")
             out = self.post("/3/Parse", source_frames=json.dumps([path]),
                             destination_frame=destination_frame, **opts)
             return RemoteFrame(self, out["destination_frame"]["name"])
-        out = self.post("/3/ImportFiles", path=path)
+        out = self.post("/3/ImportFiles", path=path, pattern=pattern)
         return RemoteFrame(self, out["destination_frames"][0])
 
     def upload_file(self, path: str, destination_frame: Optional[str] = None,

@@ -226,24 +226,39 @@ class Frame:
         return Frame(out)
 
     def rbind(self, other: "Frame") -> "Frame":
-        if self.names != other.names:
-            raise ValueError("rbind: column names differ")
+        return Frame.rbind_all([self, other])
+
+    @staticmethod
+    def rbind_all(frames: Sequence["Frame"]) -> "Frame":
+        """Stack k frames rowwise with ONE concatenate per column —
+        incremental pairwise rbind over a k-file import would copy
+        O(k²) rows."""
+        if not frames:
+            raise ValueError("rbind_all: no frames")
+        first = frames[0]
+        for fr in frames[1:]:
+            if fr.names != first.names:
+                raise ValueError("rbind: column names differ")
         out = {}
-        for n in self.names:
-            a, b = self._vecs[n], other._vecs[n]
-            if a.type == "enum" or b.type == "enum":
-                da = a.domain or []
-                db = b.domain or []
-                dom = list(dict.fromkeys(da + db))
-                remap_b = np.asarray([dom.index(x) for x in db], dtype=np.int32) if db else np.zeros(0, np.int32)
-                ca = np.asarray(a.data)
-                cb = np.asarray(b.data)
-                cb = np.where(cb >= 0, remap_b[np.maximum(cb, 0)], -1)
-                out[n] = Vec(np.concatenate([ca, cb]), "enum", domain=dom)
+        for n in first.names:
+            vs = [fr._vecs[n] for fr in frames]
+            if any(v.type == "enum" for v in vs):
+                dom = list(dict.fromkeys(
+                    x for v in vs for x in (v.domain or [])))
+                parts = []
+                for v in vs:
+                    dv = v.domain or []
+                    remap = (np.asarray([dom.index(x) for x in dv],
+                                        np.int32)
+                             if dv else np.zeros(0, np.int32))
+                    c = np.asarray(v.data)
+                    parts.append(np.where(c >= 0,
+                                          remap[np.maximum(c, 0)], -1))
+                out[n] = Vec(np.concatenate(parts), "enum", domain=dom)
             else:
                 out[n] = Vec(
-                    np.concatenate([a.to_numpy(), b.to_numpy()]), a.type, domain=a.domain
-                )
+                    np.concatenate([v.to_numpy() for v in vs]),
+                    vs[0].type, domain=vs[0].domain)
         return Frame(out)
 
     # -- split (h2o.split_frame / water.rapids AstSplitFrame) ----------------

@@ -376,6 +376,27 @@ def import_file(path: str, **kw) -> Frame:
             return fr
         finally:
             os.unlink(tmp.name)
+    if os.path.isdir(path):
+        # directory import: parse every (non-hidden, optionally
+        # pattern-filtered) file and rbind — ParseDataset's multi-file
+        # import (`h2o.import_file(path=dir, pattern=...)`)
+        import re as _re
+
+        pattern = kw.pop("pattern", None)
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if not f.startswith(".")
+            and os.path.isfile(os.path.join(path, f)))
+        if pattern:
+            files = [f for f in files
+                     if _re.search(pattern, os.path.basename(f))]
+        if not files:
+            raise ValueError(f"no files to import under {path!r}"
+                             + (f" matching {pattern!r}" if pattern else ""))
+        out = Frame.rbind_all([import_file(f, **kw) for f in files])
+        out.key = os.path.basename(os.path.normpath(path))
+        return out
+    kw.pop("pattern", None)   # pattern only filters directory imports
     if path.endswith((".svm", ".svmlight")):
         return parse_svmlight(path)
     if path.endswith(".arff"):

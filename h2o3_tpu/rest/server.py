@@ -333,7 +333,7 @@ class _Handler(BaseHTTPRequestHandler):
         from ..frame.parse import import_file as _parse_import
 
         p = self._params()
-        fr = _parse_import(p["path"])
+        fr = _parse_import(p["path"], pattern=p.get("pattern") or None)
         DKV.put(fr.key, fr)
         self._send(dict(destination_frames=[fr.key], fails=[], dels=[]))
 
@@ -772,8 +772,25 @@ class _Handler(BaseHTTPRequestHandler):
             raise KeyError(model_key)
         if not isinstance(fr, Frame):
             raise KeyError(frame_key)
-        pred = m.predict(fr)
-        pred.key = f"prediction_{model_key}_{frame_key}"
+        p = self._params()
+        # upstream ModelMetricsHandler.predict options: SHAP contributions
+        # and leaf indices ride the same route as plain predictions
+        if self._flag(p, "predict_contributions"):
+            if not hasattr(m, "predict_contributions"):
+                raise ValueError(
+                    f"{model_key!r} does not support contributions")
+            pred = m.predict_contributions(fr)
+            suffix = "_contributions"
+        elif self._flag(p, "leaf_node_assignment"):
+            if not hasattr(m, "predict_leaf_node_assignment"):
+                raise ValueError(
+                    f"{model_key!r} does not support leaf assignment")
+            pred = m.predict_leaf_node_assignment(fr)
+            suffix = "_leaves"
+        else:
+            pred = m.predict(fr)
+            suffix = ""
+        pred.key = f"prediction{suffix}_{model_key}_{frame_key}"
         DKV.put(pred.key, pred)
         self._send(dict(predictions_frame=dict(name=pred.key)))
 

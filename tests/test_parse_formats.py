@@ -258,3 +258,29 @@ def test_parquet_timestamps_strings_and_errors(tmp_path):
     pq.write_table(t2, p2)
     with pytest.raises(ValueError, match="'b'"):
         h2o.import_file(str(p2))
+
+
+def test_import_directory_with_pattern(tmp_path, cloud1):
+    """Directory import rbinds every matching file (ParseDataset
+    multi-file import; `h2o.import_file(dir, pattern=...)`)."""
+    import numpy as np
+
+    import h2o3_tpu as h2o
+
+    d = tmp_path / "parts"
+    d.mkdir()
+    for i in range(3):
+        with open(d / f"part{i}.csv", "w") as f:
+            f.write("a,b\n")
+            for r in range(10):
+                f.write(f"{i * 10 + r},{r}\n")
+    (d / "notes.txt").write_text("not,data\nx,y\n")
+    fr = h2o.import_file(str(d), pattern=r"part\d\.csv$")
+    assert fr.nrow == 30 and fr.names == ["a", "b"]
+    a = np.sort(fr.vec("a").numeric_np())
+    np.testing.assert_allclose(a[:3], [0, 1, 2])
+    np.testing.assert_allclose(a[-1], 29)
+    import pytest
+
+    with pytest.raises(ValueError, match="no files"):
+        h2o.import_file(str(d), pattern=r"nomatch")

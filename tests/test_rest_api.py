@@ -616,3 +616,33 @@ def test_job_cancel_route(server):
         time.sleep(0.5)
     assert j["status"] == "CANCELLED", j
     assert j["dest"]["name"] == jid       # no model key: result never set
+
+
+def test_predictions_route_options(server):
+    """POST /3/Predictions with predict_contributions / leaf_node_assignment
+    flags (ModelMetricsHandler.predict options)."""
+    srv, csv = server
+    r = _post(srv, "/3/ImportFiles", path=csv)
+    key = r["destination_frames"][0]
+    _post(srv, "/99/Rapids",
+          ast=f"(assign ptr (cbind (cols {key} [0 1 2])"
+              f" (as.factor (cols {key} [3]))))")
+    tr = _post(srv, "/3/ModelBuilders/gbm", training_frame="ptr",
+               response_column="y", ntrees="4", max_depth="3")
+    jid = tr["job"]["key"]["name"]
+    for _ in range(200):
+        j = _get(srv, f"/3/Jobs/{jid}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED"):
+            break
+        time.sleep(0.25)
+    assert j["status"] == "DONE", j
+    mid = j["dest"]["name"]
+    c = _post(srv, f"/3/Predictions/models/{mid}/frames/ptr",
+              predict_contributions="true")
+    cf = _get(srv, f"/3/Frames/{c['predictions_frame']['name']}/summary")
+    labels = [col["label"] for col in cf["frames"][0]["columns"]]
+    assert "BiasTerm" in labels
+    l = _post(srv, f"/3/Predictions/models/{mid}/frames/ptr",
+              leaf_node_assignment="true")
+    lf = _get(srv, f"/3/Frames/{l['predictions_frame']['name']}/summary")
+    assert lf["frames"][0]["rows"] == 500
