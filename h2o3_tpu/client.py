@@ -514,7 +514,12 @@ class RemoteModel:
 
     @property
     def scoring_history(self):
-        return self._json()["output"].get("scoring_history")
+        from .models.model_base import ScoringHistory
+
+        # same dual surface as local models: index the rows OR call it
+        # for the h2o-py table form
+        return ScoringHistory(
+            self._json()["output"].get("scoring_history") or [])
 
     def varimp(self, use_pandas=False):
         return self._json()["output"].get("variable_importances")

@@ -38,7 +38,8 @@ from .metrics import (
     ModelMetricsMultinomial,
     ModelMetricsRegression,
 )
-from .model_base import DataInfo, H2OEstimator, H2OModel, ScoreKeeper, response_info
+from .model_base import (DataInfo, H2OEstimator, H2OModel, ScoreKeeper,
+                         ScoringHistory, response_info)
 
 ACTIVATIONS = (
     "Rectifier", "Tanh", "Maxout",
@@ -680,7 +681,7 @@ class H2ODeepLearningEstimator(H2OEstimator):
         if use_scan:
             params = _unflatten(pflat)
         model.net_params = params
-        model.scoring_history = history
+        model.scoring_history = ScoringHistory(history)
         model.training_metrics = model._make_metrics(train, X_pre=X_score)
         if valid is not None:
             model.validation_metrics = model._make_metrics(valid)

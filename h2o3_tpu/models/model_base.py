@@ -92,6 +92,27 @@ class JobCancelled(RuntimeError):
     driver's next safe point, a scoring boundary)."""
 
 
+class ScoringHistory(list):
+    """Scoring-history rows, list-compatible AND callable: h2o-py's
+    `model.scoring_history()` returns a table, while this framework's
+    drivers (and earlier rounds' tests) index the rows directly — one
+    object serves both surfaces."""
+
+    def __call__(self, use_pandas: bool = False):
+        cols = {}
+        for k in (list(self[0]) if self else []):
+            vals = [r.get(k) for r in self]
+            if isinstance(vals[0], str):
+                cols[k] = np.asarray(vals, dtype=object)
+            else:
+                cols[k] = np.asarray(
+                    [np.nan if v is None else float(v) for v in vals])
+        fr = Frame.from_dict(cols) if cols else Frame({})
+        if use_pandas:
+            return fr.as_data_frame(use_pandas=True)
+        return fr
+
+
 # scoring-program row bucket: jitted scorer inputs (tree _margins, GLM
 # scoring design) quantize their row dimension to this multiple so nearby
 # frame sizes share one compiled program (each extra program is a tunnel
@@ -543,7 +564,7 @@ class H2OModel:
         self.training_metrics: Optional[ModelMetricsBase] = None
         self.validation_metrics: Optional[ModelMetricsBase] = None
         self.cross_validation_metrics: Optional[ModelMetricsBase] = None
-        self.scoring_history: List[Dict[str, Any]] = []
+        self.scoring_history: ScoringHistory = ScoringHistory()
         self.varimp_table: Optional[List] = None
         self.run_time: float = 0.0
         self._cv_holdout_pred: Optional[np.ndarray] = None

@@ -84,7 +84,8 @@ from .metrics import (
     ModelMetricsRegression,
 )
 from .model_base import (SCORE_ROW_BUCKET, DataInfo, H2OEstimator,
-                         H2OModel, ScoreKeeper, response_info)
+                         H2OModel, ScoreKeeper, ScoringHistory,
+                         response_info)
 
 
 _predict_codes_jit = jax.jit(treelib.predict_codes, static_argnames=("max_depth",))
@@ -2227,7 +2228,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 raise ValueError("calibrate_model is only supported for "
                                  "binomial models")
             model.calibrator = self._fit_calibrator(model)
-        model.scoring_history = history
+        model.scoring_history = ScoringHistory(history)
         if gain_total.sum() > 0:
             order = np.argsort(-gain_total)
             model.varimp_table = [
