@@ -634,14 +634,19 @@ class H2ODeepLearningEstimator(H2OEstimator):
                 # Early stopping keeps every event (scoring IS its signal),
                 # as does the final event and score_each_iteration.
                 if (seen < total and stopper is None and tspi == -2
-                        and not max_runtime and not multiproc
-                        and not p.get("score_each_iteration")
-                        and _score_time > float(
-                            p.get("score_duty_cycle", 0.1) or 0.1)
-                        * max(time.time() - t0, 1e-9)):
-                    if self.job:
-                        self.job.update(min(seen / total, 1.0))
-                    continue
+                        and not max_runtime
+                        and not p.get("score_each_iteration")):
+                    want_skip = _score_time > float(
+                        p.get("score_duty_cycle", 0.1) or 0.1) * max(
+                        time.time() - t0, 1e-9)
+                    # per-rank clocks diverge; one rank skipping while
+                    # another scores would desync the scoring path's
+                    # collectives — skip only on a UNANIMOUS vote
+                    want_skip = distdata.global_all(want_skip)
+                    if want_skip:
+                        if self.job:
+                            self.job.update(min(seen / total, 1.0))
+                        continue
                 _t_sc = time.time()
                 if use_scan:
                     params = _unflatten(pflat)
@@ -659,20 +664,15 @@ class H2ODeepLearningEstimator(H2OEstimator):
                     metric_val = sm.logloss
                 history.append(ev)
                 stop = stopper is not None and stopper.record(metric_val)
-                if multiproc:
-                    # metrics are local-shard here, so ranks may disagree —
-                    # a global any-rank-stops vote keeps the remaining
-                    # collective programs aligned across processes
-                    stop = float(distdata.global_sum(
-                        np.asarray([1.0 if stop else 0.0]))[0]) > 0
+                # metrics are local-shard here, so ranks may disagree — a
+                # global any-rank-stops vote keeps the remaining collective
+                # programs aligned across processes
+                stop = distdata.global_any(stop)
                 _score_time += time.time() - _t_sc
                 if stop:
                     break
             if max_runtime:
-                hit = time.time() - t0 > max_runtime
-                if multiproc:
-                    hit = float(distdata.global_sum(
-                        np.asarray([1.0 if hit else 0.0]))[0]) > 0
+                hit = distdata.global_any(time.time() - t0 > max_runtime)
                 if hit:
                     break
             if self.job:

@@ -2102,13 +2102,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     if stopper.record(val):
                         break
             if max_runtime:
-                hit = time.time() - t0 > max_runtime
-                if multiproc:
-                    # clock consensus: every rank must take the same branch
-                    # or the next chunk's collectives deadlock
-                    hit = float(distdata.global_sum(
-                        np.asarray([1.0 if hit else 0.0]))[0]) > 0
-                if hit:
+                # clock consensus: every rank must take the same branch or
+                # the next chunk's collectives deadlock
+                if distdata.global_any(time.time() - t0 > max_runtime):
                     break
             if self.job:
                 self.job.update(built / max(ntrees_target, 1))

@@ -125,6 +125,27 @@ def row_offset(n_local: int) -> int:
     return int(row_counts(n_local)[: jax.process_index()].sum())
 
 
+def global_any(flag: bool) -> bool:
+    """True iff ANY process votes True (one host collective; single-process
+    = identity). The canonical transport for control-flow consensus —
+    every rank MUST take the same branch or subsequent collectives
+    deadlock (clock votes, early-stop votes)."""
+    if not multiprocess():
+        return bool(flag)
+    votes = allgather_host(
+        np.asarray([1.0 if flag else 0.0], np.float32)).reshape(-1)
+    return bool(votes.max() >= 0.5)
+
+
+def global_all(flag: bool) -> bool:
+    """True iff EVERY process votes True (one host collective)."""
+    if not multiprocess():
+        return bool(flag)
+    votes = allgather_host(
+        np.asarray([1.0 if flag else 0.0], np.float32)).reshape(-1)
+    return bool(votes.min() >= 0.5)
+
+
 def global_minmax(local_min: np.ndarray, local_max: np.ndarray):
     """Per-column global (min, max) from per-process locals (NaN-safe: a
     process with no finite values contributes ±inf)."""

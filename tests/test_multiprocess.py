@@ -779,3 +779,38 @@ def test_gblinear_two_process_matches_single(tmp_path, cloud1):
     got = np.load(out)
     for k in want:
         assert abs(float(got[k]) - want[k]) < 5e-3, (k, float(got[k]), want[k])
+
+
+DL_TSPI_BODY = """
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+d = H2ODeepLearningEstimator(hidden=[16], epochs=8, seed=3,
+                             mini_batch_size=32,
+                             train_samples_per_iteration=-2,
+                             score_duty_cycle=0.05)
+d.train(x=[f"x{{i}}" for i in range(6)] + ["c"], y="y", training_frame=fr)
+import jax
+if jax.process_index() == 0:
+    np.savez({out!r}, auc=float(d.model_performance(fr).auc),
+             events=len(d.model.scoring_history))
+print("rank", jax.process_index(), "ok")
+"""
+
+
+def test_dl_duty_cycle_autotune_two_process(tmp_path, cloud1):
+    """train_samples_per_iteration=-2 on a 2-process cloud: the scoring
+    duty-cycle skip is a unanimous collective vote, so ranks never desync
+    (this config previously forced every scoring event on multiproc)."""
+    p = str(tmp_path / "dlt.csv")
+    _write_gbm_csv(p)
+    out = str(tmp_path / "dlt2.npz")
+    run_workers(2, DL_TSPI_BODY.format(csv=p, out=out))
+    got = np.load(out)
+    assert float(got["auc"]) > 0.85
+    # no-skip maximum: total/score_every = 8 epochs * 3000 / 3000 rows = 8
+    # events; the duty-cycle skip keeps it at or under that cadence
+    assert 1 <= int(got["events"]) <= 8
