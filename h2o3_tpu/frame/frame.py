@@ -834,6 +834,50 @@ class Frame:
                 [r[j] if j < len(r) else None for r in rows], dtype=object)
         return Frame({n: Vec(None, "string", strings=c) for n, c in cols.items()})
 
+    def lstrip(self, set: str = " ") -> "Frame":
+        """Strip leading characters (H2OFrame.lstrip / AstStrip)."""
+        return self._prim("lstrip", set)
+
+    def rstrip(self, set: str = " ") -> "Frame":
+        return self._prim("rstrip", set)
+
+    def entropy(self) -> "Frame":
+        """Per-string Shannon entropy (H2OFrame.entropy / AstEntropy)."""
+        return self._prim("entropy")
+
+    def num_valid_substrings(self, path_to_words: str) -> "Frame":
+        """Distinct substrings (length >= 2) present in the words file
+        (H2OFrame.num_valid_substrings / AstCountSubstringsWords)."""
+        return self._prim("num_valid_substrings", path_to_words)
+
+    def grep(self, pattern: str, ignore_case: bool = False,
+             invert: bool = False, output_logical: bool = False) -> "Frame":
+        """Matching rows of the (single) string column as a 0/1 column or
+        index list (H2OFrame.grep — the Rapids `grep` prim; NA rows count
+        as non-matches, so invert=True includes them, like `h2o.grep`)."""
+        import re
+
+        flags = re.IGNORECASE if ignore_case else 0
+        hit = np.asarray([
+            0.0 if s is None else float(bool(re.search(pattern, s, flags)))
+            for s in self._string_rows()], np.float64)
+        if invert:
+            hit = 1.0 - hit
+        if output_logical:
+            return Frame.from_dict({"grep": hit})
+        return Frame.from_dict(
+            {"grep": np.nonzero(hit > 0)[0].astype(np.float64)})
+
+    def ascharacter(self) -> "Frame":
+        """Every column → string (H2OFrame.ascharacter): categorical codes
+        decode through their domain (NA-safe), numerics stringify."""
+        out = {}
+        for n, v in self._vecs.items():
+            rows = Frame({n: v})._string_rows()
+            out[n] = Vec(None, "string",
+                         strings=np.asarray(rows, dtype=object))
+        return Frame(out)
+
     def countmatches(self, pattern) -> "Frame":
         pats = [pattern] if isinstance(pattern, str) else list(pattern)
         v = self.vecs()[0]

@@ -53,3 +53,52 @@ def test_grep(cloud1):
     assert list(hits.vec("row").numeric_np()) == [0.0, 2.0]
     inv = h2o.grep(fr, r"error:", invert=True)
     assert list(inv.vec("row").numeric_np()) == [1.0, 3.0]
+
+
+def test_string_method_wrappers(cloud1, tmp_path):
+    """Frame wrappers over the string prims: lstrip/rstrip, entropy,
+    num_valid_substrings, grep, ascharacter."""
+    import numpy as np
+    import pytest
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.frame.vec import Vec
+
+    fr = Frame({"s": Vec(None, "string", strings=np.asarray(
+        ["  ab", "cd  ", "aaaa"], dtype=object))})
+    np.testing.assert_array_equal(
+        np.asarray(fr.lstrip().vec("s").to_numpy(), dtype=object),
+        ["ab", "cd  ", "aaaa"])
+    np.testing.assert_array_equal(
+        np.asarray(fr.rstrip().vec("s").to_numpy(), dtype=object),
+        ["  ab", "cd", "aaaa"])
+    ent = fr.entropy().vec("entropy").numeric_np()
+    assert ent[2] == pytest.approx(0.0)          # "aaaa": one symbol
+    assert ent[0] > 0.5
+
+    words = tmp_path / "w.txt"
+    words.write_text("ab\ncd\n")
+    nv = fr.num_valid_substrings(str(words)).vec(
+        "num_valid_substrings").numeric_np()
+    assert nv[0] == 1.0 and nv[2] == 0.0
+
+    g = fr.grep("a", output_logical=True)
+    np.testing.assert_allclose(g._col0(), [1, 0, 1])
+    # NA rows count as NON-matches, so invert includes them (h2o.grep parity)
+    na_fr = Frame({"s": Vec(None, "string", strings=np.asarray(
+        ["ax", None, "b"], dtype=object))})
+    gi = na_fr.grep("a", invert=True, output_logical=True)
+    np.testing.assert_allclose(gi._col0(), [0, 1, 1])
+    idx = na_fr.grep("a")
+    np.testing.assert_allclose(idx._col0(), [0])
+
+    efr = Frame.from_dict({"c": np.asarray(["x", "y", "x"], dtype=object)},
+                          column_types={"c": "enum"})
+    ch = efr.ascharacter()
+    assert ch.vec("c").type == "string"
+    np.testing.assert_array_equal(
+        np.asarray(ch.vec("c").to_numpy(), dtype=object), ["x", "y", "x"])
+    # numeric columns stringify too (upstream ascharacter semantics)
+    nch = Frame.from_dict({"x": np.asarray([1.5, 2.5])}).ascharacter()
+    assert nch.vec("x").type == "string"
+    assert list(nch.vec("x").to_numpy()) == ["1.5", "2.5"]
