@@ -834,6 +834,11 @@ class Frame:
                 [r[j] if j < len(r) else None for r in rows], dtype=object)
         return Frame({n: Vec(None, "string", strings=c) for n, c in cols.items()})
 
+    def ifelse(self, yes, no) -> "Frame":
+        """Element-wise conditional on this (boolean/0-1) column:
+        `cond.ifelse(yes, no)` (H2OFrame.ifelse / AstIfElse)."""
+        return self._prim("ifelse", yes, no)
+
     def lstrip(self, set: str = " ") -> "Frame":
         """Strip leading characters (H2OFrame.lstrip / AstStrip)."""
         return self._prim("lstrip", set)

@@ -533,10 +533,14 @@ class RapidsSession:
             return Frame.from_dict({fr.names[0]: u[~np.isnan(u)]})
         if op == "ifelse":
             cond, yes, no = a
-            c = cond._col0().astype(bool) if isinstance(cond, Frame) else np.asarray(cond, bool)
+            craw = (cond._col0() if isinstance(cond, Frame)
+                    else np.asarray(cond, np.float64))
             yv = yes._col0() if isinstance(yes, Frame) else yes
             nv = no._col0() if isinstance(no, Frame) else no
-            return Frame.from_dict({"ifelse": np.where(c, yv, nv)})
+            out = np.where(craw != 0, yv, nv).astype(np.float64)
+            # NA condition propagates NA (AstIfElse), not the yes branch
+            out[np.isnan(craw)] = np.nan
+            return Frame.from_dict({"ifelse": out})
         if op == "nrow":
             return float(a[0].nrow)
         if op == "ncol":

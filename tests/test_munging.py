@@ -397,3 +397,16 @@ def test_frame_method_conveniences(cloud1):
     import pytest as _pt
     nfr = Frame.from_dict({"x": np.asarray([1.0, np.nan, 3.0])})
     assert nfr.var() == _pt.approx(2.0)
+
+
+def test_frame_ifelse(cloud1):
+    c = Frame.from_dict({"c": np.asarray([1.0, 0.0, 1.0])})
+    np.testing.assert_allclose(c.ifelse(10.0, 20.0)._col0(), [10, 20, 10])
+    y = Frame.from_dict({"y": np.asarray([1.0, 2, 3])})
+    n = Frame.from_dict({"n": np.asarray([9.0, 8, 7])})
+    np.testing.assert_allclose(c.ifelse(y, n)._col0(), [1, 8, 3])
+    # NA condition propagates NA (AstIfElse), never picks a branch
+    cna = Frame.from_dict({"c": np.asarray([1.0, np.nan, 0.0])})
+    out = cna.ifelse(10.0, 20.0)._col0()
+    np.testing.assert_allclose(out[[0, 2]], [10, 20])
+    assert np.isnan(out[1])
