@@ -432,6 +432,7 @@ def main():
             os._exit(0)
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    cpu_fallback_reason = None
     forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
     if config == "scaling" or forced:
         # the scaling curve runs in CPU subprocesses; keep the parent off the
@@ -441,15 +442,27 @@ def main():
         jax.config.update("jax_platforms", forced or "cpu")
     else:
         # the tunnel to the real chip can die mid-round; a bench that hangs
-        # for the driver's whole budget records nothing. Probe first, emit a
-        # parseable error line and exit fast when the chip is unreachable.
+        # for the driver's whole budget records nothing. Probe first; when
+        # the chip is unreachable, fall back to a CPU run (tagged
+        # "backend": "cpu-fallback") — an on-CPU datapoint beats the
+        # `*_unavailable` value-0.0 line that records nothing usable.
         probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90))
         platform, why = _probe_accelerator(probe_s)
         if platform is None:
-            _emit(_fail_line(config,
-                             f"accelerator unreachable ({why}); set "
-                             f"BENCH_PLATFORM=cpu to force a CPU run"))
-            sys.exit(0)
+            cpu_fallback_reason = why
+            print(f"# accelerator unreachable ({why}); "
+                  "falling back to a CPU bench run", file=sys.stderr)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            if "BENCH_ROWS" not in os.environ:
+                # shrink only the un-asked-for default workload: the CPU
+                # must land a datapoint inside the watchdog budget. An
+                # explicit BENCH_ROWS is honored as given.
+                fallback_rows = {"gbm": 100_000, "glm": 100_000,
+                                 "xgb_rank": 50_000}.get(config)
+                if fallback_rows:
+                    os.environ["BENCH_ROWS"] = str(fallback_rows)
     import jax
 
     # env vars alone do not engage the persistent cache under the remote-TPU
@@ -505,9 +518,12 @@ def main():
         "value": round(float(value), 3),
         "unit": extra.pop("unit_override", "s"),
         "vs_baseline": round(vs, 3),
-        "backend": jax.default_backend(),
+        "backend": ("cpu-fallback" if cpu_fallback_reason
+                    else jax.default_backend()),
         "runs": [round(float(v), 3) for v in values],
     }
+    if cpu_fallback_reason:
+        result["fallback_reason"] = cpu_fallback_reason
     if cold:
         result["cold"] = True
     ph = snaps[best_i]

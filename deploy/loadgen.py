@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the `/3/Predictions` serving path.
+
+N worker threads each issue M back-to-back requests against one
+(model, frame) pair and record per-request latency; the report prints
+p50/p99 and aggregate throughput, plus the 429 (shed) and error counts so
+an overload run is legible. Closed-loop means each thread waits for its
+response before sending the next request — offered load tracks service
+rate, which is the right shape for measuring the micro-batcher's
+coalescing win (open-loop generators measure queue explosion instead).
+
+Usage:
+    python deploy/loadgen.py --port 54321 --model gbm_1 --frame fr_1 \\
+        --threads 8 --requests 50
+
+Importable: `run_load(...)` returns the stats dict (the smoke test in
+tests/test_serving.py drives an in-process server through it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def run_load(host: str, port: int, model: str, frame: str,
+             threads: int = 8, requests: int = 50,
+             duration_s: Optional[float] = None,
+             timeout_s: float = 60.0) -> Dict:
+    """Drive the predict route closed-loop; returns the stats dict.
+
+    `duration_s` caps wall-clock instead of request count when set (each
+    thread stops issuing new requests once the deadline passes)."""
+    url = (f"http://{host}:{port}/3/Predictions/models/"
+           f"{urllib.parse.quote(model)}/frames/"
+           f"{urllib.parse.quote(frame)}")
+    lock = threading.Lock()
+    lat_s: List[float] = []
+    shed = [0]
+    errors = [0]
+    t_end = (time.monotonic() + duration_s) if duration_s else None
+
+    def worker():
+        for _ in range(requests):
+            if t_end is not None and time.monotonic() >= t_end:
+                break
+            t0 = time.monotonic()
+            try:
+                req = urllib.request.Request(url, data=b"")
+                with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                    r.read()
+                with lock:
+                    lat_s.append(time.monotonic() - t0)
+            except urllib.error.HTTPError as e:
+                e.read()
+                with lock:
+                    (shed if e.code == 429 else errors)[0] += 1
+            except OSError:
+                with lock:
+                    errors[0] += 1
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = max(time.monotonic() - t0, 1e-9)
+    srt = sorted(lat_s)
+    return dict(
+        url=url, threads=threads, requests_per_thread=requests,
+        completed=len(srt), shed_429=shed[0], errors=errors[0],
+        wall_s=round(wall, 3),
+        throughput_rps=round(len(srt) / wall, 2),
+        p50_ms=round(_percentile(srt, 0.50) * 1e3, 3) if srt else None,
+        p99_ms=round(_percentile(srt, 0.99) * 1e3, 3) if srt else None,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--model", required=True, help="DKV model key")
+    ap.add_argument("--frame", required=True, help="DKV frame key")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=50,
+                    help="requests per thread")
+    ap.add_argument("--duration-s", type=float, default=None,
+                    help="stop issuing after this many seconds instead")
+    args = ap.parse_args()
+    stats = run_load(args.host, args.port, args.model, args.frame,
+                     threads=args.threads, requests=args.requests,
+                     duration_s=args.duration_s)
+    print(json.dumps(stats, indent=2))
+    return 0 if stats["completed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

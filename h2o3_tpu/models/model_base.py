@@ -626,6 +626,15 @@ class H2OModel:
     def predict(self, test_data: Frame) -> Frame:
         raise NotImplementedError
 
+    def scoring_signature(self) -> tuple:
+        """(n_features, dtype) identifying this model's compiled
+        scoring-program family — the shape-bearing parts of the serving
+        cache key (serving/model_cache.py). Two models under the same DKV
+        key with different signatures can never share an executable."""
+        x = getattr(self, "x", None)
+        nf = len(x) if isinstance(x, (list, tuple)) else (1 if x else 0)
+        return (nf, "float32")
+
     def model_performance(self, test_data: Optional[Frame] = None, **kw):
         if test_data is None:
             return self.training_metrics

@@ -341,6 +341,13 @@ class MojoScorer:
         self.y = meta["y"]
         self._native_forests: Dict[int, tuple] = {}  # k → converted arrays
 
+    def scoring_signature(self) -> tuple:
+        """Serving-cache key parts — mirrors H2OModel.scoring_signature so
+        uploaded artifacts ride the same compiled-scorer cache."""
+        x = self.x
+        nf = len(x) if isinstance(x, (list, tuple)) else (1 if x else 0)
+        return (nf, "float32")
+
     def _native_forest(self, k: int):
         """Contiguous ctypes-ready forest arrays, converted once per class
         (the serving hot path must not re-copy the model every call)."""

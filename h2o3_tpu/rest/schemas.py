@@ -55,3 +55,36 @@ def schema_for(algo: str) -> Dict:
 
 def all_schemas() -> List[Dict]:
     return [schema_for(a) for a in sorted(algo_registry())]
+
+
+SERVING_SCHEMA_NAME = "ServingMetricsV3"
+
+
+def serving_metrics_schema() -> Dict:
+    """Field metadata of the `GET /3/Serving/metrics` document (the serving
+    subsystem's observability schema — docs/serving.md mirrors this)."""
+    fields = [
+        ("models", "map<model_key, ModelServingStats>",
+         "per-model counters + histograms"),
+        ("models.*.counters", "map<string,int>",
+         "requests/rejections/errors, batches/batched_requests/batched_rows,"
+         " compiles/cache_hits"),
+        ("models.*.histograms.queue_wait_ms", "histogram",
+         "request dwell in the micro-batch queue"),
+        ("models.*.histograms.device_ms", "histogram",
+         "scoring-call wall time per batch (includes compile on cold"
+         " buckets)"),
+        ("models.*.histograms.batch_size", "histogram",
+         "requests coalesced per device batch"),
+        ("totals", "map<string,int>", "counters summed over all models"),
+        ("cache", "CacheStats",
+         "compiled-scorer LRU: capacity/size/hits/misses/evictions +"
+         " per-entry warm row buckets"),
+        ("admission", "AdmissionStats",
+         "in-flight counts vs the global and per-model bounds"),
+        ("config", "ServingConfig", "the active knob values"),
+    ]
+    return dict(
+        name=SERVING_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )

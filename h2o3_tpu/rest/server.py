@@ -123,6 +123,8 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Models/([^/]+)$", "model_get"),
         ("DELETE", r"^/3/Models/([^/]+)$", "model_delete"),
         ("POST", r"^/3/Predictions/models/([^/]+)/frames/([^/]+)$", "predict"),
+        ("GET", r"^/3/Serving/metrics$", "serving_metrics"),
+        ("DELETE", r"^/3/Serving/cache$", "serving_cache_clear"),
         ("POST", r"^/3/ModelMetrics/models/([^/]+)/frames/([^/]+)$", "model_metrics"),
         ("GET", r"^/3/Jobs$", "jobs_list"),
         ("GET", r"^/3/Jobs/([^/]+)$", "job_get"),
@@ -188,11 +190,14 @@ class _Handler(BaseHTTPRequestHandler):
         Log.debug("REST " + fmt % args)
 
     # -- plumbing ------------------------------------------------------------
-    def _send(self, obj, status: int = 200):
+    def _send(self, obj, status: int = 200,
+              headers: Optional[Dict[str, str]] = None):
         body = json.dumps(_sanitize(obj), default=_json_default).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -759,10 +764,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def h_model_delete(self, key):
         DKV.remove(key)
+        # drop the model's compiled scorers too — cache hygiene on delete
+        # (the identity check in ScorerCache already guarantees a re-created
+        # model under this key can never hit the stale executable)
+        from ..serving import peek_engine
+
+        eng = peek_engine()
+        if eng is not None:
+            eng.cache.invalidate(key)
         self._send(dict())
 
     def h_predict(self, model_key, frame_key):
         from ..mojo import MojoScorer
+        from ..serving import RejectedError, get_engine
 
         m = DKV.get(model_key)
         fr = DKV.get(frame_key)
@@ -776,23 +790,58 @@ class _Handler(BaseHTTPRequestHandler):
         # upstream ModelMetricsHandler.predict options: SHAP contributions
         # and leaf indices ride the same route as plain predictions
         if self._flag(p, "predict_contributions"):
-            if not hasattr(m, "predict_contributions"):
-                raise ValueError(
-                    f"{model_key!r} does not support contributions")
-            pred = m.predict_contributions(fr)
-            suffix = "_contributions"
+            kind, suffix = "contributions", "_contributions"
         elif self._flag(p, "leaf_node_assignment"):
-            if not hasattr(m, "predict_leaf_node_assignment"):
-                raise ValueError(
-                    f"{model_key!r} does not support leaf assignment")
-            pred = m.predict_leaf_node_assignment(fr)
-            suffix = "_leaves"
+            kind, suffix = "leaves", "_leaves"
         else:
-            pred = m.predict(fr)
-            suffix = ""
+            kind, suffix = "predict", ""
+        # the serving path (docs/serving.md): admission → micro-batcher →
+        # compiled-scorer cache. Concurrent requests for one model coalesce
+        # into one device batch; repeats hit a warm executable.
+        try:
+            pred = get_engine().score(model_key, m, fr, output_kind=kind)
+        except RejectedError as e:
+            # backpressure, not failure: 429 + Retry-After so load
+            # balancers and client retry loops back off instead of piling on
+            retry = str(max(1, int(-(-e.retry_after_s // 1))))
+            self._send(dict(__meta=dict(schema_type="H2OError"),
+                            msg=str(e), http_status=429), 429,
+                       headers={"Retry-After": retry})
+            return
+        # deterministic key: re-scoring the same (model, frame, kind)
+        # OVERWRITES the previous prediction frame — the DKV must not
+        # accumulate one leaked frame per repeat call (tested by the
+        # DKV.keys() leak assertion in tests/test_rest_api.py)
         pred.key = f"prediction{suffix}_{model_key}_{frame_key}"
         DKV.put(pred.key, pred)
         self._send(dict(predictions_frame=dict(name=pred.key)))
+
+    def h_serving_metrics(self):
+        """`GET /3/Serving/metrics` — the scoring subsystem's counters +
+        latency histograms (schema: schemas.serving_metrics_schema; also
+        folded into /3/Profiler via runtime/profiler.serving_stats)."""
+        from ..serving import peek_engine
+
+        p = self._params()
+        if self._flag(p, "schema"):
+            self._send(schemas.serving_metrics_schema())
+            return
+        eng = peek_engine()
+        body = (eng.snapshot() if eng is not None
+                else dict(models={}, totals={}, cache=None, admission=None,
+                          config=None))
+        self._send(dict(__meta=dict(schema_type=schemas.SERVING_SCHEMA_NAME),
+                        **body))
+
+    def h_serving_cache_clear(self):
+        """`DELETE /3/Serving/cache[?model=key]` — evict compiled scorers
+        (all, or one model's) so a hot-swapped artifact re-traces."""
+        from ..serving import peek_engine
+
+        p = self._params()
+        eng = peek_engine()
+        n = eng.cache.invalidate(p.get("model") or None) if eng else 0
+        self._send(dict(invalidated=n))
 
     def h_model_metrics(self, model_key, frame_key):
         from ..mojo import MojoScorer
@@ -862,7 +911,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._send(dict(nodes=[dict(node="local",
                                     entries=profiler.profile(nsamples=2,
-                                                             interval=0.01))]))
+                                                             interval=0.01))],
+                        serving=profiler.serving_stats()))
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()))

@@ -39,6 +39,21 @@ def profile(nsamples: int = 10, interval: float = 0.02, depth: int = 10) -> List
     return [dict(stack=k, count=v) for k, v in counts.most_common()]
 
 
+def serving_stats() -> Dict:
+    """Serving-subsystem observability folded into the profiler surface:
+    `/3/Profiler` reports host stacks AND the scoring path's counters/
+    latency histograms in one document. Never instantiates the serving
+    engine — a profiler read on a training-only cluster reports absence."""
+    from ..serving import peek_engine
+
+    eng = peek_engine()
+    if eng is None:
+        return dict(active=False)
+    out = eng.snapshot()
+    out["active"] = True
+    return out
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """`with profiler.trace('/tmp/tb'):` — device + host trace via

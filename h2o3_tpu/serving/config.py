@@ -1,0 +1,56 @@
+"""Serving configuration — every knob of the scoring subsystem in one place.
+
+All knobs are env-overridable (`H2O3_SERVING_*`) so a deployment can tune
+the batcher/admission behavior without code changes, the same way the REST
+layer reads `H2O3_MAX_BODY_MB`. Defaults are chosen for a loopback CPU
+deployment; a real TPU serving pod wants a larger `max_batch_rows` (amortize
+the tunnel round-trip) and a tighter `max_wait_ms` (the device is fast, the
+queue should not be the latency floor).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return default if v in (None, "") else int(v)
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Knobs of the four serving pieces (docs/serving.md has the matrix)."""
+
+    # -- batcher (serving/batcher.py) --------------------------------------
+    max_batch_rows: int = 8192     # coalesce up to this many rows per batch
+    max_wait_ms: float = 2.0       # first request's max queue dwell
+    request_timeout_s: float = 300.0   # caller-side wait bound (500 beyond)
+    idle_worker_s: float = 30.0    # per-model worker thread expiry
+
+    # -- admission control (serving/admission.py) --------------------------
+    max_queue: int = 256           # global queued+in-flight request bound
+    model_inflight: int = 64       # per-model admitted request bound
+    retry_after_s: float = 1.0     # Retry-After hint on 429
+
+    # -- compiled-scorer cache (serving/model_cache.py) --------------------
+    cache_capacity: int = 32       # LRU entries (model × output_kind)
+
+    @staticmethod
+    def from_env() -> "ServingConfig":
+        return ServingConfig(
+            max_batch_rows=_env_int("H2O3_SERVING_MAX_BATCH_ROWS", 8192),
+            max_wait_ms=_env_float("H2O3_SERVING_MAX_WAIT_MS", 2.0),
+            request_timeout_s=_env_float("H2O3_SERVING_TIMEOUT_S", 300.0),
+            idle_worker_s=_env_float("H2O3_SERVING_IDLE_WORKER_S", 30.0),
+            max_queue=_env_int("H2O3_SERVING_MAX_QUEUE", 256),
+            model_inflight=_env_int("H2O3_SERVING_MODEL_INFLIGHT", 64),
+            retry_after_s=_env_float("H2O3_SERVING_RETRY_AFTER_S", 1.0),
+            cache_capacity=_env_int("H2O3_SERVING_CACHE_CAPACITY", 32),
+        )

@@ -1,0 +1,147 @@
+"""Serving metrics — per-model counters + latency histograms.
+
+The observability face of the scoring subsystem, exposed at
+`GET /3/Serving/metrics` and folded into `/3/Profiler` via
+`runtime/profiler.serving_stats()`. Counter semantics:
+
+- ``requests`` / ``rejections`` / ``errors``: admission-level accounting —
+  every `/3/Predictions` call lands in exactly one of admitted (requests),
+  shed (rejections), or admitted-but-failed (errors counts the failures
+  among admitted requests).
+- ``batches`` / ``batched_requests`` / ``batched_rows``: micro-batcher
+  output — how many device dispatches served how much work.
+- ``compiles`` / ``cache_hits``: compiled-scorer cache — a compile is a
+  scorer build OR a new padded-row-bucket trace; a cache hit is a batch
+  served entirely by a warm executable. The warm-path invariant the tests
+  pin: a repeat request moves only ``cache_hits``.
+
+Histograms are fixed-bound (log-spaced) so a snapshot is O(bounds), never
+O(requests) — the histogram state is a counts vector, not a sample list.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+# log-ish spaced upper bounds; the last bucket is +inf (overflow)
+WAIT_MS_BOUNDS = (0.5, 1, 2, 5, 10, 25, 50, 100, 250, 1000, 5000)
+DEVICE_MS_BOUNDS = (1, 2, 5, 10, 25, 50, 100, 250, 1000, 5000, 30000)
+BATCH_SIZE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 512)
+
+_COUNTERS = ("requests", "rejections", "errors", "batches",
+             "batched_requests", "batched_rows", "compiles", "cache_hits")
+
+
+class LatencyHistogram:
+    """Fixed-bound histogram: counts per bucket + running sum/min/max."""
+
+    __slots__ = ("bounds", "counts", "n", "total", "vmin", "vmax")
+
+    def __init__(self, bounds: Sequence[float]):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+
+    def record(self, v: float) -> None:
+        i = 0
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                break
+        else:
+            i = len(self.bounds)
+        self.counts[i] += 1
+        self.n += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def snapshot(self) -> Dict:
+        return dict(
+            bounds=list(self.bounds), counts=list(self.counts), count=self.n,
+            mean=round(self.total / self.n, 4) if self.n else None,
+            min=self.vmin, max=self.vmax,
+        )
+
+
+class _ModelStats:
+    __slots__ = ("counters", "queue_wait_ms", "device_ms", "batch_size")
+
+    def __init__(self):
+        self.counters = {c: 0 for c in _COUNTERS}
+        self.queue_wait_ms = LatencyHistogram(WAIT_MS_BOUNDS)
+        self.device_ms = LatencyHistogram(DEVICE_MS_BOUNDS)
+        self.batch_size = LatencyHistogram(BATCH_SIZE_BOUNDS)
+
+    def snapshot(self) -> Dict:
+        return dict(
+            counters=dict(self.counters),
+            histograms=dict(queue_wait_ms=self.queue_wait_ms.snapshot(),
+                            device_ms=self.device_ms.snapshot(),
+                            batch_size=self.batch_size.snapshot()),
+        )
+
+
+class ServingMetrics:
+    """Thread-safe per-model stats registry (one per ScoringEngine)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, _ModelStats] = {}
+
+    def _stats(self, model_key: str) -> _ModelStats:
+        # callers hold self._lock
+        s = self._models.get(model_key)
+        if s is None:
+            s = self._models[model_key] = _ModelStats()
+        return s
+
+    def _bump(self, model_key: str, counter: str, by: int = 1) -> None:
+        with self._lock:
+            self._stats(model_key).counters[counter] += by
+
+    # -- admission-level ----------------------------------------------------
+    def record_request(self, model_key: str) -> None:
+        self._bump(model_key, "requests")
+
+    def record_rejection(self, model_key: str) -> None:
+        self._bump(model_key, "rejections")
+
+    def record_error(self, model_key: str) -> None:
+        self._bump(model_key, "errors")
+
+    # -- batcher / cache level ---------------------------------------------
+    def record_queue_wait(self, model_key: str, wait_s: float) -> None:
+        with self._lock:
+            self._stats(model_key).queue_wait_ms.record(wait_s * 1e3)
+
+    def record_batch(self, model_key: str, n_requests: int, n_rows: int,
+                     device_s: float, compiled: bool) -> None:
+        with self._lock:
+            s = self._stats(model_key)
+            s.counters["batches"] += 1
+            s.counters["batched_requests"] += n_requests
+            s.counters["batched_rows"] += n_rows
+            s.counters["compiles" if compiled else "cache_hits"] += 1
+            s.device_ms.record(device_s * 1e3)
+            s.batch_size.record(float(n_requests))
+
+    # -- read side ----------------------------------------------------------
+    def counter(self, model_key: str, name: str) -> int:
+        with self._lock:
+            s = self._models.get(model_key)
+            return s.counters.get(name, 0) if s else 0
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            models = {k: s.snapshot() for k, s in self._models.items()}
+        totals = {c: sum(m["counters"][c] for m in models.values())
+                  for c in _COUNTERS}
+        return dict(models=models, totals=totals)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._models.clear()

@@ -1,0 +1,182 @@
+"""Compiled-scorer cache — warm executables for the serving hot path.
+
+Reference contrast: upstream's `/3/Predictions` route scores through the
+live model object and the JVM's JIT keeps it warm for free. Under XLA every
+new (program, shape) pair pays a trace+compile round-trip — seconds through
+a remote-chip tunnel — so the serving layer must keep *both* the scorer
+closure and its padded-batch shapes resident. This module is the inference
+counterpart of the training side's program-economy rules
+(docs/architecture.md "Program economy").
+
+Two layers of reuse:
+
+1. **Entry cache** (LRU): keyed on `(model_key, n_features, dtype,
+   output_kind)`. The key carries the scoring signature, not just the model
+   key, so re-training a model under the same DKV key (different feature
+   count) can never serve stale executables; identity of the live model
+   object is checked on every hit for the same reason.
+2. **Row-bucket warm set**: batch rows pad up to a small set of bucket
+   sizes (64/128/256, then multiples of `SCORE_ROW_BUCKET`) so nearby
+   request sizes land on one traced program. The first visit to a bucket is
+   a compile; later visits are cache hits. Note the `compiles` counter is
+   serving-level (cold bucket seen), not an XLA-compile count: scorers with
+   their own internal row bucketing (tree/GLM `_margins` pad to
+   `SCORE_ROW_BUCKET`) share one device program across the sub-512 buckets,
+   so a "compile" there costs only the host-side conversion, not a trace.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# sub-SCORE_ROW_BUCKET buckets: REST predict traffic is dominated by small
+# frames (single rows to a few hundred); padding a 3-row request straight to
+# 512 wastes device work, but 3→64→128→256 keeps the program count bounded
+_SMALL_BUCKETS = (64, 128, 256)
+
+# output_kind → model method (the three /3/Predictions scoring surfaces)
+OUTPUT_KINDS = {
+    "predict": "predict",
+    "contributions": "predict_contributions",
+    "leaves": "predict_leaf_node_assignment",
+}
+
+
+def bucket_rows(n: int) -> int:
+    """Padded row count for an n-row batch (see module docstring)."""
+    from ..models.model_base import SCORE_ROW_BUCKET
+
+    for b in _SMALL_BUCKETS:
+        if n <= b:
+            return b
+    return -(-n // SCORE_ROW_BUCKET) * SCORE_ROW_BUCKET
+
+
+def scoring_signature(model) -> Tuple[int, str]:
+    """(n_features, dtype) of a model's compiled scoring-program family —
+    the shape-bearing parts of the cache key."""
+    sig = getattr(model, "scoring_signature", None)
+    if callable(sig):
+        return sig()
+    x = getattr(model, "x", None)
+    nf = len(x) if isinstance(x, (list, tuple)) else (1 if x else 0)
+    return (nf, "float32")
+
+
+class CompiledScorer:
+    """One cache entry: a bound scoring callable + its warm bucket set."""
+
+    def __init__(self, model_key: str, model, output_kind: str):
+        method = OUTPUT_KINDS.get(output_kind)
+        if method is None:
+            raise ValueError(f"unknown output kind {output_kind!r}")
+        fn = getattr(model, method, None)
+        if fn is None:
+            what = {"contributions": "contributions",
+                    "leaves": "leaf assignment"}.get(output_kind, output_kind)
+            raise ValueError(f"{model_key!r} does not support {what}")
+        self.model_key = model_key
+        self.model = model          # identity-checked on cache hits
+        self.output_kind = output_kind
+        self._fn = fn
+        self.warm_buckets: set = set()
+        self.built_at = time.time()
+        self._lock = threading.Lock()
+
+    def score(self, frame) -> Tuple[object, bool, float]:
+        """Score one (possibly coalesced) batch.
+
+        Returns (result_frame, compiled, device_s): `compiled` is True when
+        this call traced a new padded-bucket program (cold bucket)."""
+        n = frame.nrow
+        pad = bucket_rows(n) if n else 0
+        if n and pad != n:
+            # repeat row 0 as padding — always in-domain for enum columns,
+            # unlike zeros, and sliced off below
+            idx = np.concatenate([np.arange(n, dtype=np.int64),
+                                  np.zeros(pad - n, np.int64)])
+            scored = frame.take(idx)
+        else:
+            scored = frame
+        with self._lock:
+            compiled = pad not in self.warm_buckets
+            self.warm_buckets.add(pad)
+        t0 = time.perf_counter()
+        out = self._fn(scored)
+        device_s = time.perf_counter() - t0
+        if n and pad != n:
+            out = out.take(np.arange(n))
+        return out, compiled, device_s
+
+
+class ScorerCache:
+    """LRU of CompiledScorer entries, keyed on the full scoring signature."""
+
+    def __init__(self, capacity: int = 32):
+        self.capacity = max(int(capacity), 1)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, CompiledScorer]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _key(model_key: str, model, output_kind: str) -> Tuple:
+        nf, dtype = scoring_signature(model)
+        return (model_key, nf, dtype, output_kind)
+
+    def get_or_build(self, model_key: str, model,
+                     output_kind: str = "predict"
+                     ) -> Tuple[CompiledScorer, bool]:
+        """(entry, was_hit). Builds (and LRU-inserts) on miss; a hit whose
+        entry wraps a *different* live object (model re-trained / re-loaded
+        under the same key) rebuilds — stale executables must never score."""
+        key = self._key(model_key, model, output_kind)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry.model is model:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry, True
+            # miss or stale: build outside the map but under the lock —
+            # scorer construction is cheap (the expensive trace happens at
+            # first score()), and one build per key beats a thundering herd
+            entry = CompiledScorer(model_key, model, output_kind)
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            self.misses += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return entry, False
+
+    def invalidate(self, model_key: Optional[str] = None) -> int:
+        """Drop entries for one model key (or all). Returns drop count."""
+        with self._lock:
+            if model_key is None:
+                n = len(self._entries)
+                self._entries.clear()
+                return n
+            doomed = [k for k in self._entries if k[0] == model_key]
+            for k in doomed:
+                del self._entries[k]
+            return len(doomed)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            entries = [dict(model=k[0], n_features=k[1], dtype=k[2],
+                            output_kind=k[3],
+                            warm_buckets=sorted(e.warm_buckets))
+                       for k, e in self._entries.items()]
+            return dict(capacity=self.capacity, size=len(entries),
+                        hits=self.hits, misses=self.misses,
+                        evictions=self.evictions, entries=entries)

@@ -29,6 +29,13 @@ import pytest  # noqa: E402
 assert len(jax.devices()) >= 8, "test cloud needs 8 virtual CPU devices"
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 run "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def cloud8():
     """8-device cloud (all virtual CPU devices)."""

@@ -618,6 +618,42 @@ def test_job_cancel_route(server):
     assert j["dest"]["name"] == jid       # no model key: result never set
 
 
+def test_prediction_frames_overwrite_not_accumulate(server, cloud1):
+    """Repeat scoring of the same (model, frame) pair must OVERWRITE the
+    deterministic prediction key, never accumulate one leaked frame per
+    call — DKV.keys()-based leak assertion (serving-subsystem satellite).
+
+    The model is trained in-process (cloud1) so the assertion isolates the
+    predict route's DKV behavior from the training path."""
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    srv, csv = server
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 3))
+    yb = (X[:, 0] + X[:, 1] > 0).astype(int)
+    fr = Frame.from_dict(
+        {"a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+         "y": np.asarray(["no", "yes"], dtype=object)[yb]},
+        column_types={"y": "enum"})
+    fr.key = "leaktr"
+    DKV.put(fr.key, fr)
+    est = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1,
+                                       model_id="leak_gbm")
+    est.train(x=["a", "b", "c"], y="y", training_frame=fr)
+    DKV.put("leak_gbm", est.model)
+    p1 = _post(srv, "/3/Predictions/models/leak_gbm/frames/leaktr")
+    pkey = p1["predictions_frame"]["name"]
+    assert pkey == "prediction_leak_gbm_leaktr"   # deterministic key
+    keys_after_first = set(DKV.keys())
+    for _ in range(5):
+        pn = _post(srv, "/3/Predictions/models/leak_gbm/frames/leaktr")
+        assert pn["predictions_frame"]["name"] == pkey
+    assert set(DKV.keys()) == keys_after_first, (
+        "repeat /3/Predictions calls leaked DKV keys: "
+        f"{sorted(set(DKV.keys()) - keys_after_first)}")
+
+
 def test_predictions_route_options(server):
     """POST /3/Predictions with predict_contributions / leaf_node_assignment
     flags (ModelMetricsHandler.predict options)."""
