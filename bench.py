@@ -204,6 +204,93 @@ def bench_score():
             {"auc": round(float(perf.auc()), 5)})
 
 
+def _write_ingest_csv(path: str, target_mb: float, seed: int = 0) -> int:
+    """Synthesize a mixed numeric/enum CSV of ~target_mb MB (16 numeric
+    columns with NA holes + 4 enum columns, quoted cells in one — the
+    HIGGS-like numeric-heavy shape the flagship GBM bench ingests) and
+    return the row count. Built in vectorized blocks so generation stays a
+    small fraction of the parse being measured."""
+    rng = np.random.default_rng(seed)
+    levels = np.asarray([f"lvl{i}" for i in range(40)])
+    n_num = 16
+    block = 50_000
+    rows = 0
+    with open(path, "w") as f:
+        f.write(",".join([f"n{i}" for i in range(n_num)]
+                         + ["e0", "e1", "e2", "e3"]) + "\n")
+        while f.tell() < target_mb * 1e6:
+            cols = []
+            for j in range(n_num):
+                c = rng.normal(scale=10.0 ** (j % 6), size=block) \
+                    .round(4).astype(str)
+                c[rng.random(block) < 0.03] = "NA"   # NA-token holes
+                cols.append(c)
+            cols.append(rng.integers(0, 7, block).astype(str))
+            cols.append(rng.choice(levels, block))
+            cols.append(np.char.add("city ", rng.integers(0, 200, block).astype(str)))
+            # ~1% quoted cells carrying the separator — enough to exercise
+            # the RFC-4180 fallback without drowning the bulk fast path
+            e2 = np.char.add("tag", rng.integers(0, 9, block).astype(str))
+            qm = rng.random(block) < 0.01
+            e2 = np.where(qm, np.char.add(np.char.add('"q,', e2), '"'), e2)
+            cols.append(e2)
+            out = cols[0]
+            for c in cols[1:]:
+                out = np.char.add(np.char.add(out, ","), c)
+            f.write("\n".join(out.tolist()) + "\n")
+            rows += block
+    return rows
+
+
+def bench_ingest():
+    """Chunked parallel CSV ingest (ISSUE 2): ~50 MB mixed numeric/enum CSV
+    in tmp; reports rows/s of the N-thread chunked parse plus the speedups
+    vs a 1-thread chunked run and vs the legacy per-line tokenizer
+    (acceptance: chunked ≥ 3× legacy on a multi-core host)."""
+    import shutil
+    import tempfile
+
+    mb = float(os.environ.get("BENCH_INGEST_MB", 50))
+    from h2o3_tpu.frame.parse import parse_csv
+
+    tmpdir = tempfile.mkdtemp(prefix="h2o3_ingest_bench_")
+    path = os.path.join(tmpdir, "ingest_bench.csv")
+    try:
+        nrows = _write_ingest_csv(path, mb)
+
+        def run(nthreads=None, legacy=False, reps=2):
+            best = float("inf")
+            for _ in range(reps):   # best-of-reps damps scheduler noise
+                if legacy:
+                    os.environ["H2O3_INGEST_LEGACY"] = "1"
+                try:
+                    t0 = time.perf_counter()
+                    fr = parse_csv(path, nthreads=nthreads)
+                    best = min(best, time.perf_counter() - t0)
+                finally:
+                    os.environ.pop("H2O3_INGEST_LEGACY", None)
+                assert fr.nrow == nrows, (fr.nrow, nrows)
+            return nrows / best, best
+
+        legacy_rps, legacy_s = run(legacy=True, reps=1)
+        st_rps, st_s = run(nthreads=1)
+        par_rps, par_s = run(nthreads=os.cpu_count() or 1)
+        size_mb = os.path.getsize(path) / 1e6
+        return (f"csv_ingest_{int(round(size_mb))}mb_rows_per_s", par_rps,
+                {"unit_override": "rows/s",
+                 "wall_s": round(par_s, 3),
+                 "rows": nrows,
+                 "mb": round(size_mb, 1),
+                 "mb_per_s": round(size_mb / par_s, 1),
+                 "nthreads": os.cpu_count() or 1,
+                 "speedup_vs_legacy": round(par_rps / legacy_rps, 2),
+                 "speedup_vs_1thread": round(par_rps / st_rps, 2),
+                 "legacy_rows_per_s": round(legacy_rps),
+                 "onethread_rows_per_s": round(st_rps)})
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 _SCALING_CHILD = r"""
 import json, os, time, sys
 import numpy as np
@@ -349,7 +436,7 @@ R02_BASELINE = {
 # not the machine. Repeat each wall-clock config and report the BEST run
 # (first run also absorbs executable deserialization for later ones).
 DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
-                   "scaling": 1}
+                   "scaling": 1, "ingest": 2}
 
 
 def _probe_accelerator(timeout_s: float):
@@ -482,7 +569,8 @@ def main():
     _phz.install_listener()
     fn = {"gbm": bench_gbm, "glm": bench_glm, "dl": bench_dl,
           "xgb_rank": bench_xgb_rank, "automl": bench_automl,
-          "score": bench_score, "scaling": bench_scaling}[config]
+          "score": bench_score, "scaling": bench_scaling,
+          "ingest": bench_ingest}[config]
     # cold is strictly one run: repeats within a process share the live
     # executable cache, so any second run would be warm yet labeled cold
     repeats = 1 if cold else int(os.environ.get(
@@ -500,7 +588,7 @@ def main():
         _emit(_fail_line(config, f"bench raised: {e!r}"))
         sys.exit(0)
     metric = runs[0][0]
-    higher_better = (metric.endswith("samples_per_s")
+    higher_better = (metric.endswith(("samples_per_s", "rows_per_s"))
                      or metric.endswith("speedup"))
     values = [r[1] for r in runs]
     best_i = (max if higher_better else min)(

@@ -1,0 +1,409 @@
+"""Parallel chunked CSV tokenizer — the in-process half of ingest.
+
+Reference parity: `water/parser/ParseDataset.java` runs `MultiFileParseTask`
+as a multithreaded MRTask over byte ranges, so one node saturates every core
+while parsing; `water/parser/CsvParser.java` is the per-chunk tokenizer it
+drives. Here the same shape: a process's byte payload (the whole file in
+single-process mode, this process's byte range under
+`distributed_parse.py`) is split into cache-sized chunks at RFC-4180-safe
+line boundaries, chunks tokenize concurrently on a `ThreadPoolExecutor`
+(the numpy string ufuncs, the float casts, and the native ctypes tokenizer
+all release the GIL), and the per-chunk token matrices concatenate in file
+order, so downstream coercion and the phase-2 categorical merge see exactly
+the token stream a single-chunk parse would produce.
+
+Boundary rules (the `byte_range` / first-line-after-start semantics of
+distributed_parse.py, extended with quote healing):
+
+- a chunk ends immediately after a ``\\n`` byte, so no chunk starts
+  mid-line;
+- a ``\\n`` preceded by an ODD number of ``"`` bytes is inside an open
+  RFC-4180 quoted field (an escaped ``""`` contributes two quotes, which
+  preserves the parity invariant) and is never chosen as a boundary — a
+  quoted field containing the separator or an embedded newline lands whole
+  inside one chunk and tokenizes exactly like the single-chunk path.
+
+Inside a chunk, the per-line `str.split`/`csv.reader` loop is replaced by
+one bulk pass: lines with no quote character and exactly ``ncol`` fields
+(the overwhelmingly common case) are joined and split ONCE, stripped with
+vectorized string ufuncs, and reshaped to an (nrows, ncol) token matrix;
+only quoted or ragged lines fall back to the per-line reader, with results
+spliced back in row order so semantics stay bit-identical to
+`parse._split_lines`.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# L2-cache-scale working set per chunk: big enough to amortize per-chunk
+# setup, small enough that several chunks are in flight even for modest files
+DEFAULT_CHUNK_BYTES = 4 << 20
+
+# numpy ≥2.0 ships ufunc-backed string ops (np.strings) that run at C speed
+# and release the GIL; np.char is the semantically identical slow fallback
+_S = np.strings if hasattr(np, "strings") else np.char
+
+
+def default_nthreads() -> int:
+    env = os.environ.get("H2O3_PARSE_THREADS", "")
+    if env.strip():
+        return max(1, int(env))
+    return max(1, os.cpu_count() or 1)
+
+
+def default_chunk_bytes() -> int:
+    env = os.environ.get("H2O3_PARSE_CHUNK_BYTES", "")
+    if env.strip():
+        return max(1, int(env))
+    return DEFAULT_CHUNK_BYTES
+
+
+def plan_chunks(data: bytes, target_bytes: Optional[int] = None
+                ) -> List[Tuple[int, int]]:
+    """Split `data` into ~target-sized [lo, hi) chunks that end right after
+    a newline byte whose preceding quote count is even (i.e. a real record
+    boundary, never the inside of an RFC-4180 quoted field)."""
+    n = len(data)
+    target = target_bytes or default_chunk_bytes()
+    if n <= target:
+        return [(0, n)] if n else []
+    buf = np.frombuffer(data, dtype=np.uint8)
+    nl = np.flatnonzero(buf == 0x0A)          # b"\n"
+    if nl.size:
+        q = np.flatnonzero(buf == 0x22)       # b'"'
+        if q.size:
+            # quotes-before-newline parity: odd ⇒ the newline is embedded in
+            # an open quoted field ⇒ not a legal cut point
+            nl = nl[np.searchsorted(q, nl) % 2 == 0]
+    if nl.size == 0:
+        return [(0, n)]
+    bounds = nl + 1                            # cut AFTER the newline
+    targets = np.arange(target, n, target)
+    idx = np.searchsorted(bounds, targets)     # first boundary ≥ each target
+    cuts = np.unique(bounds[idx[idx < bounds.size]])
+    cuts = cuts[cuts < n]
+    edges = [0] + [int(c) for c in cuts] + [n]
+    return list(zip(edges[:-1], edges[1:]))
+
+
+def split_csv_line(ln: str, sep: str) -> List[str]:
+    """One line's tokens with `parse._split_lines` dispatch semantics:
+    lines holding a double quote take the RFC-4180 csv reader (quoted
+    cells may hold the separator; quoting preserves edge whitespace and
+    literal quotes), everything else the fast plain split with
+    strip-spaces-then-quotes. The single shared implementation behind the
+    generic tokenizer, the fast path's rare-line patcher, and the
+    parse_setup sampler — quote/strip semantics cannot drift apart."""
+    if '"' in ln:
+        return next(_csv.reader([ln], delimiter=sep))
+    return [p.strip().strip('"') for p in ln.split(sep)]
+
+
+def _tokens_need_strip(joined: str, sep: str) -> bool:
+    """Can any token of the joined bulk lines carry edge whitespace?
+    Conservative but exact-when-False: bulk lines hold no quote character
+    and no line-break class character (splitlines consumed those), so a
+    token edge is either adjacent to `sep` or at the ends of `joined`
+    (line edges land next to the joining `sep`). Non-ASCII text gets
+    `True` wholesale rather than enumerating the unicode space classes."""
+    if not joined:
+        return False
+    if not joined.isascii():
+        return True
+    if joined[0].isspace() or joined[-1].isspace():
+        return True
+    for ws in (" ", "\t", "\x0b", "\f"):
+        if (ws + sep) in joined or (sep + ws) in joined:
+            return True
+    return False
+
+
+def tokenize_block(lines: Sequence[str], sep: str, ncol: int) -> np.ndarray:
+    """Tokenize non-blank lines into an (nrows, ncol) object matrix with
+    `parse._split_lines` semantics: quoted lines through the RFC-4180 csv
+    reader (dequoted, whitespace preserved), plain lines split + stripped
+    of spaces then quotes, short rows padded with "", extra fields dropped.
+    """
+    nrows = len(lines)
+    out = np.empty((nrows, ncol), dtype=object)
+    if nrows == 0:
+        return out
+    u = np.asarray(lines)
+    bulk = (_S.find(u, '"') < 0) & (_S.count(u, sep) == ncol - 1)
+    bulk_idx = np.flatnonzero(bulk)
+    if bulk_idx.size:
+        if bulk_idx.size == nrows:
+            joined = sep.join(lines)
+        else:
+            joined = sep.join([lines[i] for i in bulk_idx])
+        # each bulk line holds exactly ncol-1 separators, so one global
+        # split yields nrows·ncol tokens that reshape back row-major;
+        # the strip pass (and the always-no-op quote strip — bulk lines
+        # hold no quote) is elided when no token can have edge whitespace
+        toks = joined.split(sep)
+        if _tokens_need_strip(joined, sep):
+            toks = [t.strip().strip('"') for t in toks]
+        out[bulk_idx, :] = np.asarray(toks, dtype=object).reshape(-1, ncol)
+    if bulk_idx.size != nrows:
+        for i in np.flatnonzero(~bulk):
+            parts = split_csv_line(lines[i], sep)
+            np_ = len(parts)
+            out[i, :] = (parts[:ncol] if np_ >= ncol
+                         else parts + [""] * (ncol - np_))
+    return out
+
+
+def _chunk_text_to_block(text: str, sep: str, ncol: int,
+                         drop_first_line: bool) -> np.ndarray:
+    lines = text.splitlines()
+    if drop_first_line:
+        lines = lines[1:]
+    lines = [ln for ln in lines if ln.strip()]
+    return tokenize_block(lines, sep, ncol)
+
+
+# columns wider than this would make the offset-gather index matrices (and
+# the fixed-width unicode columns) memory-heavy; such chunks take the
+# generic object-token path instead
+_MAX_FAST_TOKEN_W = 256
+
+
+def _fast_chunk_columns(chunk: bytes, sep: str, ncol: int,
+                        drop_first_line: bool) -> Optional[List[np.ndarray]]:
+    """Offset tokenizer — the zero-python-object fast path of the chunked
+    pipeline. Token [start, end) byte offsets are derived with searchsorted
+    algebra over the separator/newline positions, gathered into per-column
+    fixed-width byte matrices, and viewed as unicode columns: no python
+    str objects exist for the (overwhelmingly common) plain lines, and
+    every step is a GIL-releasing numpy kernel, so chunk workers scale on
+    real cores. Rare quoted/ragged lines are tokenized per line and
+    row-patched into the columns with `_split_lines` semantics.
+
+    Returns per-column ``U`` arrays, or None when the chunk needs the
+    generic text path: non-ASCII bytes (multi-byte code points, exotic
+    unicode line breaks / space classes), control bytes that
+    `str.splitlines` treats as line breaks, NUL bytes, a multi-byte
+    separator, or pathologically wide tokens."""
+    sep_b = sep.encode() if len(sep) == 1 else b""
+    if len(sep_b) != 1:
+        return None
+    b = np.frombuffer(chunk, dtype=np.uint8)
+    if b.size == 0:
+        return [np.empty(0, dtype="S1") for _ in range(ncol)]
+    if (b >= 0x80).any() or np.isin(
+            b, (0x00, 0x0B, 0x0C, 0x1C, 0x1D, 0x1E)).any():
+        return None
+    # a \r NOT followed by \n is a line break for str.splitlines but not
+    # for this byte scan — such chunks take the generic path
+    crpos = np.flatnonzero(b == 0x0D)
+    if crpos.size and (crpos[-1] == b.size - 1
+                       or not bool(np.all(b[crpos + 1] == 0x0A))):
+        return None
+    nl = np.flatnonzero(b == 0x0A)
+    starts = np.concatenate([[0], nl + 1])
+    ends = np.concatenate([nl, [b.size]])
+    # CRLF: the \r belongs to the terminator, not the line content
+    has_content = ends > starts
+    cr = np.zeros(len(ends), bool)
+    cr[has_content] = b[ends[has_content] - 1] == 0x0D
+    ends = ends - cr
+    if drop_first_line:
+        starts, ends = starts[1:], ends[1:]
+    # blank filter ≡ `ln.strip()`: a line of only space/tab is dropped
+    # (\r needs no slot — line ends are already trimmed past it).
+    # whitespace positions are sparse in data files, so searchsorted over
+    # them beats a full-chunk cumsum
+    wpos = np.flatnonzero((b == 0x20) | (b == 0x09))
+    ws_in_line = np.searchsorted(wpos, ends) - np.searchsorted(wpos, starts)
+    blank = ws_in_line == (ends - starts)
+    starts, ends = starts[~blank], ends[~blank]
+    nrows = len(starts)
+    if nrows == 0:
+        return [np.empty(0, dtype="S1") for _ in range(ncol)]
+    qpos = np.flatnonzero(b == 0x22)
+    has_q = (np.searchsorted(qpos, ends)
+             - np.searchsorted(qpos, starts)) > 0
+    sp = np.flatnonzero(b == sep_b[0])
+    a_i = np.searchsorted(sp, starts)
+    nsep = np.searchsorted(sp, ends) - a_i
+    ok = ~has_q & (nsep == ncol - 1)
+    ok_rows = np.flatnonzero(ok)
+    bad_rows = np.flatnonzero(~ok)
+    if ncol > 1 and ok_rows.size:
+        smat = sp[a_i[ok][:, None] + np.arange(ncol - 1)[None, :]]
+        tok_s = np.concatenate([starts[ok][:, None], smat + 1], axis=1)
+        tok_e = np.concatenate([smat, ends[ok][:, None]], axis=1)
+    else:
+        tok_s = starts[ok][:, None]
+        tok_e = ends[ok][:, None]
+    lens = (tok_e - tok_s).astype(np.int32)
+    if ok_rows.size and int(lens.max()) > _MAX_FAST_TOKEN_W:
+        return None
+    # the rare quoted/ragged lines: per-line tokens, patched in below
+    bad_toks = []
+    for i in bad_rows:
+        parts = split_csv_line(
+            chunk[starts[i]:ends[i]].decode(), sep)   # ASCII by the gate
+        if len(parts) < ncol:
+            parts = parts + [""] * (ncol - len(parts))
+        bad_toks.append(parts[:ncol])
+    if bad_toks and max(len(t) for row in bad_toks for t in row) \
+            > _MAX_FAST_TOKEN_W:
+        return None
+    tok_s = tok_s.astype(np.int32)
+    # does ANY plain token carry edge whitespace? whitespace strips only at
+    # token edges, i.e. where a ws byte neighbours a separator/newline/CR
+    # or the chunk bounds — vectorized over the (sparse) ws positions.
+    # When none does, the per-token strip is provably a no-op and elided
+    # (the quote strip always is: ok lines hold no quote; ASCII gating
+    # keeps str.strip's unicode space classes out of play).
+    if wpos.size:
+        edge = np.isin(b[np.minimum(wpos + 1, b.size - 1)],
+                       (sep_b[0], 0x0A, 0x0D))
+        edge |= np.isin(b[np.maximum(wpos - 1, 0)], (sep_b[0], 0x0A))
+        edge |= (wpos == 0) | (wpos == b.size - 1)
+        needs_strip = bool(edge.any())
+    else:
+        needs_strip = False
+    w_max = int(lens.max()) if ok_rows.size else 1
+    bp = np.concatenate([b, np.zeros(w_max, np.uint8)])  # overrun pad
+    cols: List[np.ndarray] = []
+    span = np.arange(0, 1, dtype=np.int32)
+    for c in range(ncol):
+        w = int(lens[:, c].max()) if ok_rows.size else 0
+        if bad_toks:
+            w = max(w, max(len(row[c]) for row in bad_toks))
+        w = max(w, 1)
+        # columns stay BYTES (S): the chunk is ASCII-gated, S→float64 and
+        # S unique/sort run ~2× faster than their UCS4 equivalents, and
+        # byte order equals ASCII code-point order so domains sort the same
+        col = np.zeros(nrows, dtype=f"S{w}")
+        if ok_rows.size:
+            if len(span) != w:
+                span = np.arange(w, dtype=np.int32)
+            m = bp[tok_s[:, c, None] + span[None, :]]
+            m[span[None, :] >= lens[:, c, None]] = 0
+            toks = m.view(f"S{w}").ravel()
+            if needs_strip:
+                toks = _S.strip(toks)
+            col[ok_rows] = toks
+        for j, i in enumerate(bad_rows):
+            col[i] = bad_toks[j][c]
+        cols.append(col)
+    return cols
+
+
+def _run(fn, idxs, nthreads: int) -> list:
+    if nthreads <= 1 or len(idxs) <= 1:
+        return [fn(i) for i in idxs]
+    with ThreadPoolExecutor(max_workers=min(nthreads, len(idxs))) as ex:
+        return list(ex.map(fn, idxs))
+
+
+def tokenize_data(
+    data: bytes,
+    sep: str,
+    header: bool,
+    ncol: int,
+    nthreads: Optional[int] = None,
+    chunk_bytes: Optional[int] = None,
+    use_native: bool = True,
+) -> Tuple[List[np.ndarray], dict]:
+    """Phase-1 tokenize of a byte payload → per-column arrays + run facts.
+
+    Tries the native per-chunk numeric tokenizer first (all-or-nothing:
+    every chunk must parse fully numeric, mirroring the whole-file native
+    semantics — a single non-numeric chunk would otherwise mix float and
+    token columns and corrupt the categorical intern). Falls back to the
+    vectorized python tokenizer per chunk. Returns (columns, info) where
+    columns are object token arrays (or float64 on the native path) and
+    info = {n_chunks, n_threads, native}.
+    """
+    nthreads = nthreads if nthreads is not None else default_nthreads()
+    chunks = plan_chunks(data, chunk_bytes)
+    info = dict(n_chunks=len(chunks), n_threads=min(nthreads,
+                                                    max(len(chunks), 1)),
+                native=False)
+    if not chunks:
+        return [np.empty(0, dtype=object) for _ in range(ncol)], info
+    # the native field loop is quote-blind (it would split a quoted numeric
+    # like "1,234" at the embedded separator and silently mis-column the
+    # row) — any quote byte in the payload routes around it
+    if use_native and b'"' not in data:
+        from ..native import loader as native_loader  # late; optional .so
+
+        if native_loader.available():
+            def _nat(i):
+                lo, hi = chunks[i]
+                return native_loader.tokenize_chunk_numeric(
+                    data, lo, hi, sep, ncol, header and i == 0)
+
+            mats = _run(_nat, range(len(chunks)), nthreads)
+            if all(m is not None for m in mats):
+                mat = (mats[0] if len(mats) == 1
+                       else np.concatenate(mats, axis=0))
+                info["native"] = True
+                return [mat[:, c] for c in range(ncol)], info
+
+    def _py(i):
+        lo, hi = chunks[i]
+        chunk = data[lo:hi]
+        fast = _fast_chunk_columns(chunk, sep, ncol, header and i == 0)
+        if fast is not None:
+            return fast
+        text = chunk.decode("utf-8", errors="replace")
+        mat = _chunk_text_to_block(text, sep, ncol, header and i == 0)
+        return [mat[:, c] for c in range(ncol)]
+
+    mats = _run(_py, range(len(chunks)), nthreads)
+    if len(mats) == 1:
+        return mats[0], info
+    # fast chunks yield bytes (S) columns, generic chunks object-of-str;
+    # mixing would corrupt comparisons, so when any chunk went generic the
+    # S columns widen to unicode first (S+U concat then promotes to object
+    # holding str-likes throughout). All-fast stays S, widths widening to
+    # the max via np.concatenate's dtype promotion.
+    mixed = any(m[0].dtype.kind == "O" for m in mats)
+    out = []
+    for c in range(ncol):
+        parts = [m[c] for m in mats]
+        if mixed:
+            parts = [p.astype("U") if p.dtype.kind == "S" else p
+                     for p in parts]
+        out.append(np.concatenate(parts))
+    return out, info
+
+
+def tokenize_lines(
+    lines: Sequence[str],
+    sep: str,
+    ncol: int,
+    nthreads: Optional[int] = None,
+    block_rows: Optional[int] = None,
+) -> Tuple[List[np.ndarray], dict]:
+    """Tokenize pre-split lines (the distributed byte-range path, where
+    `read_range_lines` already owns the cross-process boundary semantics)
+    in parallel row blocks. Block membership cannot change per-line
+    results, so the output is bit-identical to one whole-list pass.
+    Returns (columns, info) like `tokenize_data`."""
+    n = len(lines)
+    nthreads = nthreads if nthreads is not None else default_nthreads()
+    if block_rows is None:
+        # ~4 blocks per worker bounds scheduling skew without tiny blocks
+        block_rows = max(4096, -(-n // max(nthreads * 4, 1)))
+    starts = list(range(0, n, block_rows)) or [0]
+
+    def _blk(s):
+        return tokenize_block(lines[s:s + block_rows], sep, ncol)
+
+    mats = _run(_blk, starts, nthreads)
+    mat = mats[0] if len(mats) == 1 else np.concatenate(mats, axis=0)
+    info = dict(n_chunks=len(starts), n_threads=min(nthreads, len(starts)),
+                native=False)
+    return [mat[:, c] for c in range(ncol)], info

@@ -27,13 +27,14 @@ runs.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .frame import Frame
-from .parse import _NA_TOKENS, _split_lines, parse_setup
-from .vec import Vec
+from .parse import _NA_TOKENS, parse_setup
+from .vec import Vec, bulk_try_numeric
 
 # NA tokens of Vec.from_numpy's intern path — kept separate from the parser's
 # wider _NA_TOKENS so distributed enum codes stay bit-identical to the
@@ -156,9 +157,8 @@ def read_range_lines(path: str, start: int, end: int) -> List[str]:
 # -- phase 2+3: global type vote, domain union, renumber ---------------------
 def _try_numeric(col: np.ndarray):
     try:
-        return np.asarray(
-            [np.nan if v in _NUM_NA else float(v) for v in col],
-            dtype=np.float64)
+        # tokenizer columns are str by construction → skip the type scan
+        return bulk_try_numeric(col, _NUM_NA, assume_str=True)
     except (TypeError, ValueError):
         return None
 
@@ -187,70 +187,93 @@ def parse_csv_distributed(
     collectives — identical to `parse_csv`."""
     import jax
 
+    from . import chunked as _chunked
+    from . import ingest_stats as _stats
+
+    t_start = time.perf_counter()
+    marks: Dict[str, float] = {}
     rank, nranks = jax.process_index(), jax.process_count()
-    setup = parse_setup(path, sep=sep)  # deterministic ⇒ same on every rank
-    if header is None:
-        header = setup["header"]
-    names = list(col_names) if col_names else setup["names"]
-    sep = setup["sep"]
+    with _stats.stage(marks, "setup"):
+        setup = parse_setup(path, sep=sep)  # deterministic ⇒ same on every rank
+        if header is None:
+            header = setup["header"]
+        names = list(col_names) if col_names else setup["names"]
+        sep = setup["sep"]
 
     size = os.path.getsize(path)
     start, end = byte_range(size, rank, nranks)
-    lines = read_range_lines(path, start, end)
+    with _stats.stage(marks, "read"):
+        lines = read_range_lines(path, start, end)
     if header and rank == 0 and lines:
         lines = lines[1:]
-    cols = _split_lines(lines, sep, len(names))
+    # phase-1 tokenize of this process's range: parallel row blocks through
+    # the same vectorized tokenizer as parse_csv (bit-identical to the old
+    # _split_lines pass, pinned by tests/test_parse_parallel.py)
+    with _stats.stage(marks, "tokenize"):
+        cols, tok_info = _chunked.tokenize_lines(lines, sep, len(names))
 
     col_types = col_types or {}
     vecs: Dict[str, Vec] = {}
     for i, name in enumerate(names):
-        hint = col_types.get(name)
-        col = cols[i]
-        if hint in ("real", "int", "numeric", "float"):
-            vals = np.asarray(
-                [np.nan if str(v).strip() in _NA_TOKENS else float(v)
-                 for v in col], dtype=np.float64)
-            fin = vals[np.isfinite(vals)]
-            mx = float(np.abs(fin).max()) if fin.size else 0.0
-            big = float(_allgather_f64_vec(np.asarray([mx]))[:, 0].max())
-            # global _maybe_f32: downcast only if the WHOLE column fits
-            vecs[name] = Vec(vals if big > (1 << 24)
-                             else vals.astype(np.float32), "real")
-            continue
-        if hint == "string":
-            vecs[name] = Vec(None, "string", strings=col)
-            continue
-        # numeric unless ANY process fails to parse numeric (the whole-file
-        # try of Vec.from_numpy). One fact vector per column:
-        # [parses_numeric, has_finite, all_int_or_abstain, max_abs] — an
-        # all-NA shard abstains from the int vote, and the f32 downcast is
-        # decided on the GLOBAL max magnitude (both match Vec.from_numpy
-        # over the whole column).
-        as_num = None if hint in ("enum", "factor", "categorical") \
-            else _try_numeric(col)
-        if as_num is not None:
-            fin = as_num[np.isfinite(as_num)]
-            facts = [1.0, float(fin.size > 0),
-                     1.0 if (fin.size == 0
-                             or bool(np.all(fin == np.round(fin)))) else 0.0,
-                     float(np.abs(fin).max()) if fin.size else 0.0]
-        else:
-            facts = [0.0, 0.0, 0.0, 0.0]
-        gf = _allgather_f64_vec(np.asarray(facts))
-        if as_num is not None and bool(np.all(gf[:, 0] == 1.0)):
-            is_int = bool(np.any(gf[:, 1] > 0)) and bool(np.all(gf[:, 2] == 1.0))
-            big = float(gf[:, 3].max())
-            vecs[name] = Vec(as_num if big > (1 << 24)
-                             else as_num.astype(np.float32),
-                             "int" if is_int else "real")
-            continue
-        local_dom = sorted(
-            {str(v) for v in col if v not in _ENUM_NA})
-        vecs[name] = _vec_with_domain(col, _union_domains(local_dom))
+        t_col = time.perf_counter()
+        v = _coerce_column_global(cols[i], col_types.get(name))
+        # numeric/time columns book "coerce"; enum/string book "intern"
+        # (incl. the phase-2 domain-union collectives) — same buckets as
+        # parse_csv, surfaced at /3/Profiler and /3/Ingest/metrics
+        bucket = "intern" if v.type in ("enum", "string") else "coerce"
+        marks[bucket] = marks.get(bucket, 0.0) + (time.perf_counter() - t_col)
+        vecs[name] = v
 
-    fr = Frame(vecs, key=os.path.basename(path))
-    local_n = fr.nrow
-    counts = _allgather_int(local_n)
-    fr.dist = DistInfo(rank, nranks, local_n, sum(counts),
-                       sum(counts[:rank]))
+    with _stats.stage(marks, "place"):
+        fr = Frame(vecs, key=os.path.basename(path))
+        local_n = fr.nrow
+        counts = _allgather_int(local_n)
+        fr.dist = DistInfo(rank, nranks, local_n, sum(counts),
+                           sum(counts[:rank]))
+    _stats.record(path, local_n, end - start,
+                  time.perf_counter() - t_start, marks, distributed=True,
+                  **tok_info)
     return fr
+
+
+def _coerce_column_global(col: np.ndarray, hint: Optional[str]) -> Vec:
+    """Coerce one tokenized column with GLOBALLY consistent type/domain
+    decisions (the collectives replacing the reference's Categorical/DKV
+    traffic)."""
+    if hint in ("real", "int", "numeric", "float"):
+        vals = bulk_try_numeric(col, _NA_TOKENS, strip_tokens=True,
+                                assume_str=True)
+        fin = vals[np.isfinite(vals)]
+        mx = float(np.abs(fin).max()) if fin.size else 0.0
+        big = float(_allgather_f64_vec(np.asarray([mx]))[:, 0].max())
+        # global _maybe_f32: downcast only if the WHOLE column fits
+        return Vec(vals if big > (1 << 24)
+                   else vals.astype(np.float32), "real")
+    if hint == "string":
+        return Vec(None, "string", strings=col)
+    # numeric unless ANY process fails to parse numeric (the whole-file
+    # try of Vec.from_numpy). One fact vector per column:
+    # [parses_numeric, has_finite, all_int_or_abstain, max_abs] — an
+    # all-NA shard abstains from the int vote, and the f32 downcast is
+    # decided on the GLOBAL max magnitude (both match Vec.from_numpy
+    # over the whole column).
+    as_num = None if hint in ("enum", "factor", "categorical") \
+        else _try_numeric(col)
+    if as_num is not None:
+        fin = as_num[np.isfinite(as_num)]
+        facts = [1.0, float(fin.size > 0),
+                 1.0 if (fin.size == 0
+                         or bool(np.all(fin == np.round(fin)))) else 0.0,
+                 float(np.abs(fin).max()) if fin.size else 0.0]
+    else:
+        facts = [0.0, 0.0, 0.0, 0.0]
+    gf = _allgather_f64_vec(np.asarray(facts))
+    if as_num is not None and bool(np.all(gf[:, 0] == 1.0)):
+        is_int = bool(np.any(gf[:, 1] > 0)) and bool(np.all(gf[:, 2] == 1.0))
+        big = float(gf[:, 3].max())
+        return Vec(as_num if big > (1 << 24)
+                   else as_num.astype(np.float32),
+                   "int" if is_int else "real")
+    local_dom = sorted(
+        {str(v) for v in col if v not in _ENUM_NA})
+    return _vec_with_domain(col, _union_domains(local_dom))

@@ -10,15 +10,20 @@ TPU-native shape of the same design: each host parses its own byte range of
 the file(s) into numpy columns (phase 1, embarrassingly parallel), then
 categorical domains are unioned globally and local codes renumbered
 (phase 2 — the `Categorical` merge) before the columns are placed into HBM.
-Single-process mode degenerates to "one byte range". A native C++ tokenizer
-(`h2o3_tpu/native/` via ctypes) accelerates phase 1 when built; the numpy
-path is the always-available fallback.
+Single-process mode degenerates to "one byte range". Inside a process,
+phase 1 is itself parallel: the byte range splits into RFC-4180-safe
+chunks tokenized concurrently (`frame/chunked.py`), with the native C++
+tokenizer (`h2o3_tpu/native/` via ctypes) slotting in per chunk when
+built and a vectorized numpy path always available. Stage timings and
+throughput counters land in `frame/ingest_stats.py` (surfaced at
+/3/Profiler and /3/Ingest/metrics — see docs/ingest.md).
 """
 
 from __future__ import annotations
 
 import io
 import os
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -27,6 +32,27 @@ from .frame import Frame
 from .vec import Vec
 
 _NA_TOKENS = {"", "NA", "na", "N/A", "nan", "NaN", "null", "NULL", "?"}
+
+
+def _count_unquoted(ln: str, ch: str) -> int:
+    """Occurrences of `ch` OUTSIDE double-quoted regions — separator
+    guessing must not count a comma inside `"last, first"`."""
+    cnt, inq = 0, False
+    for c in ln:
+        if c == '"':
+            inq = not inq
+        elif c == ch and not inq:
+            cnt += 1
+    return cnt
+
+
+def _split_sample_line(ln: str, sep: str) -> List[str]:
+    """Quote-aware split for the setup sample — the tokenizer's own
+    dispatch (chunked.split_csv_line), so the column count / type guess
+    sees exactly the fields the parse phase will produce."""
+    from .chunked import split_csv_line
+
+    return split_csv_line(ln, sep)
 
 
 def parse_setup(path: str, sample_bytes: int = 1 << 16, sep: Optional[str] = None):
@@ -38,19 +64,26 @@ def parse_setup(path: str, sample_bytes: int = 1 << 16, sep: Optional[str] = Non
     if not lines:
         raise ValueError(f"empty file {path}")
     if sep is None:
-        counts = {c: lines[0].count(c) for c in [",", "\t", ";", "|", " "]}
+        counts = {c: _count_unquoted(lines[0], c)
+                  for c in [",", "\t", ";", "|", " "]}
         sep = max(counts, key=counts.get)
         if counts[sep] == 0:
             sep = ","
-    first = lines[0].split(sep)
-    header = not all(_is_num_or_na(t) for t in first)
+    first = _split_sample_line(lines[0], sep)
+    # header iff the first line holds a non-numeric token AND at least one
+    # data line follows — the lone line of a single-line file is DATA (a
+    # header over zero rows parses to an empty frame)
+    header = len(lines) > 1 and not all(_is_num_or_na(t) for t in first)
     data_lines = lines[1:] if header else lines
     ncol = len(first)
+    # split each sample line ONCE and index columns from the cached parts
+    # (was O(lines·ncol²): a re-split of every line inside the column loop)
+    parts = [_split_sample_line(ln, sep) for ln in data_lines]
     types = []
     for c in range(ncol):
-        col = [ln.split(sep)[c].strip() if c < len(ln.split(sep)) else "" for ln in data_lines]
-        numeric = all(_is_num_or_na(t) for t in col)
-        types.append("numeric" if numeric else "enum")
+        col = [p[c].strip() if c < len(p) else "" for p in parts]
+        types.append("numeric" if all(_is_num_or_na(t) for t in col)
+                     else "enum")
     names = [t.strip().strip('"') for t in first] if header else [f"C{i+1}" for i in range(ncol)]
     return {"sep": sep, "header": header, "names": names, "types": types}
 
@@ -72,29 +105,103 @@ def parse_csv(
     header: Optional[bool] = None,
     col_names: Optional[Sequence[str]] = None,
     col_types: Optional[Dict[str, str]] = None,
+    nthreads: Optional[int] = None,
+    chunk_bytes: Optional[int] = None,
 ) -> Frame:
-    """Parse one CSV file into a Frame (phase-1 tokenize + phase-2 intern)."""
-    setup = parse_setup(path, sep=sep)
-    if header is None:
-        header = setup["header"]
-    names = list(col_names) if col_names else setup["names"]
-    sep = setup["sep"]
+    """Parse one CSV file into a Frame: chunked multithreaded phase-1
+    tokenize (frame/chunked.py — RFC-4180-safe byte chunks on a thread
+    pool, native tokenizer per chunk when built), vectorized column
+    coercion, then the phase-2 categorical intern. Per-stage wall-clock is
+    recorded under ``ingest_*`` in runtime/phases and in
+    frame/ingest_stats (surfaced at /3/Profiler and /3/Ingest/metrics).
 
-    from ..native import loader as native_loader  # late import; optional .so
+    `nthreads`/`chunk_bytes` override the H2O3_PARSE_THREADS /
+    H2O3_PARSE_CHUNK_BYTES defaults; chunk count never changes the result
+    (pinned bit-identical by tests/test_parse_parallel.py). Setting
+    H2O3_INGEST_LEGACY=1 routes through the historical per-line tokenizer
+    (the bench.py comparator)."""
+    from . import chunked as _chunked
+    from . import ingest_stats as _stats
 
-    cols = native_loader.tokenize_csv(path, sep, header, len(names))
-    if cols is None:
-        cols = _tokenize_numpy(path, sep, header, len(names))
+    t_start = time.perf_counter()
+    marks: Dict[str, float] = {}
+    with _stats.stage(marks, "setup"):
+        setup = parse_setup(path, sep=sep)
+        if header is None:
+            header = setup["header"]
+        names = list(col_names) if col_names else setup["names"]
+        sep = setup["sep"]
+
+    legacy = os.environ.get("H2O3_INGEST_LEGACY", "") not in ("", "0")
+    if legacy:
+        from ..native import loader as native_loader  # late; optional .so
+
+        nbytes = os.path.getsize(path)
+        info = dict(n_chunks=1, n_threads=1, native=False)
+        with _stats.stage(marks, "tokenize"):
+            cols = native_loader.tokenize_csv(path, sep, header, len(names))
+            if cols is None:
+                cols = _tokenize_numpy(path, sep, header, len(names))
+            else:
+                info["native"] = True
+    else:
+        with _stats.stage(marks, "read"):
+            with open(path, "rb") as f:
+                data = f.read()
+        nbytes = len(data)
+        with _stats.stage(marks, "tokenize"):
+            # the native pass is all-or-nothing numeric; when the sample
+            # already guessed an enum column, don't scan-and-discard (the
+            # gate only affects speed — python numerics match strtod)
+            cols, info = _chunked.tokenize_data(
+                data, sep, header, len(names),
+                nthreads=nthreads, chunk_bytes=chunk_bytes,
+                use_native=all(t == "numeric" for t in setup["types"]))
 
     col_types = col_types or {}
+    # tokenizer columns are str by construction (native ones are float64 —
+    # _column_to_vec short-circuits on dtype), so the coercers may skip
+    # their per-element type scans
+    assume_str = not info.get("native", False)
+
+    def _coerce(arg):
+        i, name = arg
+        t_col = time.perf_counter()
+        if legacy:   # the seed's sequential per-element coercion
+            v = _legacy_tokens_to_vec(cols[i], col_types.get(name))
+        else:
+            v = _column_to_vec(cols[i], col_types.get(name),
+                               assume_str=assume_str)
+        return name, v, time.perf_counter() - t_col
+
+    # columns coerce independently (numpy casts/sorts release the GIL), so
+    # they share the tokenize pool's width; collectives don't exist here
+    # (the distributed path stays sequential for rank-ordered collectives),
+    # and the legacy comparator stays sequential like the seed
+    nthr = 1 if legacy else (
+        nthreads if nthreads is not None else _chunked.default_nthreads())
+    idxs = list(enumerate(names))
+    if nthr > 1 and len(idxs) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(nthr, len(idxs))) as ex:
+            coerced = list(ex.map(_coerce, idxs))
+    else:
+        coerced = [_coerce(a) for a in idxs]
     vecs = {}
-    for i, name in enumerate(names):
-        hint = col_types.get(name)
-        guessed = setup["types"][i] if i < len(setup["types"]) else "numeric"
-        if hint is None and guessed == "enum":
-            hint = None  # Vec.from_numpy will intern strings itself
-        vecs[name] = _column_to_vec(cols[i], hint)
-    return Frame(vecs, key=os.path.basename(path))
+    for name, v, dt in coerced:
+        # numeric/time columns book "coerce"; enum/string book "intern"
+        # (the categorical merge) — same decomposition /3/Profiler shows.
+        # Under the pool these per-column seconds overlap, so bucket sums
+        # may exceed the parse wall-clock.
+        bucket = "intern" if v.type in ("enum", "string") else "coerce"
+        marks[bucket] = marks.get(bucket, 0.0) + dt
+        vecs[name] = v
+    with _stats.stage(marks, "place"):
+        fr = Frame(vecs, key=os.path.basename(path))
+    _stats.record(path, fr.nrow, nbytes, time.perf_counter() - t_start,
+                  marks, legacy=legacy, **info)
+    return fr
 
 
 def _split_lines(lines: List[str], sep: str, ncol: int) -> List[np.ndarray]:
@@ -121,8 +228,11 @@ def _split_lines(lines: List[str], sep: str, ncol: int) -> List[np.ndarray]:
 
 
 def _tokenize_numpy(path: str, sep: str, header: bool, ncol: int) -> List[np.ndarray]:
-    """Fallback tokenizer: whole-file read + per-line split. The native C++
-    path (`native/csv_parser.cpp`) replaces this when compiled."""
+    """LEGACY tokenizer: whole-file read + per-line split. The chunked
+    pipeline (frame/chunked.py) replaced it as the default; it stays as the
+    bit-exact reference the parallel path is pinned against
+    (tests/test_parse_parallel.py) and as bench.py's speedup comparator
+    (H2O3_INGEST_LEGACY=1)."""
     with open(path, "rb") as f:
         text = f.read().decode("utf-8", errors="replace")
     lines = text.splitlines()
@@ -132,20 +242,63 @@ def _tokenize_numpy(path: str, sep: str, header: bool, ncol: int) -> List[np.nda
     return _split_lines(lines, sep, ncol)
 
 
-def _column_to_vec(col: np.ndarray, hint: Optional[str]) -> Vec:
-    if hint in ("real", "int", "numeric", "float"):
-        from .vec import _maybe_f32
+def _legacy_tokens_to_vec(col: np.ndarray, hint: Optional[str]) -> Vec:
+    """The SEED coercion (pre-chunked-pipeline): per-element `float()`
+    loops and object-array `np.unique` interning. Kept verbatim as the
+    other half of the H2O3_INGEST_LEGACY comparator — bench.py measures
+    the chunked pipeline against the seed's full tokenize+coerce path, and
+    tests/test_parse_parallel.py pins the new path bit-identical to it."""
+    from .vec import _all_int, _maybe_f32
 
+    if hint in ("real", "int", "numeric", "float"):
         vals = np.asarray(
-            [np.nan if str(v).strip() in _NA_TOKENS else float(v) for v in col],
-            dtype=np.float64,
-        )
+            [np.nan if str(v).strip() in _NA_TOKENS else float(v)
+             for v in col], dtype=np.float64)
         return Vec(_maybe_f32(vals), "real")
-    if hint in ("enum", "factor", "categorical"):
-        return Vec.from_numpy(col.astype(object), "enum")
     if hint == "string":
         return Vec(None, "string", strings=col)
-    return Vec.from_numpy(col)
+
+    def _intern(values: np.ndarray) -> Vec:
+        mask = np.asarray([v in ("", "NA", "na", None) for v in values])
+        domain, codes = np.unique(np.asarray(values)[~mask],
+                                  return_inverse=True)
+        full = np.full(len(values), -1, dtype=np.int32)
+        full[~mask] = codes.astype(np.int32)
+        return Vec(full, "enum", domain=[str(d) for d in domain])
+
+    if hint in ("enum", "factor", "categorical"):
+        return _intern(col.astype(object))
+    try:
+        as_num = np.asarray(
+            [np.nan if v in ("", "NA", "na", "nan", None) else float(v)
+             for v in col], dtype=np.float64)
+        return Vec(_maybe_f32(as_num),
+                   "real" if not _all_int(as_num) else "int")
+    except (TypeError, ValueError):
+        return _intern(col)
+
+
+def _column_to_vec(col: np.ndarray, hint: Optional[str],
+                   assume_str: bool = False) -> Vec:
+    if hint in ("real", "int", "numeric", "float"):
+        from .vec import _maybe_f32, bulk_try_numeric
+
+        if col.dtype.kind == "f":
+            # native-tokenized column: already float64 with NaN NAs
+            vals = np.asarray(col, dtype=np.float64)
+        else:
+            vals = bulk_try_numeric(col, _NA_TOKENS, strip_tokens=True,
+                                    assume_str=assume_str)
+        return Vec(_maybe_f32(vals), "real")
+    if hint in ("enum", "factor", "categorical"):
+        return Vec.from_numpy(col if col.dtype.kind in "US"
+                              else col.astype(object), "enum",
+                              assume_str=assume_str)
+    if hint == "string":
+        # the fast tokenizer's bytes columns decode for the string pool
+        return Vec(None, "string",
+                   strings=col.astype("U") if col.dtype.kind == "S" else col)
+    return Vec.from_numpy(col, assume_str=assume_str)
 
 
 def parse_svmlight(path: str) -> Frame:

@@ -21,6 +21,111 @@ import numpy as np
 
 TYPES = ("real", "int", "enum", "time", "string")
 
+# numpy ≥2.0 ships ufunc-backed string ops that run at C speed and release
+# the GIL; np.char is the semantically identical slow fallback
+_S = np.strings if hasattr(np, "strings") else np.char
+
+
+def _certified_str(arr: np.ndarray, assume_str: bool) -> bool:
+    """May the vectorized string kernels touch this array? ``U`` always;
+    ``S`` only under the tokenizer's `assume_str` certificate (its fast
+    path is ASCII-gated, so bytes⇄str round-trips are lossless); object
+    arrays when certified or verified all-`str` — any other element type
+    (floats, None, np.str_, user bytes) keeps the exact per-element loop
+    semantics. The single source of truth for every coercer's fast/slow
+    dispatch, so NA/strip/intern parity can't drift between them."""
+    kind = arr.dtype.kind
+    if kind == "U":
+        return True
+    if kind == "S":
+        return assume_str
+    if kind == "O":
+        return assume_str or all(type(v) is str for v in arr.tolist())
+    return False
+
+
+def bulk_try_numeric(col, na_tokens, strip_tokens: bool = False,
+                     assume_str: bool = False) -> np.ndarray:
+    """Vectorized `[nan if v in na_tokens else float(v) for v in col]` —
+    one unicode cast + `np.isin` NA mask + a single bulk str→float64 cast
+    (all of which numpy runs without the GIL) instead of a per-element
+    `float()` loop. Raises TypeError/ValueError exactly when the
+    per-element loop would, so callers' numeric-vs-categorical try/except
+    decisions are unchanged.
+
+    `strip_tokens` applies the parser's wider NA rule
+    (`str(v).strip() in na_tokens`). `assume_str` (set by the tokenizer
+    paths, whose columns are str by construction) skips the element-type
+    scan; without it, columns holding any non-str element (python dicts
+    can carry floats/None) drop to the exact per-element loop —
+    `float(np.float32(0.1))` and `float("0.1")` differ in the last bits,
+    and bit-identity with the historical path wins over speed there."""
+    arr = np.asarray(col)
+    n = arr.shape[0]
+    if n == 0:
+        return np.empty(0, np.float64)
+    if not _certified_str(arr, assume_str):
+        # non-str objects and bytes columns: the loop IS the semantics
+        if strip_tokens:
+            return np.asarray(
+                [np.nan if str(v).strip() in na_tokens else float(v)
+                 for v in arr], dtype=np.float64)
+        return np.asarray(
+            [np.nan if v in na_tokens else float(v) for v in arr],
+            dtype=np.float64)
+    u = arr.astype("U") if arr.dtype.kind == "O" else arr
+    if u.dtype.kind == "S":
+        na = [t.encode() for t in na_tokens if isinstance(t, str)]
+    else:
+        na = [t for t in na_tokens if isinstance(t, str)]
+    key = _S.strip(u) if strip_tokens else u
+    mask = np.isin(key, na)
+    out = np.full(n, np.nan, np.float64)
+    vals = u[~mask]
+    if vals.size:
+        try:
+            conv = vals.astype(np.float64)
+        except (TypeError, ValueError):
+            # numpy's parser rejects a few forms float() accepts ("1_0",
+            # non-ASCII digits); the loop is the semantics of record — and
+            # it raises to the caller exactly like the historical path
+            conv = np.asarray(
+                [float(v.decode() if isinstance(v, bytes) else v)
+                 for v in vals], dtype=np.float64)
+        out[~mask] = conv
+    return out
+
+
+def _intern_enum(col: np.ndarray, na_tokens=("", "NA", "na", None),
+                 assume_str: bool = False) -> Vec:
+    """Categorical intern (`water/parser/Categorical.java`): NA-mask, then
+    sorted uniques as the domain and positions as codes. Pure-str columns
+    take a unicode-array route (`np.unique` over fixed-width unicode is a
+    C sort; over object arrays it is a python-compare sort) — unicode
+    code-point order equals python str ordering, so domains and codes are
+    bit-identical either way."""
+    arr = np.asarray(col)
+    if _certified_str(arr, assume_str):
+        u = arr.astype("U") if arr.dtype.kind == "O" else arr
+        if u.dtype.kind == "S":
+            # tokenizer bytes column (ASCII-gated): byte order equals
+            # code-point order, so the sorted domain is identical
+            na = [t.encode() for t in na_tokens if isinstance(t, str)]
+        else:
+            na = [t for t in na_tokens if isinstance(t, str)]
+        mask = np.isin(u, na)
+        domain, codes = np.unique(u[~mask], return_inverse=True)
+        labels = ([d.decode() for d in domain] if u.dtype.kind == "S"
+                  else [str(d) for d in domain])
+    else:
+        mask = np.asarray([v in na_tokens for v in arr])
+        domain, codes = np.unique(np.asarray(arr)[~mask],
+                                  return_inverse=True)
+        labels = [str(d) for d in domain]
+    full = np.full(len(arr), -1, dtype=np.int32)
+    full[~mask] = codes.astype(np.int32)
+    return Vec(full, "enum", domain=labels)
+
 
 class Vec:
     __slots__ = ("data", "type", "domain", "_strings")
@@ -53,33 +158,31 @@ class Vec:
 
     # -- construction -------------------------------------------------------
     @staticmethod
-    def from_numpy(col: np.ndarray, type_hint: Optional[str] = None) -> "Vec":
+    def from_numpy(col: np.ndarray, type_hint: Optional[str] = None,
+                   assume_str: bool = False) -> "Vec":
         """Build a Vec from a host column, inferring type like
-        `water/parser/ParseSetup.java` column-type guessing."""
+        `water/parser/ParseSetup.java` column-type guessing. `assume_str`
+        certifies every element is a python str (the tokenizer paths),
+        skipping the per-element type scans of the vectorized coercers."""
         if col.dtype.kind in "OUS":
+            work = col
+            if col.dtype.kind == "O" and _certified_str(col, assume_str):
+                # one unicode cast shared by the numeric try AND the intern
+                # (each would otherwise pay its own object→U conversion)
+                work = col.astype("U")
             if type_hint == "enum":
-                mask = np.asarray([v in ("", "NA", "na", None) for v in col])
-                domain, codes = np.unique(np.asarray(col)[~mask], return_inverse=True)
-                full = np.full(len(col), -1, dtype=np.int32)
-                full[~mask] = codes.astype(np.int32)
-                return Vec(full, "enum", domain=[str(d) for d in domain])
+                return _intern_enum(work, assume_str=assume_str)
             # try numeric, else categorical intern (water/parser/Categorical.java)
             try:
-                as_num = np.asarray(
-                    [np.nan if v in ("", "NA", "na", "nan", None) else float(v) for v in col],
-                    dtype=np.float64,
-                )
+                as_num = bulk_try_numeric(work, ("", "NA", "na", "nan", None),
+                                          assume_str=assume_str)
                 return Vec(_maybe_f32(as_num),
                            "real" if not _all_int(as_num) else "int")
             except (TypeError, ValueError):
                 pass
             if type_hint == "string":
                 return Vec(None, "string", strings=np.asarray(col, dtype=object))
-            mask = np.asarray([v in ("", "NA", "na", None) for v in col])
-            domain, codes = np.unique(np.asarray(col)[~mask], return_inverse=True)
-            full = np.full(len(col), -1, dtype=np.int32)
-            full[~mask] = codes.astype(np.int32)
-            return Vec(full, "enum", domain=[str(d) for d in domain])
+            return _intern_enum(work, assume_str=assume_str)
         col = np.asarray(col)
         if type_hint == "time":
             return Vec(col.astype(np.float64), "time")

@@ -4,15 +4,24 @@
 // in Java (`water/parser/CsvParser.java` state machine inside the
 // `MultiFileParseTask` MRTask); its only native code is the prebuilt XGBoost
 // .so. Here the tokenizer itself is native: a single-pass, zero-allocation
-// scan with strtod for numerics. The Python layer (frame/parse.py) handles
-// setup-guessing and categorical interning; this handles the bandwidth.
+// scan with strtod for numerics. The Python layer (frame/parse.py +
+// frame/chunked.py) handles setup-guessing, chunk planning and categorical
+// interning; this handles the bandwidth.
 //
 // Exposed via ctypes (native/loader.py):
-//   h2o3_csv_parse_numeric(path, sep, header, ncol, out, cap) -> long long
-//     out == NULL: count data rows; returns -1 if any field is non-numeric
-//     (caller falls back to the Python object-column tokenizer), -2 on IO
-//     error. out != NULL: fill row-major doubles (NaN for NA tokens),
-//     returns rows written.
+//   h2o3_csv_parse_numeric_buf(buf, start, end, sep, skip_first, ncol,
+//                              out, cap) -> long long
+//     Parses the [start, end) byte range of an in-memory buffer — the
+//     per-chunk entry the parallel chunked pipeline calls concurrently
+//     (ctypes releases the GIL around the call, so chunks really overlap).
+//     out == NULL: count non-blank data lines. out != NULL: fill row-major
+//     doubles (NaN for NA tokens); returns rows written, -1 if any field
+//     is non-numeric (caller falls back to the Python object-column
+//     tokenizer), -2 on capacity overflow.
+//   h2o3_csv_parse_numeric(path, sep, header, ncol, out, cap)
+//     Whole-file wrapper over the same loop (reads the file, then parses
+//     [0, size)); kept for the legacy single-chunk path. -2 also covers IO
+//     errors here.
 
 #include <cmath>
 #include <cstdio>
@@ -21,13 +30,88 @@
 #include <string>
 #include <vector>
 
+// Exactly the NA set of the Python unhinted-column path (Vec.from_numpy):
+// "", "NA", "na" — plus "nan", which strtod parses to the same NaN anyway.
+// Wider markers ("N/A", "null", "?") must FAIL the parse instead, so the
+// caller falls back to the Python tokenizer and both builds agree the
+// column is categorical. (The old wider set made dtypes depend on whether
+// the .so was built.)
 static bool is_na_token(const char* s, size_t n) {
   if (n == 0) return true;
-  static const char* kNA[] = {"NA", "na", "N/A", "nan", "NaN", "null", "NULL", "?"};
+  static const char* kNA[] = {"NA", "na"};
   for (const char* t : kNA) {
     if (strlen(t) == n && strncmp(s, t, n) == 0) return true;
   }
   return false;
+}
+
+extern "C" long long h2o3_csv_parse_numeric_buf(
+    const char* buf, long long start, long long end, char sep,
+    int skip_first, int ncol, double* out, long long cap) {
+  const char* p = buf + start;
+  const char* bend = buf + end;
+  long long row = 0;
+  bool skipped_header = (skip_first == 0);
+
+  while (p < bend) {
+    const char* line_end = (const char*)memchr(p, '\n', bend - p);
+    if (!line_end) line_end = bend;
+    const char* le = line_end;
+    if (le > p && le[-1] == '\r') --le;
+    // blank ≡ the Python `ln.strip()` filter: empty OR whitespace-only
+    // lines are dropped, not parsed into all-NA rows
+    bool blank = true;
+    for (const char* s = p; s < le; ++s) {
+      if (*s != ' ' && *s != '\t') { blank = false; break; }
+    }
+    if (blank) {
+      p = line_end + 1;
+      continue;
+    }
+    if (!skipped_header) {
+      skipped_header = true;
+      p = line_end + 1;
+      continue;
+    }
+    if (!out) {  // count pass: non-blank data lines only, no field parsing
+      ++row;
+      p = line_end + 1;
+      continue;
+    }
+    if ((row + 1) * (long long)ncol > cap) return -2;
+    const char* q = p;
+    for (int c = 0; c < ncol; ++c) {
+      const char* field_end = q;
+      while (field_end < le && *field_end != sep) ++field_end;
+      // trim spaces and quotes
+      const char* a = q;
+      const char* b = field_end;
+      while (a < b && (*a == ' ' || *a == '"')) ++a;
+      while (b > a && (b[-1] == ' ' || b[-1] == '"')) --b;
+      double v;
+      if (is_na_token(a, b - a)) {
+        v = NAN;
+      } else {
+        // reject C99 hexfloats ("0x1p3") up front: strtod accepts them but
+        // python float() does not, and native success must imply the
+        // python path would produce the identical column
+        for (const char* s = a; s < b; ++s) {
+          if (*s == 'x' || *s == 'X') return -1;
+        }
+        // strtod in place: fields terminate at sep/newline, both of which
+        // stop the conversion (the caller's buffer is contiguous and, for
+        // python bytes, NUL-terminated, so reads stay in bounds)
+        char* conv_end = nullptr;
+        v = strtod(a, &conv_end);
+        if (conv_end != b) return -1;  // non-numeric → python fallback
+      }
+      out[row * ncol + c] = v;
+      q = (field_end < le) ? field_end + 1 : le;
+    }
+    ++row;
+    p = line_end + 1;
+  }
+  return row;
 }
 
 extern "C" long long h2o3_csv_parse_numeric(
@@ -45,67 +129,6 @@ extern "C" long long h2o3_csv_parse_numeric(
     return -2;
   }
   fclose(f);
-
-  const char* p = buf.data();
-  const char* end = p + sz;
-  long long row = 0;
-  bool skipped_header = (header == 0);
-
-  if (!out) {
-    // count pass: non-blank data lines only (no field parsing)
-    while (p < end) {
-      const char* line_end = (const char*)memchr(p, '\n', end - p);
-      if (!line_end) line_end = end;
-      const char* le = line_end;
-      if (le > p && le[-1] == '\r') --le;
-      if (le != p) {
-        if (!skipped_header) skipped_header = true;
-        else ++row;
-      }
-      p = line_end + 1;
-    }
-    return row;
-  }
-
-  while (p < end) {
-    const char* line_end = (const char*)memchr(p, '\n', end - p);
-    if (!line_end) line_end = end;
-    const char* q = p;
-    const char* le = line_end;
-    if (le > p && le[-1] == '\r') --le;
-    if (le == p) {  // blank line
-      p = line_end + 1;
-      continue;
-    }
-    if (!skipped_header) {
-      skipped_header = true;
-      p = line_end + 1;
-      continue;
-    }
-    if ((row + 1) * (long long)ncol > cap) return -2;
-    for (int c = 0; c < ncol; ++c) {
-      const char* field_end = q;
-      while (field_end < le && *field_end != sep) ++field_end;
-      // trim spaces and quotes
-      const char* a = q;
-      const char* b = field_end;
-      while (a < b && (*a == ' ' || *a == '"')) ++a;
-      while (b > a && (b[-1] == ' ' || b[-1] == '"')) --b;
-      double v;
-      if (is_na_token(a, b - a)) {
-        v = NAN;
-      } else {
-        // strtod in place: fields terminate at sep/newline, both of which
-        // stop the conversion (buf is contiguous, so reads stay in bounds)
-        char* conv_end = nullptr;
-        v = strtod(a, &conv_end);
-        if (conv_end != b) return -1;  // non-numeric → python fallback
-      }
-      out[row * ncol + c] = v;
-      q = (field_end < le) ? field_end + 1 : le;
-    }
-    ++row;
-    p = line_end + 1;
-  }
-  return row;
+  return h2o3_csv_parse_numeric_buf(buf.data(), 0, sz, sep, header, ncol,
+                                    out, cap);
 }

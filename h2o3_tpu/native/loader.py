@@ -139,6 +139,48 @@ def tree_shap(feat: np.ndarray, thr: np.ndarray, split: np.ndarray,
     return out
 
 
+def tokenize_chunk_numeric(data: bytes, start: int, end: int, sep: str,
+                           ncol: int, skip_first_line: bool
+                           ) -> Optional[np.ndarray]:
+    """Native numeric tokenize of one [start, end) byte chunk of an
+    in-memory CSV payload — the per-chunk worker of the parallel pipeline
+    (frame/chunked.py). ctypes releases the GIL for the call, so chunk
+    workers overlap on real cores. Returns an (nrows, ncol) float64 matrix,
+    or None when the lib is absent or any field is non-numeric (the caller
+    falls back to the Python object-column tokenizer for EVERY chunk —
+    mixing float and token chunks would corrupt the categorical intern)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    try:
+        fn = lib.h2o3_csv_parse_numeric_buf
+    except AttributeError:
+        return None
+    try:
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_char, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_longlong,
+        ]
+        sep_b = sep.encode()
+        if len(sep_b) != 1:
+            return None
+        nrows = fn(data, start, end, sep_b, 1 if skip_first_line else 0,
+                   ncol, None, 0)
+        if nrows < 0:
+            return None
+        buf = np.empty((nrows, ncol), dtype=np.float64)
+        got = fn(data, start, end, sep_b, 1 if skip_first_line else 0, ncol,
+                 buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                 nrows * ncol)
+        if got != nrows:
+            return None
+        return buf
+    except (OSError, ValueError):
+        return None
+
+
 def tokenize_csv(path: str, sep: str, header: bool, ncol: int) -> Optional[List[np.ndarray]]:
     """Fast numeric-first CSV tokenize. Returns per-column object arrays, or
     None when the native lib is absent (callers fall back to numpy)."""

@@ -58,6 +58,36 @@ def all_schemas() -> List[Dict]:
 
 
 SERVING_SCHEMA_NAME = "ServingMetricsV3"
+INGEST_SCHEMA_NAME = "IngestMetricsV3"
+
+
+def ingest_metrics_schema() -> Dict:
+    """Field metadata of the `GET /3/Ingest/metrics` document (the chunked
+    parse pipeline's observability schema — docs/ingest.md mirrors this)."""
+    fields = [
+        ("totals", "IngestTotals",
+         "cumulative parses/rows/bytes/secs + derived rows_per_s,"
+         " bytes_per_s over every parse since start (or reset)"),
+        ("last", "IngestParseStats",
+         "the most recent parse, or null before the first one"),
+        ("last.rows_per_s", "double", "rows / wall seconds of that parse"),
+        ("last.bytes_per_s", "double", "bytes / wall seconds of that parse"),
+        ("last.n_chunks", "int",
+         "byte chunks (or line blocks on the distributed path) tokenized"),
+        ("last.n_threads", "int", "thread-pool workers used for phase 1"),
+        ("last.native", "boolean",
+         "true when the C++ per-chunk tokenizer handled the file"),
+        ("last.distributed", "boolean",
+         "true for the multi-process byte-range path"),
+        ("last.phases", "map<string,double>",
+         "per-stage seconds: setup / read / tokenize / coerce / intern /"
+         " place (same buckets runtime/phases records as ingest_*)"),
+        ("active", "boolean", "false until the first parse happens"),
+    ]
+    return dict(
+        name=INGEST_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )
 
 
 def serving_metrics_schema() -> Dict:

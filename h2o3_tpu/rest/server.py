@@ -124,6 +124,7 @@ class _Handler(BaseHTTPRequestHandler):
         ("DELETE", r"^/3/Models/([^/]+)$", "model_delete"),
         ("POST", r"^/3/Predictions/models/([^/]+)/frames/([^/]+)$", "predict"),
         ("GET", r"^/3/Serving/metrics$", "serving_metrics"),
+        ("GET", r"^/3/Ingest/metrics$", "ingest_metrics"),
         ("DELETE", r"^/3/Serving/cache$", "serving_cache_clear"),
         ("POST", r"^/3/ModelMetrics/models/([^/]+)/frames/([^/]+)$", "model_metrics"),
         ("GET", r"^/3/Jobs$", "jobs_list"),
@@ -833,6 +834,19 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(__meta=dict(schema_type=schemas.SERVING_SCHEMA_NAME),
                         **body))
 
+    def h_ingest_metrics(self):
+        """`GET /3/Ingest/metrics` — parse-pipeline throughput counters +
+        per-phase timings (schema: schemas.ingest_metrics_schema; also
+        folded into /3/Profiler via runtime/profiler.ingest_stats)."""
+        from ..runtime import profiler
+
+        p = self._params()
+        if self._flag(p, "schema"):
+            self._send(schemas.ingest_metrics_schema())
+            return
+        self._send(dict(__meta=dict(schema_type=schemas.INGEST_SCHEMA_NAME),
+                        **profiler.ingest_stats()))
+
     def h_serving_cache_clear(self):
         """`DELETE /3/Serving/cache[?model=key]` — evict compiled scorers
         (all, or one model's) so a hot-swapped artifact re-traces."""
@@ -912,7 +926,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(nodes=[dict(node="local",
                                     entries=profiler.profile(nsamples=2,
                                                              interval=0.01))],
-                        serving=profiler.serving_stats()))
+                        serving=profiler.serving_stats(),
+                        ingest=profiler.ingest_stats()))
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()))

@@ -54,6 +54,18 @@ def serving_stats() -> Dict:
     return out
 
 
+def ingest_stats() -> Dict:
+    """Ingest-pipeline observability folded into the profiler surface
+    (mirrors `serving_stats`): cumulative + last-parse rows/s, bytes/s and
+    the per-phase split (setup/read/tokenize/coerce/intern/place) recorded
+    by frame/ingest_stats. Pure counter read — never triggers a parse."""
+    from ..frame import ingest_stats as stats
+
+    out = stats.snapshot()
+    out["active"] = out["totals"]["parses"] > 0
+    return out
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """`with profiler.trace('/tmp/tb'):` — device + host trace via
