@@ -204,6 +204,28 @@ def bench_score():
             {"auc": round(float(perf.auc()), 5)})
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _forced_env(name: str, on: bool):
+    """Force a legacy-comparator env flag on or OFF for one timed rep —
+    a pre-exported value must not mislabel the non-legacy reps — then
+    restore whatever the operator had set."""
+    prior = os.environ.get(name)
+    if on:
+        os.environ[name] = "1"
+    else:
+        os.environ.pop(name, None)
+    try:
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prior
+
+
 def _write_ingest_csv(path: str, target_mb: float, seed: int = 0) -> int:
     """Synthesize a mixed numeric/enum CSV of ~target_mb MB (16 numeric
     columns with NA holes + 4 enum columns, quoted cells in one — the
@@ -261,14 +283,10 @@ def bench_ingest():
         def run(nthreads=None, legacy=False, reps=2):
             best = float("inf")
             for _ in range(reps):   # best-of-reps damps scheduler noise
-                if legacy:
-                    os.environ["H2O3_INGEST_LEGACY"] = "1"
-                try:
+                with _forced_env("H2O3_INGEST_LEGACY", legacy):
                     t0 = time.perf_counter()
                     fr = parse_csv(path, nthreads=nthreads)
                     best = min(best, time.perf_counter() - t0)
-                finally:
-                    os.environ.pop("H2O3_INGEST_LEGACY", None)
                 assert fr.nrow == nrows, (fr.nrow, nrows)
             return nrows / best, best
 
@@ -289,6 +307,67 @@ def bench_ingest():
                  "onethread_rows_per_s": round(st_rps)})
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def bench_munge():
+    """Vectorized munging engine (ISSUE 3): radix join + group-by + pivot
+    over a ~1M-row two-key frame; reports rows/s of the vectorized merge
+    plus the speedups vs the seed per-row paths (H2O3_MUNGE_LEGACY=1;
+    acceptance: merge ≥ 5× legacy rows/s on a 2-core host). Pure host
+    numpy — never needs the accelerator, so there is no probe to fail and
+    never a value-0.0 line."""
+    n_rows = int(os.environ.get("BENCH_MUNGE_ROWS",
+                                os.environ.get("BENCH_ROWS", 1_000_000)))
+    from h2o3_tpu.frame import rapids as R
+    from h2o3_tpu.frame.frame import Frame
+
+    rng = np.random.default_rng(0)
+    levels = np.asarray([f"L{i}" for i in range(1000)])
+    left = Frame.from_dict(
+        {"k1": rng.choice(levels, n_rows).astype(object),
+         "k2": rng.integers(0, 100, n_rows).astype(float),
+         "x": rng.random(n_rows)},
+        column_types={"k1": "enum"})
+    m = max(n_rows // 5, 1)
+    rlevels = np.asarray([f"L{i}" for i in range(1200)])
+    right = Frame.from_dict(
+        {"k1": rng.choice(rlevels, m).astype(object),
+         "k2": rng.integers(0, 110, m).astype(float),
+         "y": rng.random(m)},
+        column_types={"k1": "enum"})
+    plong = Frame.from_dict(
+        {"i": rng.integers(0, 2000, n_rows).astype(float),
+         "c": rng.integers(0, 12, n_rows).astype(float),
+         "v": rng.random(n_rows)})
+
+    def best(fn, reps=2, legacy=False):
+        t_best = float("inf")
+        for _ in range(reps):
+            with _forced_env("H2O3_MUNGE_LEGACY", legacy):
+                t0 = time.perf_counter()
+                fn()
+                t_best = min(t_best, time.perf_counter() - t0)
+        return t_best
+
+    do_merge = lambda: R.merge(left, right, by=["k1", "k2"], all_x=True)  # noqa: E731
+    do_gb = lambda: left.group_by(["k1", "k2"]).mean("x").sum("x").get_frame()  # noqa: E731
+    do_pivot = lambda: plong.pivot("i", "c", "v")  # noqa: E731
+    t_merge = best(do_merge)
+    t_merge_legacy = best(do_merge, reps=1, legacy=True)
+    t_gb = best(do_gb)
+    t_pivot = best(do_pivot)
+    t_pivot_legacy = best(do_pivot, reps=1, legacy=True)
+    rps = n_rows / t_merge
+    legacy_rps = n_rows / t_merge_legacy
+    return (f"munge_merge_{n_rows//1000}k_rows_per_s", rps,
+            {"unit_override": "rows/s",
+             "wall_s": round(t_merge, 3),
+             "rows": n_rows,
+             "vs_seed": round(rps / legacy_rps, 2),
+             "legacy_rows_per_s": round(legacy_rps),
+             "groupby_rows_per_s": round(n_rows / t_gb),
+             "pivot_rows_per_s": round(n_rows / t_pivot),
+             "pivot_vs_seed": round(t_pivot_legacy / t_pivot, 2)})
 
 
 _SCALING_CHILD = r"""
@@ -436,7 +515,7 @@ R02_BASELINE = {
 # not the machine. Repeat each wall-clock config and report the BEST run
 # (first run also absorbs executable deserialization for later ones).
 DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
-                   "scaling": 1, "ingest": 2}
+                   "scaling": 1, "ingest": 2, "munge": 2}
 
 
 def _probe_accelerator(timeout_s: float):
@@ -521,9 +600,10 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     cpu_fallback_reason = None
     forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
-    if config == "scaling" or forced:
-        # the scaling curve runs in CPU subprocesses; keep the parent off the
-        # (possibly unavailable) TPU backend entirely
+    if config in ("scaling", "munge") or forced:
+        # the scaling curve runs in CPU subprocesses and the munge bench is
+        # pure host numpy; keep the parent off the (possibly unavailable)
+        # TPU backend entirely — no probe, so never a value-0.0 line
         import jax
 
         jax.config.update("jax_platforms", forced or "cpu")
@@ -570,7 +650,7 @@ def main():
     fn = {"gbm": bench_gbm, "glm": bench_glm, "dl": bench_dl,
           "xgb_rank": bench_xgb_rank, "automl": bench_automl,
           "score": bench_score, "scaling": bench_scaling,
-          "ingest": bench_ingest}[config]
+          "ingest": bench_ingest, "munge": bench_munge}[config]
     # cold is strictly one run: repeats within a process share the live
     # executable cache, so any second run would be warm yet labeled cold
     repeats = 1 if cold else int(os.environ.get(

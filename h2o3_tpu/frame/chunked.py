@@ -253,8 +253,9 @@ def _fast_chunk_columns(chunk: bytes, sep: str, ncol: int,
         if len(parts) < ncol:
             parts = parts + [""] * (ncol - len(parts))
         bad_toks.append(parts[:ncol])
-    if bad_toks and max(len(t) for row in bad_toks for t in row) \
-            > _MAX_FAST_TOKEN_W:
+    bad_w = (max(len(t) for row in bad_toks for t in row)
+             if bad_toks else 0)
+    if bad_w > _MAX_FAST_TOKEN_W:
         return None
     tok_s = tok_s.astype(np.int32)
     # does ANY plain token carry edge whitespace? whitespace strips only at
@@ -272,6 +273,10 @@ def _fast_chunk_columns(chunk: bytes, sep: str, ncol: int,
     else:
         needs_strip = False
     w_max = int(lens.max()) if ok_rows.size else 1
+    # the per-column gather width below also covers bad-row tokens — a
+    # quoted cell wider than every plain token must widen the pad too,
+    # or the gather indexes past the buffer
+    w_max = max(w_max, bad_w, 1)
     bp = np.concatenate([b, np.zeros(w_max, np.uint8)])  # overrun pad
     cols: List[np.ndarray] = []
     span = np.arange(0, 1, dtype=np.int32)

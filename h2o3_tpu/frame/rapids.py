@@ -11,35 +11,60 @@ POST): ops execute eagerly as numpy reductions — at frame-munging scale the
 host is the right place; device time is reserved for training loops.
 GroupBy mirrors `h2o-py/h2o/group_by.py`'s builder surface
 (`fr.group_by(...).sum().mean().get_frame()`).
+
+Since the vectorized-munging round, the hot ops run as columnar kernels:
+`merge` is a factorized radix join (per-key-column code factorization,
+mixed-radix combine, one stable sort + searchsorted match producing gather
+indices — zero per-row python objects), `pivot`/`table` are
+factorize+scatter. ``H2O3_MUNGE_LEGACY=1`` re-engages the seed per-row
+paths as a bit-exact comparator (see docs/munging.md); every op books its
+stage timings into `frame/munge_stats.py`.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from . import munge_stats
 from .frame import Frame
 from .vec import Vec
 
 _AGGS = ("count", "sum", "mean", "min", "max", "sd", "var", "median", "mode", "first", "last")
+_NA_MODES = ("all", "ignore", "rm")
 
 
 class GroupBy:
-    """`h2o-py/h2o/group_by.py` builder over `AstGroup` semantics."""
+    """`h2o-py/h2o/group_by.py` builder over `AstGroup` semantics.
+
+    NA handling per aggregate (`AstGroup.NAHandling`): ``"all"`` propagates
+    NA into the aggregate (a group containing an NA aggregates to NA),
+    ``"rm"`` removes NA rows from both the numerator and the denominator,
+    ``"ignore"`` skips NAs in the accumulation but keeps the rows in the
+    denominator (mean/var/sd divide by the FULL group size)."""
 
     def __init__(self, frame: Frame, by: Union[str, Sequence[str]]):
         self.frame = frame
         self.by = [by] if isinstance(by, str) else list(by)
         self._aggs: List = []  # (op, col, na)
 
+    @staticmethod
+    def _check_na(na):
+        if na not in _NA_MODES:
+            raise ValueError(
+                f"group_by: na must be one of {_NA_MODES}, got {na!r}")
+        return na
+
     def _add(self, op, col, na):
+        self._check_na(na)
         cols = col if isinstance(col, (list, tuple)) else [col]
         for c in cols:
             self._aggs.append((op, c, na))
         return self
 
     def count(self, na="all"):
+        self._check_na(na)
         self._aggs.append(("count", None, na))
         return self
 
@@ -72,35 +97,68 @@ class GroupBy:
                 if n not in self.by and self.frame.vec(n).type in ("real", "int")]
 
     def get_frame(self) -> Frame:
+        with munge_stats.op("group_by", self.frame.nrow) as _rec:
+            out = self._get_frame()
+            _rec["rows_out"] = out.nrow
+        return out
+
+    def _get_frame(self) -> Frame:
         fr = self.frame
         keys = [fr.vec(b) for b in self.by]
         key_codes = []
         key_domains = []
         for v in keys:
             if v.type == "enum":
-                key_codes.append(np.asarray(v.data, np.int64))
-                key_domains.append(np.asarray(v.domain, dtype=object))
+                codes = np.asarray(v.data, np.int64)
+                dom = list(v.domain or [])
+                # NA keys (code -1) are their OWN group — fed raw into the
+                # mixed radix, -1 used to decode as the LAST domain label
+                # and silently collide with that group
+                key_codes.append(np.where(codes >= 0, codes, len(dom)))
+                key_domains.append(np.asarray(dom + [None], dtype=object))
             else:
                 col = v.numeric_np()
                 uniq, inv = np.unique(col, return_inverse=True)
                 key_codes.append(inv.astype(np.int64))
                 key_domains.append(uniq)
-        combined = key_codes[0].copy()
-        mult = 1
+        combined = key_codes[0].copy().astype(np.int64)
         sizes = [len(d) for d in key_domains]
+        size = max(sizes[0], 1)
         for i in range(1, len(key_codes)):
-            mult *= sizes[i - 1]
-            combined = combined + key_codes[i] * mult  # little-endian mixed radix
-        groups, ginv = np.unique(combined, return_inverse=True)
+            if size * max(sizes[i], 1) >= (1 << 62):
+                # compact before the radix product could overflow int64
+                # (same guard as the merge radix) — decode below goes via
+                # first-occurrence rows, so compaction is free
+                u, combined = np.unique(combined, return_inverse=True)
+                combined = combined.astype(np.int64)
+                size = len(u)
+            combined = combined * max(sizes[i], 1) + key_codes[i]
+            size *= max(sizes[i], 1)
+        groups, first_idx, ginv = np.unique(
+            combined, return_index=True, return_inverse=True)
         G = len(groups)
 
         out: Dict[str, np.ndarray] = {}
+        sort_keys: Dict[str, np.ndarray] = {}
         for i, b in enumerate(self.by):
-            idx = (groups // int(np.prod(sizes[:i]) if i else 1)) % sizes[i]
+            # decode each group's key from its FIRST member row — immune
+            # to whatever compaction the combine step did
+            idx = np.asarray(key_codes[i], np.int64)[first_idx]
             dom = key_domains[i]
             vals = dom[idx]
             out[b] = vals
-        order = np.lexsort([out[b] for b in reversed(self.by)])
+            if dom.dtype == object:
+                # label-sorted positions, NA (None) last — None isn't
+                # comparable to str, so the lexsort runs on positions;
+                # remap is O(|domain|), the gather O(G) in C
+                labels = [d for d in dom if d is not None]
+                pos = {d: p for p, d in enumerate(sorted(labels))}
+                remap = np.asarray(
+                    [pos.get(d, len(labels)) for d in dom], np.int64)
+                sort_keys[b] = remap[idx]
+            else:
+                sort_keys[b] = vals  # numeric: value order, NaN sorts last
+        order = np.lexsort([sort_keys[b] for b in reversed(self.by)])
 
         # vectorized per-group reductions: moments via bincount-with-weights,
         # order statistics via one sort + reduceat — O(n log n), never O(G·n)
@@ -117,29 +175,45 @@ class GroupBy:
                 sort_cache[colname] = (gs, cs, starts)
             return sort_cache[colname]
 
+        cnt_all = np.bincount(ginv, minlength=G).astype(np.float64)
         for op, col, na in self._aggs:
             if op == "count":
-                out["nrow"] = np.bincount(ginv, minlength=G).astype(np.float64)
+                # nrow with a referenced column honors na="rm" (count the
+                # non-NA rows, AstGroup's nrow agg); the builder's bare
+                # count() has no column, so it is always the group size
+                if col is not None and na == "rm":
+                    # isna_np covers every vec type (string columns have
+                    # no numeric view — numeric_np would crash)
+                    valid = ~fr.vec(col).isna_np()
+                    out["nrow"] = np.bincount(
+                        ginv[valid], minlength=G).astype(np.float64)
+                else:
+                    out["nrow"] = cnt_all.copy()
                 continue
             c = fr.vec(col).numeric_np()
             name = f"{op}_{col}"
             agg = np.full(G, np.nan)
-            valid = ~np.isnan(c)  # AstGroup skips NAs inside aggregates
+            isna = np.isnan(c)
+            valid = ~isna
             gv = ginv[valid]
             cv = c[valid]
             cnt = np.bincount(gv, minlength=G).astype(np.float64)
             nz = cnt > 0
             if op in ("sum", "mean", "sd", "var"):
                 s1 = np.bincount(gv, weights=cv, minlength=G)
+                # "ignore": skip NAs in the accumulation but divide by the
+                # FULL group size (AstGroup IGNORE keeps the rows)
+                denom = cnt_all if na == "ignore" else cnt
                 if op == "sum":
                     agg[nz] = s1[nz]
                 elif op == "mean":
-                    agg[nz] = s1[nz] / cnt[nz]
+                    agg[nz] = s1[nz] / denom[nz]
                 else:
                     s2 = np.bincount(gv, weights=cv * cv, minlength=G)
-                    mean = np.where(nz, s1 / np.maximum(cnt, 1), 0.0)
-                    ss = np.maximum(s2 - cnt * mean * mean, 0.0)
-                    var = np.where(cnt > 1, ss / np.maximum(cnt - 1, 1), 0.0)
+                    mean = np.where(nz, s1 / np.maximum(denom, 1), 0.0)
+                    ss = np.maximum(s2 - denom * mean * mean, 0.0)
+                    var = np.where(denom > 1, ss / np.maximum(denom - 1, 1),
+                                   0.0)
                     agg[nz] = np.sqrt(var[nz]) if op == "sd" else var[nz]
             elif op in ("min", "max"):
                 gs, cs, starts = _sorted(col, c)
@@ -169,20 +243,20 @@ class GroupBy:
                 gb, lb, vb = run_grp[best_order], run_len[best_order], run_val[best_order]
                 last = np.flatnonzero(np.r_[gb[1:] != gb[:-1], True])
                 agg[gb[last]] = vb[last]
+            if na == "all" and isna.any():
+                # NA propagates into the aggregate of its group
+                agg[np.bincount(ginv[isna], minlength=G) > 0] = np.nan
             out[name] = agg
 
         return Frame.from_dict({k: np.asarray(v)[order] for k, v in out.items()})
 
 
-def merge(left: Frame, right: Frame, by: Optional[Sequence[str]] = None,
-          all_x: bool = False, all_y: bool = False) -> Frame:
-    """`AstMerge` — hash/radix join on shared key columns. Inner by default;
-    all_x ⇒ left outer, all_y ⇒ right outer (h2o.merge semantics)."""
-    if by is None:
-        by = [n for n in left.names if n in right.names]
-    if not by:
-        raise ValueError("merge: no common key columns")
-
+# -- merge (AstMerge radix join) ---------------------------------------------
+def _join_indices_legacy(left: Frame, right: Frame, by: Sequence[str],
+                         all_x: bool, all_y: bool
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Seed hash join — per-row python tuples into a dict. Kept verbatim as
+    the bit-exact comparator (``H2O3_MUNGE_LEGACY=1``)."""
     def keytuple(fr: Frame):
         cols = []
         for b in by:
@@ -216,17 +290,212 @@ def merge(left: Frame, right: Frame, by: Optional[Sequence[str]] = None,
             if j not in matched_r:
                 li.append(-1)
                 ri.append(j)
-    li = np.asarray(li, np.int64)
-    ri = np.asarray(ri, np.int64)
+    return np.asarray(li, np.int64), np.asarray(ri, np.int64)
 
+
+def _factorize_key_column(lv: Vec, rv: Vec, nl: int, nr: int):
+    """Joint code factorization of ONE key column across both sides:
+    returns (l_codes, r_codes, size, l_dead, r_dead) where equal key values
+    share a code in [0, size) and ``dead`` rows can never match any row on
+    the other side. Match semantics replicate the seed tuple join exactly:
+    enum keys compare by LABEL (two enums with different domains still
+    match; the NA level None equals None, so enum-NA matches enum-NA),
+    numeric keys compare by value with NaN never equal to anything, and an
+    enum column against a numeric column never matches (labels are strings,
+    the tuple join compared them to floats)."""
+    if lv.type == "enum" and rv.type == "enum":
+        ldom = np.asarray(lv.domain or [], dtype=object)
+        rdom = np.asarray(rv.domain or [], dtype=object)
+        both = np.concatenate([ldom, rdom]) if (len(ldom) + len(rdom)) \
+            else np.empty(0, dtype=object)
+        union = np.unique(both.astype("U")) if both.size else \
+            np.empty(0, dtype="U1")
+        lmap = (np.searchsorted(union, ldom.astype("U")).astype(np.int64)
+                if ldom.size else np.empty(0, np.int64))
+        rmap = (np.searchsorted(union, rdom.astype("U")).astype(np.int64)
+                if rdom.size else np.empty(0, np.int64))
+        lc = np.asarray(lv.data, np.int64)
+        rc = np.asarray(rv.data, np.int64)
+
+        # the NA level (code -1 ⇒ label None) is itself matchable: None
+        # equals None in the seed's tuple join — it gets code len(union)
+        def _remap(codes, mapping):
+            if mapping.size == 0:  # empty domain (all-NA column): every
+                return np.full(codes.shape, len(union), np.int64)  # row NA
+            return np.where(codes >= 0, mapping[np.maximum(codes, 0)],
+                            len(union))
+
+        l_codes = _remap(lc, lmap)
+        r_codes = _remap(rc, rmap)
+        return (l_codes, r_codes, len(union) + 1,
+                np.zeros(nl, bool), np.zeros(nr, bool))
+    if lv.type != "enum" and rv.type != "enum":
+        lx = lv.numeric_np()
+        rx = rv.numeric_np()
+        l_dead = np.isnan(lx)
+        r_dead = np.isnan(rx)
+        uniq = np.unique(np.concatenate([lx[~l_dead], rx[~r_dead]]))
+        l_codes = np.zeros(nl, np.int64)
+        r_codes = np.zeros(nr, np.int64)
+        if uniq.size:
+            l_codes[~l_dead] = np.searchsorted(uniq, lx[~l_dead])
+            r_codes[~r_dead] = np.searchsorted(uniq, rx[~r_dead])
+        return l_codes, r_codes, max(int(uniq.size), 1), l_dead, r_dead
+    # mixed enum/numeric: string labels never equal floats — no match ever
+    return (np.zeros(nl, np.int64), np.zeros(nr, np.int64), 1,
+            np.ones(nl, bool), np.ones(nr, bool))
+
+
+def _join_indices_radix(left: Frame, right: Frame, by: Sequence[str],
+                        all_x: bool, all_y: bool,
+                        marks: Optional[Dict[str, float]] = None
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Factorized radix join: per-key-column joint code factorization,
+    mixed-radix combine (compacting via np.unique before the radix product
+    could overflow int64), then ONE stable sort of the right keys and a
+    searchsorted range lookup per left key — gather indices come out of
+    np.repeat/cumsum algebra with zero per-row python objects. Emits
+    (li, ri) in exactly the seed hash join's row order: left rows in
+    order, each left row's matches in ascending right-row order, then (for
+    all_y) the unmatched right rows in ascending order."""
+    marks = marks if marks is not None else {}
+    nl, nr = left.nrow, right.nrow
+    with munge_stats.stage(marks, "factorize"):
+        l_cols, r_cols, sizes = [], [], []
+        l_dead = np.zeros(nl, bool)
+        r_dead = np.zeros(nr, bool)
+        for b in by:
+            lc, rc, size, ld, rd = _factorize_key_column(
+                left.vec(b), right.vec(b), nl, nr)
+            l_cols.append(lc)
+            r_cols.append(rc)
+            sizes.append(size)
+            l_dead |= ld
+            r_dead |= rd
+    with munge_stats.stage(marks, "combine"):
+        comb_l = l_cols[0].copy()
+        comb_r = r_cols[0].copy()
+        size = sizes[0]
+        for i in range(1, len(by)):
+            if size * sizes[i] >= (1 << 62):
+                # compact the running codes before the radix product could
+                # overflow int64 (joint unique keeps cross-side equality)
+                u, inv = np.unique(np.concatenate([comb_l, comb_r]),
+                                   return_inverse=True)
+                comb_l, comb_r = inv[:nl].astype(np.int64), \
+                    inv[nl:].astype(np.int64)
+                size = len(u)
+            comb_l = comb_l * sizes[i] + l_cols[i]
+            comb_r = comb_r * sizes[i] + r_cols[i]
+            size *= sizes[i]
+        if size > max(2 * (nl + nr), 1 << 20):
+            # compact so the direct-address join table below stays a few
+            # MB instead of O(radix-product); compacted codes are < nl+nr
+            u, inv = np.unique(np.concatenate([comb_l, comb_r]),
+                               return_inverse=True)
+            comb_l, comb_r = inv[:nl].astype(np.int64), \
+                inv[nl:].astype(np.int64)
+            size = len(u)
+    with munge_stats.stage(marks, "match"):
+        r_alive = np.flatnonzero(~r_dead)
+        rs = comb_r[r_alive]
+        r_order = np.argsort(rs, kind="stable")  # ties keep right-row order
+        rs_sorted = rs[r_order]
+        r_orig = r_alive[r_order]
+
+        # direct-address join table over the (bounded) code space: per-key
+        # run start + length in rs_sorted — one O(1) gather per left row
+        # instead of a binary search (the radix-join payoff)
+        bnd = (np.flatnonzero(np.r_[True, rs_sorted[1:] != rs_sorted[:-1]])
+               if rs_sorted.size else np.empty(0, np.int64))
+        table_lo = np.zeros(max(int(size), 1), np.int64)
+        table_cnt = np.zeros(max(int(size), 1), np.int64)
+        if bnd.size:
+            ru = rs_sorted[bnd]
+            table_lo[ru] = bnd
+            table_cnt[ru] = np.r_[bnd[1:], len(rs_sorted)] - bnd
+        lo = table_lo[comb_l]
+        counts = table_cnt[comb_l]
+        counts[l_dead] = 0
+        matched_l = counts > 0
+
+        out_counts = np.where(matched_l, counts, 1 if all_x else 0)
+        total = int(out_counts.sum())
+        li = np.repeat(np.arange(nl, dtype=np.int64), out_counts)
+        starts = np.cumsum(out_counts) - out_counts
+        within = np.arange(total, dtype=np.int64) - np.repeat(starts,
+                                                              out_counts)
+        m_rep = np.repeat(matched_l, out_counts)
+        ri = np.full(total, -1, np.int64)
+        if r_orig.size:
+            gather = np.minimum(np.repeat(lo, out_counts) + within,
+                                len(r_orig) - 1)
+            ri[m_rep] = r_orig[gather[m_rep]]
+        if all_y:
+            r_matched = np.zeros(nr, bool)
+            if r_orig.size:
+                l_present = np.zeros(max(int(size), 1), bool)
+                l_present[comb_l[matched_l]] = True
+                r_matched[r_alive] = l_present[rs]
+            extra = np.flatnonzero(~r_matched)
+            li = np.concatenate([li, np.full(len(extra), -1, np.int64)])
+            ri = np.concatenate([ri, extra.astype(np.int64)])
+    return li, ri
+
+
+def merge(left: Frame, right: Frame, by: Optional[Sequence[str]] = None,
+          all_x: bool = False, all_y: bool = False) -> Frame:
+    """`AstMerge` — hash/radix join on shared key columns. Inner by default;
+    all_x ⇒ left outer, all_y ⇒ right outer (h2o.merge semantics)."""
+    if by is None:
+        by = [n for n in left.names if n in right.names]
+    if not by:
+        raise ValueError("merge: no common key columns")
+    marks: Dict[str, float] = {}
+    legacy = munge_stats.legacy_enabled()
+    with munge_stats.op("merge", left.nrow + right.nrow, stages=marks,
+                        path="legacy" if legacy else "vectorized") as _rec:
+        if legacy:
+            with munge_stats.stage(marks, "match"):
+                li, ri = _join_indices_legacy(left, right, by, all_x, all_y)
+        else:
+            li, ri = _join_indices_radix(left, right, by, all_x, all_y,
+                                         marks)
+        with munge_stats.stage(marks, "assemble"):
+            out = _assemble_merge(left, right, by, li, ri)
+        _rec["rows_out"] = out.nrow
+    return out
+
+
+def _take_or_na(v: Vec, idx: np.ndarray) -> Vec:
+    """`v.take(max(idx, 0))` that survives a 0-row source: when the frame
+    side is empty every index is -1 (pure NA fill), so synthesize the NA
+    column instead of gathering row 0 of nothing (the seed crashed here).
+    The fill keeps the source dtype — epoch-ms 'time' columns are f64."""
+    if len(v) == 0:
+        n = len(idx)
+        if v.type == "enum":
+            return Vec(np.full(n, -1, np.int32), "enum", domain=v.domain)
+        if v.type == "string":
+            return Vec(None, "string",
+                       strings=np.full(n, None, dtype=object))
+        return Vec(np.full(n, np.nan, np.asarray(v.data).dtype), v.type)
+    return v.take(np.maximum(idx, 0))
+
+
+def _assemble_merge(left: Frame, right: Frame, by: Sequence[str],
+                    li: np.ndarray, ri: np.ndarray) -> Frame:
+    """Gather the output columns from (li, ri) row indices (-1 ⇒ NA fill).
+    ONE assembly shared by the radix and legacy index builders, so the
+    comparator can only differ in match order — never in column fill."""
     out: Dict[str, Vec] = {}
     for n in left.names:
         if n in by:
             # key columns: take from whichever side matched (right-outer rows
             # must keep their join key — h2o.merge/R merge semantics)
-            lv = left.vec(n).take(np.maximum(li, 0))
+            lv = _take_or_na(left.vec(n), li)
             if (li < 0).any():
-                rv = right.vec(n).take(np.maximum(ri, 0))
+                rv = _take_or_na(right.vec(n), ri)
 
                 def _values(v: Vec) -> np.ndarray:
                     # enum → labels, numeric → numbers; per-side so a type
@@ -247,11 +516,15 @@ def merge(left: Frame, right: Frame, by: Optional[Sequence[str]] = None,
                     out[n] = Vec.from_numpy(lbl.astype(object))
                 else:
                     merged = np.where(li < 0, rv.numeric_np(), lv.numeric_np())
-                    out[n] = Vec(merged.astype(np.float32), lv.type)
+                    # keep the left side's dtype: f32 for real/int (seed
+                    # behavior), f64 for epoch-ms time keys (an f32 cast
+                    # would lose ~minutes of precision)
+                    out[n] = Vec(merged.astype(np.asarray(lv.data).dtype),
+                                 lv.type)
             else:
                 out[n] = lv
             continue
-        v = left.vec(n).take(np.maximum(li, 0))
+        v = _take_or_na(left.vec(n), li)
         out[n] = _mask_vec(v, li < 0)
     for n in right.names:
         if n in by:
@@ -259,7 +532,7 @@ def merge(left: Frame, right: Frame, by: Optional[Sequence[str]] = None,
         nn = n
         while nn in out:
             nn += "0"
-        v = right.vec(n).take(np.maximum(ri, 0))
+        v = _take_or_na(right.vec(n), ri)
         out[nn] = _mask_vec(v, ri < 0)
     return Frame(out)
 
@@ -275,9 +548,12 @@ def _mask_vec(v: Vec, na_mask: np.ndarray) -> Vec:
         s = v.to_numpy().copy()
         s[na_mask] = None
         return Vec(None, "string", strings=s)
+    src_dtype = np.asarray(v.data).dtype
     d = np.asarray(v.data, np.float64).copy()
     d[na_mask] = np.nan
-    return Vec(d.astype(np.float32), v.type)
+    # preserve the source dtype: the seed's unconditional f32 cast silently
+    # corrupted f64 epoch-ms 'time' columns on every outer merge
+    return Vec(d.astype(src_dtype), v.type)
 
 
 def quantile(frame: Frame, prob: Sequence[float], combine_method: str = "interpolate") -> Frame:
@@ -297,8 +573,46 @@ def quantile(frame: Frame, prob: Sequence[float], combine_method: str = "interpo
     return Frame.from_dict(out)
 
 
+def _factorize_labels(v: Vec):
+    """(codes, levels) of one column for the factorize+scatter reshapers:
+    codes are positions into `levels` with -1 for NA; `levels` is an object
+    array in exactly the seed's sorted-set order (python `sorted` for enum
+    labels — equal to code-point order — and ascending numeric order).
+    Unused enum domain levels are excluded, like the seed's set-of-labels."""
+    if v.type == "enum":
+        codes_raw = np.asarray(v.data, np.int64)
+        dom = v.domain or []
+        if not dom:  # all-NA enum column interns with an empty domain
+            return (np.full(codes_raw.shape, -1, np.int64),
+                    np.empty(0, dtype=object))
+        present = np.unique(codes_raw[codes_raw >= 0])
+        levels = sorted(dom[c] for c in present)
+        pos = {lbl: i for i, lbl in enumerate(levels)}
+        remap = np.full(len(dom), -1, np.int64)
+        for c in present:
+            remap[c] = pos[dom[c]]
+        codes = np.where(codes_raw >= 0, remap[np.maximum(codes_raw, 0)], -1)
+        return codes, np.asarray(levels, dtype=object)
+    col = v.numeric_np()
+    valid = ~np.isnan(col)
+    uniq = np.unique(col[valid])
+    codes = np.full(len(col), -1, np.int64)
+    if uniq.size:
+        codes[valid] = np.searchsorted(uniq, col[valid])
+    return codes, uniq.astype(object)
+
+
 def table(frame: Frame, dense: bool = True) -> Frame:
     """`AstTable` — value counts of 1–2 categorical/int columns."""
+    legacy = munge_stats.legacy_enabled()  # read ONCE: tag and dispatch
+    with munge_stats.op("table", frame.nrow,
+                        path="legacy" if legacy else "vectorized") as _rec:
+        out = _table_impl(frame, dense, legacy)
+        _rec["rows_out"] = out.nrow
+    return out
+
+
+def _table_impl(frame: Frame, dense: bool, legacy: bool) -> Frame:
     vs = frame.vecs()
     if len(vs) == 1:
         v = vs[0]
@@ -315,31 +629,52 @@ def table(frame: Frame, dense: bool = True) -> Frame:
     if len(vs) == 2:
         # two-column cross-tab, long format (col1, col2, Counts) — the
         # AstTable 2-arg form
-        def _labels(v):
-            if v.type == "enum":
-                codes = np.asarray(v.data)
-                return np.asarray(
-                    [v.domain[c] if c >= 0 else None for c in codes],
-                    dtype=object)
-            return v.numeric_np().astype(object)
-
-        a = _labels(vs[0])
-        b = _labels(vs[1])
-        keep = np.asarray([x is not None and x == x and y is not None
-                           and y == y for x, y in zip(a, b)])
-        pairs: Dict = {}
-        for x, y in zip(a[keep], b[keep]):
-            pairs[(x, y)] = pairs.get((x, y), 0) + 1
-        keys = sorted(pairs)
         t1 = "enum" if vs[0].type == "enum" else None
         t2 = "enum" if vs[1].type == "enum" else None
+        types = {k: v for k, v in
+                 [(frame.names[0], t1), (frame.names[1], t2)] if v}
+        if legacy:
+            return _table2_legacy(frame, vs, types)
+        ca, la = _factorize_labels(vs[0])
+        cb, lb = _factorize_labels(vs[1])
+        keep = (ca >= 0) & (cb >= 0)
+        nb = max(len(lb), 1)
+        comb = ca[keep] * nb + cb[keep]
+        u, cnt = np.unique(comb, return_counts=True)
+        # ascending combined code == (a level, b level) lexicographic ==
+        # the seed's sorted(pairs) order
         return Frame.from_dict(
-            {frame.names[0]: np.asarray([k[0] for k in keys], dtype=object),
-             frame.names[1]: np.asarray([k[1] for k in keys], dtype=object),
-             "Counts": np.asarray([pairs[k] for k in keys], np.float64)},
-            column_types={k: v for k, v in
-                          [(frame.names[0], t1), (frame.names[1], t2)] if v})
+            {frame.names[0]: la[u // nb] if len(la) else
+             np.empty(0, dtype=object),
+             frame.names[1]: lb[u % nb] if len(lb) else
+             np.empty(0, dtype=object),
+             "Counts": cnt.astype(np.float64)},
+            column_types=types)
     raise ValueError("table: at most 2 columns")
+
+
+def _table2_legacy(frame: Frame, vs, types) -> Frame:
+    def _labels(v):
+        if v.type == "enum":
+            codes = np.asarray(v.data)
+            return np.asarray(
+                [v.domain[c] if c >= 0 else None for c in codes],
+                dtype=object)
+        return v.numeric_np().astype(object)
+
+    a = _labels(vs[0])
+    b = _labels(vs[1])
+    keep = np.asarray([x is not None and x == x and y is not None
+                       and y == y for x, y in zip(a, b)])
+    pairs: Dict = {}
+    for x, y in zip(a[keep], b[keep]):
+        pairs[(x, y)] = pairs.get((x, y), 0) + 1
+    keys = sorted(pairs)
+    return Frame.from_dict(
+        {frame.names[0]: np.asarray([k[0] for k in keys], dtype=object),
+         frame.names[1]: np.asarray([k[1] for k in keys], dtype=object),
+         "Counts": np.asarray([pairs[k] for k in keys], np.float64)},
+        column_types=types)
 
 
 def ifelse(cond: np.ndarray, yes, no) -> np.ndarray:
@@ -378,6 +713,43 @@ def pivot(frame: Frame, index: str, column: str, value: str) -> Frame:
     """`AstPivot` — long → wide: rows keyed by `index`, one output column
     per level of `column`, cells from `value` (last write wins, NaN where
     absent)."""
+    legacy = munge_stats.legacy_enabled()
+    with munge_stats.op("pivot", frame.nrow,
+                        path="legacy" if legacy else "vectorized") as _rec:
+        out = (_pivot_legacy if legacy else _pivot_vectorized)(
+            frame, index, column, value)
+        _rec["rows_out"] = out.nrow
+    return out
+
+
+def _pivot_vectorized(frame: Frame, index: str, column: str,
+                      value: str) -> Frame:
+    """Factorize both key columns, then ONE flat scatter into the grid.
+    Last write wins exactly like the seed's row loop: `np.maximum.at` over
+    row ordinals picks the LAST valid row per cell (unbuffered, so
+    duplicate cells are well-defined — plain fancy assignment is not)."""
+    iv, cv = frame.vec(index), frame.vec(column)
+    icodes, ilevels = _factorize_labels(iv)
+    ccodes, clevels = _factorize_labels(cv)
+    vals = frame.vec(value).numeric_np()
+    n_i, n_c = len(ilevels), len(clevels)
+    grid = np.full((n_i, n_c), np.nan)
+    valid = (icodes >= 0) & (ccodes >= 0)
+    if valid.any() and n_i and n_c:
+        lin = icodes[valid] * n_c + ccodes[valid]
+        vv = vals[valid]
+        last = np.full(n_i * n_c, -1, np.int64)
+        np.maximum.at(last, lin, np.arange(len(lin), dtype=np.int64))
+        cells = np.flatnonzero(last >= 0)
+        grid.flat[cells] = vv[last[cells]]
+    out: Dict[str, np.ndarray] = {index: ilevels}
+    types = {index: "enum"} if iv.type == "enum" else {}
+    for j, cname in enumerate(clevels.tolist()):
+        out[str(cname)] = grid[:, j]
+    return Frame.from_dict(out, column_types=types)
+
+
+def _pivot_legacy(frame: Frame, index: str, column: str, value: str) -> Frame:
     iv, cv = frame.vec(index), frame.vec(column)
 
     def _labels(v):

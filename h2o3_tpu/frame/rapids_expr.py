@@ -218,6 +218,68 @@ def _jaro_winkler(a: str, b: str) -> float:
     return jaro + prefix * 0.1 * (1 - jaro)
 
 
+def _moment_rows(vals, rows, tzname: str) -> np.ndarray:
+    """The seed per-row `(moment ...)` evaluation over the given row
+    indices: one datetime() construction each, invalid components → NaN.
+    Also the exact-value fixup the vectorized path uses for timestamps
+    beyond float64's exact-integer range (CPython's total_seconds divides
+    the exact integer microseconds ONCE; two-step float math can differ
+    by an ulp out there)."""
+    import datetime as _dt
+    import zoneinfo
+
+    tz = zoneinfo.ZoneInfo(tzname)
+    rows = list(rows)
+    out = np.empty(len(rows), np.float64)
+    for k, r in enumerate(rows):
+        y_, mo, dy, hr, mi, se, ms = (vals[j][r] for j in range(7))
+        try:
+            t = _dt.datetime(int(y_), int(mo), int(dy), int(hr),
+                             int(mi), int(se), int(ms) * 1000,
+                             tzinfo=tz)
+            out[k] = t.timestamp() * 1000.0
+        except (ValueError, OverflowError):
+            out[k] = np.nan
+    return out
+
+
+def _moment_vectorized(vals, nrow: int) -> np.ndarray:
+    """UTC `(moment ...)` as datetime64 calendar algebra: truncate the
+    seven component columns, range-check them exactly like the datetime
+    constructor (day-in-month overflow detected by the month rolling), and
+    emit `(total_us / 1e6) * 1000.0` — the same float expression
+    `datetime.timestamp() * 1000.0` evaluates, so results are bit-identical
+    (rows whose |µs| ≥ 2^53 re-run through `_moment_rows` because CPython
+    divides the exact integer there)."""
+    comp = np.stack([np.asarray(v, np.float64) for v in vals], axis=0)
+    finite = np.isfinite(comp).all(axis=0)
+    # clip before the int cast: a finite-but-huge component must fail the
+    # range check below, not overflow int64
+    ci = np.trunc(np.clip(np.where(finite, comp, 0.0),
+                          -1e15, 1e15)).astype(np.int64)
+    y, mo, dy, hr, mi, se, ms = ci
+    ok = (finite & (y >= 1) & (y <= 9999) & (mo >= 1) & (mo <= 12)
+          & (dy >= 1) & (dy <= 31) & (hr >= 0) & (hr <= 23)
+          & (mi >= 0) & (mi <= 59) & (se >= 0) & (se <= 59)
+          & (ms >= 0) & (ms <= 999))
+    out = np.full(nrow, np.nan)
+    if ok.any():
+        m64 = ((y[ok] - 1970) * 12 + (mo[ok] - 1)).astype("datetime64[M]")
+        d64 = m64.astype("datetime64[D]") + (dy[ok] - 1)
+        ok_day = d64.astype("datetime64[M]") == m64  # Feb 30 rolls → invalid
+        days = d64.astype(np.int64)
+        total_us = ((days * 86400 + hr[ok] * 3600 + mi[ok] * 60 + se[ok])
+                    * 1_000_000 + ms[ok] * 1000)
+        res = (total_us.astype(np.float64) / 1e6) * 1000.0
+        res[~ok_day] = np.nan
+        big = ok_day & (np.abs(total_us) >= (1 << 53))
+        if big.any():
+            idx = np.flatnonzero(ok)[big]
+            res[big] = _moment_rows(vals, idx.tolist(), "UTC")
+        out[ok] = res
+    return out
+
+
 # (setproperty k v) — the reference sets a JVM system property; the analog
 # here is a session-scoped property table (readable for parity tests)
 _SYS_PROPS: dict = {}
@@ -639,27 +701,60 @@ class RapidsSession:
         if op == "num_valid_substrings":
             # (num_valid_substrings x path) — count DISTINCT substrings
             # (length >= 2) of each string present in the line-separated
-            # words file (ast/prims/string/AstCountSubstringsWords)
+            # words file (ast/prims/string/AstCountSubstringsWords).
+            # Factorized: each UNIQUE string is counted once (scattered
+            # back through a lookup) — the dominant win on repetitive
+            # columns. Large unique sets additionally split over the
+            # ingest-style thread pool; _count is GIL-bound python today,
+            # so that mostly buys overlap with other request threads (and
+            # the seam where a native counter would slot in).
+            from . import munge_stats as _ms
+
             with open(str(a[1])) as f:
                 words = {ln.strip() for ln in f if ln.strip()}
-            out = []
-            for s in a[0]._string_rows():
-                if s is None:
-                    out.append(np.nan)
-                    continue
-                s = str(s)
+
+            def _count(s: str) -> float:
                 subs = {s[i:j] for i in range(len(s))
                         for j in range(i + 2, len(s) + 1)}
-                out.append(float(len(subs & words)))
+                return float(len(subs & words))
+
+            rows = a[0]._string_rows()
+            legacy = _ms.legacy_enabled()
+            with _ms.op("num_valid_substrings", len(rows),
+                        path="legacy" if legacy else "vectorized"):
+                if legacy:
+                    out = [np.nan if s is None else _count(str(s))
+                           for s in rows]
+                else:
+                    uniq = sorted({str(s) for s in rows if s is not None})
+                    import os as _os
+
+                    nthreads = min(_os.cpu_count() or 1, 8)
+                    if len(uniq) >= 64 and nthreads > 1:
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        step = -(-len(uniq) // nthreads)
+                        chunks = [uniq[k:k + step]
+                                  for k in range(0, len(uniq), step)]
+                        with ThreadPoolExecutor(len(chunks)) as ex:
+                            parts = list(ex.map(
+                                lambda ch: [_count(s) for s in ch], chunks))
+                        counts = [c for p in parts for c in p]
+                    else:
+                        counts = [_count(s) for s in uniq]
+                    lut = dict(zip(uniq, counts))
+                    out = [np.nan if s is None else lut[str(s)]
+                           for s in rows]
             return Frame.from_dict(
                 {"num_valid_substrings": np.asarray(out, np.float64)})
         if op == "moment":
             # (moment yr mo dy hr mi se ms) — epoch millis in UTC
-            # (ast/prims/time/AstMoment); each arg a scalar or a column
-            import datetime as _dt
-            import zoneinfo
+            # (ast/prims/time/AstMoment); each arg a scalar or a column.
+            # Vectorized as datetime64 calendar algebra when the session
+            # time zone is UTC; per-row datetime construction otherwise
+            # (DST arithmetic) and for the seed comparator.
+            from . import munge_stats as _ms
 
-            tz = zoneinfo.ZoneInfo(_TIME_ZONE[0])
             if len(a) != 7:
                 raise ValueError(
                     "moment expects 7 args (yr mo dy hr mi se ms), got %d"
@@ -675,19 +770,23 @@ class RapidsSession:
             vals = [(c if c is not None
                      else np.full(nrow, float(a[i])))
                     for i, c in enumerate(cols)]
-            out = np.empty(nrow, np.float64)
-            for r in range(nrow):
-                y_, mo, dy, hr, mi, se, ms = (vals[j][r] for j in range(7))
-                try:
-                    t = _dt.datetime(int(y_), int(mo), int(dy), int(hr),
-                                     int(mi), int(se), int(ms) * 1000,
-                                     tzinfo=tz)
-                    out[r] = t.timestamp() * 1000.0
-                except (ValueError, OverflowError):
-                    out[r] = np.nan
+            legacy = _ms.legacy_enabled()
+            per_row = legacy or _TIME_ZONE[0] != "UTC"
+            # "legacy" is reserved for the env-forced comparator; the
+            # non-UTC per-row route books as "fallback"
+            path = ("legacy" if legacy
+                    else "fallback" if per_row else "vectorized")
+            with _ms.op("moment", nrow, path=path):
+                if per_row:
+                    out = _moment_rows(vals, range(nrow), _TIME_ZONE[0])
+                else:
+                    out = _moment_vectorized(vals, nrow)
             return Frame.from_dict({"moment": out})
         if op == "asDate":
-            # (asDate col format) — java SimpleDateFormat pattern subset
+            # (asDate col format) — java SimpleDateFormat pattern subset.
+            # Factorized: strptime runs once per UNIQUE string (per enum
+            # domain label for categoricals) and scatters back through the
+            # codes — repeated date strings parse once, not once per row.
             fmt = str(a[1])
             for j, py in (("yyyy", "%Y"), ("yy", "%y"), ("MMM", "%b"),
                           ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
@@ -696,17 +795,52 @@ class RapidsSession:
             import datetime as _dt
             import zoneinfo
 
+            from . import munge_stats as _ms
+
             tz = zoneinfo.ZoneInfo(_TIME_ZONE[0])
-            out = []
-            for s in a[0]._string_rows():
+
+            def _parse_one(s) -> float:
                 try:
                     t = _dt.datetime.strptime(str(s), fmt).replace(tzinfo=tz)
-                    out.append(t.timestamp() * 1000.0)
+                    return t.timestamp() * 1000.0
                 except (ValueError, TypeError):
-                    out.append(np.nan)
+                    return np.nan
+
             fr0 = a[0]
-            return Frame({fr0.names[0]: Vec(np.asarray(out, np.float64),
-                                            "time")})
+            v0 = fr0.vecs()[0]
+            legacy = _ms.legacy_enabled()
+            per_row = legacy or v0.type not in ("enum", "string")
+            path = ("legacy" if legacy
+                    else "fallback" if per_row else "vectorized")
+            with _ms.op("as_date", fr0.nrow, path=path):
+                if per_row:
+                    out = np.asarray([_parse_one(s)
+                                      for s in fr0._string_rows()],
+                                     np.float64)
+                elif v0.type == "enum":
+                    dom = v0.domain or []
+                    parsed = np.asarray([_parse_one(d) for d in dom]
+                                        + [np.nan], np.float64)
+                    codes = np.asarray(v0.data, np.int64)
+                    out = parsed[np.where(codes >= 0, codes, len(dom))]
+                else:
+                    arr = v0.to_numpy()
+                    na = np.asarray(arr == None, bool)  # noqa: E711
+                    work = arr.copy()
+                    work[na] = ""
+                    # unique over the OBJECT array (all-str after the NA
+                    # fill): a fixed-width "U" cast would allocate
+                    # nrow × max-string-length and one long outlier row
+                    # could blow memory
+                    uniq, inv = np.unique(work, return_inverse=True)
+                    parsed = np.asarray([_parse_one(s)
+                                         for s in uniq.tolist()],
+                                        np.float64)
+                    out = parsed[inv.reshape(-1)]
+                    # None rows go through str(None)="None" in the seed
+                    # loop — unparseable, so NaN either way
+                    out[na] = np.nan
+            return Frame({fr0.names[0]: Vec(out, "time")})
         if op == "listTimeZones":
             import zoneinfo
 
@@ -1000,14 +1134,20 @@ class RapidsSession:
             while i + 2 < len(a) + 1:
                 agg = str(a[i])
                 coli = int(a[i + 1])
-                # a[i+2] is the NA-handling mode ("all"/"rm"/"ignore")
+                # a[i+2] is the NA-handling mode ("all"/"rm"/"ignore"),
+                # honored by GroupBy (AstGroup.NAHandling semantics)
+                namode = str(a[i + 2]) if i + 2 < len(a) else "all"
                 col = fr.names[coli]
                 fn = {"nrow": "count", "mean": "mean", "sum": "sum",
                       "min": "min", "max": "max", "sdev": "sd", "sd": "sd",
                       "var": "var", "median": "median", "mode": "mode"}.get(agg)
                 if fn is None:
                     raise ValueError(f"Rapids GB: unknown aggregate {agg!r}")
-                getattr(gb, fn)(col) if fn != "count" else gb.count()
+                if fn == "count":
+                    # keep the referenced column so nrow can honor na="rm"
+                    gb._add("count", col, namode)
+                else:
+                    getattr(gb, fn)(col, na=namode)
                 i += 3
             return gb.get_frame()
         if op == "ddply":

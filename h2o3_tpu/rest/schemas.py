@@ -59,6 +59,40 @@ def all_schemas() -> List[Dict]:
 
 SERVING_SCHEMA_NAME = "ServingMetricsV3"
 INGEST_SCHEMA_NAME = "IngestMetricsV3"
+MUNGE_SCHEMA_NAME = "MungeMetricsV3"
+
+
+def munge_metrics_schema() -> Dict:
+    """Field metadata of the `GET /3/Munge/metrics` document (the
+    vectorized munging engine's observability schema — docs/munging.md
+    mirrors this)."""
+    fields = [
+        ("totals", "MungeTotals",
+         "cumulative ops/rows_in/rows_out/secs + derived rows_per_s over"
+         " every munge op since start (or reset)"),
+        ("ops", "map<op, MungeOpStats>",
+         "per-op calls/errors/rows_in/rows_out/secs/rows_per_s + path"
+         " counts (merge, group_by, pivot, table, apply_rows, moment,"
+         " as_date, num_valid_substrings); a call that raised counts in"
+         " errors with rows_out 0"),
+        ("ops.*.paths", "map<string,int>",
+         "how calls executed: vectorized (columnar kernels), fallback"
+         " (exact per-row loop — after a failed vectorized attempt, or"
+         " where vectorization doesn't apply: non-UTC moment, asDate on"
+         " a non-string/enum column, 0-row apply), legacy"
+         " (H2O3_MUNGE_LEGACY=1 seed path)"),
+        ("last", "MungeOpStats",
+         "the most recent op, or null before the first one"),
+        ("last.rows_per_s", "double", "input rows / wall seconds"),
+        ("last.stages", "map<string,double>",
+         "per-stage seconds — merge books factorize / combine / match /"
+         " assemble (same buckets runtime/phases records as munge_*)"),
+        ("active", "boolean", "false until the first munge op happens"),
+    ]
+    return dict(
+        name=MUNGE_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )
 
 
 def ingest_metrics_schema() -> Dict:

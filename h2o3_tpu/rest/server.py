@@ -125,6 +125,7 @@ class _Handler(BaseHTTPRequestHandler):
         ("POST", r"^/3/Predictions/models/([^/]+)/frames/([^/]+)$", "predict"),
         ("GET", r"^/3/Serving/metrics$", "serving_metrics"),
         ("GET", r"^/3/Ingest/metrics$", "ingest_metrics"),
+        ("GET", r"^/3/Munge/metrics$", "munge_metrics"),
         ("DELETE", r"^/3/Serving/cache$", "serving_cache_clear"),
         ("POST", r"^/3/ModelMetrics/models/([^/]+)/frames/([^/]+)$", "model_metrics"),
         ("GET", r"^/3/Jobs$", "jobs_list"),
@@ -847,6 +848,19 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(__meta=dict(schema_type=schemas.INGEST_SCHEMA_NAME),
                         **profiler.ingest_stats()))
 
+    def h_munge_metrics(self):
+        """`GET /3/Munge/metrics` — munging-engine throughput counters +
+        per-op stage timings (schema: schemas.munge_metrics_schema; also
+        folded into /3/Profiler via runtime/profiler.munge_stats)."""
+        from ..runtime import profiler
+
+        p = self._params()
+        if self._flag(p, "schema"):
+            self._send(schemas.munge_metrics_schema())
+            return
+        self._send(dict(__meta=dict(schema_type=schemas.MUNGE_SCHEMA_NAME),
+                        **profiler.munge_stats()))
+
     def h_serving_cache_clear(self):
         """`DELETE /3/Serving/cache[?model=key]` — evict compiled scorers
         (all, or one model's) so a hot-swapped artifact re-traces."""
@@ -927,7 +941,8 @@ class _Handler(BaseHTTPRequestHandler):
                                     entries=profiler.profile(nsamples=2,
                                                              interval=0.01))],
                         serving=profiler.serving_stats(),
-                        ingest=profiler.ingest_stats()))
+                        ingest=profiler.ingest_stats(),
+                        munge=profiler.munge_stats()))
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()))

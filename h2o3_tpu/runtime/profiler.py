@@ -66,6 +66,18 @@ def ingest_stats() -> Dict:
     return out
 
 
+def munge_stats() -> Dict:
+    """Munging-engine observability folded into the profiler surface
+    (mirrors `ingest_stats`): cumulative + per-op + last-op rows/s and the
+    per-stage split (e.g. merge's factorize/combine/match/assemble)
+    recorded by frame/munge_stats. Pure counter read — never runs an op."""
+    from ..frame import munge_stats as stats
+
+    out = stats.snapshot()
+    out["active"] = out["totals"]["ops"] > 0
+    return out
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """`with profiler.trace('/tmp/tb'):` — device + host trace via

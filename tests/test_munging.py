@@ -261,9 +261,12 @@ def test_apply_axis1_multivalue_rows(cloud1):
     # nrow(==ncol) values per ROW -> 2 columns, not one misread column
     wide = fr.apply(lambda row: np.asarray([1.0, 2.0]), axis=1)
     assert wide.shape == (2, 2)
-    widths = iter([1, 2])
+    # width depends on ROW CONTENT (not external iterator state — the
+    # vectorized path probes the callable, so state-carrying lambdas
+    # would observe extra calls); per-row widths 1 then 2 must raise
     with _pytest.raises(ValueError, match="ragged"):
-        fr.apply(lambda row: np.ones(next(widths)), axis=1)
+        fr.apply(lambda row: np.ones(
+            1 if float(row["a"]._col0()[0]) == 1.0 else 2), axis=1)
 
 
 def test_rapids_apply_margin1_frame_result(cloud1):

@@ -98,6 +98,18 @@ def test_chunked_vs_single_vs_legacy_bit_identical(tmp_path):
     _cmp_frames(single, _legacy_parse(p), "legacy")
 
 
+def test_quoted_token_wider_than_all_plain_tokens(tmp_path):
+    """A quoted cell wider than every plain token used to overrun the
+    fast tokenizer's byte pad (sized from OK-row token widths only) and
+    IndexError the whole parse (PR 3 review repro)."""
+    p = str(tmp_path / "wide.csv")
+    with open(p, "w") as f:
+        f.write('a,b\n"q,uoted,with,long,separators,inside",2\nx,3\n')
+    fr = parse_csv(p)
+    assert fr.nrow == 2
+    _cmp_frames(fr, _legacy_parse(p), "legacy")
+
+
 def test_quoted_field_straddling_chunk_split(tmp_path):
     """A quoted field containing the separator AND an embedded newline that
     straddles the chunk split must parse identically to the single-chunk

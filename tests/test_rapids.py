@@ -15,14 +15,19 @@ def test_group_by_aggregates(cloud1):
         "g": np.asarray(["a", "b", "a", "b", "a"], dtype=object),
         "v": [1.0, 2.0, 3.0, 4.0, np.nan],
     })
-    out = fr.group_by("g").count().sum("v").mean("v").get_frame()
+    out = fr.group_by("g").count().sum("v", na="rm").mean("v", na="rm").get_frame()
     assert out.nrow == 2
     d = out.as_data_frame()
     ia = list(d["g"]).index("a")
     ib = list(d["g"]).index("b")
     assert d["nrow"][ia] == 3
-    assert d["sum_v"][ia] == pytest.approx(4.0)   # NAs skipped
+    assert d["sum_v"][ia] == pytest.approx(4.0)   # na="rm" skips NAs
     assert d["mean_v"][ib] == pytest.approx(3.0)
+    # the default na="all" PROPAGATES NA into the aggregate (AstGroup
+    # NAHandling.ALL) — group a contains an NA, group b does not
+    d2 = fr.group_by("g").sum("v").get_frame().as_data_frame()
+    assert np.isnan(d2["sum_v"][list(d2["g"]).index("a")])
+    assert d2["sum_v"][list(d2["g"]).index("b")] == pytest.approx(6.0)
 
 
 def test_group_by_multi_key(cloud1):
