@@ -471,6 +471,78 @@ def bench_scaling():
              "rows": rows, "unit_override": "x"})
 
 
+def bench_grid():
+    """Parallel multi-model training (ISSUE 4): a small GBM grid with
+    5-fold CV, reporting rows-trained/s of the pooled path (shared
+    dataset-artifact cache + CV fold reuse + parallelism) and the speedup
+    vs the sequential seed walk (H2O3_TRAIN_LEGACY=1: no cache, per-fold
+    re-bin, no pool). Works forced-CPU (BENCH_PLATFORM=cpu skips the
+    probe); acceptance floor: vs_seed ≥ 2 on a 2-core host."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 20_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 20))
+    nfolds = int(os.environ.get("BENCH_FOLDS", 5))
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.dataset_cache import clear as _cache_clear
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    X, y = make_higgs_like(n_rows, n_feat=12)
+    names = [f"f{i}" for i in range(12)] + ["label"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names) \
+        .asfactor("label")
+    hyper = {"max_depth": [3, 4], "learn_rate": [0.1, 0.2]}
+    n_combos = 4
+    # oversubscribe the cores: candidates spend real wall in host python /
+    # dispatch gaps, so 4 in flight beat cpu_count on a 2-core box
+    par = 4
+    # the per-chunk phase-accounting sync barriers serialize exactly the
+    # overlap this bench measures — time both paths without them
+    from h2o3_tpu.runtime import phases as _phz_mod
+
+    acct_prior = _phz_mod.ENABLED
+    _phz_mod.ENABLED = False
+
+    def run(parallelism, legacy, reps=1):
+        best = float("inf")
+        for _ in range(reps):
+            _cache_clear()
+            with _forced_env("H2O3_TRAIN_LEGACY", legacy):
+                grid = H2OGridSearch(
+                    H2OGradientBoostingEstimator(
+                        ntrees=ntrees, nfolds=nfolds, seed=42,
+                        histogram_type="UniformAdaptive"),
+                    hyper, parallelism=parallelism)
+                t0 = time.perf_counter()
+                grid.train(y="label", training_frame=fr)
+                best = min(best, time.perf_counter() - t0)
+            assert len(grid.models) == n_combos, grid.failed
+        return best
+
+    # pooled reps first (rep 1 absorbs compile into the shared cache), the
+    # legacy comparator last — both measure compile-warm walls
+    try:
+        wall_new = run(par, legacy=False, reps=2)
+        wall_seq = run(1, legacy=True, reps=1)
+    finally:
+        _phz_mod.ENABLED = acct_prior
+    # the phase buckets accumulated across both comparator paths and all
+    # reps (and without the accounting barriers) — meaningless as a
+    # decomposition of the reported wall; drop them from this config
+    _phz_mod.reset()
+    # every candidate trains the parent fit + nfolds fold fits
+    rows_trained = n_combos * (nfolds + 1) * n_rows
+    rps = rows_trained / wall_new
+    return (f"grid_gbm_{n_rows//1000}k_{n_combos}combo_{nfolds}cv_rows_per_s",
+            rps,
+            {"unit_override": "rows/s",
+             "wall_s": round(wall_new, 3),
+             "seq_seed_wall_s": round(wall_seq, 3),
+             "vs_seed": round(wall_seq / wall_new, 2),
+             "rows": n_rows, "n_models": n_combos, "nfolds": nfolds,
+             "parallelism": par,
+             "seed_rows_per_s": round(rows_trained / wall_seq)})
+
+
 def bench_automl():
     """AutoML leaderboard (BASELINE.json config 5)."""
     n_rows = int(os.environ.get("BENCH_ROWS", 50_000))
@@ -515,7 +587,7 @@ R02_BASELINE = {
 # not the machine. Repeat each wall-clock config and report the BEST run
 # (first run also absorbs executable deserialization for later ones).
 DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
-                   "scaling": 1, "ingest": 2, "munge": 2}
+                   "scaling": 1, "ingest": 2, "munge": 2, "grid": 1}
 
 
 def _probe_accelerator(timeout_s: float):
@@ -575,7 +647,55 @@ def _fail_line(config: str, why: str) -> dict:
             "vs_baseline": 0.0, "error": why, "backend": None}
 
 
+def _cpu_rerun(config: str, deadline: float) -> "dict | None":
+    """Re-run this bench forced-CPU in a fresh subprocess (a half-dead jax
+    backend cannot be re-platformed in-process) and return its result JSON,
+    or None if the rerun also failed. `deadline` is the parent watchdog's
+    absolute fire time — the child gets the time actually REMAINING (minus
+    margin to emit), not the full budget, else a late accelerator failure
+    would see the watchdog kill the rerun mid-measurement."""
+    import subprocess
+
+    budget = deadline - time.time() - 30.0
+    if budget < 60.0:
+        return None     # not enough runway for a meaningful CPU datapoint
+    env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_REPEATS="1")
+    if "BENCH_ROWS" not in os.environ:
+        fallback_rows = {"gbm": 100_000, "glm": 100_000,
+                         "xgb_rank": 50_000, "dl": 20_000,
+                         "automl": 20_000}.get(config)
+        if fallback_rows:
+            env["BENCH_ROWS"] = str(fallback_rows)
+    p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         start_new_session=True)
+    _LIVE_CHILD_PGIDS.add(p.pid)
+    try:
+        out, _err = p.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        p.communicate()
+        return None
+    finally:
+        _LIVE_CHILD_PGIDS.discard(p.pid)
+    for ln in reversed(out.splitlines()):
+        if ln.startswith("{"):
+            try:
+                got = json.loads(ln)
+            except ValueError:
+                return None
+            return got if got.get("value") else None
+    return None
+
+
 def main():
+    t_main = time.time()
     config = os.environ.get("BENCH_CONFIG", "gbm")
     # the watchdog covers the probe too (the probe's own pipe drain can block
     # if an axon helper grandchild survives): whatever happens below, the
@@ -650,7 +770,8 @@ def main():
     fn = {"gbm": bench_gbm, "glm": bench_glm, "dl": bench_dl,
           "xgb_rank": bench_xgb_rank, "automl": bench_automl,
           "score": bench_score, "scaling": bench_scaling,
-          "ingest": bench_ingest, "munge": bench_munge}[config]
+          "ingest": bench_ingest, "munge": bench_munge,
+          "grid": bench_grid}[config]
     # cold is strictly one run: repeats within a process share the live
     # executable cache, so any second run would be warm yet labeled cold
     repeats = 1 if cold else int(os.environ.get(
@@ -665,7 +786,29 @@ def main():
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        _emit(_fail_line(config, f"bench raised: {e!r}"))
+        # the probe passed but the run itself died (tunnel flap mid-flight):
+        # re-run the whole bench forced-CPU in a subprocess and emit ITS
+        # measurement tagged cpu-fallback — an on-CPU datapoint beats an
+        # error-only value-0.0 line (VERDICT r05: the artifact must carry a
+        # measurement unconditionally). Already-CPU runs have nothing to
+        # fall back to.
+        try:
+            backend_is_cpu = jax.default_backend() == "cpu"
+        except Exception:
+            # the accelerator backend itself may be what died — never let
+            # the fallback decision kill the guaranteed emit
+            backend_is_cpu = False
+        already_cpu = (cpu_fallback_reason is not None
+                       or forced == "cpu"
+                       or backend_is_cpu)
+        line = None if already_cpu else _cpu_rerun(config,
+                                                   t_main + watchdog_s)
+        if line is not None:
+            line["backend"] = "cpu-fallback"
+            line["fallback_reason"] = f"bench raised on accelerator: {e!r}"
+            _emit(line)
+        else:
+            _emit(_fail_line(config, f"bench raised: {e!r}"))
         sys.exit(0)
     metric = runs[0][0]
     higher_better = (metric.endswith(("samples_per_s", "rows_per_s"))

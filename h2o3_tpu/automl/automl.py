@@ -111,6 +111,7 @@ class H2OAutoML:
         project_name: Optional[str] = None,
         verbosity: Optional[str] = None,
         keep_cross_validation_predictions: bool = True,
+        parallelism: int = 1,
         **kw,
     ):
         self.max_models = max_models
@@ -125,6 +126,10 @@ class H2OAutoML:
             set(a.upper() for a in include_algos) if include_algos else None
         )
         self.project_name = project_name or f"automl_{int(time.time())}"
+        # candidate builds in flight at once (runtime/trainpool.py); results
+        # enter the leaderboard in submission order, so any parallelism
+        # produces the same leaderboard as the sequential walk
+        self.parallelism = max(int(parallelism or 1), 1)
         self.event_log = EventLog()
         self.leaderboard: Optional[Leaderboard] = None
         self.leader = None
@@ -181,8 +186,8 @@ class H2OAutoML:
             hidden=[32, 32, 32], epochs=10, mini_batch_size=128)
         return steps
 
-    def _build_model(self, name, cls, parms, x, y, training_frame) -> bool:
-        """Build one leaderboard model (shared by default steps and grids)."""
+    def _candidate(self, name, cls, parms, x, y, training_frame):
+        """(name, build_fn) for the train pool — one leaderboard model."""
         parms = dict(parms)
         parms["seed"] = self.seed
         parms["nfolds"] = self.nfolds
@@ -193,17 +198,50 @@ class H2OAutoML:
         parms["keep_cross_validation_models"] = False
         if self.max_runtime_secs_per_model:
             parms["max_runtime_secs"] = self.max_runtime_secs_per_model
-        try:
+
+        def fn(job):
             est = cls(**parms)
+            est._external_job = job   # pool cancel reaches the driver
             est.train(x=x, y=y, training_frame=training_frame)
             est._automl_name = name
-            self._models.append(est)
-            self.leaderboard.add(est, self._lb_frame)
-            self.event_log.log("model", f"built {name} ({est.model_id})")
-            return True
-        except Exception as e:
-            self.event_log.log("error", f"{name} failed: {e}")
-            return False
+            return est
+
+        return (name, fn)
+
+    def _run_candidates(self, cands, budget_left) -> bool:
+        """Run candidate builds through the train pool (runtime/trainpool)
+        in max_models-bounded waves; leaderboard entries land in submission
+        order, so parallelism never changes the resulting leaderboard.
+        Returns False once the budget or max_models is exhausted."""
+        from ..runtime import trainpool as _tp
+
+        i = 0
+        while i < len(cands):
+            if not budget_left():
+                self.event_log.log("budget", "max_runtime_secs reached")
+                return False
+            remaining = (self.max_models - len(self._models)
+                         if self.max_models else len(cands) - i)
+            if remaining <= 0:
+                return False
+            batch = cands[i:i + remaining]
+            i += len(batch)
+            pool = _tp.TrainPool(self.parallelism, label=self.project_name)
+            recs = pool.run(batch, stop_when=lambda: not budget_left())
+            for (name, _), rec in zip(batch, recs):
+                if rec.ok:
+                    est = rec.result
+                    self._models.append(est)
+                    self.leaderboard.add(est, self._lb_frame)
+                    self.event_log.log(
+                        "model", f"built {name} ({est.model_id})")
+                elif rec.status == "failed":
+                    self.event_log.log("error", f"{name} failed: {rec.error}")
+                elif rec.status in ("skipped", "cancelled"):
+                    self.event_log.log("budget",
+                                       "max_runtime_secs reached")
+                    return False
+        return True
 
     def _run_random_grids(self, x, y, training_frame, budget_left):
         import itertools
@@ -226,6 +264,7 @@ class H2OAutoML:
                 hidden=[[32], [64, 64], [128, 128]],
                 epochs=[10], mini_batch_size=[128])),
         ]
+        cands = []
         for gi, (algo, cls, hp) in enumerate(grids):
             if not self._allowed(algo):
                 continue
@@ -234,12 +273,10 @@ class H2OAutoML:
                       for v in itertools.product(*(hp[k] for k in keys))]
             rng.shuffle(combos)
             for ci, parms in enumerate(combos[:3]):  # budget-bounded sample
-                if not budget_left():
-                    return
-                if self.max_models and len(self._models) >= self.max_models:
-                    return
-                self._build_model(f"{algo}_grid_1_model_{ci + 1}", cls, parms,
-                                  x, y, training_frame)
+                cands.append(self._candidate(
+                    f"{algo}_grid_1_model_{ci + 1}", cls, parms,
+                    x, y, training_frame))
+        self._run_candidates(cands, budget_left)
 
     def _remote_train(self, x, y, training_frame):
         """AutoML against an attached server: POST `/99/AutoMLBuilder`,
@@ -305,14 +342,11 @@ class H2OAutoML:
         budget_left = lambda: (
             self.max_runtime_secs <= 0 or time.time() - t0 < self.max_runtime_secs
         )
-        for step in self._steps(problem):
-            if not budget_left():
-                self.event_log.log("budget", "max_runtime_secs reached")
-                break
-            if self.max_models and len(self._models) >= self.max_models:
-                break
-            self._build_model(step["name"], step["cls"], step["parms"],
-                              x, y, training_frame)
+        self._run_candidates(
+            [self._candidate(s["name"], s["cls"], s["parms"],
+                             x, y, training_frame)
+             for s in self._steps(problem)],
+            budget_left)
 
         # random grids (modeling.*Steps grids: XGBoost/GBM/DL RandomDiscrete
         # exploration after the defaults, while budget remains)

@@ -134,8 +134,20 @@ def tokenize_block(lines: Sequence[str], sep: str, ncol: int) -> np.ndarray:
     out = np.empty((nrows, ncol), dtype=object)
     if nrows == 0:
         return out
-    u = np.asarray(lines)
-    bulk = (_S.find(u, '"') < 0) & (_S.count(u, sep) == ncol - 1)
+    lens = [len(ln) for ln in lines]
+    # np.asarray(lines) materializes an (nrows × longest-line) fixed-width
+    # unicode matrix; a chunk mixing many short rows with one very long
+    # field would over-allocate max_len/mean_len-fold (e.g. one 1 MB cell
+    # among 10k 40-byte rows ⇒ ~40 GB). When the skew makes the matrix
+    # cost several× the actual text, classify lines row-wise instead —
+    # same bulk mask, O(total chars) memory.
+    if max(lens) * nrows > 4 * sum(lens) + (1 << 20):
+        bulk = np.fromiter(
+            (('"' not in ln) and ln.count(sep) == ncol - 1 for ln in lines),
+            np.bool_, nrows)
+    else:
+        u = np.asarray(lines)
+        bulk = (_S.find(u, '"') < 0) & (_S.count(u, sep) == ncol - 1)
     bulk_idx = np.flatnonzero(bulk)
     if bulk_idx.size:
         if bulk_idx.size == nrows:

@@ -203,9 +203,11 @@ class Frame:
 
     def _touch(self) -> None:
         """In-place mutation hook: every mutator calls this so per-frame
-        caches (e.g. stacked-ensemble level-one predictions) can never
-        serve results computed from the frame's previous contents."""
+        caches (e.g. stacked-ensemble level-one predictions, the training
+        dataset-artifact cache keyed on `_version`) can never serve results
+        computed from the frame's previous contents."""
         self.__dict__.pop("_lvl1_preds", None)
+        self._version = getattr(self, "_version", 0) + 1
 
     def take(self, idx: np.ndarray) -> "Frame":
         return Frame({n: v.take(idx) for n, v in self._vecs.items()})

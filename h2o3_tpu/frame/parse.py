@@ -59,7 +59,12 @@ def parse_setup(path: str, sample_bytes: int = 1 << 16, sep: Optional[str] = Non
     """Guess separator / header / column types from a sample — the
     `ParseSetup.guessSetup` step."""
     with open(path, "rb") as f:
-        sample = f.read(sample_bytes).decode("utf-8", errors="replace")
+        raw = f.read(sample_bytes)
+    # a short read means the sample IS the whole file — the lone-line
+    # header tiebreak below must not fire on a truncated first line of a
+    # larger file (it would eat that file's first data row)
+    sample_is_whole_file = len(raw) < sample_bytes
+    sample = raw.decode("utf-8", errors="replace")
     lines = [ln for ln in sample.splitlines() if ln.strip()][:100]
     if not lines:
         raise ValueError(f"empty file {path}")
@@ -71,9 +76,13 @@ def parse_setup(path: str, sample_bytes: int = 1 << 16, sep: Optional[str] = Non
             sep = ","
     first = _split_sample_line(lines[0], sep)
     # header iff the first line holds a non-numeric token AND at least one
-    # data line follows — the lone line of a single-line file is DATA (a
-    # header over zero rows parses to an empty frame)
-    header = len(lines) > 1 and not all(_is_num_or_na(t) for t in first)
+    # data line follows. Lone-line tiebreak: a single multi-column line
+    # whose tokens are ALL non-numeric ("id,name\n") is a header over zero
+    # rows — the header-only export case; any numeric token (or a single
+    # column) keeps the lone line as DATA (the ISSUE-2 rule).
+    header = (len(lines) > 1 and not all(_is_num_or_na(t) for t in first)) \
+        or (len(lines) == 1 and sample_is_whole_file and len(first) > 1
+            and not any(_is_num_or_na(t) for t in first))
     data_lines = lines[1:] if header else lines
     ncol = len(first)
     # split each sample line ONCE and index columns from the cached parts

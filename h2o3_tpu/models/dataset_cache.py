@@ -1,0 +1,217 @@
+"""Dataset-artifact cache — bin/pack/transfer a training frame ONCE per sweep.
+
+Every tree fit runs the same prep pipeline over its training frame:
+`frame_to_matrix` (Frame → float64 matrix), `build_bins` (quantize to bin
+codes), sub-byte bit-packing and the H2D upload of the code matrix. A grid
+sweep or AutoML run repeats that per candidate even though every candidate
+shares ONE (frame, x) pair — exactly the waste XGBoost's `gpu_hist` avoids
+by quantizing once and reusing the compressed binned matrix across all
+boosting work ("XGBoost: Scalable GPU Accelerated Learning", PAPERS.md).
+
+This module is the sweep-level analog: a fingerprinted three-layer cache
+
+- **matrix**: key(frame, x) → (X float64, is_categorical, domains)
+- **bins**: + (nbins, histogram_type[, seed for Random]) → `BinnedMatrix`
+- **device**: + (npad rows) → the device-resident unpacked code matrix,
+  so repeat candidates skip the pack + tunnel upload entirely
+  (single-process, single-device clouds only — sharded global arrays are
+  rebuilt per fit)
+
+Fingerprint: frame identity (id + DKV key + a weakref guard), row count,
+the frame's in-place mutation counter (`Frame._touch` bumps it), the x
+column list, and each column's Vec/buffer identity — replacing a column or
+mutating the frame invalidates, while Rapids-style functional ops produce
+new frames (new ids) naturally.
+
+Eviction: LRU over entries with both an entry cap
+(``H2O3_DATASET_CACHE_ENTRIES``, default 4) and a byte budget
+(``H2O3_DATASET_CACHE_MB``, default 1024, host+device bytes). Dead frames
+drop their entries via weakref callback. ``H2O3_DATASET_CACHE=0`` (or the
+bench comparator ``H2O3_TRAIN_LEGACY=1``) disables caching entirely.
+
+Stats (hits/misses/evictions per layer) feed ``GET /3/Training/metrics``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_LOCK = threading.RLock()
+_ENTRIES: "OrderedDict[tuple, _Entry]" = OrderedDict()
+_STATS = dict(matrix_hits=0, matrix_misses=0, bins_hits=0, bins_misses=0,
+              device_hits=0, device_misses=0, evictions=0)
+
+
+def enabled() -> bool:
+    if os.environ.get("H2O3_DATASET_CACHE", "1") in ("0", "false", "no"):
+        return False
+    from ..runtime import trainpool
+
+    return not trainpool.legacy()
+
+
+def _caps() -> Tuple[int, int]:
+    """(max entries, max bytes) — read per call so tests can env-tune."""
+    ents = int(os.environ.get("H2O3_DATASET_CACHE_ENTRIES", 4))
+    mb = float(os.environ.get("H2O3_DATASET_CACHE_MB", 1024))
+    return max(ents, 1), int(mb * 1e6)
+
+
+class _Entry:
+    __slots__ = ("frame_ref", "key", "matrix", "bins", "device", "lock",
+                 "__weakref__")
+
+    def __init__(self, frame, key):
+        self.frame_ref = weakref.ref(frame, lambda _: _drop(key))
+        self.key = key
+        self.matrix = None                      # (X, is_cat, doms)
+        self.bins: Dict[tuple, object] = {}     # bkey -> BinnedMatrix
+        self.device: Dict[tuple, object] = {}   # (bkey, npad) -> jax array
+        self.lock = threading.Lock()            # serializes builds per entry
+
+    def nbytes(self) -> int:
+        total = 0
+        if self.matrix is not None:
+            total += int(self.matrix[0].nbytes)
+        for bm in self.bins.values():
+            total += int(bm.codes.nbytes)
+        for arr in self.device.values():
+            total += int(np.prod(arr.shape)) * arr.dtype.itemsize
+        return total
+
+
+def _drop(key) -> None:
+    with _LOCK:
+        _ENTRIES.pop(key, None)
+
+
+def _frame_key(frame, x: Tuple[str, ...]) -> tuple:
+    cols = tuple(
+        (n, id(v), id(v.data) if getattr(v, "data", None) is not None else 0)
+        for n, v in ((n, frame.vec(n)) for n in x))
+    return (id(frame), frame.key, int(frame.nrow),
+            int(getattr(frame, "_version", 0)), x, cols)
+
+
+def _entry_for(frame, x: Tuple[str, ...]) -> "_Entry":
+    key = _frame_key(frame, x)
+    with _LOCK:
+        e = _ENTRIES.get(key)
+        if e is not None and e.frame_ref() is frame:
+            _ENTRIES.move_to_end(key)
+            return e
+        e = _ENTRIES[key] = _Entry(frame, key)
+        _evict_locked(keep=key)
+        return e
+
+
+def _evict_locked(keep=None) -> None:
+    """LRU-evict entries other than `keep` until both caps are met."""
+    max_entries, max_bytes = _caps()
+    victims = [k for k in _ENTRIES if k != keep]
+    while victims and len(_ENTRIES) > max_entries:
+        _ENTRIES.pop(victims.pop(0), None)
+        _STATS["evictions"] += 1
+    while victims and sum(e.nbytes() for e in _ENTRIES.values()) > max_bytes:
+        _ENTRIES.pop(victims.pop(0), None)
+        _STATS["evictions"] += 1
+
+
+def _bins_key(nbins: int, histogram_type: str, seed) -> tuple:
+    ht = "UniformAdaptive" if histogram_type in ("AUTO", None) \
+        else str(histogram_type)
+    # only Random binning draws from the seed; other types share across seeds
+    return (int(nbins), ht, int(seed) if ht == "Random" else None)
+
+
+def matrix(frame, x, builder: Callable[[], tuple]):
+    """(X, is_categorical, domains) for (frame, x) — cached."""
+    e = _entry_for(frame, tuple(x))
+    with e.lock:
+        if e.matrix is not None:
+            with _LOCK:
+                _STATS["matrix_hits"] += 1
+            return e.matrix
+        with _LOCK:
+            _STATS["matrix_misses"] += 1
+        built = builder()
+        # publish under _LOCK: nbytes()/snapshot() iterate entry dicts
+        # holding only _LOCK, so mutations must not race them (lock order
+        # is always entry.lock → _LOCK, never reversed)
+        with _LOCK:
+            e.matrix = built
+    with _LOCK:
+        _evict_locked(keep=e.key)
+    return e.matrix
+
+
+def bins(frame, x, nbins: int, histogram_type: str, seed,
+         builder: Callable[[], object]):
+    """`BinnedMatrix` for (frame, x, nbins, histogram_type) — cached."""
+    e = _entry_for(frame, tuple(x))
+    bkey = _bins_key(nbins, histogram_type, seed)
+    with e.lock:
+        bm = e.bins.get(bkey)
+        if bm is not None:
+            with _LOCK:
+                _STATS["bins_hits"] += 1
+            return bm
+        with _LOCK:
+            _STATS["bins_misses"] += 1
+        bm = builder()
+        with _LOCK:   # see matrix(): publish vs nbytes()/snapshot() races
+            e.bins[bkey] = bm
+    with _LOCK:
+        _evict_locked(keep=e.key)
+    return bm
+
+
+def device_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
+                 builder: Callable[[], object]):
+    """Device-resident (padded, unpacked) code matrix — cached so repeat
+    candidates skip the pack + H2D upload. Single-device clouds only
+    (the caller gates); `builder` does the pack/upload/unpack and its own
+    byte accounting on a miss."""
+    e = _entry_for(frame, tuple(x))
+    dkey = (_bins_key(nbins, histogram_type, seed), int(npad))
+    with e.lock:
+        arr = e.device.get(dkey)
+        if arr is not None:
+            with _LOCK:
+                _STATS["device_hits"] += 1
+            return arr
+        with _LOCK:
+            _STATS["device_misses"] += 1
+        arr = builder()
+        with _LOCK:   # see matrix(): publish vs nbytes()/snapshot() races
+            e.device[dkey] = arr
+    with _LOCK:
+        _evict_locked(keep=e.key)
+    return arr
+
+
+def snapshot() -> Dict:
+    with _LOCK:
+        stats = dict(_STATS)
+        entries = len(_ENTRIES)
+        nbytes = sum(e.nbytes() for e in _ENTRIES.values())
+    stats.update(entries=entries, bytes=int(nbytes), enabled=enabled())
+    return stats
+
+
+def clear() -> None:
+    """Drop every entry (tests / explicit memory release)."""
+    with _LOCK:
+        _ENTRIES.clear()
+
+
+def reset_stats() -> None:
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0

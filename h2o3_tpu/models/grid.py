@@ -62,6 +62,7 @@ class H2OGridSearch:
         grid_id: Optional[str] = None,
         search_criteria: Optional[Dict[str, Any]] = None,
         recovery_dir: Optional[str] = None,
+        parallelism: int = 1,
     ):
         # `model` may be an estimator class or a template instance (h2o-py
         # accepts both)
@@ -81,6 +82,11 @@ class H2OGridSearch:
         self.grid_id = grid_id or f"grid_{int(time.time())}"
         self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.recovery_dir = recovery_dir
+        # upstream H2OGridSearch `parallelism`: how many candidate builds
+        # may be in flight at once (runtime/trainpool.py — results and the
+        # resulting leaderboard stay in submission order, so any value
+        # produces the same grid as parallelism=1)
+        self.parallelism = max(int(parallelism or 1), 1)
         self.models: List = []
         self.failed: List[Dict] = []
         self._done_combos: List[Dict] = []  # restored on recovery
@@ -151,7 +157,8 @@ class H2OGridSearch:
                 combos = combos[: int(mm)]
         return combos
 
-    def train(self, x=None, y=None, training_frame: Optional[Frame] = None, **kw):
+    def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
+              parallelism: Optional[int] = None, **kw):
         if getattr(training_frame, "_is_remote", False):
             if kw:
                 raise TypeError(
@@ -160,11 +167,67 @@ class H2OGridSearch:
             return self._remote_train(x, y, training_frame)
         t0 = time.time()
         budget = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
-        for combo in self._combos():
+        combos = [c for c in self._combos()
+                  if not any(d["params"] == c for d in self._done_combos)]
+        par = max(int(parallelism if parallelism is not None
+                      else self.parallelism), 1)
+
+        from ..runtime import trainpool as _tp
+
+        if _tp.legacy():
+            # H2O3_TRAIN_LEGACY=1: the seed sequential walk, verbatim — the
+            # bench.py vs_seed comparator (no pool, no artifact cache)
+            return self._train_sequential(combos, x, y, training_frame,
+                                          t0, budget, **kw)
+
+        import threading
+
+        ckpt_lock = threading.Lock()
+
+        def _candidate(combo):
+            def fn(job):
+                parms = dict(self.base_parms)
+                parms.update(combo)
+                parms.pop("model_id", None)
+                est = self.model_class(**parms)
+                # the pool's child job rides into the estimator so /3/Jobs
+                # cancellation of the grid reaches scoring-boundary safe
+                # points inside the candidate's training loop
+                est._external_job = job
+                est.train(x=x, y=y, training_frame=training_frame, **kw)
+                est._grid_combo = combo
+                if self.recovery_dir:
+                    # checkpoint failures must not mark the built model
+                    # failed; a combo only counts done once its artifact
+                    # exists on disk (seed semantics, now under a lock)
+                    with ckpt_lock:
+                        try:
+                            self._record_done(est, combo)
+                            self._save_state()
+                        except (TypeError, OSError):
+                            pass
+                return est
+            return fn
+
+        pool = _tp.TrainPool(par, label=self.grid_id,
+                             parent_job=getattr(self, "_external_job", None))
+        recs = pool.run(
+            [(f"combo{i}", _candidate(c)) for i, c in enumerate(combos)],
+            stop_when=(lambda: bool(budget) and time.time() - t0 > budget))
+        for combo, rec in zip(combos, recs):
+            if rec.ok:
+                self.models.append(rec.result)
+            elif rec.status == "failed":
+                # failed combos are recorded, the walk continues
+                self.failed.append({"params": combo, "error": rec.error})
+        return self
+
+    def _train_sequential(self, combos, x, y, training_frame, t0, budget,
+                          **kw):
+        """The seed-era sequential walk (H2O3_TRAIN_LEGACY comparator)."""
+        for combo in combos:
             if budget and time.time() - t0 > budget:
                 break
-            if any(d["params"] == combo for d in self._done_combos):
-                continue  # recovered: finished combos already have artifacts
             parms = dict(self.base_parms)
             parms.update(combo)
             parms.pop("model_id", None)
@@ -173,14 +236,10 @@ class H2OGridSearch:
                 est.train(x=x, y=y, training_frame=training_frame, **kw)
                 est._grid_combo = combo
                 self.models.append(est)
-            except Exception as e:  # failed combos are recorded, walk continues
+            except Exception as e:
                 self.failed.append({"params": combo, "error": str(e)})
                 continue
             if self.recovery_dir:
-                # checkpoint OUTSIDE the train try: an I/O failure must not
-                # mark the built model failed, and a combo only counts as
-                # done once its artifact actually exists on disk (else a
-                # resumed grid would skip it with nothing to restore).
                 try:
                     self._record_done(est, combo)
                     self._save_state()
@@ -271,6 +330,8 @@ class H2OGridSearch:
                       grid_id=self.grid_id,
                       hyper_parameters=_json.dumps(self.hyper_params),
                       search_criteria=_json.dumps(self.search_criteria))
+        if self.parallelism != 1:
+            params["parallelism"] = self.parallelism
         if x is not None:
             params["x"] = _json.dumps(list(x))
         out = conn.post(f"/99/Grid/{cls.algo}", **params)

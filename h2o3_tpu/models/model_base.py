@@ -971,11 +971,35 @@ class H2OEstimator:
                 assign = np.empty(n, np.int64)
                 assign[order] = np.arange(n) % nfolds
             folds = np.arange(nfolds)
+        # -- CV fold reuse ---------------------------------------------------
+        # Tree builders expose the parent fit's BinnedMatrix; folds then
+        # reuse its codes via row-index slicing instead of two full
+        # `Frame.take` copies + a per-fold re-bin/re-pack (LightGBM/XGBoost-
+        # style CV over one quantized matrix). The fold frame shrinks to the
+        # response + any *_column parameters. H2O3_CV_REBIN=1 (or the bench
+        # comparator H2O3_TRAIN_LEGACY=1) restores the seed per-fold path,
+        # which stays bit-exact with earlier rounds.
+        import os as _os
+
+        from ..parallel import distdata
+        from ..runtime import trainpool as _trainpool
+
+        reuse_bm = None
+        if (_os.environ.get("H2O3_CV_REBIN", "") in ("", "0")
+                and not _trainpool.legacy()
+                and not distdata.multiprocess()
+                and self._cv_can_reuse()):
+            reuse_bm = self._cv_reuse_source(model, train)
+        keep_cols = [y] + sorted(
+            v for k, v in self._parms.items()
+            if k.endswith("_column") and isinstance(v, str)
+            and v in train.names and v != y)
+
         holdout = None
         cv_models = []
         for f in folds:
-            tr = train.take(np.nonzero(assign != f)[0])
-            ho = train.take(np.nonzero(assign == f)[0])
+            idx_tr = np.nonzero(assign != f)[0]
+            idx_ho = np.nonzero(assign == f)[0]
             sub = type(self)()
             sub._parms.update(
                 {k: v for k, v in self._parms.items() if not k.startswith("_")}
@@ -983,17 +1007,42 @@ class H2OEstimator:
             sub._parms["nfolds"] = 0
             sub._parms["model_id"] = None  # fold models get their own ids
             sub._parms["_actual_seed"] = self._parms["_actual_seed"]
-            # pad fold fits up to the parent's padded row shape so every
-            # fold reuses the parent's compiled tree program (the second
-            # program load costs seconds through a remote-chip tunnel)
-            sub._parms["_npad_floor"] = getattr(model, "_npad", 0)
-            cvm = sub._fit(x, y, tr, None)
-            pred = sub._cv_predict(cvm, ho)
+            _trainpool.record_cv_fold(reused=reuse_bm is not None)
+            if reuse_bm is not None:
+                # reuse folds take their NATURAL row bucket instead of the
+                # parent's padded shape: pad rows are zero-weight no-ops
+                # (results are padded-shape invariant), every fold of every
+                # sweep candidate lands on the same ~((k-1)/k)-size bucket,
+                # and the one extra compile amortizes across all of them —
+                # while the parent shape would tax each fold ~k/(k-1)×
+                # extra histogram compute forever.
+                tr = Frame({nm: train.vec(nm).take(idx_tr)
+                            for nm in keep_cols})
+                sub._parms["_cv_reuse"] = dict(bm=reuse_bm, rows=idx_tr)
+                cvm = sub._fit(x, y, tr, None)
+                pred = sub._cv_predict_codes(cvm, reuse_bm.codes[idx_ho])
+            else:
+                # seed path: pad fold fits up to the parent's padded row
+                # shape so every fold reuses the parent's compiled tree
+                # program (the second program load costs seconds through a
+                # remote-chip tunnel)
+                sub._parms["_npad_floor"] = getattr(model, "_npad", 0)
+                tr = train.take(idx_tr)
+                ho = train.take(idx_ho)
+                cvm = sub._fit(x, y, tr, None)
+                pred = sub._cv_predict(cvm, ho)
             if holdout is None:
                 holdout = np.zeros((n,) + pred.shape[1:], dtype=np.float64)
             holdout[assign == f] = pred
             if self._parms.get("keep_cross_validation_models", True):
-                cvm.validation_metrics = cvm._make_metrics(ho)
+                if reuse_bm is not None:
+                    # fold validation metrics straight from the holdout
+                    # prediction (same probabilities _make_metrics would
+                    # score — the codes path IS the scoring path here)
+                    cvm.validation_metrics = self._metrics_from_cv(
+                        train.vec(y).take(idx_ho), None, pred)
+                else:
+                    cvm.validation_metrics = cvm._make_metrics(ho)
                 cv_models.append(cvm)
         model._cv_holdout_pred = holdout
         model.cross_validation_models = cv_models or None
@@ -1008,6 +1057,17 @@ class H2OEstimator:
 
     def _cv_predict(self, model: H2OModel, frame: Frame) -> np.ndarray:
         """Holdout prediction as probabilities (classif) or values (regr)."""
+        raise NotImplementedError
+
+    # -- CV fold-reuse hooks (overridden by builders that can slice a
+    # parent-fit artifact per fold — see shared_tree.py) --------------------
+    def _cv_can_reuse(self) -> bool:
+        return False
+
+    def _cv_reuse_source(self, model: H2OModel, train: Frame):
+        return None
+
+    def _cv_predict_codes(self, model: H2OModel, codes) -> np.ndarray:
         raise NotImplementedError
 
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> H2OModel:

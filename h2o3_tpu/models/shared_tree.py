@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from typing import Dict, List, NamedTuple, Optional, Sequence
 
@@ -357,6 +358,10 @@ def _pack_hp(tp, lr, colp, mtries_rate=0.0) -> "jnp.ndarray":
 
 
 _STEP_FNS_CAP = 32
+# the per-cloud step-program cache and the device-pack registry are shared
+# by concurrent candidate fits (runtime/trainpool.py) — guard them
+_STEP_FNS_LOCK = threading.Lock()
+_DEV_PACKS_LOCK = threading.Lock()
 
 
 @jax.jit
@@ -473,16 +478,17 @@ def _tree_step_fns(cfg: _StepCfg, cloud):
     (depths/shapes) don't accumulate programs forever."""
     from collections import OrderedDict
 
-    cache = cloud.__dict__.setdefault("_step_fns_cache", OrderedDict())
-    fns = cache.get(cfg)
-    if fns is None:
-        fns = _build_tree_step_fns(cfg, cloud)
-        cache[cfg] = fns
-        while len(cache) > _STEP_FNS_CAP:
-            cache.popitem(last=False)
-    else:
-        cache.move_to_end(cfg)
-    return fns
+    with _STEP_FNS_LOCK:
+        cache = cloud.__dict__.setdefault("_step_fns_cache", OrderedDict())
+        fns = cache.get(cfg)
+        if fns is None:
+            fns = _build_tree_step_fns(cfg, cloud)
+            cache[cfg] = fns
+            while len(cache) > _STEP_FNS_CAP:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(cfg)
+        return fns
 
 
 def _build_tree_step_fns(cfg: _StepCfg, cloud):
@@ -693,21 +699,22 @@ def _register_dev_pack(model, budget: int) -> None:
     never evicted (it is the model being trained)."""
     import weakref
 
-    _DEV_PACKS.append(weakref.ref(model))
-    live, total = [], 0
-    for r in _DEV_PACKS:
-        m = r()
-        if m is not None and m.__dict__.get("_packed_dev") is not None:
-            live.append(r)
-            total += pack_nbytes(m._packed_dev)
-    drop = 0
-    while total > budget and drop < len(live) - 1:
-        m = live[drop]()
-        if m is not None:
-            total -= pack_nbytes(m._packed_dev)
-            m.release_device_forest()
-        drop += 1
-    _DEV_PACKS[:] = live[drop:]
+    with _DEV_PACKS_LOCK:
+        _DEV_PACKS.append(weakref.ref(model))
+        live, total = [], 0
+        for r in _DEV_PACKS:
+            m = r()
+            if m is not None and m.__dict__.get("_packed_dev") is not None:
+                live.append(r)
+                total += pack_nbytes(m._packed_dev)
+        drop = 0
+        while total > budget and drop < len(live) - 1:
+            m = live[drop]()
+            if m is not None:
+                total -= pack_nbytes(m._packed_dev)
+                m.release_device_forest()
+            drop += 1
+        _DEV_PACKS[:] = live[drop:]
 
 
 class SharedTreeModel(H2OModel):
@@ -915,8 +922,34 @@ class SharedTreeModel(H2OModel):
             outs.append(np.asarray(s, np.float64)[:n] + f0k)
         return np.column_stack(outs)
 
+    def _margins_codes(self, codes: np.ndarray) -> np.ndarray:
+        """Forest margins on PRE-BINNED codes (the CV fold-reuse holdout
+        path): rows of the parent's `BinnedMatrix` score through
+        `predict_codes` directly — no raw-matrix rebuild, no re-bin. Rows
+        are bucketed like `_margins` so CV folds share compiled scorers;
+        zero pad codes walk the trees harmlessly and are sliced off."""
+        n = codes.shape[0]
+        npad = cloudlib.pad_to_multiple(n, SCORE_ROW_BUCKET)
+        if npad != n:
+            codes = np.concatenate(
+                [codes, np.zeros((npad - n, codes.shape[1]), codes.dtype)])
+        cj = jnp.asarray(codes)
+        outs = []
+        for k in range(self._n_class_forests):
+            stacked = jax.tree.map(jnp.asarray, self._padded_forest(k))
+            s = _predict_forest_codes_jit(stacked, cj, self.max_depth)
+            f0k = self.f0 if np.ndim(self.f0) == 0 else self.f0[k]
+            outs.append(np.asarray(s, np.float64)[:n] + f0k)
+        return np.column_stack(outs)
+
+    def _probs_from_codes(self, codes: np.ndarray) -> np.ndarray:
+        return self._finish_probs(self._margins_codes(codes))
+
     def _score_probs(self, X: np.ndarray, offset: Optional[np.ndarray] = None) -> np.ndarray:
-        m = self._margins(X)
+        return self._finish_probs(self._margins(X), offset)
+
+    def _finish_probs(self, m: np.ndarray,
+                      offset: Optional[np.ndarray] = None) -> np.ndarray:
         if offset is not None and self.mode != "drf":
             m = m + offset[:, None]
         out = probs_from_margins(self.mode, self.problem, self.distribution,
@@ -1243,6 +1276,35 @@ class H2OSharedTreeEstimator(H2OEstimator):
         if mt not in (-2, -1, 0) and mt < 1:
             bad(f"mtries must be -2, -1, or >= 1, got {mt}")
 
+    # -- CV fold reuse (model_base._run_cv fast path) -----------------------
+    def _cv_can_reuse(self) -> bool:
+        """Tree fits can slice the parent's binned codes per fold unless a
+        feature needs the fold's raw x columns or frame-path scoring:
+        checkpoint continuation (re-bins with the prior model's edges),
+        monotone constraints (validated against the training frame's
+        column types), and offset_column (per-fold validation metrics
+        apply the holdout's offset through the frame scoring path)."""
+        return (self._parms.get("checkpoint") is None
+                and not self._parms.get("monotone_constraints")
+                and not self._parms.get("offset_column"))
+
+    def _cv_reuse_source(self, model, train: Frame):
+        bm = getattr(model, "bm", None)
+        if isinstance(bm, BinnedMatrix) and bm.codes is not None \
+                and bm.codes.shape[0] == train.nrow:
+            return bm
+        return None
+
+    def _cv_predict_codes(self, model: SharedTreeModel,
+                          codes: np.ndarray) -> np.ndarray:
+        """`_cv_predict` on pre-binned holdout codes (fold-reuse path)."""
+        out = model._probs_from_codes(codes)
+        if model.problem == "binomial":
+            return out[:, 1]
+        if model.problem == "multinomial":
+            return out
+        return out[:, 0]
+
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> SharedTreeModel:
         _ph = _Phase()
         tp = self._tree_params()
@@ -1257,11 +1319,33 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # DRF trees fit raw response means (no boosting margin)
             dist = "gaussian" if problem == "regression" else dist
 
-        X, is_cat, doms = frame_to_matrix(train, x)
-        n, F = X.shape
-        # clamp nbins to max categorical cardinality like nbins_cats
-        max_card = int(max([len(d) for d, c in zip(doms, is_cat) if c and d], default=0))
-        nbins = max(tp["nbins"] + 1, min(max_card + 1, 1 << 10))
+        # CV fold reuse (models/model_base._run_cv): the parent fit already
+        # built the full frame's BinnedMatrix — folds slice its rows instead
+        # of re-running frame_to_matrix + build_bins per fold (the
+        # LightGBM/XGBoost-style CV over one quantized matrix).
+        # H2O3_CV_REBIN=1 disables this upstream, restoring the seed path.
+        cvr = self._parms.get("_cv_reuse")
+        from . import dataset_cache as _dsc
+
+        multiproc = distdata.multiprocess()
+        use_cache = (cvr is None and not multiproc and _dsc.enabled())
+        if cvr is not None:
+            pbm, cv_rows = cvr["bm"], np.asarray(cvr["rows"])
+            X = None
+            is_cat = np.asarray(pbm.is_categorical, bool)
+            doms = list(pbm.domains)
+            n, F = int(len(cv_rows)), int(pbm.codes.shape[1])
+            nbins = int(pbm.nbins)
+        else:
+            if use_cache:
+                X, is_cat, doms = _dsc.matrix(
+                    train, x, builder=lambda: frame_to_matrix(train, x))
+            else:
+                X, is_cat, doms = frame_to_matrix(train, x)
+            n, F = X.shape
+            # clamp nbins to max categorical cardinality like nbins_cats
+            max_card = int(max([len(d) for d, c in zip(doms, is_cat) if c and d], default=0))
+            nbins = max(tp["nbins"] + 1, min(max_card + 1, 1 << 10))
         # memory-feasibility depth clamp: the static level-complete heap
         # materializes ~2^D·F·nbins per-node histograms at the deepest level
         # (~96 B/bin-slot empirical, incl. XLA tile padding and co-resident
@@ -1290,7 +1374,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     f"({hbm_budget >> 30} GiB)")
                 tp["max_depth"] = feas
         _ph.mark("frame_to_matrix")
-        multiproc = distdata.multiprocess()
         col_ranges = None
         if multiproc:
             # multi-host cloud: this process holds its ingest shard; global
@@ -1319,11 +1402,27 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 colv = colv[np.isfinite(colv)]
                 col_qedges.append(
                     np.unique(distdata.global_quantiles(colv, qs)))
-        bm = build_bins(
-            X, nbins=nbins, histogram_type=tp["histogram_type"], names=list(x),
-            is_categorical=is_cat, domains=doms, seed=seed,
-            col_ranges=col_ranges, col_quantile_edges=col_qedges,
-        )
+        if cvr is not None:
+            # row-slice the parent's codes; edges/domains are shared objects
+            # (the fold model scores through the SAME quantization grid)
+            bm = BinnedMatrix(
+                codes=pbm.codes[cv_rows], edges=pbm.edges, nbins=pbm.nbins,
+                names=list(pbm.names), is_categorical=pbm.is_categorical,
+                domains=list(pbm.domains))
+        elif use_cache:
+            bm = _dsc.bins(
+                train, x, nbins, tp["histogram_type"], seed,
+                builder=lambda: build_bins(
+                    X, nbins=nbins, histogram_type=tp["histogram_type"],
+                    names=list(x), is_categorical=is_cat, domains=doms,
+                    seed=seed, col_ranges=col_ranges,
+                    col_quantile_edges=col_qedges))
+        else:
+            bm = build_bins(
+                X, nbins=nbins, histogram_type=tp["histogram_type"], names=list(x),
+                is_categorical=is_cat, domains=doms, seed=seed,
+                col_ranges=col_ranges, col_quantile_edges=col_qedges,
+            )
 
         w = (
             train.vec(self._parms["weights_column"]).numeric_np()
@@ -1472,6 +1571,16 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 and os.environ.get("H2O3_WARM_THREAD", "1") != "0":
             cfg_early = self._make_step_cfg(tp, npad, K, F, nbins, problem,
                                             dist)
+            # sweep-warm reuse: when this config's step program is already
+            # built in-process (a CV fold after its parent, or a repeat
+            # grid/AutoML candidate), the dummy warm execution is pure
+            # waste — a full tree step on zeros competing with the sweep's
+            # real work. The legacy comparator keeps the seed behavior.
+            from ..runtime import trainpool as _tpool
+
+            if not _tpool.legacy() and cfg_early in \
+                    cloud.__dict__.get("_step_fns_cache", {}):
+                cfg_early = None
             code_dt = jnp.uint8 if nbins <= 256 else jnp.uint16
             drf = self._mode == "drf"
 
@@ -1521,10 +1630,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 except Exception:  # warm-up is advisory; real call reports
                     pass
 
-            import threading
-
-            warm_thread = threading.Thread(target=_warm, daemon=True)
-            warm_thread.start()
+            if cfg_early is not None:
+                warm_thread = threading.Thread(target=_warm, daemon=True)
+                warm_thread.start()
 
         edges = np.full((F, nbins - 2), np.float32(np.inf), np.float32)
         for j, e in enumerate(bm.edges):
@@ -1550,19 +1658,29 @@ class H2OSharedTreeEstimator(H2OEstimator):
         else:
             from ..runtime import phases as _phases_mod
 
-            codes_p = padr(bm.codes)
-            pack_bits = (_pack_bits_for(nbins, codes_p.shape[0])
-                         if codes_p.dtype == np.uint8 else 0)
-            if pack_bits:
-                # sub-byte packing: the bin-code matrix is the biggest fixed
-                # H2D cost (~6 MB/s tunnel) — ship 4/5/6-bit codes (half to
-                # 3/4 of the bytes) and widen on device with a tiny program
-                packed = _pack_host(codes_p, pack_bits)
-                _phases_mod.add("h2d", 0.0, packed.nbytes)
-                codes_d = _unpack_device(jnp.asarray(packed), pack_bits)
-            else:
+            def _build_codes_dev():
+                codes_p = padr(bm.codes)
+                pack_bits = (_pack_bits_for(nbins, codes_p.shape[0])
+                             if codes_p.dtype == np.uint8 else 0)
+                if pack_bits:
+                    # sub-byte packing: the bin-code matrix is the biggest
+                    # fixed H2D cost (~6 MB/s tunnel) — ship 4/5/6-bit codes
+                    # (half to 3/4 of the bytes) and widen on device
+                    packed = _pack_host(codes_p, pack_bits)
+                    _phases_mod.add("h2d", 0.0, packed.nbytes)
+                    return _unpack_device(jnp.asarray(packed), pack_bits)
                 _phases_mod.add("h2d", 0.0, codes_p.nbytes)
-                codes_d = jnp.asarray(codes_p)
+                return jnp.asarray(codes_p)
+
+            if use_cache and ndev == 1:
+                # sweep-level reuse: every candidate sharing this
+                # (frame, x, nbins, histogram) trains off ONE device-resident
+                # code matrix — the pack + tunnel upload happens once
+                codes_d = _dsc.device_codes(
+                    train, x, nbins, tp["histogram_type"], seed, npad,
+                    builder=_build_codes_dev)
+            else:
+                codes_d = _build_codes_dev()
             if yk.size and bool(np.all((yk >= 0) & (yk <= 255)
                                        & (yk == np.floor(yk)))):
                 # integer-ish response (class indicators, counts): ship uint8

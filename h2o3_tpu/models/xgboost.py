@@ -178,6 +178,17 @@ class H2OXGBoostEstimator(H2OSharedTreeEstimator):
             raise ValueError("max_leaves needs grow_policy='lossguide' "
                              "(depthwise growth is bounded by max_depth)")
 
+    def _cv_can_reuse(self) -> bool:
+        """gblinear folds fit a DataInfo design from the fold frame's raw x
+        columns, and ranking folds rebuild lambdarank state (and NDCG) from
+        the fold frame — both need full fold frames, not sliced codes."""
+        if str(self._parms.get("booster", "gbtree")) == "gblinear":
+            return False
+        obj = self._parms.get("objective")
+        if obj and str(obj).startswith("rank"):
+            return False
+        return super()._cv_can_reuse()
+
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]):
         self._check_params()
         if str(self._parms.get("booster", "gbtree")) == "gblinear":

@@ -152,13 +152,25 @@ def training_guard():
     overlap host-side work."""
     import contextlib
 
+    if must_serialize_training():
+        return _training_lock
+    return contextlib.nullcontext()
+
+
+def must_serialize_training() -> bool:
+    """True when `training_guard()` would hand out the real lock — i.e.
+    concurrent training jobs are unsafe on this cloud (multi-device CPU
+    thunk-pool rendezvous, or multi-process collective launch order). The
+    train-pool scheduler (runtime/trainpool.py) checks this and degrades
+    to sequential in-thread execution instead of taking the lock from
+    worker threads — an RLock already held by the submitting thread (the
+    REST grid handler wraps the whole sweep in training_guard) would
+    deadlock its own workers."""
     import jax
 
     c = _cloud
-    if c is not None and c.size > 1 and (
-            jax.default_backend() == "cpu" or jax.process_count() > 1):
-        return _training_lock
-    return contextlib.nullcontext()
+    return bool(c is not None and c.size > 1 and (
+        jax.default_backend() == "cpu" or jax.process_count() > 1))
 
 
 def pad_to_multiple(n: int, k: int) -> int:

@@ -60,6 +60,42 @@ def all_schemas() -> List[Dict]:
 SERVING_SCHEMA_NAME = "ServingMetricsV3"
 INGEST_SCHEMA_NAME = "IngestMetricsV3"
 MUNGE_SCHEMA_NAME = "MungeMetricsV3"
+TRAINING_SCHEMA_NAME = "TrainingMetricsV3"
+
+
+def training_metrics_schema() -> Dict:
+    """Field metadata of the `GET /3/Training/metrics` document (the
+    multi-model training engine's observability schema — docs/training.md
+    mirrors this)."""
+    fields = [
+        ("totals", "TrainingTotals",
+         "cumulative pool counters since start (or reset): pools run,"
+         " candidates submitted/completed/failed/cancelled/skipped,"
+         " busy worker-seconds and pool wall-seconds"),
+        ("cv", "CvReuseStats",
+         "cross-validation fold accounting: reuse_folds (parent binned-"
+         "matrix sliced per fold) vs rebin_folds (seed per-fold re-bin,"
+         " H2O3_CV_REBIN=1 or non-tree builders)"),
+        ("candidates", "list<CandidateStats>",
+         "the most recent candidate builds: name/label/status/wall_s, the"
+         " per-candidate phase split (host_prep/h2d/compile/trace/compute/"
+         "metrics seconds, attributed via runtime/phases thread-local"
+         " sinks) and bytes_h2d"),
+        ("last_pool", "PoolStats",
+         "the most recent sweep: parallelism (requested and effective —"
+         " clouds that must serialize training degrade to 1), n_jobs,"
+         " done/failed/cancelled/skipped, wall_s, busy_s and occupancy ="
+         " busy/(wall×parallelism)"),
+        ("cache", "DatasetCacheStats",
+         "the dataset-artifact cache (models/dataset_cache.py): hits/"
+         "misses per layer (matrix/bins/device), evictions, live entries,"
+         " resident bytes, enabled flag"),
+        ("active", "boolean", "false until the first pooled sweep runs"),
+    ]
+    return dict(
+        name=TRAINING_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )
 
 
 def munge_metrics_schema() -> Dict:

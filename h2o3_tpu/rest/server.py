@@ -18,6 +18,7 @@ import json
 import os
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
@@ -126,6 +127,7 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Serving/metrics$", "serving_metrics"),
         ("GET", r"^/3/Ingest/metrics$", "ingest_metrics"),
         ("GET", r"^/3/Munge/metrics$", "munge_metrics"),
+        ("GET", r"^/3/Training/metrics$", "training_metrics"),
         ("DELETE", r"^/3/Serving/cache$", "serving_cache_clear"),
         ("POST", r"^/3/ModelMetrics/models/([^/]+)/frames/([^/]+)$", "model_metrics"),
         ("GET", r"^/3/Jobs$", "jobs_list"),
@@ -861,6 +863,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(__meta=dict(schema_type=schemas.MUNGE_SCHEMA_NAME),
                         **profiler.munge_stats()))
 
+    def h_training_metrics(self):
+        """`GET /3/Training/metrics` — the multi-model training engine's
+        scheduler occupancy, per-candidate timings, CV reuse counters and
+        dataset-artifact cache stats (schema: schemas.training_metrics_
+        schema; also folded into /3/Profiler via
+        runtime/profiler.training_stats)."""
+        from ..runtime import profiler
+
+        p = self._params()
+        if self._flag(p, "schema"):
+            self._send(schemas.training_metrics_schema())
+            return
+        self._send(dict(__meta=dict(schema_type=schemas.TRAINING_SCHEMA_NAME),
+                        **profiler.training_stats()))
+
     def h_serving_cache_clear(self):
         """`DELETE /3/Serving/cache[?model=key]` — evict compiled scorers
         (all, or one model's) so a hot-swapped artifact re-traces."""
@@ -942,7 +959,8 @@ class _Handler(BaseHTTPRequestHandler):
                                                              interval=0.01))],
                         serving=profiler.serving_stats(),
                         ingest=profiler.ingest_stats(),
-                        munge=profiler.munge_stats()))
+                        munge=profiler.munge_stats(),
+                        training=profiler.training_stats()))
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()))
@@ -1005,6 +1023,7 @@ class _Handler(BaseHTTPRequestHandler):
         if isinstance(criteria, str):
             criteria = json.loads(criteria)
         grid_id = p.pop("grid_id", None)
+        parallelism = int(p.pop("parallelism", 1) or 1)
         cls = reg[algo]
         known = {**cls._common_defaults, **cls._param_defaults}
         base = {}
@@ -1019,12 +1038,17 @@ class _Handler(BaseHTTPRequestHandler):
         from ..models.grid import H2OGridSearch
 
         gs = H2OGridSearch(cls(**base), hyper, grid_id=grid_id,
-                           search_criteria=criteria)
+                           search_criteria=criteria,
+                           parallelism=parallelism)
         import uuid
 
         job = Job(dest=f"grid_rest_{uuid.uuid4().hex[:8]}",
                   description=f"{algo} grid").start()
         job.result = gs.grid_id
+        # the sweep's parent job: POST /3/Jobs/{id}/cancel on it skips
+        # unstarted combos and cancels in-flight candidates at their next
+        # scoring boundary (runtime/trainpool.py child jobs)
+        gs._external_job = job
         DKV.put(job.dest, job)
         DKV.put(gs.grid_id, gs)
 
@@ -1034,7 +1058,11 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 with mesh.training_guard():
                     gs.train(x=x, y=y, training_frame=train)
-                job.done()
+                if job.cancel_requested:
+                    job.status = "CANCELLED"
+                    job.end_time = time.time()
+                else:
+                    job.done()
             except Exception as e:
                 Log.err(f"grid {algo}: {e}")
                 job.status = "FAILED"
@@ -1102,6 +1130,10 @@ class _Handler(BaseHTTPRequestHandler):
         max_models = int(p.get("max_models", build.get("max_models", 0)) or 0)
         if max_models:
             kw["max_models"] = max_models
+        parallelism = int(p.get("parallelism",
+                                build.get("parallelism", 1)) or 1)
+        if parallelism != 1:
+            kw["parallelism"] = parallelism
         # an EXPLICIT 0 means unlimited (the ctor default is 3600) — only
         # an absent key keeps the default
         max_rt = p.get("max_runtime_secs", build.get("max_runtime_secs"))

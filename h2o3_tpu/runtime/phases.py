@@ -33,6 +33,26 @@ _SECS: dict = defaultdict(float)
 _BYTES: dict = defaultdict(int)
 _installed = False
 
+# per-candidate attribution: a training worker (runtime/trainpool.py)
+# installs a thread-local sink around one candidate's fit, and every add()
+# from that thread (driver phase marks AND the jax monitoring listener,
+# which fires in the dispatching thread) is mirrored into it — so
+# /3/Training/metrics can report per-candidate h2d/compile/host_prep even
+# when several candidates train concurrently.
+_TLS = threading.local()
+
+
+@contextmanager
+def candidate_sink():
+    """Install a thread-local phase sink; yields {'secs': {}, 'bytes': {}}."""
+    d = {"secs": {}, "bytes": {}}
+    prev = getattr(_TLS, "sink", None)
+    _TLS.sink = d
+    try:
+        yield d
+    finally:
+        _TLS.sink = prev
+
 ENABLED = os.environ.get("H2O3_PHASE_ACCOUNTING", "").lower() not in (
     "", "0", "false", "no")
 
@@ -46,6 +66,11 @@ def add(phase: str, secs: float = 0.0, nbytes: int = 0) -> None:
         _SECS[phase] += secs
         if nbytes:
             _BYTES[phase] += nbytes
+    sink = getattr(_TLS, "sink", None)
+    if sink is not None:   # thread-local — no lock needed
+        sink["secs"][phase] = sink["secs"].get(phase, 0.0) + secs
+        if nbytes:
+            sink["bytes"][phase] = sink["bytes"].get(phase, 0) + nbytes
 
 
 def reset() -> None:

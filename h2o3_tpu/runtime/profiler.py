@@ -78,6 +78,19 @@ def munge_stats() -> Dict:
     return out
 
 
+def training_stats() -> Dict:
+    """Multi-model training observability folded into the profiler surface
+    (mirrors `serving_stats`): train-pool occupancy, per-candidate phase
+    splits, CV fold reuse counters and the dataset-artifact cache. Pure
+    counter read — never trains anything."""
+    from ..models import dataset_cache
+    from . import trainpool
+
+    out = trainpool.snapshot()
+    out["cache"] = dataset_cache.snapshot()
+    return out
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """`with profiler.trace('/tmp/tb'):` — device + host trace via

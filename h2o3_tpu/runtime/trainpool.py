@@ -1,0 +1,239 @@
+"""Train pool — bounded scheduler for multi-model training sweeps.
+
+Grid search (`models/grid.py`) and AutoML (`automl/automl.py`) submit
+candidate model builds here instead of looping sequentially. With
+``parallelism=N`` (the upstream `H2OGridSearch` knob) up to N candidates
+are in flight at once: the device serializes their actual train-step
+executions, but each candidate's HOST work — frame→matrix expansion,
+binning, bit-packing, metrics, scoring-history, checkpoint serialization —
+overlaps with its siblings' device compute, the training analog of the
+serving micro-batcher's overlap. Results come back in SUBMISSION order, so
+``parallelism=4`` produces the same model list (and therefore the same
+leaderboard) as ``parallelism=1``; training itself is seed-deterministic.
+
+Safety: on meshes where concurrent jobs are genuinely unsafe (multi-device
+XLA:CPU thunk pools, multi-process clouds — `mesh.must_serialize_training`)
+the pool degrades to sequential in-thread execution. It must NOT take
+`mesh.training_guard()` from worker threads: the REST grid handler already
+holds that RLock around the whole sweep, and its own workers would
+deadlock against it.
+
+Error isolation: one candidate's exception is captured on its record (the
+sweep continues); `JobCancelled` marks the record cancelled. Each candidate
+gets a child `Job` whose cancel check also consults the sweep's parent job,
+so the existing `POST /3/Jobs/{id}/cancel` route on a REST-driven grid
+stops in-flight candidates at their next scoring boundary and skips the
+not-yet-started ones.
+
+Observability: per-candidate wall seconds plus the phase split attributed
+through `runtime/phases.candidate_sink` (h2d / compile / trace / host_prep
+/ compute / metrics and h2d bytes), pool occupancy (busy worker-seconds ÷
+wall·parallelism), and CV fold reuse/rebin counters — served at
+``GET /3/Training/metrics`` (TrainingMetricsV3) and folded into
+``/3/Profiler`` via `runtime/profiler.training_stats`.
+
+``H2O3_TRAIN_LEGACY=1`` is the bench comparator: callers bypass the pool
+(sequential seed loop), the dataset-artifact cache disables itself, and CV
+reverts to the per-fold re-bin path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import phases as _phases
+
+# candidate phase keys surfaced per record (subset of runtime/phases keys)
+_CAND_PHASES = ("host_prep", "h2d", "compile", "trace", "deserialize",
+                "compute", "metrics", "d2h")
+
+_LOCK = threading.Lock()
+_TOTALS = dict(pools=0, submitted=0, completed=0, failed=0, cancelled=0,
+               skipped=0, busy_s=0.0, wall_s=0.0)
+_CV = dict(reuse_folds=0, rebin_folds=0)
+_CANDIDATES: deque = deque(maxlen=int(os.environ.get(
+    "H2O3_TRAIN_CANDIDATE_LOG", 64)))
+_LAST_POOL: Dict = {}
+
+
+def legacy() -> bool:
+    """The seed-comparator switch: sequential loops, no artifact cache,
+    per-fold re-binning (bench.py's vs_seed measurement)."""
+    return os.environ.get("H2O3_TRAIN_LEGACY", "") not in ("", "0")
+
+
+def record_cv_fold(reused: bool) -> None:
+    with _LOCK:
+        _CV["reuse_folds" if reused else "rebin_folds"] += 1
+
+
+@dataclass
+class JobRecord:
+    """Outcome of one submitted candidate, in submission order."""
+
+    name: str
+    status: str = "pending"   # pending/done/failed/cancelled/skipped
+    result: object = None
+    error: Optional[str] = None
+    exception: Optional[BaseException] = None
+    wall_s: float = 0.0
+    phases: Dict[str, float] = field(default_factory=dict)
+    bytes_h2d: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "done"
+
+
+def _child_job(dest: str, parent=None):
+    """Per-candidate Job whose cancel check also consults the sweep's
+    parent job (REST cancel on the grid job reaches running candidates)."""
+    from ..models.model_base import Job
+
+    class _J(Job):
+        def check_cancelled(self):
+            if parent is not None and parent.cancel_requested:
+                self.cancel_requested = True
+            Job.check_cancelled(self)
+
+    return _J(dest=dest, description="train-pool candidate").start()
+
+
+class TrainPool:
+    """Run candidate build functions with bounded parallelism.
+
+    ``items`` are ``(name, fn)`` where ``fn(job)`` builds and returns one
+    model/estimator; ``job`` is the pool-created child Job (wire it in as
+    the estimator's ``_external_job`` so cancellation reaches the driver's
+    scoring-boundary safe points).
+    """
+
+    def __init__(self, parallelism: int = 1, label: str = "train",
+                 parent_job=None):
+        self.parallelism = max(int(parallelism or 1), 1)
+        self.label = label
+        self.parent_job = parent_job
+
+    def _effective_parallelism(self) -> int:
+        if self.parallelism <= 1 or legacy():
+            return 1
+        from ..parallel import mesh as cloudlib
+
+        cloudlib.cloud()  # resolve the lazy default before deciding
+        if cloudlib.must_serialize_training():
+            return 1
+        return self.parallelism
+
+    def run(self, items: Sequence[Tuple[str, Callable]],
+            stop_when: Optional[Callable[[], bool]] = None
+            ) -> List[JobRecord]:
+        records = [JobRecord(name=name) for name, _ in items]
+        par = self._effective_parallelism()
+        t0 = time.perf_counter()
+
+        def _one(i: int) -> None:
+            rec = records[i]
+            name, fn = items[i]
+            if self.parent_job is not None \
+                    and self.parent_job.cancel_requested:
+                rec.status = "cancelled"
+                return
+            if stop_when is not None and stop_when():
+                rec.status = "skipped"
+                return
+            job = _child_job(f"{self.label}_{name}", parent=self.parent_job)
+            t1 = time.perf_counter()
+            from ..models.model_base import JobCancelled
+
+            with _phases.candidate_sink() as sink:
+                try:
+                    rec.result = fn(job)
+                    rec.status = "done"
+                except JobCancelled:
+                    rec.status = "cancelled"
+                except Exception as e:  # error isolation: sweep continues
+                    rec.status = "failed"
+                    rec.error = str(e)
+                    rec.exception = e
+            rec.wall_s = time.perf_counter() - t1
+            secs = sink["secs"]
+            rec.phases = {k: round(secs[k], 4) for k in _CAND_PHASES
+                          if k in secs}
+            rec.bytes_h2d = int(sink["bytes"].get("h2d", 0))
+            _record_candidate(self.label, rec, par)
+
+        if par <= 1:
+            for i in range(len(records)):
+                _one(i)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=par,
+                    thread_name_prefix=f"h2o3tpu-train-{self.label}") as ex:
+                list(ex.map(_one, range(len(records))))
+
+        wall = time.perf_counter() - t0
+        busy = sum(r.wall_s for r in records)
+        entry = dict(
+            label=self.label, parallelism=par,
+            requested_parallelism=self.parallelism,
+            n_jobs=len(records),
+            done=sum(r.status == "done" for r in records),
+            failed=sum(r.status == "failed" for r in records),
+            cancelled=sum(r.status == "cancelled" for r in records),
+            skipped=sum(r.status == "skipped" for r in records),
+            wall_s=round(wall, 4), busy_s=round(busy, 4),
+            occupancy=round(busy / max(wall * par, 1e-9), 4),
+        )
+        with _LOCK:
+            _TOTALS["pools"] += 1
+            _TOTALS["submitted"] += len(records)
+            _TOTALS["completed"] += entry["done"]
+            _TOTALS["failed"] += entry["failed"]
+            _TOTALS["cancelled"] += entry["cancelled"]
+            _TOTALS["skipped"] += entry["skipped"]
+            _TOTALS["busy_s"] += busy
+            _TOTALS["wall_s"] += wall
+            _LAST_POOL.clear()
+            _LAST_POOL.update(entry)
+        return records
+
+
+def _record_candidate(label: str, rec: JobRecord, parallelism: int) -> None:
+    entry = dict(label=label, name=rec.name, status=rec.status,
+                 wall_s=round(rec.wall_s, 4), parallelism=parallelism,
+                 phases=rec.phases, bytes_h2d=rec.bytes_h2d)
+    if rec.error:
+        entry["error"] = rec.error
+    with _LOCK:
+        _CANDIDATES.append(entry)
+
+
+def snapshot() -> Dict:
+    """The GET /3/Training/metrics body (cache section joined in by the
+    REST handler from models/dataset_cache.snapshot())."""
+    with _LOCK:
+        totals = dict(_TOTALS)
+        cv = dict(_CV)
+        cands = list(_CANDIDATES)
+        last = dict(_LAST_POOL) if _LAST_POOL else None
+    busy, wall = totals.pop("busy_s"), totals.pop("wall_s")
+    totals["busy_s"] = round(busy, 4)
+    totals["wall_s"] = round(wall, 4)
+    return dict(totals=totals, cv=cv, candidates=cands, last_pool=last,
+                active=totals["submitted"] > 0)
+
+
+def reset() -> None:
+    with _LOCK:
+        _TOTALS.update(pools=0, submitted=0, completed=0, failed=0,
+                       cancelled=0, skipped=0, busy_s=0.0, wall_s=0.0)
+        _CV.update(reuse_folds=0, rebin_folds=0)
+        _CANDIDATES.clear()
+        _LAST_POOL.clear()

@@ -93,11 +93,33 @@ class _Worker:
                         break
                     self.cond.wait(remaining)
                 batch = self._pop_batch(cfg.max_batch_rows)
-            self._score(batch)
+            if batch:   # the window's requests may all have expired
+                self._score(batch)
         self.batcher._retire(self)
 
     def _pop_batch(self, max_rows: int) -> List[_Pending]:
-        """Pop the schema-compatible head prefix (callers hold self.cond)."""
+        """Pop the schema-compatible head prefix (callers hold self.cond).
+
+        Expired requests — whose caller's `event.wait` already timed out
+        (and released its admission slot) — are retired HERE, unscored:
+        under sustained overload the abandoned work would otherwise keep
+        consuming device time and the deque could grow without bound
+        past `max_queue` (only live requests hold admission slots)."""
+        timeout_s = self.batcher.config.request_timeout_s
+        cutoff = time.monotonic() - timeout_s
+        expired = [p for p in self.q if p.t_enqueue < cutoff]
+        if expired:
+            dead = set(map(id, expired))
+            self.q = deque(p for p in self.q if id(p) not in dead)
+            for p in expired:
+                p.error = TimeoutError(
+                    f"request expired unscored after {timeout_s:.0f}s "
+                    "in the batch queue")
+                p.event.set()   # caller is already gone; unblock stragglers
+            self.batcher.metrics.record_expired(self.model_key,
+                                                len(expired))
+        if not self.q:
+            return []
         sig = self.q[0].sig
         batch, rows = [], 0
         while self.q and self.q[0].sig == sig:

@@ -10,6 +10,9 @@ The observability face of the scoring subsystem, exposed at
   among admitted requests).
 - ``batches`` / ``batched_requests`` / ``batched_rows``: micro-batcher
   output — how many device dispatches served how much work.
+- ``expired``: requests retired unscored at batch-pop time because their
+  caller's wait had already timed out (the admission slot was released at
+  expiry; scoring abandoned work would spend device time on nobody).
 - ``compiles`` / ``cache_hits``: compiled-scorer cache — a compile is a
   scorer build OR a new padded-row-bucket trace; a cache hit is a batch
   served entirely by a warm executable. The warm-path invariant the tests
@@ -30,7 +33,8 @@ DEVICE_MS_BOUNDS = (1, 2, 5, 10, 25, 50, 100, 250, 1000, 5000, 30000)
 BATCH_SIZE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 512)
 
 _COUNTERS = ("requests", "rejections", "errors", "batches",
-             "batched_requests", "batched_rows", "compiles", "cache_hits")
+             "batched_requests", "batched_rows", "compiles", "cache_hits",
+             "expired")
 
 
 class LatencyHistogram:
@@ -112,6 +116,11 @@ class ServingMetrics:
 
     def record_error(self, model_key: str) -> None:
         self._bump(model_key, "errors")
+
+    def record_expired(self, model_key: str, n: int = 1) -> None:
+        """Queued requests retired at pop time because their caller's wait
+        already timed out — abandoned work that never reaches the device."""
+        self._bump(model_key, "expired", by=n)
 
     # -- batcher / cache level ---------------------------------------------
     def record_queue_wait(self, model_key: str, wait_s: float) -> None:

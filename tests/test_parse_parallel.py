@@ -283,20 +283,78 @@ def test_bulk_try_numeric_matches_elementwise_loop():
 
 
 # -- parse_setup fixes -------------------------------------------------------
-def test_parse_setup_single_line_is_data(tmp_path):
+def test_parse_setup_single_line_tiebreak(tmp_path):
+    """The decided lone-line tiebreak (ROADMAP item, ISSUE 4): an all-text
+    multi-column lone line is a HEADER over zero rows; any numeric token
+    (or a single column) keeps the lone line as data (the ISSUE-2 rule)."""
     p = str(tmp_path / "one.csv")
     with open(p, "w") as f:
         f.write("alpha,beta,gamma\n")
     setup = parse_setup(p)
-    assert setup["header"] is False           # lone line = data, not header
+    assert setup["header"] is True            # all-text lone line = header
     fr = parse_csv(p)
-    assert fr.nrow == 1 and fr.names == ["C1", "C2", "C3"]
+    assert fr.nrow == 0 and fr.names == ["alpha", "beta", "gamma"]
     # single NUMERIC line was already data; stays so
     p2 = str(tmp_path / "one2.csv")
     with open(p2, "w") as f:
         f.write("1,2,3\n")
     assert parse_setup(p2)["header"] is False
     assert parse_csv(p2).nrow == 1
+    # a lone MIXED line (text + numeric tokens) stays data
+    p3 = str(tmp_path / "one3.csv")
+    with open(p3, "w") as f:
+        f.write("alpha,2,3\n")
+    assert parse_setup(p3)["header"] is False
+    assert parse_csv(p3).nrow == 1
+    # a lone single-column word stays data (not a 1-column header)
+    p4 = str(tmp_path / "one4.csv")
+    with open(p4, "w") as f:
+        f.write("hello\n")
+    assert parse_setup(p4)["header"] is False
+    assert parse_csv(p4).nrow == 1
+
+
+def test_tokenize_block_long_line_skew(tmp_path):
+    """A chunk mixing many short rows with ONE very long field must not
+    materialize the (nrows × longest-line) fixed-width unicode matrix
+    (ROADMAP item): 2000 short rows beside a ~100 KB cell would allocate
+    ~800 MB there. The row-wise classification path produces identical
+    tokens at O(total chars) memory."""
+    import tracemalloc
+
+    short = [f"{i},ab,{i * 0.5}" for i in range(2000)]
+    long_cell = "x" * 100_000
+    lines = short[:1000] + [f'7,"{long_cell}",1.5'] + short[1000:]
+    tracemalloc.start()
+    out = chunked.tokenize_block(lines, ",", 3)
+    _cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert out.shape == (2001, 3)
+    assert out[1000, 1] == long_cell        # RFC-4180 dequoted, intact
+    assert out[0, 0] == "0" and out[2000, 2] == str(1999 * 0.5)
+    # generous bound: ~10× the text itself, far under the ~8 GB matrix
+    assert peak < 64 * 1024 * 1024, f"peak {peak / 1e6:.0f} MB"
+    # and the skewed block tokenizes exactly like the per-line reference
+    ref = np.empty_like(out)
+    for i, ln in enumerate(lines):
+        parts = chunked.split_csv_line(ln, ",")
+        ref[i, :] = parts[:3] if len(parts) >= 3 else parts + [""] * (3 - len(parts))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_parse_header_only_csv_zero_rows(tmp_path):
+    """`id,name\\n` with zero data rows parses as a named 0-row frame, not
+    one DATA row named C1/C2 — pinned end to end through the chunked
+    tokenizer and the column coercers."""
+    p = str(tmp_path / "header_only.csv")
+    with open(p, "w") as f:
+        f.write("id,name\n")
+    setup = parse_setup(p)
+    assert setup["header"] is True
+    assert setup["names"] == ["id", "name"]
+    fr = parse_csv(p)
+    assert fr.nrow == 0
+    assert fr.names == ["id", "name"]
 
 
 def test_parse_setup_quoted_first_line_sep_guess(tmp_path):

@@ -251,6 +251,58 @@ def test_batch_error_isolation():
     eng.shutdown()
 
 
+def test_batcher_retires_expired_pendings_unscored():
+    """Requests whose caller already timed out (admission slot released)
+    are retired at pop time instead of scored: abandoned work must not
+    consume device time, and the deque cannot grow past the live set
+    under sustained overload (ROADMAP item)."""
+    from h2o3_tpu.serving.batcher import _Pending
+    from h2o3_tpu.serving.metrics import ServingMetrics
+    from h2o3_tpu.serving.model_cache import ScorerCache
+
+    model = StubModel()
+    gate = threading.Event()
+    blocker = StubModel(gate=gate)
+    metrics = ServingMetrics()
+    cfg = _cfg(request_timeout_s=0.15, max_wait_ms=1.0)
+    batcher = MicroBatcher(ScorerCache(4), metrics, cfg)
+
+    # a caller that will give up (its model blocks past the timeout)
+    def abandoned():
+        with pytest.raises(TimeoutError):
+            batcher.submit("m", blocker, _frame(4, base=1.0))
+
+    t = threading.Thread(target=abandoned)
+    t.start()
+    time.sleep(0.05)
+    # pile queued requests behind the blocked batch; their callers all
+    # time out before the worker ever gets to them
+    stale = [_Pending(_frame(2, base=float(i + 2)), blocker)
+             for i in range(5)]
+    with batcher._lock:
+        w = batcher._workers[("m", "predict")]
+        with w.cond:
+            w.q.extend(stale)
+            w.cond.notify_all()
+    t.join(timeout=10)
+    time.sleep(0.3)            # let every stale entry pass its timeout
+    gate.set()                 # unblock the in-flight batch
+    # a FRESH live request is still served promptly...
+    out = batcher.submit("m", model, _frame(3, base=9.0))
+    assert out.nrow == 3
+    # ...and the stale ones were retired unscored (blocker scored only its
+    # first batch — the expired queue never reached the device)
+    deadline = time.time() + 5
+    while time.time() < deadline \
+            and metrics.counter("m", "expired") < len(stale):
+        time.sleep(0.02)
+    assert metrics.counter("m", "expired") == len(stale)
+    assert blocker.calls == 1
+    for p in stale:
+        assert p.result is None and isinstance(p.error, TimeoutError)
+    batcher.shutdown()
+
+
 def test_batcher_schema_mismatch_never_coalesced():
     """Frames with different schemas must not rbind into one batch."""
     class TwoColModel(StubModel):
