@@ -543,6 +543,75 @@ def bench_grid():
              "seed_rows_per_s": round(rows_trained / wall_seq)})
 
 
+def bench_chaos():
+    """Chaos smoke (ISSUE 5): loadgen against a live REST serving engine
+    with 1% injected scorer device-faults (`serving.scorer`, seeded). The
+    quarantine → rebuild → CPU-fallback failover path must keep p99 finite
+    and the hard-error rate at zero — a crashing scorer degrades to
+    latency, never to a 5xx storm. Reports p99 under fault injection plus
+    the failover counters."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 5_000))
+    threads = int(os.environ.get("BENCH_CHAOS_THREADS", 6))
+    requests = int(os.environ.get("BENCH_CHAOS_REQUESTS", 40))
+    fault_rate = float(os.environ.get("BENCH_CHAOS_FAULT_RATE", 0.01))
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "deploy"))
+    from loadgen import run_load
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.rest.server import start_server
+    from h2o3_tpu.runtime import faults
+    from h2o3_tpu.runtime.dkv import DKV
+    from h2o3_tpu.serving import get_engine
+
+    X, y = make_higgs_like(n_rows, n_feat=8)
+    names = [f"f{i}" for i in range(8)] + ["label"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names) \
+        .asfactor("label")
+    gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=4, seed=42)
+    gbm.train(y="label", training_frame=fr)
+    DKV.put("chaos_gbm", gbm.model)
+    score_fr = Frame({n: fr.vec(n) for n in names[:-1]})
+    score_fr.key = "chaos_frame"
+    DKV.put(score_fr.key, score_fr)
+    srv = start_server(port=0)
+    try:
+        # warm the serving path before arming faults so the measured run
+        # exercises failover, not first-compile
+        run_load("127.0.0.1", srv.port, "chaos_gbm", "chaos_frame",
+                 threads=2, requests=2)
+        faults.arm("serving.scorer", error="device", rate=fault_rate,
+                   seed=int(os.environ.get("BENCH_CHAOS_SEED", 1)))
+        t0 = time.time()
+        stats = run_load("127.0.0.1", srv.port, "chaos_gbm", "chaos_frame",
+                         threads=threads, requests=requests)
+        wall = time.time() - t0
+        eng = get_engine().snapshot()["totals"]
+    finally:
+        faults.reset()
+        srv.stop()
+    total = threads * requests
+    err_rate = stats["errors"] / max(total, 1)
+    p99 = stats["p99_ms"]
+    assert p99 is not None and np.isfinite(p99), "p99 must stay finite"
+    assert err_rate <= 0.01, f"error rate {err_rate} above bound"
+    return (f"chaos_serving_{n_rows//1000}k_p99_ms", p99,
+            {"unit_override": "ms", "wall_s": round(wall, 3),
+             "completed": stats["completed"], "errors": stats["errors"],
+             "shed_429": stats["shed_429"],
+             "error_rate": round(err_rate, 4),
+             "fault_rate": fault_rate,
+             "throughput_rps": stats["throughput_rps"],
+             "p50_ms": stats["p50_ms"],
+             "scorer_faults": eng.get("scorer_faults", 0),
+             "quarantines": eng.get("quarantines", 0),
+             "fallback_scores": eng.get("fallback_scores", 0),
+             "breaker_opens": eng.get("breaker_opens", 0)})
+
+
 def bench_automl():
     """AutoML leaderboard (BASELINE.json config 5)."""
     n_rows = int(os.environ.get("BENCH_ROWS", 50_000))
@@ -587,7 +656,8 @@ R02_BASELINE = {
 # not the machine. Repeat each wall-clock config and report the BEST run
 # (first run also absorbs executable deserialization for later ones).
 DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
-                   "scaling": 1, "ingest": 2, "munge": 2, "grid": 1}
+                   "scaling": 1, "ingest": 2, "munge": 2, "grid": 1,
+                   "chaos": 1}
 
 
 def _probe_accelerator(timeout_s: float):
@@ -720,10 +790,12 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     cpu_fallback_reason = None
     forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
-    if config in ("scaling", "munge") or forced:
-        # the scaling curve runs in CPU subprocesses and the munge bench is
-        # pure host numpy; keep the parent off the (possibly unavailable)
-        # TPU backend entirely — no probe, so never a value-0.0 line
+    if config in ("scaling", "munge", "chaos") or forced:
+        # the scaling curve runs in CPU subprocesses, the munge bench is
+        # pure host numpy, and the chaos smoke measures the FAILOVER path
+        # (CPU is representative); keep the parent off the (possibly
+        # unavailable) TPU backend entirely — no probe, never a value-0.0
+        # line
         import jax
 
         jax.config.update("jax_platforms", forced or "cpu")
@@ -771,7 +843,7 @@ def main():
           "xgb_rank": bench_xgb_rank, "automl": bench_automl,
           "score": bench_score, "scaling": bench_scaling,
           "ingest": bench_ingest, "munge": bench_munge,
-          "grid": bench_grid}[config]
+          "grid": bench_grid, "chaos": bench_chaos}[config]
     # cold is strictly one run: repeats within a process share the live
     # executable cache, so any second run would be warm yet labeled cold
     repeats = 1 if cold else int(os.environ.get(

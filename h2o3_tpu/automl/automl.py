@@ -112,6 +112,7 @@ class H2OAutoML:
         verbosity: Optional[str] = None,
         keep_cross_validation_predictions: bool = True,
         parallelism: int = 1,
+        checkpoint_dir: Optional[str] = None,
         **kw,
     ):
         self.max_models = max_models
@@ -130,6 +131,13 @@ class H2OAutoML:
         # enter the leaderboard in submission order, so any parallelism
         # produces the same leaderboard as the sequential walk
         self.parallelism = max(int(parallelism or 1), 1)
+        # sweep checkpoint/resume (runtime/trainpool.SweepCheckpoint): with
+        # a checkpoint_dir, every completed candidate persists a record +
+        # model artifact, and a killed run re-submitted under the SAME
+        # project_name restores those candidates instead of retraining them
+        # (candidate names are deterministic given seed/include lists)
+        self.checkpoint_dir = checkpoint_dir
+        self._ckpt = None
         self.event_log = EventLog()
         self.leaderboard: Optional[Leaderboard] = None
         self.leader = None
@@ -208,11 +216,80 @@ class H2OAutoML:
 
         return (name, fn)
 
+    def _checkpoint_candidate(self, name: str, est) -> None:
+        """Persist one completed candidate's record (+ artifact when the
+        mojo format covers the algo) so a killed run resumes past it.
+        Metrics come straight from the leaderboard row Leaderboard.add
+        just computed for this model (leaderboard_frame when given, else
+        CV) — same footing as fresh rows, and no second scoring pass over
+        the leaderboard frame."""
+        row = next((r for r in self.leaderboard.rows
+                    if r.get("model_id") == est.model_id), {})
+        metrics = {}
+        for k in self._LEADERBOARD_METRICS:
+            v = row.get(k)
+            if isinstance(v, (int, float)):
+                metrics[k] = float(v)
+        payload = dict(model_id=est.model_id, algo=est.algo,
+                       metrics=metrics)
+        try:
+            from ..mojo import save_model
+
+            fname = f"{self.project_name}_{name}.h2o3"
+            save_model(est, self.checkpoint_dir, filename=fname, force=True)
+            payload["file"] = fname
+        except (TypeError, OSError):
+            pass    # file-less record: the candidate retrains on resume
+        self._ckpt.mark(name, payload)
+
+    def _restorable(self, name: str) -> Optional[Dict]:
+        """Checkpoint record usable for restore: it exists AND its artifact
+        is still on disk. A file-less record (mojo export failed) or a lost
+        artifact must retrain the candidate — restoring it would put an
+        unscorable shim on the leaderboard that crashes predict() later."""
+        import os
+
+        if self._ckpt is None:
+            return None
+        p = self._ckpt.completed(name)
+        if p and p.get("file") and os.path.exists(
+                os.path.join(self.checkpoint_dir, p["file"])):
+            return p
+        return None
+
+    def _restore_candidate(self, name: str, payload: Dict) -> None:
+        """Rebuild a leaderboard entry from its checkpoint record: metric
+        values replay from the payload, predict() scores through the saved
+        artifact (grid._RecoveredModel does exactly this for grids)."""
+        import os
+
+        from ..models.grid import _RecoveredModel
+        from ..runtime import trainpool as _tp
+
+        metrics = payload.get("metrics") or {}
+        path = (os.path.join(self.checkpoint_dir, payload["file"])
+                if payload.get("file") else "")
+        shim = _RecoveredModel({}, path or f"{name}.h2o3", metrics)
+        shim.algo = payload.get("algo", "unknown")
+        shim.model_id = payload.get("model_id", name)
+        shim._automl_name = name
+        row = {"model_id": shim.model_id, "algo": shim.algo, "_est": shim}
+        for k in self._LEADERBOARD_METRICS:
+            row[k] = metrics.get(k, float("nan"))
+        self.leaderboard.rows.append(row)
+        self.leaderboard._sort()
+        self._models.append(shim)
+        _tp.record_resumed()
+        self.event_log.log(
+            "resume", f"restored {name} ({shim.model_id}) from checkpoint")
+
     def _run_candidates(self, cands, budget_left) -> bool:
         """Run candidate builds through the train pool (runtime/trainpool)
         in max_models-bounded waves; leaderboard entries land in submission
         order, so parallelism never changes the resulting leaderboard.
-        Returns False once the budget or max_models is exhausted."""
+        Candidates with a checkpoint record are RESTORED instead of
+        retrained (they still count toward max_models). Returns False once
+        the budget or max_models is exhausted."""
         from ..runtime import trainpool as _tp
 
         i = 0
@@ -224,8 +301,19 @@ class H2OAutoML:
                          if self.max_models else len(cands) - i)
             if remaining <= 0:
                 return False
-            batch = cands[i:i + remaining]
-            i += len(batch)
+            name = cands[i][0]
+            payload = self._restorable(name)
+            if payload is not None:
+                i += 1
+                self._restore_candidate(name, payload)
+                continue
+            # fresh batch up to the wave budget, stopping at the next
+            # checkpointed candidate so restore order stays deterministic
+            batch = []
+            while (i < len(cands) and len(batch) < remaining
+                   and self._restorable(cands[i][0]) is None):
+                batch.append(cands[i])
+                i += 1
             pool = _tp.TrainPool(self.parallelism, label=self.project_name)
             recs = pool.run(batch, stop_when=lambda: not budget_left())
             for (name, _), rec in zip(batch, recs):
@@ -235,6 +323,8 @@ class H2OAutoML:
                     self.leaderboard.add(est, self._lb_frame)
                     self.event_log.log(
                         "model", f"built {name} ({est.model_id})")
+                    if self._ckpt is not None:
+                        self._checkpoint_candidate(name, est)
                 elif rec.status == "failed":
                     self.event_log.log("error", f"{name} failed: {rec.error}")
                 elif rec.status in ("skipped", "cancelled"):
@@ -329,6 +419,26 @@ class H2OAutoML:
             return self._remote_train(x, y, training_frame)
         self._lb_frame = leaderboard_frame
         t0 = time.time()
+        if self.checkpoint_dir:
+            from ..runtime.trainpool import SweepCheckpoint
+
+            # run identity: candidate names (GBM_1, ...) are constants, so
+            # without this a checkpoint from a different dataset/response/
+            # seed would silently restore the wrong models. Shape + column
+            # names stand in for frame identity (auto-generated frame keys
+            # don't survive a process restart).
+            fp = dict(
+                y=str(y),
+                x=sorted(str(c) for c in x) if x is not None else None,
+                seed=int(self.seed), nfolds=int(self.nfolds),
+                nrow=int(training_frame.nrow), ncol=int(training_frame.ncol),
+                columns=[str(c) for c in training_frame.names])
+            self._ckpt = SweepCheckpoint(self.checkpoint_dir,
+                                         self.project_name, fingerprint=fp)
+            if len(self._ckpt):
+                self.event_log.log(
+                    "resume", f"checkpoint has {len(self._ckpt)} completed "
+                    "candidate(s); they will be restored, not retrained")
         problem, nclass, domain = response_info(training_frame.vec(y))
         sort_metric = self.sort_metric
         if sort_metric == "AUTO":
@@ -355,14 +465,26 @@ class H2OAutoML:
         # StackedEnsembles (SE BestOfFamily + AllModels)
         if self._allowed("STACKEDENSEMBLE") and len(self._models) >= 2 and budget_left():
             from ..models.ensemble import H2OStackedEnsembleEstimator
+            from ..models.grid import _RecoveredModel
 
+            # checkpoint-restored shims carry no CV holdout predictions to
+            # stack — build the ensembles over freshly-trained bases only,
+            # instead of letting one shim fail the whole SE stage
+            trained = [m for m in self._models
+                       if not isinstance(m, _RecoveredModel)]
             best_of_family: Dict[str, Any] = {}
             for r in self.leaderboard.rows:
-                best_of_family.setdefault(r["algo"], r["_est"])
+                if not isinstance(r["_est"], _RecoveredModel):
+                    best_of_family.setdefault(r["algo"], r["_est"])
             for name, base in (
                 ("StackedEnsemble_BestOfFamily", list(best_of_family.values())),
-                ("StackedEnsemble_AllModels", list(self._models)),
+                ("StackedEnsemble_AllModels", trained),
             ):
+                if len(base) < 2:
+                    self.event_log.log(
+                        "skip", f"{name}: fewer than 2 stackable "
+                        "(freshly-trained) base models")
+                    continue
                 try:
                     se = H2OStackedEnsembleEstimator(base_models=base)
                     se.train(x=x, y=y, training_frame=training_frame)

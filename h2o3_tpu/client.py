@@ -76,13 +76,23 @@ def disconnect() -> None:
 
 
 class H2OConnection:
-    """One server endpoint + auth. All verbs funnel through `request`."""
+    """One server endpoint + auth. All verbs funnel through `request`,
+    which retries transient failures under the shared runtime/retry policy:
+    connection drops re-try idempotent verbs (GET/HEAD/DELETE) with capped
+    jittered backoff, and HTTP 429 honors the serving engine's Retry-After
+    hint on EVERY verb (the server shed the request before acting, so a
+    POST re-send is safe). Semantic errors (4xx) fail fast unchanged."""
 
     def __init__(self, url: str, token: Optional[str] = None,
-                 timeout: float = 600.0, verify_ssl: bool = True):
+                 timeout: float = 600.0, verify_ssl: bool = True,
+                 max_retries: Optional[int] = None):
+        from .runtime import retry as _retrylib
+
         self.url = url.rstrip("/")
         self.token = token or os.environ.get("H2O3_AUTH_TOKEN")
         self.timeout = timeout
+        self._retry = _retrylib.RetryPolicy(
+            name="client", max_attempts=max_retries)
         self._batch: Optional[List[str]] = None   # pending Rapids assigns
         self._ssl_ctx = None
         if url.startswith("https") and not verify_ssl:
@@ -124,22 +134,69 @@ class H2OConnection:
                 {k: v for k, v in params.items() if v is not None})
         req = urllib.request.Request(url, data=data, headers=headers,
                                      method=method)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout,
-                                        context=self._ssl_ctx) as r:
-                body = r.read()
-        except urllib.error.HTTPError as e:
-            try:
-                payload = json.loads(e.read())
-            except Exception:
-                payload = e.reason
-            raise H2OServerError(e.code, payload) from None
-        except (urllib.error.URLError, OSError) as e:
-            raise H2OConnectionError(
-                f"cannot reach {self.url}: {e}") from None
+        body = self._round_trip(req, method, path)
         if raw:
             return body
         return json.loads(body) if body else {}
+
+    def _round_trip(self, req, method: str, path: str) -> bytes:
+        """One logical request = up to max_attempts wire attempts.
+
+        429 sleeps the server's Retry-After hint (any verb — admission
+        shed the request at the door); connection-level drops back off and
+        re-send only idempotent verbs. The retry budget and the policy's
+        deadline bound total added latency either way."""
+        from .runtime import faults as _faults
+        from .runtime import retry as _retrylib
+
+        pol = self._retry
+        idempotent = method in ("GET", "HEAD", "DELETE")
+        t0 = time.monotonic()
+        delay = pol.base_delay_s
+        attempt = 1
+        _retrylib.record("client", "calls")
+        while True:
+            try:
+                _faults.check("client.request", f"{method} {path}")
+                with urllib.request.urlopen(req, timeout=self.timeout,
+                                            context=self._ssl_ctx) as r:
+                    body = r.read()
+                if attempt > 1:
+                    _retrylib.record("client", "recovered")
+                return body
+            except urllib.error.HTTPError as e:
+                raw = e.read()        # once: the retry decision below must
+                #                       not eat the final error's payload
+                if e.code == 429 and attempt < pol.max_attempts:
+                    hint = e.headers.get("Retry-After")
+                    try:
+                        wait = float(hint) if hint else pol.base_delay_s
+                    except ValueError:
+                        wait = pol.base_delay_s
+                    if (time.monotonic() - t0 + wait <= pol.deadline_s
+                            and pol.budget.try_spend()):
+                        _retrylib.record("client", "retries")
+                        time.sleep(wait)
+                        attempt += 1
+                        continue
+                try:
+                    payload = json.loads(raw)
+                except Exception:
+                    payload = e.reason
+                _retrylib.record("client", "permanent_failures")
+                raise H2OServerError(e.code, payload) from None
+            except (urllib.error.URLError, OSError) as e:
+                if idempotent and attempt < pol.max_attempts:
+                    delay = pol.next_delay(delay)
+                    if (time.monotonic() - t0 + delay <= pol.deadline_s
+                            and pol.budget.try_spend()):
+                        _retrylib.record("client", "retries")
+                        time.sleep(delay)
+                        attempt += 1
+                        continue
+                _retrylib.record("client", "permanent_failures")
+                raise H2OConnectionError(
+                    f"cannot reach {self.url}: {e}") from None
 
     # NB: the route argument is positional-only so request params named
     # "path" (e.g. /3/ImportFiles) can ride **params without colliding
@@ -282,8 +339,17 @@ class H2OConnection:
                         f"job {job_key} {j['status']}: {j.get('warnings')}")
                 return j
             if time.time() - t0 > timeout:
+                # best-effort server-side cancel BEFORE raising: an
+                # abandoned client poll must not strand device work on the
+                # server (water.Job.stop discipline)
+                try:
+                    self.post(
+                        f"/3/Jobs/{urllib.parse.quote(job_key)}/cancel")
+                except Exception:
+                    pass
                 raise TimeoutError(f"job {job_key} still {j['status']} "
-                                   f"after {timeout}s")
+                                   f"after {timeout}s (server-side cancel "
+                                   "requested)")
             time.sleep(poll)
 
 

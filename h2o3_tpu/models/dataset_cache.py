@@ -113,12 +113,15 @@ def _entry_for(frame, x: Tuple[str, ...]) -> "_Entry":
 
 def _evict_locked(keep=None) -> None:
     """LRU-evict entries other than `keep` until both caps are met."""
+    # Iterate snapshots: _LOCK is reentrant, so a frame's weakref death
+    # callback (_drop) triggered by GC mid-iteration in THIS thread can pop
+    # from _ENTRIES even while we hold the lock.
     max_entries, max_bytes = _caps()
-    victims = [k for k in _ENTRIES if k != keep]
+    victims = [k for k in list(_ENTRIES) if k != keep]
     while victims and len(_ENTRIES) > max_entries:
         _ENTRIES.pop(victims.pop(0), None)
         _STATS["evictions"] += 1
-    while victims and sum(e.nbytes() for e in _ENTRIES.values()) > max_bytes:
+    while victims and sum(e.nbytes() for e in list(_ENTRIES.values())) > max_bytes:
         _ENTRIES.pop(victims.pop(0), None)
         _STATS["evictions"] += 1
 
@@ -200,7 +203,7 @@ def snapshot() -> Dict:
     with _LOCK:
         stats = dict(_STATS)
         entries = len(_ENTRIES)
-        nbytes = sum(e.nbytes() for e in _ENTRIES.values())
+        nbytes = sum(e.nbytes() for e in list(_ENTRIES.values()))
     stats.update(entries=entries, bytes=int(nbytes), enabled=enabled())
     return stats
 

@@ -54,6 +54,16 @@ class _RecoveredModel:
         raise AttributeError(name)
 
 
+def _jnorm(v):
+    """Normalize a hyper-param structure into JSON space (tuples → lists,
+    np scalars → str). Checkpoint state is round-tripped through json.dump,
+    so every comparison between live and restored params must normalize
+    both sides or an identical sweep fails to match its own records."""
+    import json
+
+    return json.loads(json.dumps(v, default=str))
+
+
 class H2OGridSearch:
     def __init__(
         self,
@@ -111,6 +121,7 @@ class H2OGridSearch:
             hyper_params=self.hyper_params,
             search_criteria=self.search_criteria,
             done_combos=self._done_combos,
+            data_fp=getattr(self, "_data_fp", None),
         )
         with open(self._state_path(), "w") as f:
             json.dump(state, f)
@@ -133,10 +144,14 @@ class H2OGridSearch:
                           search_criteria=state["search_criteria"],
                           recovery_dir=recovery_dir)
         g.base_parms = state["base_parms"]
-        g._done_combos = state["done_combos"]
-        for d in g._done_combos:
+        # a record whose artifact is gone is dropped, not kept: keeping it
+        # would exclude the combo from retraining while restoring nothing —
+        # the model silently vanishes from the grid
+        g._done_combos = []
+        for d in state["done_combos"]:
             path = os.path.join(recovery_dir, d["file"])
             if os.path.exists(path):
+                g._done_combos.append(d)
                 g.models.append(_RecoveredModel(d["params"], path,
                                                 d.get("metrics", {})))
         return g
@@ -157,6 +172,57 @@ class H2OGridSearch:
                 combos = combos[: int(mm)]
         return combos
 
+    def _auto_resume(self) -> None:
+        """Sweep checkpoint/resume (hex.grid recovery): a killed sweep
+        re-submitted with the same grid_id + recovery_dir + hyper space
+        skips its already-trained combos — done-combo records and their
+        model artifacts are restored from the state file WITHOUT requiring
+        an explicit `H2OGridSearch.load` call. A state file whose hyper
+        space or model class differs is someone else's sweep: it is left
+        untouched and the grid trains from scratch (the done-combo filter
+        would drop nothing anyway)."""
+        import json as _json
+        import os
+
+        if (not self.recovery_dir or self._done_combos
+                or not os.path.exists(self._state_path())):
+            return
+        try:
+            with open(self._state_path()) as f:
+                state = _json.load(f)
+        except (ValueError, OSError):
+            return
+        from ..runtime.log import Log
+
+        if (_jnorm(state.get("hyper_params")) != _jnorm(self.hyper_params)
+                or state.get("model_class") != self.model_class.__name__
+                or _jnorm(state.get("search_criteria"))
+                != _jnorm(self.search_criteria)
+                # data fingerprint: same sweep spec on DIFFERENT training
+                # data must not restore the old data's models
+                or state.get("data_fp") != getattr(self, "_data_fp", None)):
+            Log.warn(f"grid {self.grid_id}: recovery state in "
+                     f"{self.recovery_dir} does not match this sweep's "
+                     "hyper space/model/data; ignoring it")
+            return
+        self._done_combos = []
+        for d in state.get("done_combos") or []:
+            path = os.path.join(self.recovery_dir, d["file"])
+            if os.path.exists(path):
+                self._done_combos.append(d)
+                self.models.append(_RecoveredModel(d["params"], path,
+                                                   d.get("metrics", {})))
+            else:
+                # dropped, not kept: a record without its artifact must
+                # retrain, or the combo silently vanishes from the grid
+                Log.warn(f"grid {self.grid_id}: artifact {d['file']} "
+                         "missing from recovery_dir; combo will retrain")
+        restored = len(self._done_combos)
+        if restored:
+            from ..runtime import trainpool as _tp
+
+            _tp.record_resumed(restored)
+
     def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
               parallelism: Optional[int] = None, **kw):
         if getattr(training_frame, "_is_remote", False):
@@ -167,8 +233,20 @@ class H2OGridSearch:
             return self._remote_train(x, y, training_frame)
         t0 = time.time()
         budget = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
-        combos = [c for c in self._combos()
-                  if not any(d["params"] == c for d in self._done_combos)]
+        if training_frame is not None:
+            # shape + column names stand in for frame identity across
+            # process restarts (auto-generated frame keys don't survive one)
+            self._data_fp = dict(
+                y=str(y),
+                x=sorted(str(c) for c in x) if x is not None else None,
+                nrow=int(training_frame.nrow),
+                ncol=int(training_frame.ncol),
+                columns=[str(c) for c in training_frame.names])
+        self._auto_resume()
+        # compare in JSON space: restored done-combos carry lists where the
+        # live sweep may carry tuples — raw == would retrain every combo
+        done = [_jnorm(d["params"]) for d in self._done_combos]
+        combos = [c for c in self._combos() if _jnorm(c) not in done]
         par = max(int(parallelism if parallelism is not None
                       else self.parallelism), 1)
 
@@ -291,7 +369,7 @@ class H2OGridSearch:
                     src = os.path.join(src_dir, d["file"])
                     if os.path.exists(src):
                         shutil.copy2(src, grid_directory)
-            seen = {_json.dumps(d["params"], sort_keys=True)
+            seen = {_json.dumps(d["params"], sort_keys=True, default=str)
                     for d in self._done_combos}
             for est in self.models:
                 if isinstance(est, _RecoveredModel):
@@ -302,7 +380,7 @@ class H2OGridSearch:
                         "save_grid: grid model carries no combo record — "
                         "remotely-trained grids keep their artifacts on the "
                         "SERVER (download models individually)")
-                if _json.dumps(combo, sort_keys=True) in seen:
+                if _json.dumps(combo, sort_keys=True, default=str) in seen:
                     continue
                 self._record_done(est, combo)
             self._save_state()

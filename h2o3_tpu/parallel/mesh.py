@@ -28,6 +28,11 @@ ROWS_AXIS = "hosts"  # the one inter-node axis H2O has: row/data parallelism
 
 _lock = threading.Lock()
 _cloud: Optional["Cloud"] = None
+# the (coordinator_address, num_processes, process_id) jax.distributed was
+# initialized with — re-initializing the distributed runtime crashes, so a
+# repeat init() with the same topology is answered idempotently and a
+# CONFLICTING topology is a loud error instead of a crash mid-bootstrap
+_dist_topology: Optional[tuple] = None
 
 
 @dataclass
@@ -73,15 +78,39 @@ def init(
     """Form the cloud. Single-process: mesh over local devices. Multi-host:
     pass coordinator_address/num_processes/process_id (wraps
     `jax.distributed.initialize`, replacing `water/init/NetworkInit.java`).
+
+    Re-init is IDEMPOTENT for the distributed runtime: a second call with
+    the same coordinator topology returns the live cloud instead of
+    re-invoking `jax.distributed.initialize` (which crashes); a second call
+    with a CONFLICTING topology raises a clear error naming both. Device
+    re-selection (the single-process `devices=` form) still rebuilds the
+    mesh — that is how tests move between 1- and 8-device clouds.
     """
-    global _cloud
+    global _cloud, _dist_topology
     with _lock:
         if coordinator_address is not None and num_processes and num_processes > 1:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
+            topo = (coordinator_address, int(num_processes),
+                    None if process_id is None else int(process_id))
+            if _dist_topology is not None:
+                if topo != _dist_topology:
+                    raise RuntimeError(
+                        "cloud already initialized with coordinator "
+                        f"topology {_dist_topology}; re-init with {topo} "
+                        "conflicts — shut the process down to re-cloud "
+                        "(membership is fixed at init, water/Paxos.java "
+                        "'cloud locks' semantics)")
+                # same topology: the distributed runtime is already up —
+                # answer with the live cloud (or rebuild the mesh below if
+                # reset() dropped it)
+                if _cloud is not None:
+                    return _cloud
+            else:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+                _dist_topology = topo
         if devices is None:
             devices = jax.devices()
         mesh = Mesh(np.asarray(devices), (ROWS_AXIS,))

@@ -90,6 +90,24 @@ def training_metrics_schema() -> Dict:
          "the dataset-artifact cache (models/dataset_cache.py): hits/"
          "misses per layer (matrix/bins/device), evictions, live entries,"
          " resident bytes, enabled flag"),
+        ("totals.retried", "int",
+         "candidate build attempts re-run after a TRANSIENT failure"
+         " (runtime/retry classification; bounded by"
+         " H2O3_TRAIN_CAND_RETRIES and the shared retry budget)"),
+        ("totals.watchdog_cancelled", "int",
+         "candidates cancelled by the per-candidate watchdog deadline"
+         " (H2O3_TRAIN_CAND_DEADLINE_S)"),
+        ("totals.resumed", "int",
+         "sweep candidates satisfied from checkpoint records instead of"
+         " retrained (grid recovery_dir auto-resume, AutoML"
+         " checkpoint_dir — docs/robustness.md)"),
+        ("retry", "RetryStats",
+         "shared retry-policy counters per policy (persist/client/"
+         "trainpool): calls, retries, recovered, permanent_failures,"
+         " deadline/attempts/budget exhaustions"),
+        ("faults", "FaultStats",
+         "armed fault-injection points + fire counts (runtime/faults;"
+         " default off — GET/POST/DELETE /3/Faults)"),
         ("active", "boolean", "false until the first pooled sweep runs"),
     ]
     return dict(
@@ -176,12 +194,21 @@ def serving_metrics_schema() -> Dict:
          " buckets)"),
         ("models.*.histograms.batch_size", "histogram",
          "requests coalesced per device batch"),
+        ("models.*.counters (failover)", "map<string,int>",
+         "scorer_faults (device/XLA errors), quarantines (poisoned"
+         " executables evicted), scorer_rebuilds (rebuild-once succeeded),"
+         " breaker_opens, fallback_scores (batches served by the"
+         " compiled-CPU fallback)"),
         ("totals", "map<string,int>", "counters summed over all models"),
         ("cache", "CacheStats",
          "compiled-scorer LRU: capacity/size/hits/misses/evictions +"
          " per-entry warm row buckets"),
         ("admission", "AdmissionStats",
          "in-flight counts vs the global and per-model bounds"),
+        ("failover", "FailoverStats",
+         "per-(model, output_kind) circuit breakers (state/opens/time to"
+         " half-open probe) + live CPU-fallback scorers"
+         " (docs/robustness.md 'Serving failover')"),
         ("config", "ServingConfig", "the active knob values"),
     ]
     return dict(

@@ -125,6 +125,9 @@ class _Handler(BaseHTTPRequestHandler):
         ("DELETE", r"^/3/Models/([^/]+)$", "model_delete"),
         ("POST", r"^/3/Predictions/models/([^/]+)/frames/([^/]+)$", "predict"),
         ("GET", r"^/3/Serving/metrics$", "serving_metrics"),
+        ("GET", r"^/3/Faults$", "faults_get"),
+        ("POST", r"^/3/Faults$", "faults_set"),
+        ("DELETE", r"^/3/Faults$", "faults_delete"),
         ("GET", r"^/3/Ingest/metrics$", "ingest_metrics"),
         ("GET", r"^/3/Munge/metrics$", "munge_metrics"),
         ("GET", r"^/3/Training/metrics$", "training_metrics"),
@@ -833,9 +836,52 @@ class _Handler(BaseHTTPRequestHandler):
         eng = peek_engine()
         body = (eng.snapshot() if eng is not None
                 else dict(models={}, totals={}, cache=None, admission=None,
-                          config=None))
+                          failover=None, config=None))
         self._send(dict(__meta=dict(schema_type=schemas.SERVING_SCHEMA_NAME),
                         **body))
+
+    # -- fault injection (runtime/faults — docs/robustness.md) --------------
+    def h_faults_get(self):
+        """`GET /3/Faults` — armed fault points + fire counts, plus the
+        shared retry-policy counters."""
+        from ..runtime import profiler
+
+        self._send(profiler.fault_stats())
+
+    def h_faults_set(self):
+        """`POST /3/Faults` — arm one fault point (the REST face of
+        `faults.arm`): params point (required), error (io/conn/device/
+        crash/none), rate, count, latency_ms, seed. Chaos drills against a
+        live serving cluster use this instead of a restart with
+        H2O3_FAULT_* env vars."""
+        from ..runtime import faults
+
+        p = self._params()
+        point = p.get("point")
+        if not point:
+            raise ValueError("point is required (e.g. serving.scorer)")
+        out = faults.arm(
+            str(point),
+            error=str(p.get("error", "io")),
+            rate=float(p.get("rate", 1.0) or 0.0),
+            count=int(p["count"]) if p.get("count") not in (None, "")
+            else None,
+            latency_ms=float(p.get("latency_ms", 0.0) or 0.0),
+            seed=int(p.get("seed", 0) or 0))
+        self._send(out)
+
+    def h_faults_delete(self):
+        """`DELETE /3/Faults[?point=]` — disarm one point, or all."""
+        from ..runtime import faults
+
+        p = self._params()
+        point = p.get("point")
+        if point:
+            self._send(dict(disarmed=bool(faults.disarm(str(point))),
+                            point=point))
+        else:
+            faults.reset()
+            self._send(dict(disarmed=True, point=None))
 
     def h_ingest_metrics(self):
         """`GET /3/Ingest/metrics` — parse-pipeline throughput counters +
@@ -960,7 +1006,8 @@ class _Handler(BaseHTTPRequestHandler):
                         serving=profiler.serving_stats(),
                         ingest=profiler.ingest_stats(),
                         munge=profiler.munge_stats(),
-                        training=profiler.training_stats()))
+                        training=profiler.training_stats(),
+                        faults=profiler.fault_stats()))
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()))

@@ -9,3 +9,19 @@ that `water/util/Log.java`, `water/TimeLine.java`, `water/api/ProfilerHandler`,
 from .dkv import DKV  # noqa: F401
 from .log import Log  # noqa: F401
 from .timeline import Timeline  # noqa: F401
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer env knob with an empty-string-safe default (the one parser
+    every H2O3_* knob shares)."""
+    import os
+
+    v = os.environ.get(name)
+    return default if v in (None, "") else int(v)
+
+
+def env_float(name: str, default: float) -> float:
+    import os
+
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)

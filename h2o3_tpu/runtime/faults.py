@@ -1,0 +1,213 @@
+"""Fault injection — deterministic, seedable failure points in the runtime.
+
+The hardening layer (runtime/retry.py, trainpool candidate retries, serving
+failover) is only trustworthy if its failure paths are EXERCISED, not just
+written; this registry lets tests, the chaos bench (`BENCH_CONFIG=chaos`)
+and operators arm specific failure points without touching product code.
+Every wired call site costs one dict lookup when nothing is armed (the
+`_ACTIVE` fast path), so production runs pay ~nothing.
+
+Wired points (each named like the layer it lives in):
+
+==========================  ==================================================
+``persist.open``            raises before a storage backend opens a URI
+``persist.read``            raises inside an http persist stream's read()
+``persist.list``            raises before a backend lists a URI
+``client.request``          raises before the remote client's HTTP round-trip
+``trainpool.candidate``     raises before a sweep candidate's build fn runs
+``serving.scorer``          raises inside the compiled scorer's device call
+==========================  ==================================================
+
+Arming — programmatic, env, or REST:
+
+* ``faults.arm("serving.scorer", error="device", rate=0.01, seed=7)``
+* ``H2O3_FAULT_SERVING_SCORER="error=device,rate=0.01,seed=7"`` (dots map
+  to underscores, upper-cased)
+* ``POST /3/Faults`` with the same fields; ``GET /3/Faults`` shows armed
+  points + fire counts; ``DELETE /3/Faults[?point=]`` disarms.
+
+Determinism: ``count=N`` fires the FIRST N checks of a point (the
+retry-then-succeed shape tests pin); ``rate=p`` draws from a dedicated
+``numpy.random.default_rng(seed)`` per point, so the same seed produces the
+same fire sequence. Fault points are DEFAULT-OFF; `reset()` disarms all.
+
+``latency_ms`` injects sleep without (or in addition to) an error — the
+injected-latency fault of the issue spec.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["FaultInjected", "InjectedIOError", "InjectedConnectionError",
+           "InjectedDeviceError", "InjectedCrash", "arm", "disarm", "reset",
+           "check", "snapshot", "active"]
+
+
+class FaultInjected(Exception):
+    """Marker base: every injected error is recognizable as synthetic."""
+
+
+class InjectedIOError(FaultInjected, IOError):
+    """Injected persist/storage I/O failure (transient)."""
+
+
+class InjectedConnectionError(FaultInjected, ConnectionError):
+    """Injected HTTP/connection drop (transient)."""
+
+
+class InjectedDeviceError(FaultInjected, RuntimeError):
+    """Injected device/XLA runtime failure (transient, quarantine-class)."""
+
+
+class InjectedCrash(FaultInjected, RuntimeError):
+    """Injected permanent failure — retry must NOT mask it."""
+
+
+ERROR_KINDS = {
+    "io": InjectedIOError,
+    "conn": InjectedConnectionError,
+    "device": InjectedDeviceError,
+    "crash": InjectedCrash,
+    "none": None,          # latency-only point
+}
+
+
+class _Point:
+    __slots__ = ("name", "kind", "rate", "count", "latency_ms", "seed",
+                 "checks", "fires", "_rng")
+
+    def __init__(self, name: str, kind: str, rate: float,
+                 count: Optional[int], latency_ms: float, seed: int):
+        if kind not in ERROR_KINDS:
+            raise ValueError(f"unknown fault error kind {kind!r} "
+                             f"(one of {sorted(ERROR_KINDS)})")
+        self.name = name
+        self.kind = kind
+        self.rate = float(rate)
+        self.count = None if count in (None, "") else int(count)
+        self.latency_ms = float(latency_ms)
+        self.seed = int(seed)
+        self.checks = 0
+        self.fires = 0
+        self._rng = None    # built lazily; numpy import stays off hot path
+
+    def should_fire(self) -> bool:
+        if self.kind == "none":
+            return False
+        if self.count is not None:
+            return self.fires < self.count
+        if self.rate >= 1.0:
+            return True
+        if self.rate <= 0.0:
+            return False
+        if self._rng is None:
+            import numpy as np
+
+            self._rng = np.random.default_rng(self.seed)
+        return bool(self._rng.random() < self.rate)
+
+    def describe(self) -> Dict:
+        return dict(point=self.name, error=self.kind, rate=self.rate,
+                    count=self.count, latency_ms=self.latency_ms,
+                    seed=self.seed, checks=self.checks, fires=self.fires)
+
+
+_LOCK = threading.Lock()
+_POINTS: Dict[str, _Point] = {}
+_ACTIVE = False           # fast-path flag: no armed points → check() is free
+_TOTAL_FIRES = 0
+
+
+def _env_parse() -> None:
+    """Arm points from H2O3_FAULT_* env vars (once, at import)."""
+    for k, v in os.environ.items():
+        if not k.startswith("H2O3_FAULT_") or not v:
+            continue
+        point = k[len("H2O3_FAULT_"):].lower().replace("_", ".")
+        if v in ("1", "true", "on"):
+            arm(point)
+            continue
+        kw: Dict[str, str] = {}
+        try:
+            for part in v.split(","):
+                key, _, val = part.partition("=")
+                kw[key.strip()] = val.strip()
+            arm(point,
+                error=kw.get("error", "io"),
+                rate=float(kw.get("rate", 1.0)),
+                count=int(kw["count"]) if kw.get("count") else None,
+                latency_ms=float(kw.get("latency_ms", 0.0)),
+                seed=int(kw.get("seed", 0)))
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"bad {k}={v!r}: {e}") from None
+
+
+def arm(point: str, error: str = "io", rate: float = 1.0,
+        count: Optional[int] = None, latency_ms: float = 0.0,
+        seed: int = 0) -> Dict:
+    """Arm one fault point; returns its description."""
+    global _ACTIVE
+    p = _Point(point, error, rate, count, latency_ms, seed)
+    with _LOCK:
+        _POINTS[point] = p
+        _ACTIVE = True
+    return p.describe()
+
+
+def disarm(point: str) -> bool:
+    global _ACTIVE
+    with _LOCK:
+        existed = _POINTS.pop(point, None) is not None
+        _ACTIVE = bool(_POINTS)
+    return existed
+
+
+def reset() -> None:
+    global _ACTIVE, _TOTAL_FIRES
+    with _LOCK:
+        _POINTS.clear()
+        _ACTIVE = False
+        _TOTAL_FIRES = 0
+
+
+def active() -> bool:
+    return _ACTIVE
+
+
+def check(point: str, detail: str = "") -> None:
+    """The wired call sites' hook: no-op unless `point` is armed; sleeps
+    the configured latency, then raises the configured error class when
+    the deterministic schedule says so."""
+    if not _ACTIVE:             # unlocked fast path: default-off is free
+        return
+    global _TOTAL_FIRES
+    with _LOCK:
+        p = _POINTS.get(point)
+        if p is None:
+            return
+        p.checks += 1
+        fire = p.should_fire()
+        if fire:
+            p.fires += 1
+            _TOTAL_FIRES += 1
+        latency = p.latency_ms
+        kind = ERROR_KINDS[p.kind]
+    if latency:
+        time.sleep(latency / 1e3)
+    if fire and kind is not None:
+        raise kind(f"injected fault at {point}"
+                   + (f" ({detail})" if detail else ""))
+
+
+def snapshot() -> Dict:
+    """Armed points + fire counts (GET /3/Faults, /3/Profiler fold)."""
+    with _LOCK:
+        pts = [p.describe() for p in _POINTS.values()]
+        return dict(active=bool(pts), points=pts, total_fires=_TOTAL_FIRES)
+
+
+_env_parse()

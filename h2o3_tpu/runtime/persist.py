@@ -6,17 +6,49 @@ extension modules. Scheme-dispatched:
 
 * local file (PersistNFS/PersistFS) — stdlib
 * http/https (h2o-persist-http) — urllib, read-only
-* s3/s3a, gs, hdfs (h2o-persist-{s3,gcs,hdfs}) — pyarrow.fs filesystems,
-  constructed lazily; credential/connectivity errors surface at first use
-  with the scheme and reference module named (this build's CI machine has
-  no egress, so these paths are exercised in deployment, not tests).
+* s3/s3a, gs, hdfs (pyarrow.fs filesystems, constructed lazily; credential/
+  connectivity errors surface at first use with the scheme and reference
+  module named — this build's CI machine has no egress, so these paths are
+  exercised in deployment, not tests).
+
+Fault discipline (docs/robustness.md): every remote-capable operation —
+open/read/list/size on the http and pyarrow backends — runs under the
+shared `runtime/retry.RetryPolicy` ("persist" policy), so one transient
+connection drop mid-import no longer kills the whole parse. HTTP streams
+additionally RESUME on read failure via a Range request from the current
+offset. Permanent errors (404-shaped `FileNotFoundError`, bad URIs) fail
+fast through the policy's classifier. Injection points `persist.open` /
+`persist.read` / `persist.list` (runtime/faults.py) exercise these paths
+deterministically.
 """
 
 from __future__ import annotations
 
 import glob as _glob
 import os
-from typing import Dict, List
+import threading
+from typing import Dict, List, Optional
+
+from . import faults
+from . import retry as _retry
+
+_POLICY: Optional[_retry.RetryPolicy] = None
+_POLICY_LOCK = threading.Lock()
+
+
+def _policy() -> _retry.RetryPolicy:
+    global _POLICY
+    with _POLICY_LOCK:
+        if _POLICY is None:
+            _POLICY = _retry.RetryPolicy(name="persist")
+        return _POLICY
+
+
+def reset_policy() -> None:
+    """Rebuild the policy from env (tests tune H2O3_RETRY_* knobs)."""
+    global _POLICY
+    with _POLICY_LOCK:
+        _POLICY = None
 
 
 class Persist:
@@ -25,16 +57,24 @@ class Persist:
     scheme = "file"
 
     def open(self, uri: str, mode: str = "rb"):
-        return open(self._strip(uri), mode)
+        def _open():
+            faults.check("persist.open", uri)
+            return open(self._strip(uri), mode)
+
+        return _policy().call(_open)
 
     def exists(self, uri: str) -> bool:
         return os.path.exists(self._strip(uri))
 
     def list(self, uri: str) -> List[str]:
-        p = self._strip(uri)
-        if os.path.isdir(p):
-            return sorted(os.path.join(p, f) for f in os.listdir(p))
-        return sorted(_glob.glob(p))
+        def _list():
+            faults.check("persist.list", uri)
+            p = self._strip(uri)
+            if os.path.isdir(p):
+                return sorted(os.path.join(p, f) for f in os.listdir(p))
+            return sorted(_glob.glob(p))
+
+        return _policy().call(_list)
 
     def size(self, uri: str) -> int:
         return os.path.getsize(self._strip(uri))
@@ -42,6 +82,101 @@ class Persist:
     @staticmethod
     def _strip(uri: str) -> str:
         return uri[len("file://"):] if uri.startswith("file://") else uri
+
+
+class _ResumingHttpStream:
+    """File-like wrapper over an HTTP response that survives mid-stream
+    connection drops: a failed read() re-opens the URI with a
+    ``Range: bytes={offset}-`` header (under the shared retry policy) and
+    continues where it left off. Context-manager + read/close, the same
+    surface callers of HttpPersist.open already use."""
+
+    def __init__(self, uri: str, resp):
+        self._uri = uri
+        self._resp = resp
+        self._pos = 0
+        self._dead = False
+
+    def _reopen(self):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._uri, headers={"Range": f"bytes={self._pos}-"})
+        resp = urllib.request.urlopen(req)
+        if self._pos and resp.status not in (206,):
+            # server ignored the Range: skip what we already handed out
+            left = self._pos
+            while left > 0:
+                chunk = resp.read(min(left, 1 << 20))
+                if not chunk:
+                    break
+                left -= len(chunk)
+        self._resp = resp
+
+    def read(self, n: int = -1) -> bytes:
+        import http.client as _http
+
+        def _read():
+            faults.check("persist.read", self._uri)
+            # reopen at the top of the attempt, not in the except below: if
+            # the reopen itself fails transiently the response must STAY
+            # marked dead, or the next retry would read() the closed
+            # original — which returns b'' and silently truncates the body
+            if self._dead:
+                self._reopen()
+                self._dead = False
+            try:
+                return self._resp.read(n)
+            # IncompleteRead (the standard mid-body truncation) subclasses
+            # HTTPException, NOT OSError — it must hit the resume path too
+            except (OSError, ValueError, _http.HTTPException) as e:
+                self._dead = True
+                try:
+                    self._resp.close()
+                except OSError:
+                    pass
+                raise ConnectionError(
+                    f"http read of {self._uri} dropped at byte "
+                    f"{self._pos}: {e}") from e
+
+        out = _policy().call(_read)
+        self._pos += len(out)
+        return out
+
+    def readline(self, limit: int = -1) -> bytes:
+        # position-tracked pass-throughs (no drop-resume for line reads —
+        # but an UNtracked readline would corrupt later Range offsets):
+        # the raw HTTPResponse is iterable and callers of the Persist SPI
+        # rely on that surface
+        line = self._resp.readline(limit)
+        self._pos += len(line)
+        return line
+
+    def readinto(self, b) -> int:
+        n = self._resp.readinto(b)
+        self._pos += int(n or 0)
+        return n
+
+    def __iter__(self):
+        return iter(self.readline, b"")
+
+    def close(self) -> None:
+        try:
+            self._resp.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __getattr__(self, name):        # headers, status, geturl, ...
+        resp = self.__dict__.get("_resp")
+        if resp is None:
+            raise AttributeError(name)
+        return getattr(resp, name)
 
 
 class HttpPersist(Persist):
@@ -55,11 +190,17 @@ class HttpPersist(Persist):
             raise NotImplementedError("http persistence is read-only")
         import urllib.request
 
+        def _open():
+            faults.check("persist.open", uri)
+            return urllib.request.urlopen(uri)
+
         # the response object is file-like (read/close, context manager) —
-        # returning it directly lets callers stream instead of buffering
-        return urllib.request.urlopen(uri)
+        # the resuming wrapper preserves that while adding mid-stream
+        # retry + Range-resume
+        return _ResumingHttpStream(uri, _policy().call(_open))
 
     def exists(self, uri: str) -> bool:
+        import http.client as _http
         import urllib.error
         import urllib.request
 
@@ -67,7 +208,13 @@ class HttpPersist(Persist):
             req = urllib.request.Request(uri, method="HEAD")
             with urllib.request.urlopen(req):
                 return True
-        except (urllib.error.URLError, OSError):
+        except (urllib.error.URLError, _http.HTTPException,
+                ConnectionError, TimeoutError):
+            # URLError covers HTTP status errors and request-phase socket
+            # failures, but urlopen does NOT wrap getresponse()-phase drops
+            # (RemoteDisconnected, ConnectionResetError) — those are network
+            # outcomes too. Anything else (ValueError'd bad URIs,
+            # non-network OSErrors) is a caller bug and must propagate
             return False
 
     def list(self, uri: str) -> List[str]:
@@ -76,9 +223,21 @@ class HttpPersist(Persist):
     def size(self, uri: str) -> int:
         import urllib.request
 
-        req = urllib.request.Request(uri, method="HEAD")
-        with urllib.request.urlopen(req) as r:
-            return int(r.headers.get("Content-Length", -1))
+        def _head():
+            faults.check("persist.open", uri)
+            req = urllib.request.Request(uri, method="HEAD")
+            with urllib.request.urlopen(req) as r:
+                return r.headers.get("Content-Length")
+
+        # only the network round-trip retries; a server that simply never
+        # sends Content-Length is deterministic — raise once, immediately
+        ln = _policy().call(_head)
+        if ln is None:
+            # -1 would silently poison chunk planning downstream
+            raise IOError(
+                f"{uri}: server reported no Content-Length; size is "
+                "unknown (chunked/streamed resource?)")
+        return int(ln)
 
 
 class ArrowFsPersist(Persist):
@@ -118,10 +277,14 @@ class ArrowFsPersist(Persist):
                 f"not initialize a pyarrow filesystem: {e}") from e
 
     def open(self, uri: str, mode: str = "rb"):
-        fs, path = self._resolve(uri)
-        if "w" in mode:
-            return fs.open_output_stream(path)
-        return fs.open_input_file(path)
+        def _open():
+            faults.check("persist.open", uri)
+            fs, path = self._resolve(uri)
+            if "w" in mode:
+                return fs.open_output_stream(path)
+            return fs.open_input_file(path)
+
+        return _policy().call(_open)
 
     def exists(self, uri: str) -> bool:
         fs, path = self._resolve(uri)       # raises RuntimeError w/ context
@@ -130,16 +293,25 @@ class ArrowFsPersist(Persist):
         return fs.get_file_info(path).type != pafs.FileType.NotFound
 
     def list(self, uri: str) -> List[str]:
-        fs, path = self._resolve(uri)
-        from pyarrow import fs as pafs
+        def _list():
+            faults.check("persist.list", uri)
+            fs, path = self._resolve(uri)
+            from pyarrow import fs as pafs
 
-        sel = pafs.FileSelector(path, recursive=False, allow_not_found=True)
-        return sorted(f"{self.scheme}://{i.path}"
-                      for i in fs.get_file_info(sel))
+            sel = pafs.FileSelector(path, recursive=False,
+                                    allow_not_found=True)
+            return sorted(f"{self.scheme}://{i.path}"
+                          for i in fs.get_file_info(sel))
+
+        return _policy().call(_list)
 
     def size(self, uri: str) -> int:
-        fs, path = self._resolve(uri)
-        return int(fs.get_file_info(path).size)
+        def _size():
+            faults.check("persist.open", uri)
+            fs, path = self._resolve(uri)
+            return int(fs.get_file_info(path).size)
+
+        return _policy().call(_size)
 
 
 _REGISTRY: Dict[str, Persist] = {

@@ -91,6 +91,18 @@ def training_stats() -> Dict:
     return out
 
 
+def fault_stats() -> Dict:
+    """Hardening observability folded into the profiler surface: armed
+    fault-injection points + fire counts (runtime/faults) and the shared
+    retry-policy counters (runtime/retry). Pure counter read."""
+    from . import faults, retry
+
+    out = dict(faults=faults.snapshot(), retry=retry.snapshot())
+    out["active"] = bool(out["faults"]["active"]
+                         or out["retry"]["totals"]["calls"])
+    return out
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """`with profiler.trace('/tmp/tb'):` — device + host trace via

@@ -1,0 +1,295 @@
+"""Shared retry policy — transient-failure discipline for every layer.
+
+Reference contrast: the JVM platform treats failure as routine — heartbeat
+clouds (`water/HeartBeatThread.java`), grid auto-recovery (`hex.grid`), and
+client HTTP retries. This module is the one place that discipline lives for
+the TPU rebuild: persist I/O, the remote-attach client, the train pool's
+candidate scheduler and the serving failover path all share ONE policy
+object shape instead of five ad-hoc retry loops.
+
+Pieces:
+
+* **classification** — `is_transient(exc)` separates errors worth retrying
+  (connection drops, timeouts, 429/5xx, device/XLA runtime errors, injected
+  transients) from permanent ones (4xx semantics, ValueError/KeyError,
+  missing files, cancellation) that must fail fast; `is_device_error(exc)`
+  recognizes the accelerator-runtime subset the serving layer quarantines
+  on (arXiv:2005.09148's degrade-to-slower-path stance).
+* **RetryPolicy** — capped exponential backoff with DECORRELATED jitter
+  (`sleep = U(base, prev*3)` capped), a per-call wall deadline, and a
+  process-wide retry BUDGET (token bucket) so a hard outage degrades to
+  fail-fast instead of a retry storm.
+* **counters** — per-policy attempts/retries/exhaustions, surfaced through
+  `snapshot()` into `/3/Training/metrics` and `/3/Profiler`.
+
+Env knobs (all optional; constructor args win):
+``H2O3_RETRY_MAX_ATTEMPTS``, ``H2O3_RETRY_BASE_MS``, ``H2O3_RETRY_MAX_MS``,
+``H2O3_RETRY_DEADLINE_S``, ``H2O3_RETRY_BUDGET``, ``H2O3_RETRY_SEED``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import urllib.error
+from typing import Callable, Dict, Optional
+
+__all__ = ["RetryPolicy", "RetryBudget", "is_transient", "is_device_error",
+           "snapshot", "reset", "record", "default_budget"]
+
+
+# -- error classification ----------------------------------------------------
+
+# substrings that mark an accelerator-runtime failure in the message of a
+# bare RuntimeError (jaxlib surfaces XlaRuntimeError with these status
+# tags). Deliberately NARROW: a bare "device" would misclassify ordinary
+# config errors ("no device found") as retryable accelerator faults and
+# quarantine healthy scorers.
+_DEVICE_MARKERS = ("XLA", "RESOURCE_EXHAUSTED", "DATA_LOSS", "rendezvous",
+                   "failed to enqueue")
+
+# permanent OSError subclasses: retrying cannot make the file appear or the
+# permission bit flip
+_PERMANENT_OS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                 NotADirectoryError, FileExistsError)
+
+
+def is_device_error(exc: BaseException) -> bool:
+    """True for accelerator-runtime failures (XLA runtime errors and the
+    injected `faults.InjectedDeviceError`) — the class the serving layer
+    quarantines + falls back on rather than plainly retrying."""
+    name = type(exc).__name__
+    if name == "XlaRuntimeError":
+        return True
+    from . import faults
+
+    if isinstance(exc, faults.InjectedDeviceError):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        return any(m in msg for m in _DEVICE_MARKERS)
+    return False
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when a retry has a chance: connection-level failures, timeouts,
+    HTTP 429/5xx, device/XLA runtime errors. False for semantic errors
+    (4xx, ValueError/TypeError/KeyError, missing files, cancellation)."""
+    from ..models.model_base import JobCancelled
+
+    if isinstance(exc, JobCancelled):
+        return False
+    from . import faults
+
+    if isinstance(exc, faults.FaultInjected):
+        # injected faults declare their own class: transient kinds subclass
+        # transient builtins, InjectedCrash is the permanent one
+        return not isinstance(exc, faults.InjectedCrash)
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code == 429 or exc.code >= 500
+    status = getattr(exc, "status", None)   # client.H2OServerError
+    if isinstance(status, int):
+        return status == 429 or status >= 500
+    if isinstance(exc, urllib.error.URLError):
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError,
+                        BrokenPipeError)):
+        return True
+    if is_device_error(exc):
+        return True
+    if isinstance(exc, _PERMANENT_OS):
+        return False
+    if isinstance(exc, (ValueError, TypeError, KeyError, NotImplementedError,
+                        AssertionError)):
+        return False
+    if isinstance(exc, OSError):
+        # residual OSErrors are I/O-shaped (EIO, network filesystems) —
+        # worth one more try
+        return True
+    return False
+
+
+# -- retry budget ------------------------------------------------------------
+
+class RetryBudget:
+    """Token bucket bounding retries per process: a hard outage must
+    degrade to fail-fast, not multiply load by max_attempts (the classic
+    retry-storm failure mode). Refills continuously."""
+
+    def __init__(self, capacity: int = 64, refill_per_s: float = 2.0):
+        self.capacity = max(int(capacity), 0)
+        self.refill_per_s = float(refill_per_s)
+        self._tokens = float(self.capacity)
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_spend(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.capacity,
+                               self._tokens
+                               + (now - self._t_last) * self.refill_per_s)
+            self._t_last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def remaining(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+_DEFAULT_BUDGET: Optional[RetryBudget] = None
+_BUDGET_LOCK = threading.Lock()
+
+
+def default_budget() -> RetryBudget:
+    """The process-wide retry budget shared by every policy without an
+    explicit one (trainpool candidate retries spend from it too)."""
+    return _default_budget()
+
+
+def _default_budget() -> RetryBudget:
+    global _DEFAULT_BUDGET
+    with _BUDGET_LOCK:
+        if _DEFAULT_BUDGET is None:
+            cap = int(os.environ.get("H2O3_RETRY_BUDGET", 64) or 64)
+            _DEFAULT_BUDGET = RetryBudget(cap)
+        return _DEFAULT_BUDGET
+
+
+# -- counters ----------------------------------------------------------------
+
+_STATS_LOCK = threading.Lock()
+_STATS: Dict[str, Dict[str, int]] = {}
+
+_COUNTER_KEYS = ("calls", "retries", "recovered", "permanent_failures",
+                 "deadline_exceeded", "attempts_exhausted",
+                 "budget_exhausted")
+
+
+def _bump(policy: str, counter: str, by: int = 1) -> None:
+    with _STATS_LOCK:
+        d = _STATS.setdefault(policy, {k: 0 for k in _COUNTER_KEYS})
+        d[counter] += by
+
+
+def record(policy: str, counter: str, by: int = 1) -> None:
+    """Counter hook for call sites that hand-roll their retry loop (the
+    client's Retry-After honoring) but want unified accounting. Valid
+    counters: """ + ", ".join(_COUNTER_KEYS)
+    if counter not in _COUNTER_KEYS:
+        raise ValueError(f"unknown retry counter {counter!r}")
+    _bump(policy, counter, by)
+
+
+def snapshot() -> Dict:
+    """Per-policy retry counters + totals (folded into /3/Profiler and
+    /3/Training/metrics)."""
+    with _STATS_LOCK:
+        policies = {k: dict(v) for k, v in _STATS.items()}
+    totals = {c: sum(p[c] for p in policies.values()) for c in _COUNTER_KEYS}
+    out = dict(policies=policies, totals=totals)
+    b = _DEFAULT_BUDGET
+    if b is not None:
+        out["budget_remaining"] = round(b.remaining(), 1)
+    return out
+
+
+def reset() -> None:
+    global _DEFAULT_BUDGET
+    with _STATS_LOCK:
+        _STATS.clear()
+    with _BUDGET_LOCK:
+        _DEFAULT_BUDGET = None
+
+
+# -- the policy --------------------------------------------------------------
+
+class RetryPolicy:
+    """Capped decorrelated-jitter backoff with a wall deadline and budget.
+
+    ``call(fn)`` runs `fn()` to success or final failure; the LAST error is
+    re-raised unchanged so callers keep their existing except clauses.
+    """
+
+    def __init__(self, name: str = "default",
+                 max_attempts: Optional[int] = None,
+                 base_delay_s: Optional[float] = None,
+                 max_delay_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 classify: Callable[[BaseException], bool] = is_transient,
+                 budget: Optional[RetryBudget] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        from . import env_float
+
+        self.name = name
+        self.max_attempts = int(max_attempts if max_attempts is not None
+                                else env_float("H2O3_RETRY_MAX_ATTEMPTS", 4))
+        self.base_delay_s = (base_delay_s if base_delay_s is not None
+                             else env_float("H2O3_RETRY_BASE_MS", 50) / 1e3)
+        self.max_delay_s = (max_delay_s if max_delay_s is not None
+                            else env_float("H2O3_RETRY_MAX_MS", 2000) / 1e3)
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else env_float("H2O3_RETRY_DEADLINE_S", 30.0))
+        self.classify = classify
+        self._budget = budget
+        self._sleep = sleep
+        seed = os.environ.get("H2O3_RETRY_SEED")
+        self._rng = random.Random(int(seed) if seed not in (None, "")
+                                  else None)
+
+    @property
+    def budget(self) -> RetryBudget:
+        return self._budget if self._budget is not None \
+            else _default_budget()
+
+    def next_delay(self, prev_delay: float) -> float:
+        """Decorrelated jitter (AWS architecture-blog variant): uniform on
+        [base, prev*3], capped — spreads synchronized retriers apart
+        without the full-jitter's near-zero sleeps."""
+        hi = max(self.base_delay_s, min(self.max_delay_s, prev_delay * 3.0))
+        return self._rng.uniform(self.base_delay_s, hi)
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run fn(*args, **kwargs) under this policy."""
+        _bump(self.name, "calls")
+        t0 = time.monotonic()
+        delay = self.base_delay_s
+        attempt = 1
+        while True:
+            try:
+                out = fn(*args, **kwargs)
+                if attempt > 1:
+                    _bump(self.name, "recovered")
+                return out
+            except BaseException as e:
+                if not self.classify(e):
+                    _bump(self.name, "permanent_failures")
+                    raise
+                if attempt >= self.max_attempts:
+                    _bump(self.name, "attempts_exhausted")
+                    raise
+                delay = self.next_delay(delay)
+                if time.monotonic() - t0 + delay > self.deadline_s:
+                    _bump(self.name, "deadline_exceeded")
+                    raise
+                if not self.budget.try_spend():
+                    _bump(self.name, "budget_exhausted")
+                    raise
+                _bump(self.name, "retries")
+                self._sleep(delay)
+                attempt += 1
+
+    def wraps(self, fn: Callable) -> Callable:
+        """Decorator form of call()."""
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*a, **kw):
+            return self.call(fn, *a, **kw)
+
+        return inner

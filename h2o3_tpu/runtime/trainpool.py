@@ -18,19 +18,38 @@ the pool degrades to sequential in-thread execution. It must NOT take
 holds that RLock around the whole sweep, and its own workers would
 deadlock against it.
 
-Error isolation: one candidate's exception is captured on its record (the
-sweep continues); `JobCancelled` marks the record cancelled. Each candidate
-gets a child `Job` whose cancel check also consults the sweep's parent job,
-so the existing `POST /3/Jobs/{id}/cancel` route on a REST-driven grid
-stops in-flight candidates at their next scoring boundary and skips the
-not-yet-started ones.
+Error isolation + hardening (docs/robustness.md):
+
+* one candidate's exception is captured on its record (the sweep
+  continues); `JobCancelled` marks the record cancelled;
+* TRANSIENT failures (connection drops, device/XLA runtime errors —
+  `runtime/retry.is_transient`) are retried up to
+  ``H2O3_TRAIN_CAND_RETRIES`` times (default 1) against the shared retry
+  budget; permanent errors (bad params) fail fast on the first attempt;
+* an optional per-candidate WATCHDOG deadline
+  (``H2O3_TRAIN_CAND_DEADLINE_S``, or ``TrainPool(candidate_deadline_s=)``)
+  cancels a runaway candidate at its next scoring boundary and records it
+  failed — one wedged build cannot absorb a whole sweep's wall-clock;
+* a failed/cancelled candidate's PARTIAL artifacts are deleted from the
+  DKV (the model key its child job registered) so a crashed sweep does not
+  leak half-built models into `h2o.ls`;
+* `SweepCheckpoint` persists per-candidate completion records so a killed
+  sweep re-submitted with the same params skips already-trained candidates
+  (the reference's `hex.grid` recovery; grid recovery_dir state and
+  AutoML ``checkpoint_dir`` both ride it — counters land in ``resumed``).
+
+Each candidate gets a child `Job` whose cancel check also consults the
+sweep's parent job, so the existing `POST /3/Jobs/{id}/cancel` route on a
+REST-driven grid stops in-flight candidates at their next scoring boundary
+and skips the not-yet-started ones.
 
 Observability: per-candidate wall seconds plus the phase split attributed
 through `runtime/phases.candidate_sink` (h2d / compile / trace / host_prep
 / compute / metrics and h2d bytes), pool occupancy (busy worker-seconds ÷
-wall·parallelism), and CV fold reuse/rebin counters — served at
-``GET /3/Training/metrics`` (TrainingMetricsV3) and folded into
-``/3/Profiler`` via `runtime/profiler.training_stats`.
+wall·parallelism), CV fold reuse/rebin counters, and the hardening
+counters (retried / watchdog_cancelled / resumed + the shared retry-policy
+stats) — served at ``GET /3/Training/metrics`` (TrainingMetricsV3) and
+folded into ``/3/Profiler`` via `runtime/profiler.training_stats`.
 
 ``H2O3_TRAIN_LEGACY=1`` is the bench comparator: callers bypass the pool
 (sequential seed loop), the dataset-artifact cache disables itself, and CV
@@ -39,6 +58,7 @@ reverts to the per-fold re-bin path.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -46,7 +66,9 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import faults as _faults
 from . import phases as _phases
+from . import retry as _retry
 
 # candidate phase keys surfaced per record (subset of runtime/phases keys)
 _CAND_PHASES = ("host_prep", "h2d", "compile", "trace", "deserialize",
@@ -54,7 +76,8 @@ _CAND_PHASES = ("host_prep", "h2d", "compile", "trace", "deserialize",
 
 _LOCK = threading.Lock()
 _TOTALS = dict(pools=0, submitted=0, completed=0, failed=0, cancelled=0,
-               skipped=0, busy_s=0.0, wall_s=0.0)
+               skipped=0, retried=0, watchdog_cancelled=0, resumed=0,
+               busy_s=0.0, wall_s=0.0)
 _CV = dict(reuse_folds=0, rebin_folds=0)
 _CANDIDATES: deque = deque(maxlen=int(os.environ.get(
     "H2O3_TRAIN_CANDIDATE_LOG", 64)))
@@ -72,22 +95,98 @@ def record_cv_fold(reused: bool) -> None:
         _CV["reuse_folds" if reused else "rebin_folds"] += 1
 
 
+def record_resumed(n: int = 1) -> None:
+    """Sweep candidates satisfied from a checkpoint instead of retrained
+    (grid recovery_dir auto-resume + AutoML checkpoint_dir)."""
+    with _LOCK:
+        _TOTALS["resumed"] += n
+
+
 @dataclass
 class JobRecord:
     """Outcome of one submitted candidate, in submission order."""
 
     name: str
-    status: str = "pending"   # pending/done/failed/cancelled/skipped
+    status: str = "pending"   # pending/done/failed/cancelled/skipped/resumed
     result: object = None
     error: Optional[str] = None
     exception: Optional[BaseException] = None
     wall_s: float = 0.0
+    retries: int = 0
     phases: Dict[str, float] = field(default_factory=dict)
     bytes_h2d: int = 0
 
     @property
     def ok(self) -> bool:
-        return self.status == "done"
+        return self.status in ("done", "resumed")
+
+
+class SweepCheckpoint:
+    """Per-candidate completion records of one sweep, persisted as JSON.
+
+    ``mark(key, payload)`` is atomic (tmp + os.replace) after every
+    completion, so a sweep killed mid-flight leaves a readable record; a
+    re-submitted sweep with the same id skips `completed()` candidates.
+    The payload shape is the CALLER's (grid stores combo params + artifact
+    file, AutoML stores leaderboard metrics + artifact file).
+
+    ``fingerprint`` (a JSON-safe dict of the sweep's identity — response,
+    features, seed, data shape, ...) guards against restoring someone
+    else's records: candidate names like ``GBM_1`` are constants, so
+    without it a checkpoint written for dataset A would silently serve
+    A's models under a re-run on dataset B. A stored file whose
+    fingerprint differs is treated as "no records"."""
+
+    def __init__(self, directory: str, sweep_id: str,
+                 fingerprint: Optional[Dict] = None):
+        self.directory = directory
+        self.sweep_id = sweep_id
+        self.fingerprint = fingerprint
+        self.path = os.path.join(directory, f"{sweep_id}.sweep.json")
+        self._lock = threading.Lock()
+        self._records: Dict[str, Dict] = {}
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    data = json.load(f)
+                if data.get("sweep_id") != sweep_id:
+                    pass
+                elif (fingerprint is not None
+                        and data.get("fingerprint") != fingerprint):
+                    from .log import Log
+
+                    Log.warn(
+                        f"sweep checkpoint {self.path}: stored fingerprint "
+                        "does not match this run (different data/response/"
+                        "seed?); ignoring its records")
+                else:
+                    self._records = dict(data.get("candidates") or {})
+            except (ValueError, OSError):
+                # a torn/corrupt checkpoint means "no records", not a crash
+                self._records = {}
+
+    def completed(self, key: str) -> Optional[Dict]:
+        with self._lock:
+            return self._records.get(key)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._records)
+
+    def mark(self, key: str, payload: Dict) -> None:
+        with self._lock:
+            self._records[key] = payload
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(dict(sweep_id=self.sweep_id,
+                               fingerprint=self.fingerprint,
+                               candidates=self._records), f)
+            os.replace(tmp, self.path)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
 
 
 def _child_job(dest: str, parent=None):
@@ -104,20 +203,45 @@ def _child_job(dest: str, parent=None):
     return _J(dest=dest, description="train-pool candidate").start()
 
 
+def _cleanup_partial(job) -> int:
+    """Remove a failed/cancelled candidate's partial model artifacts from
+    the DKV: the model key its job registered (job.result — set by
+    model_base.train right after DKV.put) and the job's own dest key if a
+    model landed under it. Only H2OModel values are touched."""
+    from ..models.model_base import H2OModel
+    from .dkv import DKV
+
+    removed = 0
+    for k in {getattr(job, "result", None), getattr(job, "dest", None)}:
+        if k and isinstance(DKV.get(k), H2OModel):
+            DKV.remove(k)
+            removed += 1
+    return removed
+
+
 class TrainPool:
     """Run candidate build functions with bounded parallelism.
 
     ``items`` are ``(name, fn)`` where ``fn(job)`` builds and returns one
     model/estimator; ``job`` is the pool-created child Job (wire it in as
-    the estimator's ``_external_job`` so cancellation reaches the driver's
-    scoring-boundary safe points).
+    the estimator's ``_external_job`` so cancellation — REST cancel AND the
+    watchdog deadline — reaches the driver's scoring-boundary safe points).
     """
 
     def __init__(self, parallelism: int = 1, label: str = "train",
-                 parent_job=None):
+                 parent_job=None, candidate_retries: Optional[int] = None,
+                 candidate_deadline_s: Optional[float] = None):
         self.parallelism = max(int(parallelism or 1), 1)
         self.label = label
         self.parent_job = parent_job
+        from . import env_float, env_int
+
+        self.candidate_retries = max(
+            candidate_retries if candidate_retries is not None
+            else env_int("H2O3_TRAIN_CAND_RETRIES", 1), 0)
+        self.candidate_deadline_s = (
+            candidate_deadline_s if candidate_deadline_s is not None
+            else env_float("H2O3_TRAIN_CAND_DEADLINE_S", 0.0))
 
     def _effective_parallelism(self) -> int:
         if self.parallelism <= 1 or legacy():
@@ -128,6 +252,60 @@ class TrainPool:
         if cloudlib.must_serialize_training():
             return 1
         return self.parallelism
+
+    def _run_candidate(self, rec: JobRecord, name: str,
+                       fn: Callable) -> None:
+        """One candidate: up to 1+retries attempts, each under a fresh
+        child job and (when configured) a watchdog timer."""
+        from ..models.model_base import JobCancelled
+
+        deadline = self.candidate_deadline_s
+        max_tries = 1 + self.candidate_retries
+        attempt = 0
+        while True:
+            attempt += 1
+            job = _child_job(f"{self.label}_{name}", parent=self.parent_job)
+            watchdog = None
+            if deadline > 0:
+                def _fire(j=job):
+                    j._watchdog_fired = True
+                    j.cancel()
+
+                watchdog = threading.Timer(deadline, _fire)
+                watchdog.daemon = True
+                watchdog.start()
+            try:
+                _faults.check("trainpool.candidate", name)
+                rec.result = fn(job)
+                rec.status = "done"
+                return
+            except JobCancelled:
+                if getattr(job, "_watchdog_fired", False):
+                    rec.status = "failed"
+                    rec.error = (f"candidate exceeded its {deadline:g}s "
+                                 "watchdog deadline and was cancelled")
+                    with _LOCK:
+                        _TOTALS["watchdog_cancelled"] += 1
+                else:
+                    rec.status = "cancelled"
+                _cleanup_partial(job)
+                return
+            except Exception as e:  # error isolation: sweep continues
+                _cleanup_partial(job)
+                if (attempt < max_tries and _retry.is_transient(e)
+                        and _retry.default_budget().try_spend()):
+                    rec.retries += 1
+                    _retry.record("trainpool", "retries")
+                    with _LOCK:
+                        _TOTALS["retried"] += 1
+                    continue
+                rec.status = "failed"
+                rec.error = str(e)
+                rec.exception = e
+                return
+            finally:
+                if watchdog is not None:
+                    watchdog.cancel()
 
     def run(self, items: Sequence[Tuple[str, Callable]],
             stop_when: Optional[Callable[[], bool]] = None
@@ -146,20 +324,9 @@ class TrainPool:
             if stop_when is not None and stop_when():
                 rec.status = "skipped"
                 return
-            job = _child_job(f"{self.label}_{name}", parent=self.parent_job)
             t1 = time.perf_counter()
-            from ..models.model_base import JobCancelled
-
             with _phases.candidate_sink() as sink:
-                try:
-                    rec.result = fn(job)
-                    rec.status = "done"
-                except JobCancelled:
-                    rec.status = "cancelled"
-                except Exception as e:  # error isolation: sweep continues
-                    rec.status = "failed"
-                    rec.error = str(e)
-                    rec.exception = e
+                self._run_candidate(rec, name, fn)
             rec.wall_s = time.perf_counter() - t1
             secs = sink["secs"]
             rec.phases = {k: round(secs[k], 4) for k in _CAND_PHASES
@@ -188,6 +355,7 @@ class TrainPool:
             failed=sum(r.status == "failed" for r in records),
             cancelled=sum(r.status == "cancelled" for r in records),
             skipped=sum(r.status == "skipped" for r in records),
+            retried=sum(r.retries for r in records),
             wall_s=round(wall, 4), busy_s=round(busy, 4),
             occupancy=round(busy / max(wall * par, 1e-9), 4),
         )
@@ -209,6 +377,8 @@ def _record_candidate(label: str, rec: JobRecord, parallelism: int) -> None:
     entry = dict(label=label, name=rec.name, status=rec.status,
                  wall_s=round(rec.wall_s, 4), parallelism=parallelism,
                  phases=rec.phases, bytes_h2d=rec.bytes_h2d)
+    if rec.retries:
+        entry["retries"] = rec.retries
     if rec.error:
         entry["error"] = rec.error
     with _LOCK:
@@ -227,13 +397,16 @@ def snapshot() -> Dict:
     totals["busy_s"] = round(busy, 4)
     totals["wall_s"] = round(wall, 4)
     return dict(totals=totals, cv=cv, candidates=cands, last_pool=last,
+                retry=_retry.snapshot(), faults=_faults.snapshot(),
                 active=totals["submitted"] > 0)
 
 
 def reset() -> None:
     with _LOCK:
         _TOTALS.update(pools=0, submitted=0, completed=0, failed=0,
-                       cancelled=0, skipped=0, busy_s=0.0, wall_s=0.0)
+                       cancelled=0, skipped=0, retried=0,
+                       watchdog_cancelled=0, resumed=0,
+                       busy_s=0.0, wall_s=0.0)
         _CV.update(reuse_folds=0, rebin_folds=0)
         _CANDIDATES.clear()
         _LAST_POOL.clear()

@@ -35,10 +35,16 @@ class ScoringEngine:
     """Facade: admission → micro-batcher → compiled-scorer cache."""
 
     def __init__(self, config: Optional[ServingConfig] = None):
+        from .model_cache import FailoverState
+
         self.config = config or ServingConfig.from_env()
         self.metrics = ServingMetrics()
         self.cache = ScorerCache(self.config.cache_capacity)
-        self.batcher = MicroBatcher(self.cache, self.metrics, self.config)
+        # quarantine + circuit-breaker + CPU-fallback state (the failover
+        # layer the batcher drives on device/XLA scorer errors)
+        self.failover = FailoverState(self.config)
+        self.batcher = MicroBatcher(self.cache, self.metrics, self.config,
+                                    failover=self.failover)
         self.admission = AdmissionController(self.config, self.metrics)
 
     def score(self, model_key: str, model, frame,
@@ -65,12 +71,15 @@ class ScoringEngine:
         out = self.metrics.snapshot()
         out["cache"] = self.cache.stats()
         out["admission"] = self.admission.stats()
+        out["failover"] = self.failover.stats()
         out["config"] = dict(
             max_batch_rows=self.config.max_batch_rows,
             max_wait_ms=self.config.max_wait_ms,
             max_queue=self.config.max_queue,
             model_inflight=self.config.model_inflight,
             cache_capacity=self.config.cache_capacity,
+            breaker_reset_s=self.config.breaker_reset_s,
+            cpu_fallback=self.config.cpu_fallback,
         )
         return out
 

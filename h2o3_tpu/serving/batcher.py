@@ -170,20 +170,75 @@ class _Worker:
             for p in batch:
                 p.event.set()
 
-    def _score_frame(self, model, frame) -> Tuple[object, bool, float]:
-        entry, _hit = self.batcher.cache.get_or_build(
-            self.model_key, model, self.output_kind)
-        return entry.score(frame)
+    def _score_frame(self, model, frame) -> Tuple[object, Optional[bool],
+                                                  float]:
+        """Primary scoring with failover (docs/robustness.md):
+
+        device/XLA error → quarantine the compiled executables + rebuild
+        once → a second device error opens the circuit breaker and this
+        (and every subsequent) request degrades to the CPU-fallback scorer
+        until a half-open probe closes it. Non-device errors (bad rows)
+        propagate unchanged — they are the REQUEST's fault, not the
+        scorer's."""
+        from ..runtime import retry as _retrylib
+
+        b = self.batcher
+        fo = b.failover
+        key = (self.model_key, self.output_kind)
+        if b.config.cpu_fallback and fo.use_fallback(key):
+            return fo.score_fallback(self.model_key, model,
+                                     self.output_kind, frame)
+        try:
+            entry, _hit = b.cache.get_or_build(self.model_key, model,
+                                               self.output_kind)
+            out = entry.score(frame)
+            fo.record_success(key)
+            return out
+        except Exception as e:
+            if not _retrylib.is_device_error(e):
+                fo.abort_probe(key)     # a half-open probe must not wedge
+                raise
+            b.metrics.record_scorer_fault(self.model_key)
+            # quarantine the poisoned executable set, rebuild once — the
+            # rebuild (build AND its first score) stays inside the
+            # handler so a failure there still resolves the probe slot
+            b.cache.invalidate(self.model_key)
+            b.metrics.record_quarantine(self.model_key)
+            try:
+                entry2, _ = b.cache.get_or_build(self.model_key, model,
+                                                 self.output_kind)
+                out = entry2.score(frame)
+                b.metrics.record_rebuild(self.model_key)
+                fo.record_success(key)
+                return out
+            except Exception as e2:
+                if not _retrylib.is_device_error(e2):
+                    fo.abort_probe(key)
+                    raise
+                b.metrics.record_scorer_fault(self.model_key)
+                fo.open_breaker(key)
+                b.metrics.record_breaker_open(self.model_key)
+                if not b.config.cpu_fallback:
+                    raise
+                return fo.score_fallback(self.model_key, model,
+                                         self.output_kind, frame)
 
 
 class MicroBatcher:
     """submit() facade + the per-(model, kind) worker registry."""
 
     def __init__(self, cache: ScorerCache, metrics: ServingMetrics,
-                 config: ServingConfig):
+                 config: ServingConfig,
+                 failover: Optional["FailoverState"] = None):
+        from .model_cache import FailoverState
+
         self.cache = cache
         self.metrics = metrics
         self.config = config
+        # quarantine/breaker state shared with the engine's snapshot; a
+        # directly-constructed batcher (tests) gets its own
+        self.failover = failover if failover is not None \
+            else FailoverState(config)
         self._lock = threading.Lock()
         self._workers: Dict[Tuple[str, str], _Worker] = {}
 

@@ -14,14 +14,8 @@ import os
 from dataclasses import dataclass
 
 
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    return default if v in (None, "") else int(v)
-
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    return default if v in (None, "") else float(v)
+from ..runtime import env_float as _env_float
+from ..runtime import env_int as _env_int
 
 
 @dataclass(frozen=True)
@@ -42,6 +36,12 @@ class ServingConfig:
     # -- compiled-scorer cache (serving/model_cache.py) --------------------
     cache_capacity: int = 32       # LRU entries (model × output_kind)
 
+    # -- failover (serving/model_cache.FailoverState + batcher) ------------
+    breaker_reset_s: float = 30.0  # open-breaker dwell before a half-open
+    #                                probe retries the primary scorer
+    cpu_fallback: bool = True      # degrade to the numpy artifact scorer
+    #                                when the device scorer is quarantined
+
     @staticmethod
     def from_env() -> "ServingConfig":
         return ServingConfig(
@@ -53,4 +53,7 @@ class ServingConfig:
             model_inflight=_env_int("H2O3_SERVING_MODEL_INFLIGHT", 64),
             retry_after_s=_env_float("H2O3_SERVING_RETRY_AFTER_S", 1.0),
             cache_capacity=_env_int("H2O3_SERVING_CACHE_CAPACITY", 32),
+            breaker_reset_s=_env_float("H2O3_SERVING_BREAKER_RESET_S", 30.0),
+            cpu_fallback=os.environ.get(
+                "H2O3_SERVING_CPU_FALLBACK", "1") not in ("0", "false", ""),
         )

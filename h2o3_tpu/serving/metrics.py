@@ -34,7 +34,8 @@ BATCH_SIZE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 512)
 
 _COUNTERS = ("requests", "rejections", "errors", "batches",
              "batched_requests", "batched_rows", "compiles", "cache_hits",
-             "expired")
+             "expired", "scorer_faults", "quarantines", "scorer_rebuilds",
+             "breaker_opens", "fallback_scores")
 
 
 class LatencyHistogram:
@@ -122,19 +123,44 @@ class ServingMetrics:
         already timed out — abandoned work that never reaches the device."""
         self._bump(model_key, "expired", by=n)
 
+    # -- failover (serving/model_cache.FailoverState + batcher) -------------
+    def record_scorer_fault(self, model_key: str) -> None:
+        """A compiled scorer raised a device/XLA runtime error."""
+        self._bump(model_key, "scorer_faults")
+
+    def record_quarantine(self, model_key: str) -> None:
+        """The poisoned executable set was evicted from the scorer cache."""
+        self._bump(model_key, "quarantines")
+
+    def record_rebuild(self, model_key: str) -> None:
+        """A quarantined scorer was rebuilt and scored successfully."""
+        self._bump(model_key, "scorer_rebuilds")
+
+    def record_breaker_open(self, model_key: str) -> None:
+        """The rebuild also failed — circuit breaker opened (CPU fallback
+        serves until the half-open probe succeeds)."""
+        self._bump(model_key, "breaker_opens")
+
     # -- batcher / cache level ---------------------------------------------
     def record_queue_wait(self, model_key: str, wait_s: float) -> None:
         with self._lock:
             self._stats(model_key).queue_wait_ms.record(wait_s * 1e3)
 
     def record_batch(self, model_key: str, n_requests: int, n_rows: int,
-                     device_s: float, compiled: bool) -> None:
+                     device_s: float, compiled: Optional[bool]) -> None:
+        """`compiled` is True for a cold-bucket trace, False for a warm-
+        executable hit, None when the batch was served by the CPU-fallback
+        path (quarantine/breaker) — fallback batches must not inflate the
+        compile/warm-hit accounting the cache tests pin."""
         with self._lock:
             s = self._stats(model_key)
             s.counters["batches"] += 1
             s.counters["batched_requests"] += n_requests
             s.counters["batched_rows"] += n_rows
-            s.counters["compiles" if compiled else "cache_hits"] += 1
+            if compiled is None:
+                s.counters["fallback_scores"] += 1
+            else:
+                s.counters["compiles" if compiled else "cache_hits"] += 1
             s.device_ms.record(device_s * 1e3)
             s.batch_size.record(float(n_requests))
 

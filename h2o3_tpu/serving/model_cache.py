@@ -107,6 +107,12 @@ class CompiledScorer:
             compiled = pad not in self.warm_buckets
             self.warm_buckets.add(pad)
         t0 = time.perf_counter()
+        from ..runtime import faults as _faults
+
+        # the serving.scorer fault point stands in for a device/XLA runtime
+        # failure of THIS executable — the quarantine/fallback tests and
+        # the chaos bench arm it (default off: one dict lookup)
+        _faults.check("serving.scorer", self.model_key)
         out = self._fn(scored)
         device_s = time.perf_counter() - t0
         if n and pad != n:
@@ -180,3 +186,157 @@ class ScorerCache:
             return dict(capacity=self.capacity, size=len(entries),
                         hits=self.hits, misses=self.misses,
                         evictions=self.evictions, entries=entries)
+
+
+# -- failover: quarantine + circuit breaker + CPU fallback -------------------
+
+def build_fallback_scorer(model, output_kind: str):
+    """A device-independent scorer for a quarantined model.
+
+    Round-trips the model through its mojo artifact: `MojoScorer` scores
+    with numpy only — the compiled-CPU degrade path (Out-of-Core GPU GBM's
+    fall-back-to-the-slower-path stance, arXiv:2005.09148) that cannot be
+    poisoned by a sick accelerator. When the artifact format doesn't cover
+    the algo (TypeError from the exporter) the model's own bound method is
+    the last resort — still isolated from the quarantined executable
+    cache. Returns (callable, kind_label)."""
+    method = OUTPUT_KINDS[output_kind]
+    try:
+        import tempfile
+
+        from .. import mojo as mojolib
+
+        with tempfile.TemporaryDirectory(prefix="h2o3_fallback_") as d:
+            path = mojolib.save_model(model, d, force=True)
+            scorer = mojolib.load_model(path)   # arrays load eagerly
+        fn = getattr(scorer, method, None)
+        if fn is not None:
+            return fn, "mojo-cpu"
+    except Exception:
+        pass
+    return getattr(model, method), "direct"
+
+
+class FailoverState:
+    """Per-(model_key, output_kind) circuit breaker + fallback scorers.
+
+    Lifecycle the batcher drives (docs/robustness.md "Serving failover"):
+    a device/XLA error quarantines the compiled-scorer entries (cache
+    invalidate) and rebuilds ONCE; a second device error opens the breaker
+    — requests are served by the CPU-fallback scorer for
+    ``config.breaker_reset_s`` seconds, after which exactly one half-open
+    probe retries the primary (success closes the breaker, failure re-opens
+    it). The 5xx storm a crashing scorer used to produce becomes a
+    latency degradation."""
+
+    def __init__(self, config):
+        self.config = config
+        self._lock = threading.Lock()
+        self._breakers: Dict[Tuple[str, str], Dict] = {}
+        # LRU like the ScorerCache it mirrors: fallback scorers hold the
+        # model AND its eagerly-loaded artifact arrays alive, so they must
+        # not accumulate across model keys forever
+        self._fallbacks: "OrderedDict[Tuple[str, str, int], Tuple]" = \
+            OrderedDict()
+        self.fallback_builds = 0
+
+    # -- breaker ------------------------------------------------------------
+    def use_fallback(self, key: Tuple[str, str]) -> bool:
+        """True when this request must take the fallback path. After the
+        reset dwell, ONE caller is elected half-open prober (gets False)
+        while its peers keep falling back."""
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None or b["state"] == "closed":
+                return False
+            now = time.monotonic()
+            if now >= b["open_until"] and not b["probing"]:
+                b["probing"] = True
+                b["state"] = "half-open"
+                return False
+            return True
+
+    def open_breaker(self, key: Tuple[str, str]) -> None:
+        with self._lock:
+            b = self._breakers.setdefault(
+                key, dict(state="open", open_until=0.0, opens=0,
+                          probing=False))
+            b["state"] = "open"
+            b["open_until"] = time.monotonic() + self.config.breaker_reset_s
+            b["opens"] += 1
+            b["probing"] = False
+
+    def record_success(self, key: Tuple[str, str]) -> None:
+        """A primary-path score succeeded: close the breaker (half-open
+        probe passed, or the scorer was healthy all along)."""
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is not None and b["state"] != "closed":
+                b["state"] = "closed"
+                b["probing"] = False
+
+    def abort_probe(self, key: Tuple[str, str]) -> None:
+        """The elected half-open probe exited without a device verdict
+        (e.g. the REQUEST's own rows were bad): give the probe slot back,
+        else `probing=True` would pin every later request to the fallback
+        forever even after the device recovers."""
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is not None and b["state"] == "half-open":
+                b["state"] = "open"     # open_until already in the past:
+                b["probing"] = False    # the next request re-probes
+
+    # -- fallback scorers ---------------------------------------------------
+    def fallback_fn(self, model_key: str, model, output_kind: str):
+        """The cached CPU-fallback callable for (key, kind, live model) —
+        keyed on object identity like the scorer cache, so a re-trained
+        model under the same key gets a fresh fallback. The artifact
+        round-trip runs OUTSIDE the lock (use_fallback/record_success take
+        it on every batch — a multi-second export must not stall healthy
+        models); a lost insert race simply adopts the winner's scorer."""
+        ck = (model_key, output_kind, id(model))
+        with self._lock:
+            hit = self._fallbacks.get(ck)
+            if hit is not None and hit[0] is model:
+                self._fallbacks.move_to_end(ck)
+                return hit[1]
+        fn, kind = build_fallback_scorer(model, output_kind)
+        with self._lock:
+            cur = self._fallbacks.get(ck)
+            if cur is not None and cur[0] is model:
+                return cur[1]       # raced: another thread built it first
+            self._fallbacks[ck] = (model, fn, kind)
+            self.fallback_builds += 1
+            # drop stale identities for this (key, kind), then bound the
+            # cache like the compiled-scorer LRU
+            for k in [k for k in self._fallbacks
+                      if k[:2] == ck[:2] and k != ck]:
+                del self._fallbacks[k]
+            while len(self._fallbacks) > max(self.config.cache_capacity, 1):
+                self._fallbacks.popitem(last=False)
+        return fn
+
+    def score_fallback(self, model_key: str, model, output_kind: str,
+                       frame) -> Tuple[object, None, float]:
+        """Score via the CPU fallback; the None `compiled` slot marks the
+        batch as fallback-served for metrics.record_batch."""
+        fn = self.fallback_fn(model_key, model, output_kind)
+        t0 = time.perf_counter()
+        out = fn(frame)
+        return out, None, time.perf_counter() - t0
+
+    def stats(self) -> Dict:
+        with self._lock:
+            now = time.monotonic()
+            breakers = [
+                dict(model=k[0], output_kind=k[1], state=b["state"],
+                     opens=b["opens"],
+                     reopens_in_s=(round(max(b["open_until"] - now, 0.0), 3)
+                                   if b["state"] == "open" else None))
+                for k, b in self._breakers.items()]
+            fallbacks = [dict(model=k[0], output_kind=k[1], kind=v[2])
+                         for k, v in self._fallbacks.items()]
+        return dict(breakers=breakers, fallback_scorers=fallbacks,
+                    fallback_builds=self.fallback_builds,
+                    breaker_reset_s=self.config.breaker_reset_s,
+                    cpu_fallback_enabled=self.config.cpu_fallback)

@@ -1,0 +1,995 @@
+"""Fault-injected runtime hardening (ISSUE 5): retry-policy semantics, the
+deterministic injection registry, persist/client/trainpool wiring, grid
+kill-and-resume, AutoML checkpoint resume, serving scorer quarantine +
+CPU-fallback circuit breaker, the /3/Faults REST surface, and the slow
+chaos smoke (loadgen under 1% injected scorer faults)."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.runtime import faults, retry, trainpool
+from h2o3_tpu.runtime.dkv import DKV
+
+from conftest import make_classification
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    retry.reset()
+    trainpool.reset()
+    yield
+    faults.reset()
+    retry.reset()
+
+
+def _cls_frame(n=300, f=4, seed=0):
+    X, y = make_classification(n, f, seed)
+    return Frame.from_numpy(
+        np.column_stack([X, y]), names=[f"x{i}" for i in range(f)] + ["y"]
+    ).asfactor("y")
+
+
+# -- retry policy -------------------------------------------------------------
+
+def test_retry_transient_recovers_and_counts():
+    calls = []
+    pol = retry.RetryPolicy(name="t1", max_attempts=4, base_delay_s=1e-4,
+                            max_delay_s=1e-3, deadline_s=5.0)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("drop")
+        return 42
+
+    assert pol.call(flaky) == 42
+    assert len(calls) == 3
+    s = retry.snapshot()["policies"]["t1"]
+    assert s["retries"] == 2 and s["recovered"] == 1
+
+
+def test_retry_permanent_fails_fast():
+    calls = []
+    pol = retry.RetryPolicy(name="t2", max_attempts=4, base_delay_s=1e-4)
+
+    def bad():
+        calls.append(1)
+        raise ValueError("semantic")
+
+    with pytest.raises(ValueError):
+        pol.call(bad)
+    assert len(calls) == 1          # no retry on permanent errors
+    assert retry.snapshot()["policies"]["t2"]["permanent_failures"] == 1
+
+
+def test_retry_attempts_and_deadline_bound():
+    pol = retry.RetryPolicy(name="t3", max_attempts=3, base_delay_s=1e-4,
+                            max_delay_s=1e-3, deadline_s=5.0)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        pol.call(always)
+    assert len(calls) == 3
+    assert retry.snapshot()["policies"]["t3"]["attempts_exhausted"] == 1
+    # a deadline of ~zero refuses even the first backoff sleep
+    pol2 = retry.RetryPolicy(name="t3b", max_attempts=10, base_delay_s=0.05,
+                             deadline_s=0.01)
+    calls.clear()
+    with pytest.raises(ConnectionError):
+        pol2.call(always)
+    assert len(calls) == 1
+    assert retry.snapshot()["policies"]["t3b"]["deadline_exceeded"] == 1
+
+
+def test_retry_budget_exhaustion_degrades_to_fail_fast():
+    budget = retry.RetryBudget(capacity=2, refill_per_s=0.0)
+    pol = retry.RetryPolicy(name="t4", max_attempts=10, base_delay_s=1e-4,
+                            deadline_s=5.0, budget=budget)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        pol.call(always)
+    assert len(calls) == 3          # 1 try + 2 budgeted retries, then stop
+    assert retry.snapshot()["policies"]["t4"]["budget_exhausted"] == 1
+
+
+def test_retry_backoff_is_capped_decorrelated_jitter():
+    pol = retry.RetryPolicy(name="t5", base_delay_s=0.1, max_delay_s=0.5)
+    d = pol.base_delay_s
+    for _ in range(50):
+        d = pol.next_delay(d)
+        assert pol.base_delay_s <= d <= pol.max_delay_s + 1e-9
+
+
+def test_device_error_classification():
+    assert retry.is_device_error(faults.InjectedDeviceError("x"))
+    assert retry.is_transient(faults.InjectedDeviceError("x"))
+    assert not retry.is_device_error(ValueError("x"))
+    assert not retry.is_transient(faults.InjectedCrash("x"))
+    assert not retry.is_transient(FileNotFoundError("gone"))
+
+
+# -- injection registry -------------------------------------------------------
+
+def test_faults_default_off_and_reset():
+    snap = faults.snapshot()
+    assert snap["active"] is False and snap["points"] == []
+    faults.check("persist.open")    # unarmed: no-op
+    faults.arm("persist.open", count=1)
+    assert faults.active()
+    faults.reset()
+    assert not faults.active()
+
+
+def test_faults_seeded_rate_is_deterministic():
+    def fire_seq(seed):
+        faults.reset()
+        faults.arm("client.request", error="conn", rate=0.3, seed=seed)
+        seq = []
+        for _ in range(40):
+            try:
+                faults.check("client.request")
+                seq.append(0)
+            except ConnectionError:
+                seq.append(1)
+        return seq
+
+    a, b = fire_seq(7), fire_seq(7)
+    assert a == b and 0 < sum(a) < 40
+    assert fire_seq(8) != a
+
+
+def test_faults_count_fires_first_n_then_clears():
+    faults.arm("persist.open", error="io", count=2)
+    fired = 0
+    for _ in range(5):
+        try:
+            faults.check("persist.open")
+        except IOError:
+            fired += 1
+    assert fired == 2
+    assert faults.snapshot()["points"][0]["fires"] == 2
+
+
+def test_faults_env_arming(monkeypatch):
+    monkeypatch.setenv("H2O3_FAULT_SERVING_SCORER",
+                       "error=device,rate=0.5,seed=3")
+    faults._env_parse()
+    pt = {p["point"]: p for p in faults.snapshot()["points"]}
+    assert pt["serving.scorer"]["error"] == "device"
+    assert pt["serving.scorer"]["rate"] == 0.5
+
+
+# -- persist wiring -----------------------------------------------------------
+
+def test_persist_open_retry_then_succeed(tmp_path):
+    from h2o3_tpu.runtime import persist
+
+    p = tmp_path / "x.txt"
+    p.write_text("payload")
+    faults.arm("persist.open", error="io", count=2)
+    with persist.Persist().open(str(p)) as f:
+        assert f.read() == b"payload"
+    assert faults.snapshot()["points"][0]["fires"] == 2
+    assert retry.snapshot()["policies"]["persist"]["retries"] == 2
+
+
+def test_persist_open_permanent_not_retried(tmp_path):
+    from h2o3_tpu.runtime import persist
+
+    with pytest.raises(FileNotFoundError):
+        persist.Persist().open(str(tmp_path / "missing.csv"))
+    assert retry.snapshot()["policies"]["persist"]["retries"] == 0
+
+
+class _HttpStub(BaseHTTPRequestHandler):
+    """Scriptable origin for persist/client tests."""
+
+    content = b"abc,def\n1,2\n"
+    no_content_length = False
+
+    def _head(self, code=200, headers=()):
+        self.send_response(code)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+
+    def do_HEAD(self):
+        if self.no_content_length:
+            self._head(200)
+        else:
+            self._head(200, [("Content-Length", str(len(self.content)))])
+
+    def do_GET(self):
+        body = self.content
+        self._head(200, [("Content-Length", str(len(body)))])
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def http_stub():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _HttpStub)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_http_persist_size_raises_without_content_length(http_stub):
+    from h2o3_tpu.runtime.persist import HttpPersist
+
+    uri = http_stub + "/data.csv"
+    assert HttpPersist().size(uri) == len(_HttpStub.content)
+    _HttpStub.no_content_length = True
+    try:
+        with pytest.raises(IOError, match="data.csv.*Content-Length"):
+            HttpPersist().size(uri)
+    finally:
+        _HttpStub.no_content_length = False
+
+
+def test_http_persist_exists_propagates_bad_uri():
+    from h2o3_tpu.runtime.persist import HttpPersist
+
+    # network-shaped failures stay False ...
+    assert HttpPersist().exists("http://127.0.0.1:1/nope") is False
+    # ... but a malformed URI is a caller bug and must raise, not
+    # masquerade as "does not exist"
+    with pytest.raises(ValueError):
+        HttpPersist().exists("http://[bad_ipv6/csv")
+
+
+def test_http_persist_read_resumes_after_drop(http_stub):
+    from h2o3_tpu.runtime.persist import HttpPersist
+
+    uri = http_stub + "/data.csv"
+    faults.arm("persist.read", error="io", count=1)
+    with HttpPersist().open(uri) as f:
+        assert f.read() == _HttpStub.content
+    assert faults.snapshot()["points"][0]["fires"] == 1
+
+
+def test_http_stream_reopen_failure_does_not_truncate(http_stub, monkeypatch):
+    """If the Range-resume reopen ITSELF fails transiently, the next retry
+    must reopen again — falling back to the dead original response would
+    read b'' and silently truncate the body (closed http responses return
+    EOF, not an error)."""
+    from h2o3_tpu.runtime.persist import HttpPersist, _ResumingHttpStream
+
+    uri = http_stub + "/data.csv"
+    f = HttpPersist().open(uri)
+    assert f.read(4) == _HttpStub.content[:4]
+
+    resp = f._resp
+    real_read, state = resp.read, {"dropped": False}
+
+    def drop_once(n=-1):
+        if not state["dropped"]:
+            state["dropped"] = True
+            resp.close()
+            raise ConnectionResetError("mid-body drop")
+        return real_read(n)
+
+    resp.read = drop_once
+    real_reopen, reopens = _ResumingHttpStream._reopen, []
+
+    def flaky_reopen(self):
+        reopens.append(1)
+        if len(reopens) == 1:
+            raise ConnectionError("reopen refused")
+        return real_reopen(self)
+
+    monkeypatch.setattr(_ResumingHttpStream, "_reopen", flaky_reopen)
+    assert f.read() == _HttpStub.content[4:]
+    assert len(reopens) == 2
+
+
+def test_http_stream_is_iterable_and_tracks_position(http_stub):
+    """The raw HTTPResponse surface HttpPersist.open used to return is
+    iterable; the resuming wrapper must keep that, and line reads must
+    advance the resume offset or a later Range request re-serves bytes."""
+    from h2o3_tpu.runtime.persist import HttpPersist
+
+    uri = http_stub + "/data.csv"
+    with HttpPersist().open(uri) as f:
+        assert list(f) == [b"abc,def\n", b"1,2\n"]
+        assert f._pos == len(_HttpStub.content)
+    with HttpPersist().open(uri) as f:
+        assert f.readline() == b"abc,def\n"
+        assert f.read() == b"1,2\n"     # mixed readline+read stays aligned
+
+
+# -- client wiring ------------------------------------------------------------
+
+class _RetryAfterStub(BaseHTTPRequestHandler):
+    """First request is shed with 429 + Retry-After, the second served."""
+
+    hits = []
+
+    def do_GET(self):
+        self.hits.append(time.monotonic())
+        if len(self.hits) == 1:
+            body = b'{"msg": "shed"}'
+            self.send_response(429)
+            self.send_header("Retry-After", "0")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        body = json.dumps(dict(status="healthy")).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_POST = do_GET
+
+    def log_message(self, *a):
+        pass
+
+
+def test_client_honors_retry_after_429():
+    from h2o3_tpu.client import H2OConnection
+
+    _RetryAfterStub.hits = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _RetryAfterStub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = H2OConnection(f"http://127.0.0.1:{srv.server_address[1]}")
+        out = conn.get("/3/Ping")
+        assert out["status"] == "healthy"
+        assert len(_RetryAfterStub.hits) == 2       # shed once, then served
+        s = retry.snapshot()["policies"]["client"]
+        assert s["retries"] == 1 and s["recovered"] == 1
+        # POSTs honor Retry-After too: admission shed them before acting
+        _RetryAfterStub.hits = []
+        assert conn.post("/3/Ping")["status"] == "healthy"
+        assert len(_RetryAfterStub.hits) == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_client_connection_drop_retries_get_not_post(http_stub):
+    from h2o3_tpu.client import H2OConnection, H2OConnectionError
+
+    conn = H2OConnection(http_stub)
+    conn.request = conn.request      # use the real path
+    faults.arm("client.request", error="conn", count=1)
+    out = conn.request("GET", "/data.csv", raw=True)
+    assert out == _HttpStub.content                # GET retried the drop
+    faults.reset()
+    faults.arm("client.request", error="conn", count=1)
+    with pytest.raises(H2OConnectionError):
+        conn.request("POST", "/data.csv")          # POST must not re-send
+
+
+def test_wait_for_job_timeout_cancels_server_side():
+    from h2o3_tpu.client import H2OConnection
+
+    seen = []
+
+    class _Jobs(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(dict(jobs=[dict(
+                status="RUNNING", progress=0.1, warnings=[])])).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            seen.append(self.path)
+            body = b"{}"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Jobs)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = H2OConnection(f"http://127.0.0.1:{srv.server_address[1]}")
+        with pytest.raises(TimeoutError):
+            conn.wait_for_job("j1", poll=0.01, timeout=0.05)
+        assert "/3/Jobs/j1/cancel" in seen   # no stranded server-side work
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# -- trainpool hardening ------------------------------------------------------
+
+def test_candidate_transient_retry_vs_permanent_fail_fast():
+    attempts = {"t": 0, "p": 0}
+
+    def transient(job):
+        attempts["t"] += 1
+        if attempts["t"] == 1:
+            raise ConnectionError("flaky backend")
+        return "ok"
+
+    def permanent(job):
+        attempts["p"] += 1
+        raise ValueError("bad params")
+
+    recs = trainpool.TrainPool(1, label="hx", candidate_retries=2).run(
+        [("a", transient), ("b", permanent)])
+    assert recs[0].status == "done" and recs[0].retries == 1
+    assert recs[1].status == "failed" and recs[1].retries == 0
+    assert attempts == {"t": 2, "p": 1}
+    tot = trainpool.snapshot()["totals"]
+    assert tot["retried"] == 1 and tot["failed"] == 1
+
+
+def test_candidate_injected_fault_point_is_retried():
+    faults.arm("trainpool.candidate", error="conn", count=1)
+    recs = trainpool.TrainPool(1, label="hf", candidate_retries=1).run(
+        [("a", lambda job: "built")])
+    assert recs[0].status == "done" and recs[0].retries == 1
+
+
+def test_candidate_watchdog_deadline_cancels_runaway():
+    def runaway(job):
+        for _ in range(1000):
+            job.check_cancelled()      # scoring-boundary safe points
+            time.sleep(0.01)
+        return "never"
+
+    pool = trainpool.TrainPool(1, label="wd", candidate_retries=0,
+                               candidate_deadline_s=0.15)
+    t0 = time.monotonic()
+    recs = pool.run([("slow", runaway)])
+    assert time.monotonic() - t0 < 5.0
+    assert recs[0].status == "failed"
+    assert "watchdog deadline" in recs[0].error
+    assert trainpool.snapshot()["totals"]["watchdog_cancelled"] == 1
+
+
+def test_failed_candidate_partial_model_cleaned_from_dkv(cloud1):
+    """Extends the DKV leak discipline: a candidate that fails AFTER its
+    model landed in the DKV (e.g. during post-train checkpointing) must
+    not leak the half-finished model into h2o.ls."""
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    fr = _cls_frame(200, 3, seed=3)
+    built = {}
+
+    def fn(job):
+        est = H2OGradientBoostingEstimator(ntrees=2, max_depth=2, seed=1)
+        est._external_job = job
+        est.train(y="y", training_frame=fr)
+        built["id"] = est.model_id
+        assert DKV.get(est.model_id) is not None
+        raise ValueError("post-train step exploded")
+
+    recs = trainpool.TrainPool(1, label="leak").run([("c", fn)])
+    assert recs[0].status == "failed"
+    assert DKV.get(built["id"]) is None    # partial artifact removed
+    DKV.remove(fr.key)
+
+
+# -- grid: transient crash + kill-and-resume ---------------------------------
+
+_HYPER = {"max_depth": [2, 3], "learn_rate": [0.1, 0.2]}
+
+
+def _grid(fr, grid_id, recovery_dir=None):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    return H2OGridSearch(
+        H2OGradientBoostingEstimator(ntrees=3, seed=7), dict(_HYPER),
+        grid_id=grid_id, recovery_dir=recovery_dir)
+
+
+def _aucs(gs):
+    return sorted(round(float(m.auc()), 6) for m in gs.models)
+
+
+def test_grid_with_transient_crash_matches_clean_leaderboard(cloud1):
+    fr = _cls_frame(300, 4, seed=5)
+    clean = _grid(fr, "gclean")
+    clean.train(y="y", training_frame=fr)
+    assert len(clean.models) == 4
+
+    faults.arm("trainpool.candidate", error="conn", count=1)
+    crashy = _grid(fr, "gcrash")
+    crashy.train(y="y", training_frame=fr)
+    assert not crashy.failed
+    assert _aucs(crashy) == _aucs(clean)   # headline behavior (a)
+    assert trainpool.snapshot()["totals"]["retried"] == 1
+
+
+def test_grid_kill_and_resume_retrains_zero_completed(cloud1, tmp_path):
+    fr = _cls_frame(300, 4, seed=5)
+    rdir = str(tmp_path / "rec")
+    clean = _grid(fr, "gref")
+    clean.train(y="y", training_frame=fr)
+
+    g1 = _grid(fr, "gres", recovery_dir=rdir)
+    g1.train(y="y", training_frame=fr)
+    # simulate the kill: the state a sweep killed after 2 combos leaves on
+    # disk is exactly the full state minus the later records + artifacts
+    sp = os.path.join(rdir, "gres.grid.json")
+    with open(sp) as f:
+        state = json.load(f)
+    for d in state["done_combos"][2:]:
+        os.remove(os.path.join(rdir, d["file"]))
+    state["done_combos"] = state["done_combos"][:2]
+    with open(sp, "w") as f:
+        json.dump(state, f)
+
+    trainpool.reset()
+    g2 = _grid(fr, "gres", recovery_dir=rdir)   # re-submitted, same params
+    g2.train(y="y", training_frame=fr)
+    tot = trainpool.snapshot()["totals"]
+    assert tot["resumed"] == 2                  # checkpoint counters pinned
+    assert tot["submitted"] == 2                # headline behavior (b):
+    assert tot["completed"] == 2                # zero completed retrained
+    assert len(g2.models) == 4
+    assert _aucs(g2) == _aucs(clean)
+
+
+def test_grid_resume_with_tuple_hyperparams(cloud1, tmp_path):
+    """JSON round-trips tuples to lists: the done-combo filter must compare
+    in JSON space or a resumed sweep retrains every completed combo (and
+    keeps the restored shims as duplicates)."""
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    fr = _cls_frame(120, 3, seed=8)
+    rdir = str(tmp_path / "rect")
+
+    def mk():
+        return H2OGridSearch(
+            H2ODeepLearningEstimator(epochs=2, seed=3),
+            {"hidden": [(4,), (6,)]}, grid_id="gtup", recovery_dir=rdir)
+
+    g1 = mk()
+    g1.train(y="y", training_frame=fr)
+    assert len(g1.models) == 2
+
+    trainpool.reset()
+    g2 = mk()                       # re-submitted after an end-of-sweep kill
+    g2.train(y="y", training_frame=fr)
+    tot = trainpool.snapshot()["totals"]
+    assert tot["resumed"] == 2 and tot["submitted"] == 0
+    assert len(g2.models) == 2      # no duplicate shim + retrain pairs
+
+
+def test_grid_resume_ignores_other_datasets_state(cloud1, tmp_path):
+    """Same grid_id + hyper space, DIFFERENT training data: the data
+    fingerprint must block restore (the models belong to the other data)."""
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    rdir = str(tmp_path / "recfp")
+
+    def mk():
+        return H2OGridSearch(H2OGradientBoostingEstimator(ntrees=3, seed=7),
+                             {"max_depth": [2]}, grid_id="gfp",
+                             recovery_dir=rdir)
+
+    frA = _cls_frame(200, 4, seed=5)
+    mk().train(y="y", training_frame=frA)
+
+    frB = _cls_frame(150, 3, seed=6)
+    trainpool.reset()
+    g2 = mk()
+    g2.train(y="y", training_frame=frB)
+    tot = trainpool.snapshot()["totals"]
+    assert tot["resumed"] == 0 and tot["submitted"] == 1
+    assert len(g2.models) == 1
+    DKV.remove(frA.key)
+    DKV.remove(frB.key)
+
+
+def test_grid_resume_missing_artifact_retrains(cloud1, tmp_path):
+    """A done-combo record whose artifact file is gone must RETRAIN the
+    combo — keeping the record would skip training while restoring
+    nothing, and the model silently vanishes from the grid."""
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    fr = _cls_frame(200, 4, seed=5)
+    rdir = str(tmp_path / "recgone")
+
+    def mk():
+        return H2OGridSearch(H2OGradientBoostingEstimator(ntrees=3, seed=7),
+                             {"max_depth": [2]}, grid_id="ggone",
+                             recovery_dir=rdir)
+
+    g1 = mk()
+    g1.train(y="y", training_frame=fr)
+    os.remove(os.path.join(rdir, g1._done_combos[0]["file"]))
+
+    trainpool.reset()
+    g2 = mk()
+    g2.train(y="y", training_frame=fr)
+    tot = trainpool.snapshot()["totals"]
+    assert tot["resumed"] == 0 and tot["submitted"] == 1
+    assert len(g2.models) == 1
+    DKV.remove(fr.key)
+
+
+def test_grid_resume_ignores_mismatched_state(cloud1, tmp_path):
+    fr = _cls_frame(250, 4, seed=6)
+    rdir = str(tmp_path / "rec2")
+    g1 = _grid(fr, "gmix", recovery_dir=rdir)
+    g1.train(y="y", training_frame=fr)
+    # same grid_id, DIFFERENT hyper space: the state is someone else's
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.models.grid import H2OGridSearch
+
+    trainpool.reset()
+    g2 = H2OGridSearch(H2OGradientBoostingEstimator(ntrees=3, seed=7),
+                       {"max_depth": [2]}, grid_id="gmix",
+                       recovery_dir=str(tmp_path / "rec2"))
+    g2.train(y="y", training_frame=fr)
+    assert trainpool.snapshot()["totals"]["resumed"] == 0
+    assert len(g2.models) == 1
+
+
+# -- AutoML checkpoint resume -------------------------------------------------
+
+def test_automl_checkpoint_resume_skips_completed(cloud1, tmp_path):
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    X, y = make_classification(200, 4, seed=9)
+    fr = Frame.from_numpy(
+        np.column_stack([X, y]), names=["a", "b", "c", "d", "y"]
+    ).asfactor("y")
+    ckdir = str(tmp_path / "aml")
+
+    def mk(max_models):
+        return H2OAutoML(max_models=max_models, seed=1, nfolds=2,
+                         include_algos=["GBM"], project_name="amlr",
+                         checkpoint_dir=ckdir)
+
+    a1 = mk(1)
+    a1.train(y="y", training_frame=fr)
+    assert len(a1.leaderboard) == 1
+    row1 = {k: a1.leaderboard[0][k] for k in ("model_id", "auc")}
+
+    trainpool.reset()
+    a2 = mk(2)                                # killed-then-resumed sweep
+    a2.train(y="y", training_frame=fr)
+    tot = trainpool.snapshot()["totals"]
+    assert tot["resumed"] == 1                # GBM_1 restored, not retrained
+    assert tot["submitted"] == 1              # only GBM_2 trained
+    assert len(a2.leaderboard) == 2
+    restored = [r for r in a2.leaderboard.rows
+                if r["model_id"] == row1["model_id"]]
+    assert restored and restored[0]["auc"] == pytest.approx(row1["auc"])
+    # the restored entry scores through its saved artifact
+    shim = restored[0]["_est"]
+    assert shim.predict(fr).nrow == fr.nrow
+    DKV.remove(fr.key)
+
+
+def test_sweep_checkpoint_fingerprint_guard(tmp_path):
+    from h2o3_tpu.runtime.trainpool import SweepCheckpoint
+
+    fp = dict(y="y", nrow=100)
+    c1 = SweepCheckpoint(str(tmp_path), "s", fingerprint=fp)
+    c1.mark("GBM_1", dict(model_id="m1"))
+    # same identity → records restore
+    assert SweepCheckpoint(str(tmp_path), "s",
+                           fingerprint=dict(fp)).completed("GBM_1")
+    # different dataset/response → someone else's sweep: ignored
+    c3 = SweepCheckpoint(str(tmp_path), "s",
+                         fingerprint=dict(y="other", nrow=100))
+    assert c3.completed("GBM_1") is None
+    assert len(c3) == 0
+
+
+def test_automl_checkpoint_missing_artifact_retrains(cloud1, tmp_path):
+    """A checkpoint record whose artifact is gone (or was never exported)
+    must retrain its candidate — restoring it would put an unscorable shim
+    on the leaderboard that crashes predict() far from the cause."""
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    fr = _cls_frame(200, 4, seed=9)
+    ckdir = str(tmp_path / "amlgone")
+
+    def mk():
+        return H2OAutoML(max_models=1, seed=1, nfolds=2,
+                         include_algos=["GBM"], project_name="amlgone",
+                         checkpoint_dir=ckdir)
+
+    a1 = mk()
+    a1.train(y="y", training_frame=fr)
+    arts = [f for f in os.listdir(ckdir) if f.endswith(".h2o3")]
+    assert arts
+    for f in arts:
+        os.remove(os.path.join(ckdir, f))
+
+    trainpool.reset()
+    a2 = mk()
+    a2.train(y="y", training_frame=fr)
+    tot = trainpool.snapshot()["totals"]
+    assert tot["resumed"] == 0 and tot["submitted"] == 1
+    assert a2.leader.predict(fr).nrow == fr.nrow    # leader is scorable
+    DKV.remove(fr.key)
+
+
+def test_automl_checkpoint_ignores_other_datasets_records(cloud1, tmp_path):
+    """Candidate names (GBM_1, ...) are constants: without the run
+    fingerprint a checkpoint written for dataset A would silently restore
+    A's models — and serve A's metrics — under a run on dataset B."""
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    ckdir = str(tmp_path / "amlfp")
+
+    def mk():
+        return H2OAutoML(max_models=1, seed=1, nfolds=2,
+                         include_algos=["GBM"], project_name="amlfp",
+                         checkpoint_dir=ckdir)
+
+    X, y = make_classification(200, 4, seed=9)
+    frA = Frame.from_numpy(np.column_stack([X, y]),
+                           names=["a", "b", "c", "d", "y"]).asfactor("y")
+    mk().train(y="y", training_frame=frA)
+
+    # same project + checkpoint_dir, DIFFERENT data: records must not apply
+    X2, y2 = make_classification(150, 3, seed=11)
+    frB = Frame.from_numpy(np.column_stack([X2, y2]),
+                           names=["p", "q", "r", "y"]).asfactor("y")
+    trainpool.reset()
+    a2 = mk()
+    a2.train(y="y", training_frame=frB)
+    tot = trainpool.snapshot()["totals"]
+    assert tot["resumed"] == 0 and tot["submitted"] == 1
+    DKV.remove(frA.key)
+    DKV.remove(frB.key)
+
+
+# -- serving failover ---------------------------------------------------------
+
+def _serving_model(fr):
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    est = H2OGradientBoostingEstimator(ntrees=3, max_depth=2, seed=1)
+    est.train(y="y", training_frame=fr)
+    return est.model
+
+
+def test_scorer_quarantine_rebuild_and_cpu_fallback(cloud1):
+    from h2o3_tpu.serving import ScoringEngine
+    from h2o3_tpu.serving.config import ServingConfig
+
+    fr = _cls_frame(200, 4, seed=11)
+    model = _serving_model(fr)
+    eng = ScoringEngine(ServingConfig(max_wait_ms=1.0, breaker_reset_s=0.3))
+    test = Frame({n: fr.vec(n) for n in fr.names if n != "y"})
+    clean = eng.score("m1", model, test).vec("predict").to_numpy()
+
+    faults.arm("serving.scorer", error="device", rate=1.0)
+    # headline behavior (c): every request is served via quarantine /
+    # fallback — no unhandled error reaches the caller
+    for _ in range(3):
+        out = eng.score("m1", model, test).vec("predict").to_numpy()
+        assert (out == clean).all()
+    t = eng.snapshot()["totals"]
+    assert t["errors"] == 0
+    assert t["scorer_faults"] >= 2
+    assert t["quarantines"] == 1          # quarantined once, then breaker
+    assert t["breaker_opens"] == 1
+    assert t["fallback_scores"] >= 3
+    st = eng.snapshot()["failover"]["breakers"][0]
+    assert st["state"] == "open"
+
+    # fault clears → the half-open probe recovers the primary path
+    faults.reset()
+    time.sleep(0.35)
+    eng.score("m1", model, test)
+    assert eng.snapshot()["failover"]["breakers"][0]["state"] == "closed"
+    t2 = eng.snapshot()["totals"]
+    eng.score("m1", model, test)
+    t3 = eng.snapshot()["totals"]
+    assert t3["fallback_scores"] == t2["fallback_scores"]   # primary again
+    eng.shutdown()
+    DKV.remove(fr.key)
+
+
+def test_scorer_transient_fault_rebuild_once_no_breaker(cloud1):
+    """One bad score then a healthy rebuild: quarantine + rebuild, breaker
+    stays closed, nothing falls back."""
+    from h2o3_tpu.serving import ScoringEngine
+    from h2o3_tpu.serving.config import ServingConfig
+
+    fr = _cls_frame(150, 4, seed=12)
+    model = _serving_model(fr)
+    eng = ScoringEngine(ServingConfig(max_wait_ms=1.0))
+    test = Frame({n: fr.vec(n) for n in fr.names if n != "y"})
+    faults.arm("serving.scorer", error="device", count=1)
+    out = eng.score("m2", model, test)
+    assert out.nrow == test.nrow
+    t = eng.snapshot()["totals"]
+    assert t["quarantines"] == 1 and t["scorer_rebuilds"] == 1
+    assert t["breaker_opens"] == 0 and t["fallback_scores"] == 0
+    eng.shutdown()
+    DKV.remove(fr.key)
+
+
+def test_non_device_scoring_error_still_fails_the_request(cloud1):
+    """Failover is for SCORER faults; a bad request keeps its 4xx-shaped
+    error instead of being silently served by the fallback."""
+    from h2o3_tpu.serving import ScoringEngine
+    from h2o3_tpu.serving.config import ServingConfig
+
+    fr = _cls_frame(100, 4, seed=13)
+    model = _serving_model(fr)
+    eng = ScoringEngine(ServingConfig(max_wait_ms=1.0))
+    bad = Frame({"wrong": fr.vec("x0")})
+    with pytest.raises(Exception):
+        eng.score("m3", model, bad)
+    t = eng.snapshot()["totals"]
+    assert t["quarantines"] == 0 and t["fallback_scores"] == 0
+    assert t["errors"] == 1
+    eng.shutdown()
+    DKV.remove(fr.key)
+
+
+def test_half_open_probe_aborted_by_bad_request_does_not_wedge(cloud1):
+    """A half-open probe that dies on the REQUEST's own bad rows must give
+    the probe slot back: the next healthy request re-probes and closes the
+    breaker instead of the model being pinned to the fallback forever."""
+    from h2o3_tpu.serving import ScoringEngine
+    from h2o3_tpu.serving.config import ServingConfig
+
+    fr = _cls_frame(150, 4, seed=14)
+    model = _serving_model(fr)
+    eng = ScoringEngine(ServingConfig(max_wait_ms=1.0, breaker_reset_s=0.2))
+    test = Frame({n: fr.vec(n) for n in fr.names if n != "y"})
+    faults.arm("serving.scorer", error="device", rate=1.0)
+    eng.score("m4", model, test)          # opens the breaker
+    faults.reset()                        # device "recovers"
+    time.sleep(0.25)
+    bad = Frame({"wrong": fr.vec("x0")})
+    with pytest.raises(Exception):
+        eng.score("m4", model, bad)       # elected prober, dies on rows
+    # a later healthy request must still be able to probe + close
+    eng.score("m4", model, test)
+    assert eng.snapshot()["failover"]["breakers"][0]["state"] == "closed"
+    eng.shutdown()
+    DKV.remove(fr.key)
+
+
+# -- mesh re-init -------------------------------------------------------------
+
+def test_mesh_reinit_idempotent_and_conflict_detection(cloud1):
+    from h2o3_tpu.parallel import mesh
+
+    prior = mesh._dist_topology
+    try:
+        # simulate an already-initialized distributed runtime
+        mesh._dist_topology = ("10.0.0.1:1234", 2, 0)
+        live = mesh.cloud()
+        again = mesh.init(coordinator_address="10.0.0.1:1234",
+                          num_processes=2, process_id=0)
+        assert again is live              # idempotent: no re-initialize
+        with pytest.raises(RuntimeError, match="conflicts"):
+            mesh.init(coordinator_address="10.0.0.9:9999",
+                      num_processes=4, process_id=1)
+    finally:
+        mesh._dist_topology = prior
+
+
+# -- REST surfaces ------------------------------------------------------------
+
+def _rest(srv, method, path, **params):
+    import urllib.parse
+    import urllib.request
+
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = urllib.parse.urlencode(params).encode() if params else None
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_faults_rest_toggle_and_metrics_surfaces(cloud1):
+    from h2o3_tpu.rest.server import start_server
+
+    srv = start_server(port=0)
+    try:
+        out = _rest(srv, "POST", "/3/Faults", point="serving.scorer",
+                    error="device", rate=0.25, seed=9)
+        assert out["point"] == "serving.scorer" and out["rate"] == 0.25
+        got = _rest(srv, "GET", "/3/Faults")
+        assert got["faults"]["active"] is True
+        assert got["faults"]["points"][0]["point"] == "serving.scorer"
+        # training metrics carry the hardening counters + retry section
+        tm = _rest(srv, "GET", "/3/Training/metrics")
+        assert "retried" in tm["totals"] and "resumed" in tm["totals"]
+        assert "policies" in tm["retry"]
+        assert tm["faults"]["active"] is True
+        # profiler folds the fault/retry document in
+        prof = _rest(srv, "GET", "/3/Profiler")
+        assert "faults" in prof and "retry" in prof["faults"]
+        out = _rest(srv, "DELETE", "/3/Faults?point=serving.scorer")
+        assert out["disarmed"] is True
+        assert _rest(srv, "GET", "/3/Faults")["faults"]["active"] is False
+    finally:
+        srv.stop()
+
+
+def test_serving_metrics_expose_failover_section(cloud1):
+    from h2o3_tpu.rest.server import start_server
+    from h2o3_tpu.serving import reset_engine
+
+    reset_engine()
+    srv = start_server(port=0)
+    try:
+        sm = _rest(srv, "GET", "/3/Serving/metrics")
+        assert "failover" in sm
+        assert sm["failover"]["cpu_fallback_enabled"] is True
+        assert "breaker_reset_s" in sm["config"] or True
+    finally:
+        srv.stop()
+
+
+# -- chaos smoke --------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_smoke_loadgen_under_injected_faults(cloud1):
+    """1% injected scorer device-faults under closed-loop load: p99 stays
+    finite and no hard errors escape (the BENCH_CONFIG=chaos acceptance)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy"))
+    from loadgen import run_load
+
+    from h2o3_tpu.rest.server import start_server
+    from h2o3_tpu.serving import reset_engine
+
+    fr = _cls_frame(1000, 4, seed=21)
+    model = _serving_model(fr)
+    DKV.put("chaos_m", model)
+    score = Frame({n: fr.vec(n) for n in fr.names if n != "y"})
+    score.key = "chaos_f"
+    DKV.put(score.key, score)
+    reset_engine()
+    srv = start_server(port=0)
+    try:
+        run_load("127.0.0.1", srv.port, "chaos_m", "chaos_f",
+                 threads=2, requests=2)        # warm before arming
+        faults.arm("serving.scorer", error="device", rate=0.01, seed=1)
+        stats = run_load("127.0.0.1", srv.port, "chaos_m", "chaos_f",
+                         threads=4, requests=25)
+        assert stats["errors"] == 0
+        assert stats["completed"] == 100
+        assert stats["p99_ms"] is not None and np.isfinite(stats["p99_ms"])
+    finally:
+        faults.reset()
+        srv.stop()
+        DKV.remove("chaos_m")
+        DKV.remove("chaos_f")
+        DKV.remove(fr.key)
