@@ -1,26 +1,47 @@
 #!/usr/bin/env python
-"""Closed-loop load generator for the `/3/Predictions` serving path.
+"""Load generator for the `/3/Predictions` serving path — closed- and
+open-loop.
 
-N worker threads each issue M back-to-back requests against one
-(model, frame) pair and record per-request latency; the report prints
-p50/p99 and aggregate throughput, plus the 429 (shed) and error counts so
-an overload run is legible. Closed-loop means each thread waits for its
-response before sending the next request — offered load tracks service
-rate, which is the right shape for measuring the micro-batcher's
-coalescing win (open-loop generators measure queue explosion instead).
+**Closed-loop** (`run_load`): N worker threads each issue M back-to-back
+requests; each thread waits for its response before sending the next, so
+offered load tracks service rate — the right shape for measuring the
+micro-batcher's coalescing win.
+
+**Open-loop** (`run_load_open`): requests arrive on a fixed schedule
+(`rate` per second) regardless of how fast earlier ones complete — the
+right shape for a serving-SLO lane, because a slow server faces the SAME
+offered load a fast one does instead of being graded on a curve. Latency
+bins into `LATENCY_MS_BOUNDS` below — the same fixed buckets as
+`h2o3_tpu.runtime.metrics_registry.LATENCY_MS_BOUNDS` (equality is
+pinned by a test) — so the reported p50/p95/p99 are bucket-comparable
+with the serving histograms scraped at `GET /3/Metrics`.
+
+The standalone CLI is STDLIB-ONLY: it must run from a loadgen host with
+no jax/h2o3 installed, and must not import (and configure) jax as a side
+effect in the loadgen process. When the platform is already loaded
+in-process (bench.py, the in-process test servers), every request is
+additionally folded into the central registry
+(`h2o3_loadgen_request_ms{mode=...}`) so a loadgen run is itself
+scrapable.
 
 Usage:
     python deploy/loadgen.py --port 54321 --model gbm_1 --frame fr_1 \\
-        --threads 8 --requests 50
+        --threads 8 --requests 50               # closed-loop
+    python deploy/loadgen.py --port 54321 --model gbm_1 --frame fr_1 \\
+        --rate 50 --duration-s 10               # open-loop, 50 req/s
 
-Importable: `run_load(...)` returns the stats dict (the smoke test in
-tests/test_serving.py drives an in-process server through it).
+Importable: `run_load(...)` / `run_load_open(...)` return the stats dict
+(the smoke tests in tests/test_serving.py and tests/test_observability.py
+drive an in-process server through them; `BENCH_CONFIG=serving` in
+bench.py is the open-loop SLO lane).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import threading
 import time
 import urllib.error
@@ -28,12 +49,102 @@ import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional
 
+if __package__ in (None, ""):  # `python deploy/loadgen.py` from anywhere
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# literal copy of metrics_registry.LATENCY_MS_BOUNDS (this module cannot
+# import the platform — see docstring); test_observability pins equality
+LATENCY_MS_BOUNDS = (0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+                     5000, 10000, 30000)
+
+
+class _BucketHist:
+    """Stdlib fixed-bound histogram over the shared latency buckets, with
+    the same bucket-interpolated percentile estimate as the registry's
+    Histogram — O(bounds) state, directly comparable with /3/Metrics."""
+
+    def __init__(self, bounds=LATENCY_MS_BOUNDS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        i = len(self.bounds)
+        for j, b in enumerate(self.bounds):
+            if v <= b:
+                i = j
+                break
+        self.counts[i] += 1
+        self.n += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def percentile(self, q: float) -> Optional[float]:
+        if self.n == 0:
+            return None
+        rank = q * (self.n - 1)
+        cum = 0
+        for i, cnt in enumerate(self.counts):
+            if cnt == 0:
+                continue
+            if rank < cum + cnt:
+                lo = self.bounds[i - 1] if i > 0 else (
+                    self.vmin if self.vmin is not None else 0.0)
+                hi = self.bounds[i] if i < len(self.bounds) else (
+                    self.vmax if self.vmax is not None else lo)
+                lo = max(lo, self.vmin) if self.vmin is not None else lo
+                hi = min(hi, self.vmax) if self.vmax is not None else hi
+                if hi <= lo:
+                    return float(lo)
+                frac = (rank - cum + 1) / cnt if cnt > 1 else 0.5
+                frac = min(max(frac, 0.0), 1.0)
+                return float(lo + (hi - lo) * frac)
+            cum += cnt
+        return self.vmax
+
+    def summary(self) -> Dict:
+        return dict(
+            bounds=list(self.bounds), counts=list(self.counts), count=self.n,
+            mean=round(self.total / self.n, 4) if self.n else None,
+            min=self.vmin, max=self.vmax,
+            p50=self.percentile(0.50), p95=self.percentile(0.95),
+            p99=self.percentile(0.99),
+        )
+
+
+def _registry_hist():
+    """The scrapable registry fold of every loadgen request — ONLY when
+    the platform is already loaded in this process. The standalone CLI
+    never imports h2o3_tpu (which would drag jax in and mutate its config
+    as an import side effect); returns None there and callers skip the
+    fold."""
+    if "h2o3_tpu" not in sys.modules:
+        return None
+    from h2o3_tpu.runtime import metrics_registry as reg
+
+    return reg.histogram(
+        "h2o3_loadgen_request_ms",
+        "loadgen request latency (ms), shared latency buckets",
+        bounds=reg.LATENCY_MS_BOUNDS, labelnames=("mode",))
+
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
     if not sorted_vals:
         return float("nan")
     i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
     return sorted_vals[i]
+
+
+def _predict_url(host: str, port: int, model: str, frame: str) -> str:
+    return (f"http://{host}:{port}/3/Predictions/models/"
+            f"{urllib.parse.quote(model)}/frames/"
+            f"{urllib.parse.quote(frame)}")
 
 
 def run_load(host: str, port: int, model: str, frame: str,
@@ -44,14 +155,13 @@ def run_load(host: str, port: int, model: str, frame: str,
 
     `duration_s` caps wall-clock instead of request count when set (each
     thread stops issuing new requests once the deadline passes)."""
-    url = (f"http://{host}:{port}/3/Predictions/models/"
-           f"{urllib.parse.quote(model)}/frames/"
-           f"{urllib.parse.quote(frame)}")
+    url = _predict_url(host, port, model, frame)
     lock = threading.Lock()
     lat_s: List[float] = []
     shed = [0]
     errors = [0]
     t_end = (time.monotonic() + duration_s) if duration_s else None
+    reg_hist = _registry_hist()
 
     def worker():
         for _ in range(requests):
@@ -62,8 +172,11 @@ def run_load(host: str, port: int, model: str, frame: str,
                 req = urllib.request.Request(url, data=b"")
                 with urllib.request.urlopen(req, timeout=timeout_s) as r:
                     r.read()
+                lat = time.monotonic() - t0
+                if reg_hist is not None:
+                    reg_hist.observe(lat * 1e3, "closed")
                 with lock:
-                    lat_s.append(time.monotonic() - t0)
+                    lat_s.append(lat)
             except urllib.error.HTTPError as e:
                 e.read()
                 with lock:
@@ -91,6 +204,105 @@ def run_load(host: str, port: int, model: str, frame: str,
     )
 
 
+def run_load_open(host: str, port: int, model: str, frame: str,
+                  rate: float = 20.0, duration_s: float = 10.0,
+                  timeout_s: float = 60.0, max_inflight: int = 256) -> Dict:
+    """Drive the predict route open-loop at a fixed arrival rate.
+
+    One dispatcher thread fires a request thread at each scheduled arrival
+    (`t0 + i/rate`), never waiting for earlier responses — queueing delay
+    shows up as latency, not as reduced load. `max_inflight` is the
+    safety valve: arrivals beyond it are counted `dropped` (a dropped
+    arrival means the server is more than `max_inflight` requests behind
+    the schedule, itself an SLO verdict) instead of growing threads
+    without bound.
+
+    Percentiles come from the shared fixed latency buckets
+    (LATENCY_MS_BOUNDS — the same bounds the serving histograms use), so
+    they are directly comparable with `GET /3/Metrics` and with every
+    other loadgen/bench report; `hist_*` fields carry the raw bucket
+    vector for the bench JSON."""
+    if rate <= 0:
+        raise ValueError(f"open-loop rate must be > 0 req/s (got {rate})")
+    url = _predict_url(host, port, model, frame)
+    n_arrivals = max(int(rate * duration_s), 1)
+    lock = threading.Lock()
+    # per-run local histogram over the SAME shared bounds: the report must
+    # cover this run only, while the registered family below (in-process
+    # runs only) accumulates process-wide for the scrape surface
+    hist = _BucketHist()
+    reg_hist = _registry_hist()
+    counts = dict(completed=0, shed_429=0, errors=0, dropped=0)
+    inflight = [0]
+    live: List[threading.Thread] = []
+
+    def one_request():
+        t_req = time.monotonic()
+        try:
+            req = urllib.request.Request(url, data=b"")
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                r.read()
+            lat_ms = (time.monotonic() - t_req) * 1e3
+            with lock:
+                hist.observe(lat_ms)
+            if reg_hist is not None:
+                reg_hist.observe(lat_ms, "open")
+            with lock:
+                counts["completed"] += 1
+        except urllib.error.HTTPError as e:
+            e.read()
+            with lock:
+                counts["shed_429" if e.code == 429 else "errors"] += 1
+        except OSError:
+            with lock:
+                counts["errors"] += 1
+        finally:
+            with lock:
+                inflight[0] -= 1
+
+    t0 = time.monotonic()
+    for i in range(n_arrivals):
+        target = t0 + i / rate
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        with lock:
+            if inflight[0] >= max_inflight:
+                counts["dropped"] += 1
+                continue
+            inflight[0] += 1
+        t = threading.Thread(target=one_request, daemon=True)
+        t.start()
+        live.append(t)
+    # wall is the offered-load window (the arrival schedule), measured
+    # BEFORE draining stragglers: one request hanging to its timeout must
+    # show up as drain/latency, not deflate achieved_rps into a phantom
+    # throughput collapse
+    wall = max(time.monotonic() - t0, 1e-9)
+    deadline = time.monotonic() + timeout_s + 5.0
+    for t in live:
+        t.join(timeout=max(deadline - time.monotonic(), 0.0))
+    drain = max(time.monotonic() - t0 - wall, 0.0)
+    summary = hist.summary()
+    offered = n_arrivals
+    return dict(
+        url=url, mode="open", rate_rps=rate,
+        duration_s=round(duration_s, 3), offered=offered,
+        completed=counts["completed"], shed_429=counts["shed_429"],
+        errors=counts["errors"], dropped=counts["dropped"],
+        wall_s=round(wall, 3), drain_s=round(drain, 3),
+        achieved_rps=round(counts["completed"] / wall, 2),
+        p50_ms=(round(summary["p50"], 3)
+                if summary["p50"] is not None else None),
+        p95_ms=(round(summary["p95"], 3)
+                if summary["p95"] is not None else None),
+        p99_ms=(round(summary["p99"], 3)
+                if summary["p99"] is not None else None),
+        mean_ms=summary["mean"], max_ms=summary["max"],
+        hist_bounds_ms=summary["bounds"], hist_counts=summary["counts"],
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -99,13 +311,28 @@ def main() -> int:
     ap.add_argument("--frame", required=True, help="DKV frame key")
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--requests", type=int, default=50,
-                    help="requests per thread")
+                    help="requests per thread (closed-loop)")
     ap.add_argument("--duration-s", type=float, default=None,
-                    help="stop issuing after this many seconds instead")
+                    help="closed-loop: stop issuing after this many "
+                         "seconds; open-loop: run length (default 10)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate (req/s); setting this "
+                         "selects open-loop mode")
+    ap.add_argument("--max-inflight", type=int, default=256,
+                    help="open-loop: arrivals beyond this many in flight "
+                         "are dropped (overload safety valve)")
     args = ap.parse_args()
-    stats = run_load(args.host, args.port, args.model, args.frame,
-                     threads=args.threads, requests=args.requests,
-                     duration_s=args.duration_s)
+    if args.rate is not None and args.rate <= 0:
+        ap.error("--rate must be > 0 (requests per second)")
+    if args.rate is not None:
+        stats = run_load_open(args.host, args.port, args.model, args.frame,
+                              rate=args.rate,
+                              duration_s=args.duration_s or 10.0,
+                              max_inflight=args.max_inflight)
+    else:
+        stats = run_load(args.host, args.port, args.model, args.frame,
+                         threads=args.threads, requests=args.requests,
+                         duration_s=args.duration_s)
     print(json.dumps(stats, indent=2))
     return 0 if stats["completed"] else 1
 

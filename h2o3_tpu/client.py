@@ -18,8 +18,10 @@ in Flow (`/flow/`).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import threading
 import time
 import urllib.error
 import urllib.parse
@@ -94,6 +96,9 @@ class H2OConnection:
         self._retry = _retrylib.RetryPolicy(
             name="client", max_attempts=max_retries)
         self._batch: Optional[List[str]] = None   # pending Rapids assigns
+        # trace() pins per THREAD: a connection shared across threads must
+        # not leak one thread's pinned trace id into another's requests
+        self._trace_tls = threading.local()
         self._ssl_ctx = None
         if url.startswith("https") and not verify_ssl:
             import ssl
@@ -120,6 +125,14 @@ class H2OConnection:
         headers = {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        # request tracing (docs/observability.md): the CLIENT mints the
+        # trace id — one fresh id per request, or the pinned id inside a
+        # `with conn.trace():` block so a whole train+predict workflow
+        # correlates into one server-side trace
+        from .runtime import tracing as _tracing
+
+        headers["X-H2O3-Trace-Id"] = (
+            getattr(self._trace_tls, "tid", None) or _tracing.new_trace_id())
         if json_body is not None:
             data = json.dumps(json_body).encode()
             headers["Content-Type"] = "application/json"
@@ -296,6 +309,21 @@ class H2OConnection:
         program = "\n".join(self._batch)
         self._batch.clear()   # before the POST: request() re-enters here
         self.request("POST", "/99/Rapids", json_body={"ast": program})
+
+    @contextlib.contextmanager
+    def trace(self, trace_id: Optional[str] = None):
+        """Pin ONE trace id on every request inside the block (nestable;
+        inner blocks win): `with conn.trace() as tid:` train + predict,
+        then `GET /3/Trace?trace_id=tid` returns the whole correlated tree
+        — request, job, candidate and batch spans under one id."""
+        from .runtime import tracing as _tracing
+
+        prev = getattr(self._trace_tls, "tid", None)
+        self._trace_tls.tid = trace_id or _tracing.new_trace_id()
+        try:
+            yield self._trace_tls.tid
+        finally:
+            self._trace_tls.tid = prev
 
     def batch(self):
         """Deferred-munging context: inside `with conn.batch():` every

@@ -32,6 +32,36 @@ _LAST: Dict = {}
 PHASE_ORDER = ("setup", "read", "tokenize", "coerce", "intern", "place")
 
 
+_REGISTRY = None
+
+
+def _registry():
+    """Central-registry counters backing the /3/Ingest/metrics totals (the
+    scrape surface at GET /3/Metrics). Registered lazily (memoized — this
+    runs per parse) and bound to the REST fields they back so the
+    metrics-consistency test can hold the two surfaces together."""
+    global _REGISTRY
+    if _REGISTRY is not None:
+        return _REGISTRY
+    from ..runtime import metrics_registry as reg
+
+    c = {
+        "parses": reg.counter("h2o3_ingest_parses",
+                              "completed CSV parses"),
+        "rows": reg.counter("h2o3_ingest_rows", "rows ingested"),
+        "bytes": reg.counter("h2o3_ingest_bytes", "bytes ingested"),
+        "secs": reg.counter("h2o3_ingest_seconds",
+                            "wall seconds spent parsing"),
+    }
+    for field, metric in (("totals.parses", "h2o3_ingest_parses"),
+                          ("totals.rows", "h2o3_ingest_rows"),
+                          ("totals.bytes", "h2o3_ingest_bytes"),
+                          ("totals.secs", "h2o3_ingest_seconds")):
+        reg.bind_rest_field("ingest", field, metric)
+    _REGISTRY = c
+    return c
+
+
 @contextmanager
 def stage(marks: Dict[str, float], name: str):
     """Accumulate wall-clock of one parse stage into `marks[name]`."""
@@ -73,6 +103,18 @@ def record(path: str, rows: int, nbytes: int, secs: float,
         _TOTALS["secs"] += secs
         _LAST.clear()
         _LAST.update(entry)
+    # observability spine: monotone registry counters (GET /3/Metrics) +
+    # a retroactive child span of whatever request/job ran this parse
+    reg = _registry()
+    reg["parses"].inc(1)
+    reg["rows"].inc(int(rows))
+    reg["bytes"].inc(int(nbytes))
+    reg["secs"].inc(secs)
+    from ..runtime import tracing as _tracing
+
+    _tracing.record_span(f"ingest:{path}", secs, kind="ingest",
+                         rows=int(rows), bytes=int(nbytes),
+                         n_chunks=int(n_chunks), native=bool(native))
 
 
 def snapshot() -> Dict:

@@ -33,6 +33,38 @@ _PER_OP: Dict[str, Dict] = {}
 _LAST: Dict = {}
 
 
+_REGISTRY = None
+
+
+def _registry():
+    """Central-registry counters backing the /3/Munge/metrics totals
+    (scraped at GET /3/Metrics; per-op detail labeled by op/path).
+    Memoized — this runs on every munge op."""
+    global _REGISTRY
+    if _REGISTRY is not None:
+        return _REGISTRY
+    from ..runtime import metrics_registry as reg
+
+    c = {
+        "ops": reg.counter("h2o3_munge_ops", "completed munge ops",
+                           labelnames=("op", "path")),
+        "errors": reg.counter("h2o3_munge_errors", "munge ops that raised",
+                              labelnames=("op",)),
+        "rows_in": reg.counter("h2o3_munge_rows_in", "input rows munged"),
+        "rows_out": reg.counter("h2o3_munge_rows_out",
+                                "output rows produced"),
+        "secs": reg.counter("h2o3_munge_seconds",
+                            "wall seconds spent in munge ops"),
+    }
+    for field, metric in (("totals.ops", "h2o3_munge_ops"),
+                          ("totals.rows_in", "h2o3_munge_rows_in"),
+                          ("totals.rows_out", "h2o3_munge_rows_out"),
+                          ("totals.secs", "h2o3_munge_seconds")):
+        reg.bind_rest_field("munge", field, metric)
+    _REGISTRY = c
+    return c
+
+
 def legacy_enabled() -> bool:
     """True when ``H2O3_MUNGE_LEGACY=1`` forces the seed per-row paths
     (the bit-exact comparator the parity tests diff against)."""
@@ -70,6 +102,18 @@ def record(op: str, rows_in: int, rows_out: int, secs: float,
     )
     if error:
         entry["error"] = True
+    reg = _registry()
+    reg["ops"].inc(1, op, path)
+    if error:
+        reg["errors"].inc(1, op)
+    reg["rows_in"].inc(int(rows_in))
+    reg["rows_out"].inc(int(rows_out))
+    reg["secs"].inc(secs)
+    from ..runtime import tracing as _tracing
+
+    _tracing.record_span(f"munge:{op}", secs, kind="munge",
+                         rows_in=int(rows_in), rows_out=int(rows_out),
+                         path=path, **(dict(error=True) if error else {}))
     with _LOCK:
         _TOTALS["ops"] += 1
         _TOTALS["rows_in"] += int(rows_in)

@@ -132,6 +132,10 @@ class Job:
     status: str = "CREATED"  # CREATED/RUNNING/DONE/FAILED/CANCELLED
     warnings: List[str] = field(default_factory=list)
     cancel_requested: bool = False
+    # observability spine: the REST request (or client call) that created
+    # this job stamps its trace id here, so the job's worker thread — and
+    # every trainpool candidate under it — records spans in the same trace
+    trace_id: Optional[str] = None
 
     def start(self):
         self.start_time = time.time()

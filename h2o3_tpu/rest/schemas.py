@@ -61,6 +61,45 @@ SERVING_SCHEMA_NAME = "ServingMetricsV3"
 INGEST_SCHEMA_NAME = "IngestMetricsV3"
 MUNGE_SCHEMA_NAME = "MungeMetricsV3"
 TRAINING_SCHEMA_NAME = "TrainingMetricsV3"
+OBSERVABILITY_SCHEMA_NAME = "ObservabilityV3"
+
+# the per-subsystem JSON metrics endpoints whose counter fields must be
+# backed by central-registry metrics (metrics_registry.bind_rest_field);
+# the metrics-consistency test walks these against GET /3/Metrics
+METRICS_ENDPOINTS = {
+    "serving": "/3/Serving/metrics",
+    "ingest": "/3/Ingest/metrics",
+    "munge": "/3/Munge/metrics",
+    "training": "/3/Training/metrics",
+}
+
+
+def observability_schema() -> Dict:
+    """Field metadata of the observability-spine surfaces
+    (docs/observability.md mirrors this)."""
+    fields = [
+        ("GET /3/Metrics", "text/plain",
+         "Prometheus text exposition (0.0.4) of the central metrics"
+         " registry: every subsystem counter/gauge/histogram, HELP/TYPE"
+         " lines, _total counter suffixes, _bucket/_sum/_count histogram"
+         " series — the machine-scrapable surface"),
+        ("GET /3/Trace?trace_id=", "TraceEventsJSON",
+         "Chrome-trace/Perfetto JSON of recorded spans: request (root,"
+         " trace id from the X-H2O3-Trace-Id header), job, candidate,"
+         " batch, ingest and munge spans with retry/fault annotations"),
+        ("GET /3/Timeline?since=&n=", "TimelineV3",
+         "bounded event ring + recent span summaries; every event carries"
+         " a monotone seq — pass the returned cursor back as since= for"
+         " incremental tailing"),
+        ("X-H2O3-Trace-Id", "header",
+         "client-minted (or server-minted when absent) trace id,"
+         " propagated into Jobs/candidates/batches and echoed on every"
+         " response"),
+    ]
+    return dict(
+        name=OBSERVABILITY_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )
 
 
 def training_metrics_schema() -> Dict:

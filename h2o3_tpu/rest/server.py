@@ -28,10 +28,22 @@ import numpy as np
 from ..frame.frame import Frame
 from ..frame.rapids_expr import RapidsSession
 from ..models.model_base import H2OModel, Job
+from ..runtime import metrics_registry as registry
+from ..runtime import tracing
 from ..runtime.dkv import DKV
 from ..runtime.log import Log
 from ..runtime.timeline import Timeline
 from . import schemas
+
+# per-route request accounting in the central registry: counter + latency
+# histogram labeled by handler name (bounded cardinality — the route table
+# is fixed), so the REST face itself is scrapable at GET /3/Metrics
+_REQ_COUNT = registry.counter("h2o3_rest_requests",
+                              "REST requests dispatched, per handler",
+                              labelnames=("handler", "status"))
+_REQ_MS = registry.histogram("h2o3_rest_request_ms",
+                             "REST request wall time (ms), per handler",
+                             labelnames=("handler",))
 
 
 def _json_default(o):
@@ -138,6 +150,8 @@ class _Handler(BaseHTTPRequestHandler):
         ("POST", r"^/99/Rapids$", "rapids"),
         ("GET", r"^/3/Logs(?:/download)?$", "logs"),
         ("GET", r"^/3/Timeline$", "timeline"),
+        ("GET", r"^/3/Metrics$", "metrics"),
+        ("GET", r"^/3/Trace$", "trace"),
         ("GET", r"^/3/Profiler$", "profiler"),
         ("GET", r"^/3/Metadata/schemas$", "metadata_schemas"),
         ("POST", r"^/3/Frames/([^/]+)/export$", "frame_export"),
@@ -200,9 +214,20 @@ class _Handler(BaseHTTPRequestHandler):
     def _send(self, obj, status: int = 200,
               headers: Optional[Dict[str, str]] = None):
         body = json.dumps(_sanitize(obj), default=_json_default).encode()
+        self._send_raw(body, "application/json", status=status,
+                       headers=headers)
+
+    def _send_raw(self, body: bytes, content_type: str, status: int = 200,
+                  headers: Optional[Dict[str, str]] = None):
+        self._status = status
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        tid = getattr(self, "_trace_id", None)
+        if tid:
+            # echo the request's trace id (minted server-side when the
+            # client sent none) so callers can fetch GET /3/Trace?trace_id=
+            self.send_header("X-H2O3-Trace-Id", tid)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -244,6 +269,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str):
         path = urllib.parse.urlparse(self.path).path
+        # observability spine: every request runs under a root span whose
+        # trace id comes from the client's X-H2O3-Trace-Id header (minted
+        # here when absent) and is echoed back by _send; child work — jobs,
+        # candidates, batches, parses, munge ops — records into the same
+        # trace. Assigned first thing, per request: the handler instance
+        # persists across a keep-alive connection, so a stale id must never
+        # leak into the next request's response (a 401/404 included).
+        tid = (self.headers.get("X-H2O3-Trace-Id") or "")[:64]
+        self._trace_id = tid or tracing.new_trace_id()
         token = getattr(self.server, "auth_token", None)
         if token:
             # bearer-token auth (the `-internal_security_conf` stance:
@@ -265,9 +299,16 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             g = re.match(pat, path)
             if g:
+                self._status = 200
+                t0 = time.perf_counter()
                 try:
-                    Timeline.record("rest", f"{method} {path}")
-                    getattr(self, "h_" + name)(*[urllib.parse.unquote(x) for x in g.groups()])
+                    Timeline.record("rest", f"{method} {path}",
+                                    trace_id=self._trace_id)
+                    with tracing.span(f"{method} {path}", kind="request",
+                                      trace_id=self._trace_id,
+                                      handler=name):
+                        getattr(self, "h_" + name)(
+                            *[urllib.parse.unquote(x) for x in g.groups()])
                 except _PayloadTooLarge as e:
                     self._send(dict(__meta=dict(schema_type="H2OError"),
                                     msg=str(e), http_status=413), 413)
@@ -292,6 +333,9 @@ class _Handler(BaseHTTPRequestHandler):
                                     msg=str(e), http_status=500,
                                     dev_msg=f"unhandled in h_{name}",
                                     exception_type=type(e).__name__), 500)
+                finally:
+                    _REQ_COUNT.inc(1, name, str(self._status))
+                    _REQ_MS.observe((time.perf_counter() - t0) * 1e3, name)
                 return
         self._send(dict(msg=f"no route for {method} {path}"), 404)
 
@@ -558,6 +602,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         job = Job(dest=f"{algo}_rest_{uuid.uuid4().hex[:8]}",
                   description=f"{algo} train").start()
+        job.trace_id = tracing.current_trace_id()
         job.result = None  # model key once DONE (the job's `dest` is stable)
         DKV.put(job.dest, job)
         # the estimator adopts THIS job, so /3/Jobs progress and
@@ -569,7 +614,9 @@ class _Handler(BaseHTTPRequestHandler):
             from ..parallel import mesh
 
             try:
-                with mesh.training_guard():
+                with tracing.attach(job.trace_id, name=f"job:{job.dest}",
+                                    kind="job", algo=algo), \
+                        mesh.training_guard():
                     est.train(x=x, y=y, training_frame=train,
                               validation_frame=valid)
                 m = est.model
@@ -995,7 +1042,46 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(logs=Log.get_logs()))
 
     def h_timeline(self):
-        self._send(dict(events=Timeline.snapshot()))
+        """`GET /3/Timeline[?since=cursor&n=]` — the bounded event ring,
+        plus recent span summaries. Every event carries a monotone `seq`;
+        pass the returned `cursor` back as `since=` to tail
+        incrementally."""
+        p = self._params()
+        try:
+            since = p.get("since")
+            since = int(since) if since not in (None, "") else None
+            # n clamps to [1, 10000]: n=0 must not mean "the whole ring",
+            # and with since= it must not return an empty page whose
+            # cursor jumps past (and permanently loses) unread events
+            n = min(max(int(p.get("n", 1000) or 1000), 1), 10_000)
+        except ValueError as e:
+            self._send(dict(__meta=dict(schema_type="H2OError"),
+                            msg=f"bad since=/n= query param: {e}",
+                            http_status=400), 400)
+            return
+        events, cursor = Timeline.tail(since, n=n)
+        self._send(dict(events=events, cursor=cursor,
+                        spans=tracing.summaries(min(n, 200))))
+
+    def h_metrics(self):
+        """`GET /3/Metrics` — the central registry in Prometheus text
+        exposition format: every counter/gauge/histogram of every
+        subsystem (serving, ingest, munge, training, retry, faults, REST,
+        XLA compile/retrace) in one scrape. `?schema=1` returns the
+        ObservabilityV3 field metadata as JSON instead (the sibling
+        /3/*/metrics convention)."""
+        if self._flag(self._params(), "schema"):
+            self._send(schemas.observability_schema())
+            return
+        self._send_raw(registry.prometheus_text().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+
+    def h_trace(self):
+        """`GET /3/Trace[?trace_id=]` — recorded spans as Chrome-trace/
+        Perfetto JSON (load at ui.perfetto.dev). Without trace_id, the
+        whole span ring exports; with it, one correlated request tree."""
+        p = self._params()
+        self._send(tracing.export_chrome(p.get("trace_id") or None))
 
     def h_profiler(self):
         from ..runtime import profiler
@@ -1007,10 +1093,14 @@ class _Handler(BaseHTTPRequestHandler):
                         ingest=profiler.ingest_stats(),
                         munge=profiler.munge_stats(),
                         training=profiler.training_stats(),
-                        faults=profiler.fault_stats()))
+                        faults=profiler.fault_stats(),
+                        xla=profiler.xla_stats(),
+                        tracing=profiler.tracing_stats(),
+                        metrics=profiler.registry_stats()))
 
     def h_metadata_schemas(self):
-        self._send(dict(schemas=schemas.all_schemas()))
+        self._send(dict(schemas=schemas.all_schemas()
+                        + [schemas.observability_schema()]))
 
     # -- uploads (PostFileHandler) ------------------------------------------
     def h_post_file(self):
@@ -1091,6 +1181,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         job = Job(dest=f"grid_rest_{uuid.uuid4().hex[:8]}",
                   description=f"{algo} grid").start()
+        job.trace_id = tracing.current_trace_id()
         job.result = gs.grid_id
         # the sweep's parent job: POST /3/Jobs/{id}/cancel on it skips
         # unstarted combos and cancels in-flight candidates at their next
@@ -1103,7 +1194,9 @@ class _Handler(BaseHTTPRequestHandler):
             from ..parallel import mesh
 
             try:
-                with mesh.training_guard():
+                with tracing.attach(job.trace_id, name=f"job:{job.dest}",
+                                    kind="job", algo=algo), \
+                        mesh.training_guard():
                     gs.train(x=x, y=y, training_frame=train)
                 if job.cancel_requested:
                     job.status = "CANCELLED"
@@ -1199,6 +1292,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         job = Job(dest=f"automl_rest_{uuid.uuid4().hex[:8]}",
                   description="AutoML").start()
+        job.trace_id = tracing.current_trace_id()
         job.result = aml.project_name
         DKV.put(job.dest, job)
         DKV.put(aml.project_name, aml)
@@ -1210,7 +1304,9 @@ class _Handler(BaseHTTPRequestHandler):
             from ..parallel import mesh
 
             try:
-                with mesh.training_guard():
+                with tracing.attach(job.trace_id, name=f"job:{job.dest}",
+                                    kind="job", algo="automl"), \
+                        mesh.training_guard():
                     aml.train(x=x, y=y, training_frame=train)
                 job.done()
             except Exception as e:
@@ -1785,6 +1881,11 @@ class H2OApiServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "H2OApiServer":
+        # a serving REST process always tracks XLA compiles/retraces — the
+        # /3/Metrics retrace counters must not depend on bench env flags
+        from ..runtime import phases
+
+        phases.install_listener()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="h2o3tpu-rest")
         self._thread.start()

@@ -6,14 +6,10 @@ that `water/util/Log.java`, `water/TimeLine.java`, `water/api/ProfilerHandler`,
 `water/persist/Persist.java` and `water/DKV.java` provide in the reference.
 """
 
-from .dkv import DKV  # noqa: F401
-from .log import Log  # noqa: F401
-from .timeline import Timeline  # noqa: F401
-
-
 def env_int(name: str, default: int) -> int:
     """Integer env knob with an empty-string-safe default (the one parser
-    every H2O3_* knob shares)."""
+    every H2O3_* knob shares). Defined before the submodule imports below
+    so modules they pull in (timeline) can use it during package init."""
     import os
 
     v = os.environ.get(name)
@@ -25,3 +21,8 @@ def env_float(name: str, default: float) -> float:
 
     v = os.environ.get(name)
     return default if v in (None, "") else float(v)
+
+
+from .dkv import DKV  # noqa: E402,F401
+from .log import Log  # noqa: E402,F401
+from .timeline import Timeline  # noqa: E402,F401

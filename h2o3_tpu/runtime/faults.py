@@ -196,11 +196,33 @@ def check(point: str, detail: str = "") -> None:
             _TOTAL_FIRES += 1
         latency = p.latency_ms
         kind = ERROR_KINDS[p.kind]
+    if fire:
+        # observability spine: fired injections are scrapable and annotate
+        # the owning span (only on fire — the disarmed fast path above and
+        # the armed-but-quiet path stay allocation-free)
+        from . import metrics_registry as _reg
+        from . import tracing as _tracing
+
+        _fired_counter(_reg).inc(1, point)
+        _tracing.event("fault_fired", point=point, kind=p.kind,
+                       **(dict(detail=detail) if detail else {}))
     if latency:
         time.sleep(latency / 1e3)
     if fire and kind is not None:
         raise kind(f"injected fault at {point}"
                    + (f" ({detail})" if detail else ""))
+
+
+_FIRED = None
+
+
+def _fired_counter(reg):
+    global _FIRED
+    if _FIRED is None:
+        _FIRED = reg.counter("h2o3_fault_fires",
+                             "injected faults fired, per armed point",
+                             labelnames=("point",))
+    return _FIRED
 
 
 def snapshot() -> Dict:

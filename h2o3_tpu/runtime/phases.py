@@ -22,16 +22,178 @@ Two sources:
 
 from __future__ import annotations
 
+import itertools
 import os
+import sys
 import threading
 import time
-from collections import defaultdict
+import weakref
+import zlib
+from collections import OrderedDict, defaultdict
 from contextlib import contextmanager
 
 _LOCK = threading.Lock()
 _SECS: dict = defaultdict(float)
 _BYTES: dict = defaultdict(int)
 _installed = False
+
+# -- XLA compile/retrace tracker ---------------------------------------------
+# Counts compiles / traces / persistent-cache retrievals per program
+# signature, so "warm cache never re-traces" is a pinned counter instead of
+# a monkeypatch test, and the fused-GBM work can prove per-level retrace
+# count == 0. A "retrace" is any trace event for a signature that has
+# already traced at least once this process.
+_XLA_LOCK = threading.Lock()
+_XLA_TOTALS = dict(compiles=0, traces=0, retraces=0, cache_retrievals=0,
+                   persistent_cache_hits=0, persistent_cache_misses=0)
+_XLA_PER_SIG: "OrderedDict[str, dict]" = OrderedDict()
+_XLA_SIG_CAP = 512
+# stable per-process serial for each live traced-function object (see
+# _xla_signature): weakref-keyed so a dead function's serial dies with it
+# instead of its id being recycled into another program's identity
+_SIG_SERIALS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_SIG_NEXT = itertools.count(1)
+
+
+def _fun_serial(obj) -> int:
+    try:
+        with _XLA_LOCK:
+            s = _SIG_SERIALS.get(obj)
+            if s is None:
+                s = _SIG_SERIALS[obj] = next(_SIG_NEXT)
+            return s
+    except TypeError:          # not hashable / not weakref-able
+        return 0
+
+
+def _xla_signature() -> str:
+    """Attribute a compile-pipeline event to the PROGRAM that triggered it.
+
+    jax emits every duration event from `dispatch.log_elapsed_time`, whose
+    generator frame carries the jitted program's name in its `fun_name`
+    local (`fun.__name__` for jaxpr traces, the computation name for
+    backend compiles); persistent-cache retrievals fire from
+    `compiler.py` with `module_name` in scope. That is real program
+    identity — stable regardless of which tracing span happens to be open,
+    so the retrace pin neither fires on two different programs compiled
+    under one span nor misses the same program re-traced under
+    differently-named spans.
+
+    Trace events additionally join jax's own cache identity, read from the
+    `memoized_fun` caller frame: the lu cache is `fun_caches[fun.f][key]`
+    and the event fires exactly when it misses, so "same (fun.f, key)
+    traced twice" is by construction "jax re-traced the identical
+    program". `fun.f` is identified by a weakref-keyed serial — a new
+    function object (e.g. the fresh `partial` each eager primitive bakes
+    its static shape into) gets a fresh serial, never a recycled id, so
+    two shape buckets are distinct first traces and a GC'd function can
+    never alias a live one into a fabricated retrace. `in_type` (input
+    avals) is the fallback digest. Only runs on compile/trace events,
+    which are rare by design."""
+    try:
+        f = sys._getframe(1)
+        depth = 0
+        while f is not None and depth < 40:
+            loc = f.f_locals
+            if f.f_code.co_name == "log_elapsed_time" and "fun_name" in loc:
+                sig = str(loc["fun_name"])
+                g, hops, avals = f.f_back, 0, None
+                while g is not None and hops < 6:
+                    gl = g.f_locals
+                    if g.f_code.co_name == "memoized_fun" and "key" in gl:
+                        base = gl.get("fun")
+                        base = getattr(base, "f", base)
+                        return "%s/%d.%016x" % (
+                            sig, _fun_serial(base),
+                            hash(gl["key"]) & 0xFFFFFFFFFFFFFFFF)
+                    if avals is None and "in_type" in gl:
+                        avals = str(gl["in_type"])
+                    g = g.f_back
+                    hops += 1
+                if avals is not None:
+                    sig += "/%08x" % (zlib.crc32(avals.encode())
+                                      & 0xFFFFFFFF)
+                return sig
+            if "module_name" in loc and "cache_key" in loc:
+                return str(loc["module_name"])
+            f = f.f_back
+            depth += 1
+    except Exception:
+        pass
+    # unknown emission site (a future jax moved the locals): one shared
+    # bucket that never counts retraces — missing a real retrace beats
+    # fabricating one into a pinned counter
+    return "unattributed"
+
+
+_XLA_REG: dict = {}
+
+
+def _xla_counters() -> dict:
+    """Memoized registry families for the XLA event counters — counting a
+    compile-pipeline event must not take the registry's registration lock
+    (same stance as every other subsystem's memoized _registry())."""
+    if not _XLA_REG:
+        from . import metrics_registry as _reg
+
+        for kind in ("compiles", "traces", "cache_retrievals"):
+            _XLA_REG[kind] = _reg.counter(
+                f"h2o3_xla_{kind}",
+                f"XLA compile-pipeline {kind} observed via jax monitoring")
+        _XLA_REG["retraces"] = _reg.counter(
+            "h2o3_xla_retraces",
+            "trace events for an already-traced program signature "
+            "(a warm path must keep this flat)")
+    return _XLA_REG
+
+
+def _xla_count(kind: str, sig: str) -> None:
+    retraced = False
+    with _XLA_LOCK:
+        _XLA_TOTALS[kind] += 1
+        d = _XLA_PER_SIG.get(sig)
+        if d is None:
+            d = _XLA_PER_SIG[sig] = dict(compiles=0, traces=0, retraces=0,
+                                         cache_retrievals=0)
+            while len(_XLA_PER_SIG) > _XLA_SIG_CAP:
+                _XLA_PER_SIG.popitem(last=False)
+        if kind in d:
+            if (kind == "traces" and d["traces"] >= 1
+                    and sig != "unattributed"):
+                retraced = True
+                d["retraces"] += 1
+                _XLA_TOTALS["retraces"] += 1
+            d[kind] += 1
+    reg = _xla_counters()
+    reg[kind].inc()
+    if retraced:
+        reg["retraces"].inc()
+    # candidate/batch/request correlation lives on the span as an event
+    # annotation, NOT in the signature — span names must not leak into
+    # program identity
+    try:
+        from . import tracing
+
+        tracing.event(f"xla_{kind}", sig=sig)
+        if retraced:
+            tracing.event("xla_retrace", sig=sig)
+    except Exception:
+        pass
+
+
+def xla_counts() -> dict:
+    """Cumulative compile/trace/retrace/cache totals (bench JSON embed +
+    the warm-path counter pins)."""
+    with _XLA_LOCK:
+        return dict(_XLA_TOTALS)
+
+
+def xla_snapshot() -> dict:
+    """Totals + per-program-signature breakdown (most recent signatures
+    first, bounded)."""
+    with _XLA_LOCK:
+        sigs = {k: dict(v) for k, v in reversed(_XLA_PER_SIG.items())}
+        return dict(totals=dict(_XLA_TOTALS), signatures=sigs)
 
 # per-candidate attribution: a training worker (runtime/trainpool.py)
 # installs a thread-local sink around one candidate's fit, and every add()
@@ -169,12 +331,28 @@ def install_listener() -> None:
     def _on(event: str, duration: float, **kw) -> None:
         if "backend_compile" in event:
             add("compile", duration)
+            _xla_count("compiles", _xla_signature())
         elif "jaxpr_trace" in event or "mlir_module" in event:
             add("trace", duration)
+            if "jaxpr_trace" in event:
+                # one logical trace per program: the mlir lowering event of
+                # the same compile must not double-count it
+                _xla_count("traces", _xla_signature())
         elif "cache_retrieval" in event or "deserialize" in event:
             add("deserialize", duration)
+            _xla_count("cache_retrievals", _xla_signature())
+
+    def _on_event(event: str, **kw) -> None:
+        # persistent compilation-cache hit/miss counts (no duration)
+        if "compilation_cache/cache_hits" in event:
+            with _XLA_LOCK:
+                _XLA_TOTALS["persistent_cache_hits"] += 1
+        elif "compilation_cache/cache_misses" in event:
+            with _XLA_LOCK:
+                _XLA_TOTALS["persistent_cache_misses"] += 1
 
     monitoring.register_event_duration_secs_listener(_on)
+    monitoring.register_event_listener(_on_event)
 
 
 if ENABLED:

@@ -103,6 +103,34 @@ def fault_stats() -> Dict:
     return out
 
 
+def xla_stats() -> Dict:
+    """XLA compile/trace/retrace counters folded into the profiler surface
+    (runtime/phases tracker): totals + per-program-signature breakdown.
+    Pure counter read."""
+    from . import phases
+
+    out = phases.xla_snapshot()
+    out["active"] = any(out["totals"].values())
+    return out
+
+
+def registry_stats() -> Dict:
+    """The central metrics registry's JSON view (counters/gauges/histogram
+    summaries + windowed rates) — the /3/Profiler fold of the same store
+    GET /3/Metrics scrapes as Prometheus text."""
+    from . import metrics_registry
+
+    return metrics_registry.snapshot()
+
+
+def tracing_stats(n: int = 20) -> Dict:
+    """Recent span summaries (the /3/Timeline fold, also available here)."""
+    from . import tracing
+
+    return dict(recorded=tracing.span_count(),
+                recent=tracing.summaries(n))
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """`with profiler.trace('/tmp/tb'):` — device + host trace via

@@ -171,10 +171,35 @@ _COUNTER_KEYS = ("calls", "retries", "recovered", "permanent_failures",
                  "budget_exhausted")
 
 
+_REG_COUNTER = None
+
+
+def _reg_counter():
+    """Central-registry family backing the retry counters (GET /3/Metrics):
+    one labeled counter, policy × event."""
+    global _REG_COUNTER
+    if _REG_COUNTER is None:
+        from . import metrics_registry as reg
+
+        _REG_COUNTER = reg.counter(
+            "h2o3_retry_events",
+            "shared retry-policy events (calls/retries/recovered/"
+            "exhaustions) per policy", labelnames=("policy", "event"))
+        for k in _COUNTER_KEYS:
+            reg.bind_rest_field("training", f"retry.totals.{k}",
+                                "h2o3_retry_events")
+    return _REG_COUNTER
+
+
 def _bump(policy: str, counter: str, by: int = 1) -> None:
     with _STATS_LOCK:
         d = _STATS.setdefault(policy, {k: 0 for k in _COUNTER_KEYS})
         d[counter] += by
+    _reg_counter().inc(by, policy, counter)
+    if counter == "retries":
+        from . import tracing as _tracing
+
+        _tracing.event("retry", policy=policy)
 
 
 def record(policy: str, counter: str, by: int = 1) -> None:

@@ -1,10 +1,16 @@
-"""Timeline — lock-free-ish event ring for distributed debugging.
+"""Timeline — bounded event ring for distributed debugging.
 
 Reference parity: `h2o-core/src/main/java/water/TimeLine.java` — a ring of
 64-byte records (timestamp, peer, task) for every packet send/recv, dumped
 cluster-wide via `/3/Timeline` (`water/util/TimelineSnapshot.java` merges the
 per-node rings). Here the interesting events are compiles, device transfers,
-collective launches and training milestones; one ring per process.
+collective launches, REST requests and training milestones; one ring per
+process, bounded (``H2O3_TIMELINE_EVENTS``, default 4096) so sustained REST
+traffic recycles slots instead of growing the host.
+
+Every event carries a monotone ``seq`` cursor: ``GET /3/Timeline?since=N``
+returns only events recorded after cursor N plus the new cursor, so a
+tailing client polls incrementally instead of re-downloading the ring.
 """
 
 from __future__ import annotations
@@ -12,12 +18,16 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
+
+from . import env_int
 
 
 class Timeline:
-    _ring: deque = deque(maxlen=4096)
+    _ring: deque = deque(maxlen=max(env_int("H2O3_TIMELINE_EVENTS", 4096),
+                                    16))
     _lock = threading.Lock()
+    _seq = 0
 
     @classmethod
     def record(cls, kind: str, detail: str = "", **extra):
@@ -25,12 +35,44 @@ class Timeline:
         if extra:
             ev.update(extra)
         with cls._lock:
+            cls._seq += 1
+            ev["seq"] = cls._seq
             cls._ring.append(ev)
 
     @classmethod
-    def snapshot(cls, n: int = 1000) -> List[Dict]:
+    def snapshot(cls, n: int = 1000,
+                 since: Optional[int] = None) -> List[Dict]:
+        """Latest `n` events; with `since`, only events with seq > since
+        (incremental tailing — each event's own ``seq`` is the cursor)."""
         with cls._lock:
-            return list(cls._ring)[-n:]
+            evs = list(cls._ring)
+        if since is not None:
+            evs = [e for e in evs if e["seq"] > since]
+        return evs[-n:]
+
+    @classmethod
+    def tail(cls, since: Optional[int],
+             n: int = 1000) -> Tuple[List[Dict], int]:
+        """One atomic tailing page: ``(events, cursor)`` under a single
+        lock acquisition, so the cursor always corresponds to the events
+        actually returned. With ``since``, the page is the OLDEST `n`
+        events after the cursor (a burst larger than one page is paged
+        through, never silently skipped) and the cursor is the last
+        returned event's seq; without, the page is the latest `n` and the
+        cursor is the global latest seq (start tailing from now)."""
+        with cls._lock:
+            evs = list(cls._ring)
+            latest = cls._seq
+        if since is None:
+            return evs[-n:], latest
+        page = [e for e in evs if e["seq"] > since][:n]
+        return page, (page[-1]["seq"] if page else latest)
+
+    @classmethod
+    def cursor(cls) -> int:
+        """The latest sequence number (pass back as ``since=``)."""
+        with cls._lock:
+            return cls._seq
 
     @classmethod
     def clear(cls):

@@ -69,6 +69,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from . import faults as _faults
 from . import phases as _phases
 from . import retry as _retry
+from . import tracing as _tracing
 
 # candidate phase keys surfaced per record (subset of runtime/phases keys)
 _CAND_PHASES = ("host_prep", "h2d", "compile", "trace", "deserialize",
@@ -90,9 +91,48 @@ def legacy() -> bool:
     return os.environ.get("H2O3_TRAIN_LEGACY", "") not in ("", "0")
 
 
+_TOTAL_FIELDS = ("pools", "submitted", "completed", "failed", "cancelled",
+                 "skipped", "retried", "watchdog_cancelled", "resumed")
+
+
+_REGISTRY = None
+
+
+def _registry():
+    """Central-registry counters backing /3/Training/metrics totals + CV
+    fold accounting (GET /3/Metrics scrape surface). Memoized — this runs
+    per CV fold and per pool."""
+    global _REGISTRY
+    if _REGISTRY is not None:
+        return _REGISTRY
+    from . import metrics_registry as reg
+
+    c = {f: reg.counter(f"h2o3_train_{f}",
+                        f"train pool {f.replace('_', ' ')}")
+         for f in _TOTAL_FIELDS}
+    c["busy_s"] = reg.counter("h2o3_train_busy_seconds",
+                              "busy worker-seconds across pool candidates")
+    c["wall_s"] = reg.counter("h2o3_train_wall_seconds",
+                              "pool wall-seconds")
+    c["cv"] = reg.counter("h2o3_train_cv_folds",
+                          "CV folds by preparation mode",
+                          labelnames=("mode",))
+    for f in _TOTAL_FIELDS:
+        reg.bind_rest_field("training", f"totals.{f}", f"h2o3_train_{f}")
+    reg.bind_rest_field("training", "totals.busy_s",
+                        "h2o3_train_busy_seconds")
+    reg.bind_rest_field("training", "totals.wall_s",
+                        "h2o3_train_wall_seconds")
+    reg.bind_rest_field("training", "cv.reuse_folds", "h2o3_train_cv_folds")
+    reg.bind_rest_field("training", "cv.rebin_folds", "h2o3_train_cv_folds")
+    _REGISTRY = c
+    return c
+
+
 def record_cv_fold(reused: bool) -> None:
     with _LOCK:
         _CV["reuse_folds" if reused else "rebin_folds"] += 1
+    _registry()["cv"].inc(1, "reuse" if reused else "rebin")
 
 
 def record_resumed(n: int = 1) -> None:
@@ -100,6 +140,7 @@ def record_resumed(n: int = 1) -> None:
     (grid recovery_dir auto-resume + AutoML checkpoint_dir)."""
     with _LOCK:
         _TOTALS["resumed"] += n
+    _registry()["resumed"].inc(n)
 
 
 @dataclass
@@ -286,6 +327,9 @@ class TrainPool:
                                  "watchdog deadline and was cancelled")
                     with _LOCK:
                         _TOTALS["watchdog_cancelled"] += 1
+                    _registry()["watchdog_cancelled"].inc()
+                    _tracing.event("watchdog_cancelled",
+                                   deadline_s=deadline)
                 else:
                     rec.status = "cancelled"
                 _cleanup_partial(job)
@@ -298,6 +342,9 @@ class TrainPool:
                     _retry.record("trainpool", "retries")
                     with _LOCK:
                         _TOTALS["retried"] += 1
+                    _registry()["retried"].inc()
+                    _tracing.event("retry", attempt=attempt,
+                                   error=f"{type(e).__name__}: {e}")
                     continue
                 rec.status = "failed"
                 rec.error = str(e)
@@ -313,6 +360,15 @@ class TrainPool:
         records = [JobRecord(name=name) for name, _ in items]
         par = self._effective_parallelism()
         t0 = time.perf_counter()
+        # trace correlation: candidates run on pool worker threads, so the
+        # submitting thread's span (the REST job span, usually) is captured
+        # here and re-attached per candidate — every candidate span shares
+        # the request's trace id
+        parent_span = _tracing.current()
+        trace_id = (parent_span.trace_id if parent_span is not None
+                    else getattr(self.parent_job, "trace_id", None))
+        parent_id = (parent_span.span_id if parent_span is not None
+                     else None)
 
         def _one(i: int) -> None:
             rec = records[i]
@@ -325,8 +381,12 @@ class TrainPool:
                 rec.status = "skipped"
                 return
             t1 = time.perf_counter()
-            with _phases.candidate_sink() as sink:
+            with _tracing.span(f"candidate:{name}", kind="candidate",
+                               trace_id=trace_id, parent_id=parent_id,
+                               label=self.label) as sp, \
+                    _phases.candidate_sink() as sink:
                 self._run_candidate(rec, name, fn)
+                sp.annotate(status=rec.status)
             rec.wall_s = time.perf_counter() - t1
             secs = sink["secs"]
             rec.phases = {k: round(secs[k], 4) for k in _CAND_PHASES
@@ -370,6 +430,15 @@ class TrainPool:
             _TOTALS["wall_s"] += wall
             _LAST_POOL.clear()
             _LAST_POOL.update(entry)
+        reg = _registry()
+        reg["pools"].inc()
+        reg["submitted"].inc(len(records))
+        reg["completed"].inc(entry["done"])
+        reg["failed"].inc(entry["failed"])
+        reg["cancelled"].inc(entry["cancelled"])
+        reg["skipped"].inc(entry["skipped"])
+        reg["busy_s"].inc(busy)
+        reg["wall_s"].inc(wall)
         return records
 
 

@@ -35,8 +35,13 @@ class ScoringEngine:
     """Facade: admission → micro-batcher → compiled-scorer cache."""
 
     def __init__(self, config: Optional[ServingConfig] = None):
+        from ..runtime import phases
         from .model_cache import FailoverState
 
+        # serving always tracks XLA compiles/retraces: the warm-cache
+        # "zero new traces" invariant is a pinned counter (runtime/phases
+        # xla_counts), not a bench-only accounting mode
+        phases.install_listener()
         self.config = config or ServingConfig.from_env()
         self.metrics = ServingMetrics()
         self.cache = ScorerCache(self.config.cache_capacity)

@@ -38,11 +38,19 @@ class _Pending:
     """One enqueued request: input + rendezvous for the caller thread."""
 
     __slots__ = ("frame", "nrow", "sig", "model", "event", "result", "error",
-                 "t_enqueue")
+                 "t_enqueue", "trace_id", "parent_span_id")
 
     def __init__(self, frame, model):
+        from ..runtime import tracing
+
         self.frame = frame
         self.nrow = frame.nrow
+        # trace correlation: the submitting thread (the REST handler with
+        # its root request span) hands its ids over so the batch span the
+        # worker thread records lands in the request's trace
+        cur = tracing.current()
+        self.trace_id = cur.trace_id if cur is not None else None
+        self.parent_span_id = cur.span_id if cur is not None else None
         # coalescing compatibility: exact column names + types, in order,
         # AND the live model object's identity — a model re-put under the
         # same DKV key mid-flight must not have its requests scored by its
@@ -131,6 +139,23 @@ class _Worker:
         return batch
 
     def _score(self, batch: List[_Pending]) -> None:
+        from ..runtime import tracing
+
+        # the batch span adopts the first request's trace (one batch can
+        # serve several traces — the rest ride along as an attribute)
+        lead = next((p for p in batch if p.trace_id), None)
+        extra = sorted({p.trace_id for p in batch
+                        if p.trace_id} - {lead.trace_id if lead else None})
+        with tracing.span(f"batch:{self.model_key}", kind="batch",
+                          trace_id=lead.trace_id if lead else None,
+                          parent_id=lead.parent_span_id if lead else None,
+                          output_kind=self.output_kind,
+                          n_requests=len(batch),
+                          n_rows=sum(p.nrow for p in batch),
+                          **(dict(other_trace_ids=extra) if extra else {})):
+            self._score_inner(batch)
+
+    def _score_inner(self, batch: List[_Pending]) -> None:
         from ..frame.frame import Frame
 
         t_start = time.monotonic()

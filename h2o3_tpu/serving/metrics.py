@@ -37,6 +37,46 @@ _COUNTERS = ("requests", "rejections", "errors", "batches",
              "expired", "scorer_faults", "quarantines", "scorer_rebuilds",
              "breaker_opens", "fallback_scores")
 
+_REGISTRY = None
+
+
+def _registry():
+    """Central-registry families backing the serving counters/histograms
+    (GET /3/Metrics scrape surface). The per-engine ServingMetrics object
+    stays the resettable REST-snapshot state; the registry is the monotone
+    process-wide view — both are written on every record so the
+    /3/Serving/metrics document stays byte-compatible. Model-key label
+    cardinality is bounded by the registry itself (H2O3_METRICS_MAX_SERIES
+    → `_overflow` series), so uuid-keyed model churn on a long-lived
+    fleet cannot grow the scrape surface without limit."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        from ..runtime import metrics_registry as reg
+
+        counters = {c: reg.counter(f"h2o3_serving_{c}",
+                                   f"serving {c.replace('_', ' ')}",
+                                   labelnames=("model",))
+                    for c in _COUNTERS}
+        for c in _COUNTERS:
+            reg.bind_rest_field("serving", f"totals.{c}",
+                                f"h2o3_serving_{c}")
+        _REGISTRY = dict(
+            counters=counters,
+            queue_wait_ms=reg.histogram(
+                "h2o3_serving_queue_wait_ms",
+                "request dwell in the micro-batch queue (ms)",
+                bounds=WAIT_MS_BOUNDS, labelnames=("model",)),
+            device_ms=reg.histogram(
+                "h2o3_serving_device_ms",
+                "scoring-call wall time per batch (ms)",
+                bounds=DEVICE_MS_BOUNDS, labelnames=("model",)),
+            batch_size=reg.histogram(
+                "h2o3_serving_batch_size",
+                "requests coalesced per device batch",
+                bounds=BATCH_SIZE_BOUNDS, labelnames=("model",)),
+        )
+    return _REGISTRY
+
 
 class LatencyHistogram:
     """Fixed-bound histogram: counts per bucket + running sum/min/max."""
@@ -107,6 +147,7 @@ class ServingMetrics:
     def _bump(self, model_key: str, counter: str, by: int = 1) -> None:
         with self._lock:
             self._stats(model_key).counters[counter] += by
+        _registry()["counters"][counter].inc(by, model_key)
 
     # -- admission-level ----------------------------------------------------
     def record_request(self, model_key: str) -> None:
@@ -145,6 +186,7 @@ class ServingMetrics:
     def record_queue_wait(self, model_key: str, wait_s: float) -> None:
         with self._lock:
             self._stats(model_key).queue_wait_ms.record(wait_s * 1e3)
+        _registry()["queue_wait_ms"].observe(wait_s * 1e3, model_key)
 
     def record_batch(self, model_key: str, n_requests: int, n_rows: int,
                      device_s: float, compiled: Optional[bool]) -> None:
@@ -163,6 +205,17 @@ class ServingMetrics:
                 s.counters["compiles" if compiled else "cache_hits"] += 1
             s.device_ms.record(device_s * 1e3)
             s.batch_size.record(float(n_requests))
+        r = _registry()
+        r["counters"]["batches"].inc(1, model_key)
+        r["counters"]["batched_requests"].inc(n_requests, model_key)
+        r["counters"]["batched_rows"].inc(n_rows, model_key)
+        if compiled is None:
+            r["counters"]["fallback_scores"].inc(1, model_key)
+        else:
+            r["counters"]["compiles" if compiled
+                          else "cache_hits"].inc(1, model_key)
+        r["device_ms"].observe(device_s * 1e3, model_key)
+        r["batch_size"].observe(float(n_requests), model_key)
 
     # -- read side ----------------------------------------------------------
     def counter(self, model_key: str, name: str) -> int:

@@ -145,7 +145,8 @@ def test_logs_timeline_profiler_metadata(server):
     prof = _get(srv, "/3/Profiler")
     assert prof["nodes"][0]["entries"]
     meta = _get(srv, "/3/Metadata/schemas")
-    algos = [s["algo"] for s in meta["schemas"]]
+    # algo builder schemas plus non-algo ones (ObservabilityV3)
+    algos = [s["algo"] for s in meta["schemas"] if "algo" in s]
     assert {"gbm", "glm", "deeplearning", "kmeans"} <= set(algos)
 
 

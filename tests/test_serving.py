@@ -400,11 +400,20 @@ def test_rest_warm_predict_hits_cache_no_new_compile(rest_server, cloud1):
     c1 = snap1["models"][mid]["counters"]
     assert c1["compiles"] >= 1
 
+    from h2o3_tpu.runtime import phases
+
+    xla1 = phases.xla_counts()
     r2 = _http("POST", srv.port, f"/3/Predictions/models/{mid}/frames/{fkey}")
     assert r2["predictions_frame"]["name"] == pred_key   # overwrote, same key
     snap2 = _http("GET", srv.port, "/3/Serving/metrics")
     c2 = snap2["models"][mid]["counters"]
     assert c2["compiles"] == c1["compiles"], "warm call re-traced!"
+    # the counter pin (ISSUE 6): the warm call records ZERO new XLA traces
+    # in the runtime/phases tracker — pinned at the jax-monitoring layer,
+    # not just the serving cache's own bookkeeping
+    xla2 = phases.xla_counts()
+    assert xla2["traces"] == xla1["traces"], "warm predict traced!"
+    assert xla2["retraces"] == xla1["retraces"]
     assert c2["cache_hits"] == c1["cache_hits"] + 1
     assert c2["requests"] == c1["requests"] + 1
     # histograms recorded
