@@ -176,13 +176,17 @@ def bins(frame, x, nbins: int, histogram_type: str, seed,
 
 
 def device_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
-                 builder: Callable[[], object]):
-    """Device-resident (padded, unpacked) code matrix — cached so repeat
-    candidates skip the pack + H2D upload. Single-device clouds only
-    (the caller gates); `builder` does the pack/upload/unpack and its own
-    byte accounting on a miss."""
+                 builder: Callable[[], object], pack_bits: int = 0):
+    """Device-resident (padded) code matrix — cached so repeat candidates
+    skip the pack + H2D upload. With `pack_bits` > 0 the cached artifact
+    is the `ops.packing` packed word matrix (2-4× smaller resident HBM,
+    ISSUE 7); the packing mode is part of the key so packed and
+    full-width consumers (e.g. a legacy-flag comparator run) never share
+    an entry. Single-device clouds only (the caller gates); `builder`
+    does the pack/upload and its own byte accounting on a miss."""
     e = _entry_for(frame, tuple(x))
-    dkey = (_bins_key(nbins, histogram_type, seed), int(npad))
+    dkey = (_bins_key(nbins, histogram_type, seed), int(npad),
+            int(pack_bits))
     with e.lock:
         arr = e.device.get(dkey)
         if arr is not None:

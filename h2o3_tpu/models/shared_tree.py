@@ -343,6 +343,8 @@ class _StepCfg(NamedTuple):
     grow_policy: str = "depthwise"   # "lossguide" = xgboost leaf-wise
     max_leaves: int = 0              # lossguide leaf budget (0 = 2^depth)
     compact_cap: int = 0             # deep-level active-node compaction
+    pack_bits: int = 0               # device-RESIDENT sub-byte code packing
+    fused_split: bool = False        # single-pass split search (ISSUE 7)
 
 
 def _pack_hp(tp, lr, colp, mtries_rate=0.0) -> "jnp.ndarray":
@@ -374,72 +376,23 @@ def _concat_args(*xs):
     return jnp.concatenate(xs, axis=0)
 
 
-def _pack_host(codes: np.ndarray, bits: int) -> np.ndarray:
-    """Pack uint8 bin codes < 2^bits into `bits` bits per value along rows.
-    bits ∈ {4, 5, 6}: {2, 8, 4} row-groups → {1, 5, 3} bytes. Rows must be
-    a multiple of the group size (padded row counts are multiples of 8).
-    The bin-code matrix is the dominant fixed H2D cost through a ~6 MB/s
-    remote-chip tunnel — every shaved bit is ~2% of upload wall."""
-    if bits == 4:
-        return (codes[0::2] << 4) | codes[1::2]
-    if bits == 5:
-        a, b, c, d, e, f, g, hh = (codes[i::8] for i in range(8))
-        out = np.empty((5 * a.shape[0],) + codes.shape[1:], np.uint8)
-        out[0::5] = (a << 3) | (b >> 2)
-        out[1::5] = ((b & 0x3) << 6) | (c << 1) | (d >> 4)
-        out[2::5] = ((d & 0xF) << 4) | (e >> 1)
-        out[3::5] = ((e & 0x1) << 7) | (f << 2) | (g >> 3)
-        out[4::5] = ((g & 0x7) << 5) | hh
-        return out
-    # 6-bit: stays uint8 end to end (max 63<<2 = 252)
-    a, b, c, d = codes[0::4], codes[1::4], codes[2::4], codes[3::4]
-    out = np.empty((3 * a.shape[0],) + codes.shape[1:], np.uint8)
-    out[0::3] = (a << 2) | (b >> 4)
-    out[1::3] = ((b & 0xF) << 4) | (c >> 2)
-    out[2::3] = ((c & 0x3) << 6) | d
-    return out
+# sub-byte code packing lives in ops/packing.py since ISSUE 7 (the
+# histogram kernels and the partition step consume the packed words
+# directly); these aliases keep the driver's historical surface
+from ..ops import packing as _packing
+from ..ops.histogram import record_fit_plan as _record_fit_plan
+
+_pack_host = _packing.pack_host
+_unpack_device = _packing.unpack_device
+_pack_bits_for = _packing.pack_bits_for
 
 
-@functools.partial(jax.jit, static_argnames=("bits",))
-def _unpack_device(packed, bits: int):
-    """Inverse of _pack_host, on device: one tiny widening program."""
-    if bits == 4:
-        k = packed.shape[0]
-        out = jnp.stack([packed >> 4, packed & 0xF], axis=1)
-        return out.reshape((2 * k,) + packed.shape[1:]).astype(jnp.uint8)
-    if bits == 5:
-        b = [packed[i::5].astype(jnp.uint16) for i in range(5)]
-        k = packed.shape[0] // 5
-        vals = [
-            b[0] >> 3,
-            ((b[0] & 0x7) << 2) | (b[1] >> 6),
-            (b[1] >> 1) & 0x1F,
-            ((b[1] & 0x1) << 4) | (b[2] >> 4),
-            ((b[2] & 0xF) << 1) | (b[3] >> 7),
-            (b[3] >> 2) & 0x1F,
-            ((b[3] & 0x3) << 3) | (b[4] >> 5),
-            b[4] & 0x1F,
-        ]
-        out = jnp.stack(vals, axis=1).reshape((8 * k,) + packed.shape[1:])
-        return out.astype(jnp.uint8)
-    b0 = packed[0::3].astype(jnp.uint16)
-    b1 = packed[1::3].astype(jnp.uint16)
-    b2 = packed[2::3].astype(jnp.uint16)
-    a = b0 >> 2
-    b = ((b0 & 0x3) << 4) | (b1 >> 4)
-    c = ((b1 & 0xF) << 2) | (b2 >> 6)
-    d = b2 & 0x3F
-    k = packed.shape[0] // 3
-    out = jnp.stack([a, b, c, d], axis=1).reshape((4 * k,) + packed.shape[1:])
-    return out.astype(jnp.uint8)
-
-
-def _pack_bits_for(nbins: int, nrows: int) -> int:
-    """Narrowest usable packing for codes < nbins (0 = ship unpacked)."""
-    for bits, group in ((4, 2), (5, 8), (6, 4)):
-        if nbins <= (1 << bits) and nrows % group == 0:
-            return bits
-    return 0
+def tree_legacy() -> bool:
+    """True when ``H2O3_TREE_LEGACY=1`` pins the seed tree hot path —
+    full-width resident codes, the (L, F, B)-temporary split search and
+    blocking chunk-boundary scoring — as the bit-exactness comparator
+    (same pattern as the ingest/munge/train legacy flags)."""
+    return os.environ.get("H2O3_TREE_LEGACY", "") == "1"
 
 
 def _bucket_rows(npad: int) -> int:
@@ -547,7 +500,9 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                 **lg_kwargs)
         kwargs = dict(max_depth=cfg.max_depth, nbins=cfg.nbins,
                       hist_method=cfg.hist_method,
-                      compact_cap=cfg.compact_cap)
+                      compact_cap=cfg.compact_cap,
+                      pack_bits=cfg.pack_bits,
+                      fused_split=cfg.fused_split)
         if cloud.size > 1:
             from jax import shard_map
 
@@ -1205,12 +1160,39 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 f"mtries={mtries} exceeds the {F} usable feature columns")
         return mtries
 
-    def _make_step_cfg(self, tp, npad, K, F, nbins, problem, dist) -> _StepCfg:
+    def _make_step_cfg(self, tp, npad, K, F, nbins, problem, dist,
+                       pack_bits: int = 0,
+                       single_dev: bool = True) -> _StepCfg:
         """The structural step config, derivable before any device upload —
         built identically by the early warm-up thread and the training path
-        so both hit the same cached program."""
+        so both hit the same cached program. `pack_bits` is the resident
+        code packing the caller resolved (0 = full-width); `single_dev`
+        gates the host-callback histogram default (it cannot run under a
+        collective program)."""
         mtries = self._resolved_mtries(tp, F, problem)
         colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
+        legacy = tree_legacy()
+        # the ONE auto→concrete hist-method resolution for the fused path:
+        # CPU's XLA scatter loops updates at ~100 ns each, the host
+        # np.add.at callback runs the same sequential f32 fold ~9× faster
+        # and consumes the packed codes without widening. Resolved HERE (a
+        # structural cfg field → program-cache key), like the env override
+        # below, so an in-process flag flip retraces instead of being
+        # silently frozen into a cached program.
+        #
+        # Row floor: a pure_callback custom-call embeds a process-local
+        # pointer, so host-path programs are EXCLUDED from the persistent
+        # compilation cache — every fresh process pays the full XLA
+        # compile (~5 s/config). Real workloads amortize that against the
+        # 9× per-level win; tiny fits (tests, toy frames) never do, so
+        # they keep the cacheable segment program.
+        hist_method = os.environ.get(
+            "H2O3_HIST_METHOD", tp.get("hist_method", "auto"))
+        if (hist_method == "auto" and not legacy and single_dev
+                and jax.default_backend() == "cpu"
+                and npad >= int(os.environ.get(
+                    "H2O3_HOST_HIST_MIN_ROWS", 32768))):
+            hist_method = "host"
         return _StepCfg(
             npad=npad, K=K, F=F, nbins=nbins, problem=problem, dist=dist,
             mode=self._mode, max_depth=tp["max_depth"],
@@ -1223,14 +1205,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
                            if "tweedie_power" in self._parms else 1.5),
             quantile_alpha=(float(self._parms.get("quantile_alpha", 0.5))
                             if "quantile_alpha" in self._parms else 0.5),
-            # the env override is resolved HERE (a structural cfg field →
-            # program-cache key), not inside the jitted kernel: an env read
-            # at trace time would be frozen into the compiled program and
-            # silently ignored on later in-process changes
-            hist_method=os.environ.get(
-                "H2O3_HIST_METHOD", tp.get("hist_method", "auto")),
+            hist_method=hist_method,
             grow_policy=tp.get("grow_policy", "depthwise"),
             max_leaves=int(tp.get("max_leaves", 0)),
+            pack_bits=int(pack_bits),
+            fused_split=not legacy,
             # deep trees switch wide levels to active-node compaction
             # (measured: DRF depth-17 levels carry ~700 live nodes of 131k
             # heap cells). Off for monotone (needs per-node bounds) and
@@ -1555,6 +1534,25 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
         _ph.mark("build_bins")
 
+        # ---- resident sub-byte code packing (ISSUE 7 tentpole) -----------
+        # The device-resident code matrix stays PACKED for the whole fit:
+        # the histogram kernels consume the packed words (the CPU host
+        # callback unpacks per 64k-row chunk; in-graph kernels widen once
+        # per program) and the partition step reads per-row codes straight
+        # from them — so the matrix the dataset cache holds in HBM (and
+        # ships through the ~6 MB/s tunnel) shrinks 2-4×. Paths that score
+        # `predict_codes` against the resident matrix (DART dropout,
+        # checkpoint fast-forward) and the lossguide builder keep full
+        # width; H2O3_TREE_LEGACY=1 restores the seed unpack-once path.
+        resident_bits = 0
+        if (not tree_legacy() and not multiproc
+                and self._parms.get("checkpoint") is None
+                and not tp.get("dart")
+                and tp.get("grow_policy", "depthwise") != "lossguide"
+                and nbins <= 256):
+            resident_bits = _pack_bits_for(nbins, npad)
+        single_dev = not multiproc and ndev == 1
+
         # ---- background program warm-up ----------------------------------
         # The first dispatch of the tree-step program pays trace + XLA
         # compile-cache load (~3 s through a remote-TPU tunnel) in the
@@ -1570,7 +1568,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 and not multiproc \
                 and os.environ.get("H2O3_WARM_THREAD", "1") != "0":
             cfg_early = self._make_step_cfg(tp, npad, K, F, nbins, problem,
-                                            dist)
+                                            dist, pack_bits=resident_bits,
+                                            single_dev=single_dev)
             # sweep-warm reuse: when this config's step program is already
             # built in-process (a CV fold after its parent, or a repeat
             # grid/AutoML candidate), the dummy warm execution is pure
@@ -1582,6 +1581,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     cloud.__dict__.get("_step_fns_cache", {}):
                 cfg_early = None
             code_dt = jnp.uint8 if nbins <= 256 else jnp.uint16
+            # packed codes: the dummy matrix takes the packed shape so the
+            # warm trace IS the real program
+            codes_shape = ((npad * resident_bits // 8, F) if resident_bits
+                           else (npad, F))
             drf = self._mode == "drf"
 
             def _warm():
@@ -1591,7 +1594,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         jnp.zeros((npad, K), jnp.float32),                # margins
                         jnp.zeros((npad, K) if drf else (1, K), jnp.float32),
                         jnp.zeros(npad if drf else 1, jnp.float32),
-                        jnp.zeros((npad, F), code_dt),                    # codes
+                        jnp.zeros(codes_shape, code_dt),                  # codes
                         jnp.zeros((npad, K), jnp.float32),                # y
                         jnp.zeros(npad, jnp.float32),                     # w
                         jnp.ones(npad, jnp.float32),                      # rate
@@ -1660,12 +1663,19 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
             def _build_codes_dev():
                 codes_p = padr(bm.codes)
+                if resident_bits:
+                    # fused path: ship packed AND keep it packed in HBM —
+                    # the resident matrix is 2-4× smaller and the tree
+                    # kernels consume the packed words directly
+                    packed = _pack_host(codes_p, resident_bits)
+                    _phases_mod.add("h2d", 0.0, packed.nbytes)
+                    return jnp.asarray(packed)
                 pack_bits = (_pack_bits_for(nbins, codes_p.shape[0])
                              if codes_p.dtype == np.uint8 else 0)
                 if pack_bits:
-                    # sub-byte packing: the bin-code matrix is the biggest
-                    # fixed H2D cost (~6 MB/s tunnel) — ship 4/5/6-bit codes
-                    # (half to 3/4 of the bytes) and widen on device
+                    # legacy/ungated path: the bin-code matrix is still the
+                    # biggest fixed H2D cost (~6 MB/s tunnel) — ship 4/5/6-
+                    # bit codes (half to 3/4 of the bytes), widen on device
                     packed = _pack_host(codes_p, pack_bits)
                     _phases_mod.add("h2d", 0.0, packed.nbytes)
                     return _unpack_device(jnp.asarray(packed), pack_bits)
@@ -1675,10 +1685,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if use_cache and ndev == 1:
                 # sweep-level reuse: every candidate sharing this
                 # (frame, x, nbins, histogram) trains off ONE device-resident
-                # code matrix — the pack + tunnel upload happens once
+                # code matrix — the pack + tunnel upload happens once. The
+                # packing mode keys the cache entry: a packed and a
+                # full-width consumer never share an artifact.
                 codes_d = _dsc.device_codes(
                     train, x, nbins, tp["histogram_type"], seed, npad,
-                    builder=_build_codes_dev)
+                    builder=_build_codes_dev, pack_bits=resident_bits)
             else:
                 codes_d = _build_codes_dev()
             if yk.size and bool(np.all((yk >= 0) & (yk <= 255)
@@ -1886,7 +1898,25 @@ class H2OSharedTreeEstimator(H2OEstimator):
         colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
         custom_obj = getattr(self, "_objective_fn", None)
         mono_vec = getattr(self, "_monotone_vec", None)
-        cfg = self._make_step_cfg(tp, npad, K, F, nbins, problem, dist)
+        cfg = self._make_step_cfg(tp, npad, K, F, nbins, problem, dist,
+                                  pack_bits=resident_bits,
+                                  single_dev=single_dev)
+        # per-fit kernel plan (ISSUE 7 satellite): resolve + record which
+        # histogram kernel each level will actually run (method, pallas
+        # row_chunk, VMEM-pressure fallbacks — logged once per fit) into
+        # the metrics registry and the /3/Profiler `tree` fold, so the
+        # auto-dispatch is never guesswork. Shares resolve_method with
+        # build_histograms, so the plan cannot diverge from reality.
+        if cfg.grow_policy != "lossguide":
+            plan_levels = treelib.histogram_level_plan(cfg.max_depth,
+                                                      cfg.compact_cap)
+        else:
+            plan_levels = [("lossguide_node", 1)]
+        _record_fit_plan(
+            f"{getattr(self, 'algo', self._mode)}:{K}x{tp['ntrees']}t"
+            f"_d{cfg.max_depth}", plan_levels, nbins, cfg.hist_method,
+            pack_bits=cfg.pack_bits,
+            axis_name=cloudlib.ROWS_AXIS if ndev > 1 else None)
         if warm_thread is not None:
             warm_thread.join()
         _tree_jit, _single_jit = _tree_step_fns(cfg, cloud)
@@ -2033,6 +2063,60 @@ class H2OSharedTreeEstimator(H2OEstimator):
         dart_scales: List[float] = []
         dart_rng = np.random.default_rng(
             (int(self._parms["_actual_seed"]) + 7919) & 0x7FFFFFFF)
+
+        def _run_chunk(margins, oob_sum, oob_cnt, m0, nsteps):
+            """One chunk of tree dispatches, incl. the compact-cap
+            overflow-rebuild guard (exactness is never traded)."""
+            if cfg.compact_cap:
+                # snapshot the mutable (donated) state: if any tree in the
+                # chunk overflows the compact-slot cap, the chunk is
+                # rebuilt DENSELY from here
+                snap = _copy_args(margins, oob_sum, oob_cnt)
+            margins, oob_sum, oob_cnt, packed, gains, ov = _train_chunk(
+                margins, oob_sum, oob_cnt, key, m0, nsteps=nsteps)
+            if cfg.compact_cap and int(np.asarray(ov)) > 0:
+                from ..runtime.log import Log
+
+                Log.warn(
+                    f"tree chunk at m={m0}: compact-node cap "
+                    f"{cfg.compact_cap} overflowed — rebuilding the "
+                    "chunk with dense levels")
+                dense_jit, _ = _tree_step_fns(
+                    cfg._replace(compact_cap=0), cloud)
+                margins, oob_sum, oob_cnt = snap
+                margins, oob_sum, oob_cnt, packed, gains, _ = _train_chunk(
+                    margins, oob_sum, oob_cnt, key, m0, nsteps=nsteps,
+                    tree_fn=dense_jit)
+            return margins, oob_sum, oob_cnt, packed, gains
+
+        # overlapped chunk scoring (ISSUE 7 tentpole part 3): double-buffer
+        # — chunk m+1's tree programs are ENQUEUED while chunk m's metric
+        # transfers and evaluates, so the device stays busy through
+        # score_tree_interval instead of idling at every chunk boundary.
+        # Gated to paths whose scoring event runs on device (the DRF OOB
+        # event pulls host arrays) and OFF under the legacy comparator,
+        # DART and custom objectives (inherently host-synced, chunk=1) and
+        # compact-cap fits (their overflow-flag pull is a host sync, so a
+        # "speculative" chunk would complete synchronously before the stop
+        # decision — strictly worse than the sequential path).
+        overlap = (not tree_legacy() and not multiproc
+                   and custom_obj is None and not dart
+                   and not cfg.compact_cap
+                   and not (self._mode == "drf" and row_sampled)
+                   and os.environ.get("H2O3_TREE_OVERLAP", "1") != "0")
+        spec = None        # speculatively dispatched next chunk (+ nsteps)
+        spec_snap = None   # pre-dispatch state copies (its buffers donate)
+
+        def _discard_spec():
+            """Abandon the speculative chunk on an early stop: restore the
+            pre-dispatch state copies (the spec's programs donated the live
+            buffers) and drop its outputs — trees past the stopping point
+            vanish exactly as if the sequential path never built them."""
+            nonlocal spec, margins, oob_sum, oob_cnt
+            if spec is not None:
+                margins, oob_sum, oob_cnt = spec_snap
+                spec = None
+
         while m < ntrees_target:
             nsteps = min(chunk, ntrees_target - m)
             drop_idx = ()
@@ -2098,28 +2182,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 cloudlib.collective_fence(margins)
                 packed = packed[None]
                 nsteps = 1
+            elif spec is not None:
+                # consume the chunk dispatched while the PREVIOUS chunk's
+                # metric was in flight (overlapped chunk scoring)
+                margins, oob_sum, oob_cnt, packed, gains, nsteps = spec
+                spec = None
             else:
-                if cfg.compact_cap:
-                    # snapshot the mutable (donated) state: if any tree in
-                    # the chunk overflows the compact-slot cap, the chunk is
-                    # rebuilt DENSELY from here — exactness is never traded
-                    snap = _copy_args(margins, oob_sum, oob_cnt)
-                margins, oob_sum, oob_cnt, packed, gains, ov = \
-                    _train_chunk(margins, oob_sum, oob_cnt, key, m,
-                                 nsteps=nsteps)
-                if cfg.compact_cap and int(np.asarray(ov)) > 0:
-                    from ..runtime.log import Log
-
-                    Log.warn(
-                        f"tree chunk at m={m}: compact-node cap "
-                        f"{cfg.compact_cap} overflowed — rebuilding the "
-                        "chunk with dense levels")
-                    dense_jit, _ = _tree_step_fns(
-                        cfg._replace(compact_cap=0), cloud)
-                    margins, oob_sum, oob_cnt = snap
-                    margins, oob_sum, oob_cnt, packed, gains, _ = \
-                        _train_chunk(margins, oob_sum, oob_cnt, key, m,
-                                     nsteps=nsteps, tree_fn=dense_jit)
+                margins, oob_sum, oob_cnt, packed, gains = _run_chunk(
+                    margins, oob_sum, oob_cnt, m, nsteps)
             # chunks stay on device until the post-loop bulk D2H (sync
             # transfers through the tunnel cost ~seconds each), unless the
             # accumulated forest would blow the HBM budget
@@ -2180,7 +2250,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 self.job.check_cancelled()
             if do_score:
                 if self._mode == "drf" and row_sampled and n_prior == 0:
-                    # score on OOB predictions (DRF scoring history is OOB)
+                    # score on OOB predictions (DRF scoring history is OOB;
+                    # pulls host arrays — stays synchronous, overlap off)
                     osum = distdata.to_local(oob_sum)[:n].astype(np.float64)
                     ocnt = distdata.to_local(oob_cnt)[:n].astype(np.float64)
                     have = ocnt > 0
@@ -2188,19 +2259,37 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     oob_mean = np.where(have[:, None],
                                         osum / np.maximum(ocnt[:, None], 1.0),
                                         mnp / max(built, 1))
-                    ev = self._score_event(problem, dist,
-                                           oob_mean * max(built, 1),
-                                           y_d, w_d, n, built + n_prior)
+                    ev0 = self._score_event(problem, dist,
+                                            oob_mean * max(built, 1),
+                                            y_d, w_d, n, built + n_prior)
+                    fin = lambda ev0=ev0: ev0
                 else:
-                    ev = self._score_event(problem, dist, margins, y_d, w_d,
-                                           n, built + n_prior,
-                                           row_mask=row_mask_d)
+                    # ENQUEUE the device loss program(s) now; block later
+                    fin = self._score_event_async(
+                        problem, dist, margins, y_d, w_d, n,
+                        built + n_prior, row_mask=row_mask_d)
+                vfin = None
                 if valid_state is not None:
-                    vev = self._score_event(
+                    vfin = self._score_event_async(
                         problem, dist, valid_state[2],
                         valid_state[4], None, valid_state[3],
                         built + n_prior, row_mask=valid_state[5],
                     )
+                if overlap and m < ntrees_target:
+                    # double-buffer: enqueue chunk m+1's tree programs
+                    # BEFORE blocking on chunk m's metric scalar — the
+                    # device crunches the next chunk through the host's
+                    # metric wait + stopping decision. If the decision is
+                    # "stop", the speculative chunk is discarded and the
+                    # pre-dispatch state restored (bit-exact either way).
+                    if stopper is not None or max_runtime:
+                        spec_snap = _copy_args(margins, oob_sum, oob_cnt)
+                    sp_n = min(chunk, ntrees_target - m)
+                    spec = _run_chunk(margins, oob_sum, oob_cnt,
+                                      m, sp_n) + (sp_n,)
+                ev = fin()
+                if vfin is not None:
+                    vev = vfin()
                     ev.update({f"validation_{k2}": v for k2, v in vev.items()
                                if k2 not in ("number_of_trees", "timestamp")})
                 history.append(ev)
@@ -2218,11 +2307,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
                             np.nan,
                         )
                     if stopper.record(val):
+                        _discard_spec()
                         break
             if max_runtime:
                 # clock consensus: every rank must take the same branch or
                 # the next chunk's collectives deadlock
                 if distdata.global_any(time.time() - t0 > max_runtime):
+                    _discard_spec()
                     break
             if self.job:
                 self.job.update(built / max(ntrees_target, 1))
@@ -2493,6 +2584,42 @@ class H2OSharedTreeEstimator(H2OEstimator):
             return sm.lower()
         return "logloss" if problem in ("binomial", "multinomial") else "deviance"
 
+    def _score_event_async(self, problem, dist, margins, y_d, w_d, n,
+                           ntrees, row_mask=None):
+        """Dispatch a scoring-history event and return a FINALIZER.
+
+        Device path: the loss-reduction program is enqueued immediately
+        and the returned callable blocks on its scalar only when invoked —
+        the overlapped-chunk-scoring hook (ISSUE 7): the driver enqueues
+        chunk m+1's tree programs between dispatch and finalize, so the
+        device crunches the next chunk while the host waits on chunk m's
+        metric and runs the early-stopping decision. Host paths compute
+        eagerly and return a constant finalizer."""
+        if row_mask is not None and not isinstance(margins, np.ndarray):
+            val_dev = _event_loss_device(
+                margins, y_d, row_mask, jnp.float32(1.0 / max(ntrees, 1)),
+                self._mode, problem, dist)
+
+            def _fin() -> Dict:
+                val = float(val_dev)
+                ev: Dict = {"number_of_trees": ntrees,
+                            "timestamp": time.time()}
+                if problem in ("binomial", "multinomial"):
+                    ev["logloss"] = val
+                    ev["training_deviance"] = val
+                    if problem == "binomial":
+                        ev["auc"] = float("nan")  # full AUC at final scoring
+                else:
+                    ev["deviance"] = val
+                    ev["rmse"] = float(np.sqrt(val))
+                    ev["training_deviance"] = val
+                return ev
+
+            return _fin
+        ev = self._score_event(problem, dist, margins, y_d, w_d, n,
+                               ntrees, row_mask=row_mask)
+        return lambda: ev
+
     def _score_event(self, problem, dist, margins, y_d, w_d, n, ntrees,
                      row_mask=None) -> Dict:
         """One scoring-history event. With `row_mask` (device real-row
@@ -2504,21 +2631,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
         them therefore agree); the host fallback (OOB means arrive as numpy)
         reduces with ONE `global_sum` instead."""
         if row_mask is not None and not isinstance(margins, np.ndarray):
-            val = float(_event_loss_device(
-                margins, y_d, row_mask,
-                jnp.float32(1.0 / max(ntrees, 1)),
-                self._mode, problem, dist))
-            ev: Dict = {"number_of_trees": ntrees, "timestamp": time.time()}
-            if problem in ("binomial", "multinomial"):
-                ev["logloss"] = val
-                ev["training_deviance"] = val
-                if problem == "binomial":
-                    ev["auc"] = float("nan")  # full AUC at final scoring
-            else:
-                ev["deviance"] = val
-                ev["rmse"] = float(np.sqrt(val))
-                ev["training_deviance"] = val
-            return ev
+            return self._score_event_async(problem, dist, margins, y_d,
+                                           w_d, n, ntrees,
+                                           row_mask=row_mask)()
         multiproc = distdata.multiprocess()
         m = distdata.to_local(margins)[:n].astype(np.float64)
         y = distdata.to_local(y_d)[:n].astype(np.float64)

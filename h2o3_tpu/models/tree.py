@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import packing
 from ..ops.histogram import build_histograms
 
 
@@ -46,6 +47,35 @@ class Tree(NamedTuple):
 
 def heap_size(depth: int) -> int:
     return 2 ** (depth + 1) - 1
+
+
+def compact_switch_depth(max_depth: int, compact_cap: int) -> int:
+    """First level handled by active-node compaction (max_depth = never) —
+    the ONE switch rule shared by `build_tree` and the driver's fit-plan
+    recorder (`ops.histogram.record_fit_plan`), so the recorded plan
+    cannot diverge from the structure that actually runs."""
+    if not compact_cap:
+        return max_depth
+    for d in range(max_depth):
+        if 2 ** d > compact_cap:
+            return d
+    return max_depth
+
+
+def histogram_level_plan(max_depth: int, compact_cap: int = 0):
+    """(label, n_nodes) of each histogram pass a depthwise `build_tree`
+    dispatches: d0 over 1 node, deeper dense levels over the PARENT count
+    (sibling subtraction builds only left children), then the compact
+    transition + per-level passes over compact_cap+1 slots. Consumed by
+    the driver's per-fit kernel-plan recording."""
+    d_sw = compact_switch_depth(max_depth, compact_cap)
+    levels = [("d%d" % d, 1 if d == 0 else 2 ** (d - 1))
+              for d in range(min(d_sw, max_depth))]
+    if d_sw < max_depth:
+        levels.append(("compact_transition", compact_cap + 1))
+        levels += [("d%d" % d, compact_cap + 1)
+                   for d in range(d_sw, max_depth)]
+    return levels
 
 
 # one-hot contraction beats a per-row dynamic gather on TPU by ~10× (the
@@ -82,6 +112,81 @@ def _row_feature_value(codes: jax.Array, rf: jax.Array) -> jax.Array:
     return jnp.where(feat_oh, codes.astype(jnp.int32), 0).sum(axis=1)
 
 
+def _fused_level_best(hist, node_ok, feat_mask, keep, nbins: int, min_rows,
+                      reg_lambda, reg_alpha, gsum, hsum, wsum,
+                      monotone=None, lo_lvl=None, hi_lvl=None):
+    """Single-pass split search (ISSUE 7 tentpole): ONE sequential pass
+    over features computes each feature's (L, B) gain tile and folds it
+    into a running per-node best, so a level emits only the (L,) winner
+    tuple — the legacy path materializes ~6 (L, F, B) f32 temporaries
+    (cumsums, thresholded sums, gain, masks) that round-trip HBM at every
+    level (xgboost EvaluateSplits restructured as a running scan-argmax).
+
+    Bit-exact with the legacy flat ``argmax(gain.reshape(L, F·B))``:
+    per-feature cumsums are the same per-lane folds as the (L, F, B)
+    ``jnp.cumsum`` (lanes are independent), the running compare uses
+    strict ``>`` so ties keep the EARLIEST feature/bin exactly like
+    argmax's first-occurrence rule, and NaN gains (possible at
+    reg_lambda=0) are treated as the maximum with first-occurrence order,
+    matching argmax's NaN propagation.
+
+    Returns (best_gain, best_feat, best_bin, vL_best, vR_best) — the
+    child-value pair at the winning bin is only meaningful under
+    `monotone` (it feeds the bound propagation); it is 0 where no
+    admissible split exists, which the caller neutralizes via the
+    do_split gate."""
+    L, F, B = hist.shape[0], hist.shape[1], hist.shape[2]
+    G, H, W = gsum[:, None], hsum[:, None], wsum[:, None]   # (L, 1)
+    tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
+    Gt = tl1(G)
+    base = Gt * Gt / (H + reg_lambda)                        # (L, 1)
+    bin_ok = (jnp.arange(nbins) < nbins - 1)[None, :]        # no NA-bin split
+    mono_on = monotone is not None
+
+    def body(f, carry):
+        best_g, best_f, best_b, vl_b, vr_b = carry
+        hf = jax.lax.dynamic_index_in_dim(hist, f, axis=1, keepdims=False)
+        WL = jnp.cumsum(hf[..., 0], axis=1)                  # (L, B)
+        GL = jnp.cumsum(hf[..., 1], axis=1)
+        HL = jnp.cumsum(hf[..., 2], axis=1)
+        GR, HR, WR = G - GL, H - HL, W - WL
+        GLt, GRt = tl1(GL), tl1(GR)
+        gain = (GLt * GLt / (HL + reg_lambda)
+                + GRt * GRt / (HR + reg_lambda) - base)
+        ok = (WL >= min_rows) & (WR >= min_rows) & bin_ok
+        ok = ok & (jax.lax.dynamic_index_in_dim(feat_mask, f,
+                                                keepdims=False) > 0)
+        ok = ok & node_ok[:, None]
+        if keep is not None:
+            ok = ok & jax.lax.dynamic_index_in_dim(
+                keep, f, axis=1, keepdims=False)[:, None]
+        if mono_on:
+            vL = jnp.clip(-GLt / (HL + reg_lambda + 1e-12),
+                          lo_lvl[:, None], hi_lvl[:, None])
+            vR = jnp.clip(-GRt / (HR + reg_lambda + 1e-12),
+                          lo_lvl[:, None], hi_lvl[:, None])
+            mc = jax.lax.dynamic_index_in_dim(monotone, f, keepdims=False)
+            ok = ok & ((mc == 0) | (mc * (vR - vL) >= 0))
+        gain = jnp.where(ok, gain, -jnp.inf)
+        bb_f = jnp.argmax(gain, axis=1).astype(jnp.int32)     # (L,)
+        g_f = jnp.take_along_axis(gain, bb_f[:, None], axis=1)[:, 0]
+        better = (g_f > best_g) | (jnp.isnan(g_f) & ~jnp.isnan(best_g))
+        best_g = jnp.where(better, g_f, best_g)
+        best_f = jnp.where(better, f, best_f).astype(jnp.int32)
+        best_b = jnp.where(better, bb_f, best_b)
+        if mono_on:
+            vl_b = jnp.where(better, jnp.take_along_axis(
+                vL, bb_f[:, None], axis=1)[:, 0], vl_b)
+            vr_b = jnp.where(better, jnp.take_along_axis(
+                vR, bb_f[:, None], axis=1)[:, 0], vr_b)
+        return best_g, best_f, best_b, vl_b, vr_b
+
+    init = (jnp.full(L, -jnp.inf, jnp.float32), jnp.zeros(L, jnp.int32),
+            jnp.zeros(L, jnp.int32), jnp.zeros(L, jnp.float32),
+            jnp.zeros(L, jnp.float32))
+    return jax.lax.fori_loop(0, F, body, init)
+
+
 def value_at(table: jax.Array, idx: jax.Array) -> jax.Array:
     """table[idx] for a small f32 table (e.g. leaf values by heap index) as
     an MXU one-hot matvec. Precision.HIGHEST is required: the TPU default
@@ -101,11 +206,12 @@ def value_at(table: jax.Array, idx: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=(
         "max_depth", "nbins", "hist_method", "axis_name", "mtries",
-        "compact_cap",
+        "compact_cap", "pack_bits", "fused_split",
     ),
 )
 def build_tree(
-    codes: jax.Array,        # (N, F) uint bin codes
+    codes: jax.Array,        # (N, F) uint bin codes, or the `ops.packing`
+    #                          packed (N·bits/8, F) words when pack_bits
     g: jax.Array,            # (N,) gradients
     h: jax.Array,            # (N,) hessians
     w: jax.Array,            # (N,) row weights (0 = masked/pad/OOB)
@@ -128,6 +234,8 @@ def build_tree(
     max_abs_leaf=None,  # traced scalar: |leaf value| cap (GBM
     #                     max_abs_leafnode_pred / xgboost max_delta_step)
     compact_cap: int = 0,
+    pack_bits: int = 0,
+    fused_split: bool = False,
 ):
     """Build one tree; returns (Tree, final_leaf_heap_idx (N,),
     gain_per_feature (F,), cover (T,) — Σ training row weights per heap node,
@@ -153,8 +261,23 @@ def build_tree(
     count ever exceeds the cap, the returned overflow flag is nonzero and
     the caller must rebuild densely (the driver does). Requires
     monotone=None.
+
+    pack_bits in {4, 5, 6} means `codes` is the `ops.packing` packed word
+    matrix: histogram kernels consume it (per-chunk unpack — the host/CPU
+    path never widens; in-graph kernels widen once per program) and the
+    partition step reads each row's selected-feature code straight from
+    the packed words (`packed_row_values`, two byte gathers per row).
+
+    fused_split=True switches the per-level split search to the
+    single-pass scan-argmax (`_fused_level_best`, bit-exact with the
+    legacy flat argmax); False keeps the seed formulation — the
+    ``H2O3_TREE_LEGACY=1`` comparator.
     """
-    N, F = codes.shape
+    if pack_bits:
+        F = codes.shape[1]
+        N = packing.packed_nrows(codes.shape[0], pack_bits)
+    else:
+        N, F = codes.shape
     T = heap_size(max_depth)
     feat_a = jnp.zeros(T, jnp.int32)
     bin_a = jnp.zeros(T, jnp.int32)
@@ -174,15 +297,9 @@ def build_tree(
     lo_lvl = jnp.full(1, -BIG)
     hi_lvl = jnp.full(1, BIG)
 
-    # first level handled by active-node compaction (0 = never)
-    d_switch = max_depth
-    if compact_cap:
-        if monotone is not None:
-            raise ValueError("compact_cap requires monotone=None")
-        for _d in range(max_depth):
-            if 2 ** _d > compact_cap:
-                d_switch = _d
-                break
+    if compact_cap and monotone is not None:
+        raise ValueError("compact_cap requires monotone=None")
+    d_switch = compact_switch_depth(max_depth, compact_cap)
     # per-row frozen leaf id (absolute heap node) — maintained only when the
     # compact phase can run, since compaction stops flowing dead rows left
     row_leaf = jnp.zeros(N, jnp.int32) if d_switch < max_depth else None
@@ -193,7 +310,8 @@ def build_tree(
         base = L - 1                        # heap offset of this level
         if d == 0:
             hist = build_histograms(
-                codes, idx, g, h, w, L, nbins, method=hist_method, axis_name=axis_name
+                codes, idx, g, h, w, L, nbins, method=hist_method,
+                axis_name=axis_name, pack_bits=pack_bits,
             )  # (L, F, B, 3)
         else:
             # sibling subtraction (the gpu_hist/LightGBM trick): build only
@@ -203,6 +321,7 @@ def build_tree(
             hist_left = build_histograms(
                 codes, idx // 2, g, h, w * is_left.astype(w.dtype),
                 L // 2, nbins, method=hist_method, axis_name=axis_name,
+                pack_bits=pack_bits,
             )  # (L/2, F, B, 3) indexed by parent
             hist_right = hist_prev - hist_left
             hist = jnp.stack([hist_left, hist_right], axis=1).reshape(
@@ -226,58 +345,79 @@ def build_tree(
         value_a = value_a.at[base : base + L].set(node_val)
         cover_a = cover_a.at[base : base + L].set(wsum.astype(jnp.float32))
 
-        # split search: cumulative over bins → gain per (L, F, B)
-        cw = jnp.cumsum(hist[..., 0], axis=2)
-        cg = jnp.cumsum(hist[..., 1], axis=2)
-        ch = jnp.cumsum(hist[..., 2], axis=2)
-        GL, HL, WL = cg, ch, cw
-        G = gsum[:, None, None]
-        H = hsum[:, None, None]
-        W = wsum[:, None, None]
-        GR, HR, WR = G - GL, H - HL, W - WL
-        # xgboost CalcSplitGain: L1 soft-threshold the gradient sums before
-        # squaring (ThresholdL1); exact no-op at reg_alpha=0
-        tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
-        GLt, GRt, Gt = tl1(GL), tl1(GR), tl1(G)
-        gain = (
-            GLt * GLt / (HL + reg_lambda)
-            + GRt * GRt / (HR + reg_lambda)
-            - Gt * Gt / (H + reg_lambda)
-        )
-        ok = (WL >= min_rows) & (WR >= min_rows)
-        ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)   # no split at NA bin
-        ok = ok & (feat_mask[None, :, None] > 0)
-        ok = ok & active[:, None, None]
-        if monotone is not None:
-            # monotone_constraints (hex/tree Constraints / LightGBM): a split
-            # on feature f with constraint c is admissible only when
-            # c·(value_right − value_left) ≥ 0, where the child values use
-            # the SAME soft-thresholded formula as materialized node values
-            # and are clamped into the node's inherited bounds. Bound
-            # propagation (below) then guarantees zero violations.
-            gthrL = jnp.sign(GL) * jnp.maximum(jnp.abs(GL) - reg_alpha, 0.0)
-            gthrR = jnp.sign(GR) * jnp.maximum(jnp.abs(GR) - reg_alpha, 0.0)
-            vL = jnp.clip(-gthrL / (HL + reg_lambda + 1e-12),
-                          lo_lvl[:, None, None], hi_lvl[:, None, None])
-            vR = jnp.clip(-gthrR / (HR + reg_lambda + 1e-12),
-                          lo_lvl[:, None, None], hi_lvl[:, None, None])
-            mc = monotone[None, :, None]
-            ok = ok & ((mc == 0) | (mc * (vR - vL) >= 0))
+        # per-(node,feature) bernoulli keep with the same node psum'd RNG
+        # on every host (key is replicated) so partitions stay consistent.
+        # Drawn identically (one split per level) on both search paths.
+        keep = None
         if mtries > 0 or mtries_rate is not None:
             key, sub = jax.random.split(key)
             rate = mtries_rate if mtries_rate is not None else (mtries / F)
-            # per-(node,feature) bernoulli keep with the same node psum'd RNG
-            # on every host (key is replicated) so partitions stay consistent
             keep = jax.random.uniform(sub, (L, F)) < rate
             keep = keep.at[:, 0].set(keep[:, 0] | ~keep.any(axis=1))  # >=1 kept
-            ok = ok & keep[:, :, None]
-        gain = jnp.where(ok, gain, -jnp.inf)
 
-        flat = gain.reshape(L, F * nbins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (best // nbins).astype(jnp.int32)
-        bb = (best % nbins).astype(jnp.int32)
+        vLs = vRs = None
+        if fused_split:
+            best_gain, bf, bb, vLs, vRs = _fused_level_best(
+                hist, active, feat_mask, keep, nbins, min_rows, reg_lambda,
+                reg_alpha, gsum, hsum, wsum, monotone=monotone,
+                lo_lvl=lo_lvl if monotone is not None else None,
+                hi_lvl=hi_lvl if monotone is not None else None)
+        else:
+            # legacy split search: cumulative over bins → gain per (L, F, B)
+            cw = jnp.cumsum(hist[..., 0], axis=2)
+            cg = jnp.cumsum(hist[..., 1], axis=2)
+            ch = jnp.cumsum(hist[..., 2], axis=2)
+            GL, HL, WL = cg, ch, cw
+            G = gsum[:, None, None]
+            H = hsum[:, None, None]
+            W = wsum[:, None, None]
+            GR, HR, WR = G - GL, H - HL, W - WL
+            # xgboost CalcSplitGain: L1 soft-threshold the gradient sums
+            # before squaring (ThresholdL1); exact no-op at reg_alpha=0
+            tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
+            GLt, GRt, Gt = tl1(GL), tl1(GR), tl1(G)
+            gain = (
+                GLt * GLt / (HL + reg_lambda)
+                + GRt * GRt / (HR + reg_lambda)
+                - Gt * Gt / (H + reg_lambda)
+            )
+            ok = (WL >= min_rows) & (WR >= min_rows)
+            ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)   # no split at NA bin
+            ok = ok & (feat_mask[None, :, None] > 0)
+            ok = ok & active[:, None, None]
+            if monotone is not None:
+                # monotone_constraints (hex/tree Constraints / LightGBM): a
+                # split on feature f with constraint c is admissible only
+                # when c·(value_right − value_left) ≥ 0, where the child
+                # values use the SAME soft-thresholded formula as
+                # materialized node values and are clamped into the node's
+                # inherited bounds. Bound propagation (below) then
+                # guarantees zero violations.
+                gthrL = jnp.sign(GL) * jnp.maximum(jnp.abs(GL) - reg_alpha, 0.0)
+                gthrR = jnp.sign(GR) * jnp.maximum(jnp.abs(GR) - reg_alpha, 0.0)
+                vL = jnp.clip(-gthrL / (HL + reg_lambda + 1e-12),
+                              lo_lvl[:, None, None], hi_lvl[:, None, None])
+                vR = jnp.clip(-gthrR / (HR + reg_lambda + 1e-12),
+                              lo_lvl[:, None, None], hi_lvl[:, None, None])
+                mc = monotone[None, :, None]
+                ok = ok & ((mc == 0) | (mc * (vR - vL) >= 0))
+            if keep is not None:
+                ok = ok & keep[:, :, None]
+            gain = jnp.where(ok, gain, -jnp.inf)
+
+            flat = gain.reshape(L, F * nbins)
+            best = jnp.argmax(flat, axis=1)
+            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+            bf = (best // nbins).astype(jnp.int32)
+            bb = (best % nbins).astype(jnp.int32)
+            if monotone is not None:
+                # child values at the chosen split, gathered from the SAME
+                # vL/vR used by the admissibility check (bound propagation)
+                sel = (bf * nbins + bb)[:, None]
+                flat_pick = lambda A: jnp.take_along_axis(
+                    A.reshape(L, F * nbins), sel, axis=1)[:, 0]
+                vLs = flat_pick(vL)
+                vRs = flat_pick(vR)
         do_split = best_gain > jnp.maximum(min_split_improvement, 1e-10)
         gain_per_feature = gain_per_feature + jax.ops.segment_sum(
             jnp.where(do_split, best_gain, 0.0).astype(jnp.float32), bf, num_segments=F
@@ -300,7 +440,13 @@ def build_tree(
         rf = _lookup_int(bf, idx, L)
         rb = _lookup_int(bb, idx, L)
         rs = _lookup_bool(do_split, idx, L)
-        rcode = _row_feature_value(codes, rf)
+        if pack_bits:
+            # the row's selected-feature code straight from the packed
+            # words: two byte gathers + a shift per row, O(N) instead of
+            # the O(N·F) one-hot contraction over full-width codes
+            rcode = packing.packed_row_values(codes, rf, pack_bits)
+        else:
+            rcode = _row_feature_value(codes, rf)
         go_right = (rcode > rb) & rs
         idx = 2 * idx + go_right.astype(jnp.int32)
         if row_leaf is not None:
@@ -310,13 +456,9 @@ def build_tree(
         if monotone is not None:
             # propagate bounds to children: on a ±1-constrained split the
             # mid-point of the chosen split's child values caps the lower-
-            # valued side and floors the higher-valued side. Child values
-            # gathered from the SAME vL/vR used by the admissibility check.
-            sel = (bf * nbins + bb)[:, None]
-            flat_pick = lambda A: jnp.take_along_axis(
-                A.reshape(L, F * nbins), sel, axis=1)[:, 0]
-            vLs = flat_pick(vL)
-            vRs = flat_pick(vR)
+            # valued side and floors the higher-valued side. vLs/vRs were
+            # gathered above from the SAME vL/vR the admissibility check
+            # used (legacy flat_pick or the fused running carry).
             mid = 0.5 * (vLs + vRs)
             c = monotone[bf] * do_split.astype(monotone.dtype)
             # c=+1: left ≤ mid ≤ right; c=−1: mirrored; c=0: inherit as-is
@@ -383,7 +525,8 @@ def build_tree(
     # available across the dense/compact boundary)
     slot_hist = build_histograms(
         codes, row_slot, g, h, w * (row_slot < CAP).astype(w.dtype),
-        CAP + 1, nbins, method=hist_method, axis_name=axis_name)
+        CAP + 1, nbins, method=hist_method, axis_name=axis_name,
+        pack_bits=pack_bits)
 
     pad_edges_c = jnp.concatenate(
         [edges.astype(jnp.float32), jnp.full((F, 1), jnp.inf, jnp.float32)],
@@ -407,35 +550,42 @@ def build_tree(
             jnp.where(valid, wsum.astype(jnp.float32), 0.0), mode="drop")
 
         # split search over live slots (same math as the dense level)
-        cw = jnp.cumsum(slot_hist[..., 0], axis=2)
-        cg = jnp.cumsum(slot_hist[..., 1], axis=2)
-        ch = jnp.cumsum(slot_hist[..., 2], axis=2)
-        GL, HL, WL = cg, ch, cw
-        G = gsum[:, None, None]
-        H = hsum[:, None, None]
-        W = wsum[:, None, None]
-        GR, HR, WR = G - GL, H - HL, W - WL
-        tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
-        GLt, GRt, Gt = tl1(GL), tl1(GR), tl1(G)
-        gain = (GLt * GLt / (HL + reg_lambda)
-                + GRt * GRt / (HR + reg_lambda)
-                - Gt * Gt / (H + reg_lambda))
-        ok = (WL >= min_rows) & (WR >= min_rows)
-        ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)
-        ok = ok & (feat_mask[None, :, None] > 0)
-        ok = ok & valid[:, None, None]
+        keep = None
         if mtries > 0 or mtries_rate is not None:
             key, sub = jax.random.split(key)
             rate = mtries_rate if mtries_rate is not None else (mtries / F)
             keep = jax.random.uniform(sub, (CAP + 1, F)) < rate
             keep = keep.at[:, 0].set(keep[:, 0] | ~keep.any(axis=1))
-            ok = ok & keep[:, :, None]
-        gain = jnp.where(ok, gain, -jnp.inf)
-        flat = gain.reshape(CAP + 1, F * nbins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (best // nbins).astype(jnp.int32)
-        bb = (best % nbins).astype(jnp.int32)
+        if fused_split:
+            best_gain, bf, bb, _, _ = _fused_level_best(
+                slot_hist, valid, feat_mask, keep, nbins, min_rows,
+                reg_lambda, reg_alpha, gsum, hsum, wsum)
+        else:
+            cw = jnp.cumsum(slot_hist[..., 0], axis=2)
+            cg = jnp.cumsum(slot_hist[..., 1], axis=2)
+            ch = jnp.cumsum(slot_hist[..., 2], axis=2)
+            GL, HL, WL = cg, ch, cw
+            G = gsum[:, None, None]
+            H = hsum[:, None, None]
+            W = wsum[:, None, None]
+            GR, HR, WR = G - GL, H - HL, W - WL
+            tl1 = lambda A: jnp.sign(A) * jnp.maximum(jnp.abs(A) - reg_alpha, 0.0)
+            GLt, GRt, Gt = tl1(GL), tl1(GR), tl1(G)
+            gain = (GLt * GLt / (HL + reg_lambda)
+                    + GRt * GRt / (HR + reg_lambda)
+                    - Gt * Gt / (H + reg_lambda))
+            ok = (WL >= min_rows) & (WR >= min_rows)
+            ok = ok & (jnp.arange(nbins)[None, None, :] < nbins - 1)
+            ok = ok & (feat_mask[None, :, None] > 0)
+            ok = ok & valid[:, None, None]
+            if keep is not None:
+                ok = ok & keep[:, :, None]
+            gain = jnp.where(ok, gain, -jnp.inf)
+            flat = gain.reshape(CAP + 1, F * nbins)
+            best = jnp.argmax(flat, axis=1)
+            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+            bf = (best // nbins).astype(jnp.int32)
+            bb = (best % nbins).astype(jnp.int32)
         do = best_gain > jnp.maximum(min_split_improvement, 1e-10)
         gain_per_feature = gain_per_feature + jax.ops.segment_sum(
             jnp.where(do, best_gain, 0.0).astype(jnp.float32), bf,
@@ -454,7 +604,10 @@ def build_tree(
         rs_do = do[row_slot]
         bf_r = bf[row_slot]
         bb_r = bb[row_slot]
-        rcode = _row_feature_value(codes, bf_r)
+        if pack_bits:
+            rcode = packing.packed_row_values(codes, bf_r, pack_bits)
+        else:
+            rcode = _row_feature_value(codes, bf_r)
         go_right = (rcode > bb_r) & rs_do
         child_local = 2 * slot_node[row_slot] + go_right.astype(jnp.int32)
         row_leaf = jnp.where(rs_do, (2 ** (d + 1) - 1) + child_local,
@@ -482,7 +635,8 @@ def build_tree(
         # RIGHT by parent-minus-left (the sibling-subtraction trick)
         wl = w * ((~go_right) & rs_do).astype(w.dtype)
         hl = build_histograms(codes, row_slot, g, h, wl, CAP + 1, nbins,
-                              method=hist_method, axis_name=axis_name)
+                              method=hist_method, axis_name=axis_name,
+                              pack_bits=pack_bits)
         prc = jnp.minimum(pr, CAP)
         hl_p = hl[prc]
         hp_p = slot_hist[prc]

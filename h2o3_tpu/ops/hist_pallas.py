@@ -15,6 +15,19 @@ therefore just codes-in + histogram-out.
 
 Layout: grid = (row_chunks,); per step the kernel scans features with a
 fori_loop, computing hist[f, 3, L·B] += valsᵀ(3,R) @ onehot(R, L·B).
+
+Packed-code input (ISSUE 7): the device-RESIDENT matrix is the 4/5/6-bit
+`ops.packing` word matrix; `build_histograms` widens it IN-GRAPH before
+these kernels, once per compiled tree program (XLA CSEs the widen across
+every level's pass — only a program-lifetime transient is full-width, the
+resident/cached/tunnelled artifact stays packed). In-KERNEL sub-byte
+decode was evaluated and deferred: the factored kernel reads codes as
+8-sublane f32 feature blocks, while Mosaic's int8 minimum tile is
+(32, 128) — a u8 packed operand would force a 32-feature block
+restructure (4× one-hot VMEM per step) or lane-strided unpacking of the
+interleaved row groups, neither validatable without a chip in the loop.
+See docs/perf.md appendix; ROADMAP items 1/3 stream the same packed
+representation and inherit whichever decode lands.
 """
 
 from __future__ import annotations

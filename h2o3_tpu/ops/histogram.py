@@ -9,29 +9,62 @@ histogram arrays across nodes), and XGBoost's CUDA `gpu_hist` updater
 
 On TPU, scatter-add (the GPU approach: atomics into shared-memory
 histograms) is the enemy — the VPU has no atomics and XLA lowers scatter to
-serialized updates. Two TPU-shaped strategies, selectable and benchmarked:
+serialized updates. On CPU, XLA's scatter emitter is the enemy too: it
+loops updates at ~100 ns each. Strategies, selectable and benchmarked:
 
 * ``onehot``: encode (node,bin) as a one-hot matrix and reduce with a
   matmul — rides the MXU. hist[c, l*B+b] = Σ_rows vals[c,row] ·
   onehot[row, l*B+b], scanned over features. O(N·L·B) FLOPs per feature but
   systolic-array FLOPs are nearly free at these sizes.
 * ``segment``: `jax.ops.segment_sum` with ids = node·B + bin (XLA sorted
-  scatter). Wins on CPU and for very large L·B.
+  scatter). The seed CPU default, kept as the ``H2O3_TREE_LEGACY``
+  comparator and for very large L·B.
+* ``host``: `jax.pure_callback` to a scalar ``np.add.at`` loop — numpy's
+  indexed-add fast path runs the SAME sequential in-order f32 fold as the
+  XLA scatter at ~10x the speed (measured 16 ms vs 150 ms for 1.4M updates
+  on the dev box), so it is bit-exact with ``segment``. The fused-tree CPU
+  default for fits >= H2O3_HOST_HIST_MIN_ROWS (32768) padded rows: a
+  callback custom-call embeds a process-local pointer, which excludes the
+  program from the persistent compile cache — tiny fits keep the cacheable
+  ``segment`` program instead of paying a fresh XLA compile per process.
+  Consumes 4/5/6-bit packed codes directly, unpacking per row-chunk in
+  numpy. Single-shard only (never under a collective).
+* ``pallas``/``pallas_factored``: the fused VMEM kernels in
+  `hist_pallas.py`. With packed input they widen IN-GRAPH once per jitted
+  tree program (XLA CSEs the widen across every level's histogram pass of
+  the program), so the RESIDENT matrix — what the dataset cache holds
+  across fits and what crosses the ~6 MB/s tunnel — stays packed; only a
+  program-lifetime transient is full-width. True in-kernel sub-byte decode
+  is blocked by Mosaic's (32, 128) int8 tile granularity at the kernel's
+  8-feature block shape (see docs/perf.md).
 
 The cross-host combine (ScoreBuildHistogram2.reduce / Rabit allreduce) is a
 single `lax.psum` over the ``hosts`` mesh axis, applied by the caller inside
 `shard_map` — see `h2o3_tpu/models/tree.py`.
+
+Kernel-selection observability (ISSUE 7): every dispatch records the chosen
+method (and the VMEM-pressure pallas→segment fallbacks) into the central
+metrics registry, and the tree driver records a per-fit level plan via
+``record_fit_plan`` — surfaced at ``GET /3/Profiler`` under ``tree`` so
+"which kernel actually ran, at which row_chunk" is never guesswork.
 """
 
 from __future__ import annotations
 
 import functools
 import os
+import threading
+from collections import deque
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from . import packing
+
+# row-chunk for the host callback's packed unpack (numpy transient bound)
+HOST_UNPACK_CHUNK = 1 << 16
 
 
 def _pallas_available() -> bool:
@@ -45,7 +78,8 @@ def _factored_row_chunk(n_nodes: int, nbins: int) -> int:
     scratch and (8B,R) bf16 bin one-hot each ≤8 MB (empirical pass/fail
     boundary on the bench chip) AND scratch + one-hot + the revisited
     (3L,8B) f32 output block ≤16 MB together. Returns <512 when no chunk
-    fits (caller falls back to the XLA onehot path)."""
+    fits (caller falls back to the XLA segment path — recorded, see
+    `resolve_method`)."""
     out_bytes = 3 * n_nodes * 8 * nbins * 4
     rc = 8192
     while rc >= 512:
@@ -56,6 +90,147 @@ def _factored_row_chunk(n_nodes: int, nbins: int) -> int:
             break
         rc //= 2
     return rc
+
+
+# -- kernel-selection observability ----------------------------------------
+
+_SEL_LOCK = threading.Lock()
+_SEL_REG: dict = {}
+_FIT_PLANS: "deque" = deque(maxlen=16)
+
+
+def _sel_registry() -> dict:
+    """Memoized registry families for kernel-selection counters (same
+    memoization stance as runtime/phases._xla_counters)."""
+    if not _SEL_REG:
+        from ..runtime import metrics_registry as _reg
+
+        _SEL_REG["dispatch"] = _reg.counter(
+            "h2o3_tree_hist_dispatch",
+            "histogram kernel dispatches by resolved method (trace-time)",
+            labelnames=("method",))
+        _SEL_REG["vmem_fallbacks"] = _reg.counter(
+            "h2o3_tree_hist_vmem_fallbacks",
+            "fit-plan levels (per fit, per level) whose pallas_factored "
+            "selection fell back to the segment path because no VMEM row "
+            "chunk >= 512 fits")
+    return _SEL_REG
+
+
+def resolve_method(n_nodes: int, nbins: int, method: str = "auto",
+                   axis_name: Optional[str] = None,
+                   platform: Optional[str] = None) -> dict:
+    """The ONE auto-dispatch rule, shared by `build_histograms` and the
+    driver's per-fit plan recording so the observed plan cannot diverge
+    from what actually runs. Returns
+    ``{"method", "row_chunk", "fallback"}`` — `row_chunk` is the pallas
+    grid chunk (None off the pallas path), `fallback` names why a
+    requested kernel was substituted (today: "vmem" for the
+    `_factored_row_chunk` < 512 pressure fallback)."""
+    if method == "auto":
+        method = os.environ.get("H2O3_HIST_METHOD", "auto")
+    if platform is None:
+        platform = jax.default_backend()
+    if method == "auto":
+        if platform == "cpu":
+            method = "segment"
+        elif platform == "tpu":
+            # measured on the real chip (1M×28, B=64, BENCH_r02 sweep): the
+            # factored pallas kernel is ≥ parity with onehot at L≤16 and
+            # 5–14× faster at L≥64 (flat ~10–27 ms vs 130–390 ms)
+            method = "pallas_factored" if _pallas_available() else "onehot"
+        else:
+            method = "onehot"  # non-TPU accelerators: Mosaic won't lower
+    row_chunk = None
+    fallback = None
+    if method == "host" and axis_name is not None:
+        # the host callback cannot run under a collective program — the
+        # psum'd shard path keeps the in-graph scatter
+        method, fallback = "segment", "collective"
+    if method == "pallas_factored":
+        rc = _factored_row_chunk(n_nodes, nbins)
+        if rc < 512:
+            # scratch would not fit VMEM at any useful chunk. Deep levels
+            # (L·B ≳ 20k) are where XLA's sorted-scatter wins: measured on
+            # the real chip (50k×12, B=21) segment is 25–78 ms flat for
+            # L=4k..64k vs 64–700 ms for the one-hot matmul paths
+            method, fallback = "segment", "vmem"
+        else:
+            row_chunk = rc
+    return {"method": method, "row_chunk": row_chunk, "fallback": fallback}
+
+
+def _record_selection(sel: dict, vmem: bool = False) -> None:
+    """Count a resolution. Each counter has ONE source so the numbers stay
+    semantically consistent: `dispatch` counts trace-time kernel dispatches
+    (`build_histograms` only — dispatches are rare by design), while
+    `vmem_fallbacks` counts per-fit per-level plan entries
+    (`record_fit_plan` only, `vmem=True`) — the 'once per fit' satellite
+    contract, never double-counted by the trace that follows."""
+    try:
+        reg = _sel_registry()
+        if vmem:
+            if sel["fallback"] == "vmem":
+                reg["vmem_fallbacks"].inc()
+        else:
+            reg["dispatch"].inc(1.0, sel["method"])
+    except Exception:
+        pass
+
+
+def record_fit_plan(tag: str, levels, nbins: int, hist_method: str,
+                    pack_bits: int = 0, axis_name: Optional[str] = None,
+                    platform: Optional[str] = None) -> dict:
+    """Resolve + record the per-level kernel plan of one tree fit.
+
+    `levels` is a sequence of (label, n_nodes) histogram passes the fit
+    will run. Logs ONE warning per fit when any level hits the VMEM
+    pressure fallback (the previously-silent `_factored_row_chunk` < 512
+    path), counts every level's selection in the registry, and keeps the
+    plan in a bounded ring surfaced at /3/Profiler."""
+    import time as _time
+
+    plan_levels = []
+    fellback = []
+    for label, n_nodes in levels:
+        sel = resolve_method(n_nodes, nbins, hist_method,
+                             axis_name=axis_name, platform=platform)
+        _record_selection(sel, vmem=True)
+        plan_levels.append(dict(level=label, n_nodes=int(n_nodes), **sel))
+        if sel["fallback"] == "vmem":
+            fellback.append((label, int(n_nodes)))
+    plan = dict(tag=tag, ts=_time.time(), nbins=int(nbins),
+                hist_method=hist_method, pack_bits=int(pack_bits),
+                levels=plan_levels)
+    if fellback:
+        from ..runtime.log import Log
+
+        Log.warn(
+            f"tree fit {tag}: histogram levels {fellback} exceed the VMEM "
+            f"row-chunk floor — falling back to the segment kernel "
+            "(counted in h2o3_tree_hist_vmem_fallbacks)")
+    with _SEL_LOCK:
+        _FIT_PLANS.append(plan)
+    return plan
+
+
+def kernel_stats() -> dict:
+    """Per-fit kernel plans + cumulative dispatch counters (the /3/Profiler
+    `tree` fold). Pure counter read."""
+    with _SEL_LOCK:
+        plans = list(_FIT_PLANS)
+    out = dict(plans=plans, dispatch={}, vmem_fallbacks=0)
+    try:
+        reg = _sel_registry()
+        out["dispatch"] = {lv[0]: c.value()
+                           for lv, c in reg["dispatch"].children().items()}
+        out["vmem_fallbacks"] = reg["vmem_fallbacks"].value()
+    except Exception:
+        pass
+    return out
+
+
+# -- kernels ----------------------------------------------------------------
 
 
 def _hist_onehot(codes, node_id, vals, n_nodes: int, nbins: int):
@@ -114,6 +289,51 @@ def _hist_segment(codes, node_id, vals, n_nodes: int, nbins: int):
     return hists.reshape(F, n_nodes, nbins, 3).transpose(1, 0, 2, 3)
 
 
+def _host_hist_cb(codes, node_id, vals, n_nodes: int, nbins: int,
+                  pack_bits: int) -> np.ndarray:
+    """The host accumulate loop: scalar ``np.add.at`` per (feature,
+    channel) — numpy's indexed-add fast path, a sequential in-order f32
+    fold bit-identical to the XLA scatter the `segment` path runs.
+    Packed codes are widened per `HOST_UNPACK_CHUNK` rows, so the
+    full-width matrix never materializes."""
+    codes = np.asarray(codes)
+    node_id = np.asarray(node_id, dtype=np.int32)
+    vals = np.asarray(vals)
+    F = codes.shape[1]
+    LB = n_nodes * nbins
+    out = np.zeros((F, LB, 3), np.float32)
+    base_all = node_id * np.int32(nbins)
+    n = (packing.packed_nrows(codes.shape[0], pack_bits) if pack_bits
+         else codes.shape[0])
+    group = packing.GROUP_ROWS.get(pack_bits, 1)
+    gbytes = packing.GROUP_BYTES.get(pack_bits, 1)
+    step = HOST_UNPACK_CHUNK - (HOST_UNPACK_CHUNK % group or 0)
+    for r0 in range(0, n, step):
+        r1 = min(r0 + step, n)
+        if pack_bits:
+            chunk = packing.unpack_host(
+                codes[r0 // group * gbytes: r1 // group * gbytes], pack_bits)
+        else:
+            chunk = codes[r0:r1]
+        base = base_all[r0:r1]
+        for f in range(F):
+            ids = base + chunk[:, f].astype(np.int32)
+            for k in range(3):
+                np.add.at(out[f, :, k], ids, vals[k, r0:r1])
+    return out.reshape(F, n_nodes, nbins, 3).transpose(1, 0, 2, 3)
+
+
+def _hist_host(codes, node_id, vals, n_nodes: int, nbins: int,
+               pack_bits: int):
+    """`pure_callback` wrapper around `_host_hist_cb` (CPU fast path)."""
+    F = codes.shape[1]
+    cb = functools.partial(_host_hist_cb, n_nodes=n_nodes, nbins=nbins,
+                           pack_bits=pack_bits)
+    return jax.pure_callback(
+        cb, jax.ShapeDtypeStruct((n_nodes, F, nbins, 3), jnp.float32),
+        codes, node_id, vals)
+
+
 def build_histograms(
     codes: jax.Array,
     node_id: jax.Array,
@@ -124,52 +344,50 @@ def build_histograms(
     nbins: int,
     method: str = "auto",
     axis_name: Optional[str] = None,
+    pack_bits: int = 0,
 ) -> jax.Array:
     """Histogram of {Σw, Σg, Σh} per (tree-node, feature, bin).
 
     Rows with w==0 (padding, row-sampling dropouts, OOB) contribute nothing —
     g/h/w must already be masked by the caller. `axis_name` triggers the
     cross-host psum (the MRTask.reduce step) when called under shard_map.
+
+    With ``pack_bits`` in {4, 5, 6}, `codes` is the `ops.packing` packed
+    matrix; the host and pallas paths consume it directly (per-row-chunk
+    unpack), other paths widen in-graph before accumulating.
     """
     vals = jnp.stack([w, g * w, h * w]).astype(jnp.float32)  # (3, N)
-    if method == "auto":
-        method = os.environ.get("H2O3_HIST_METHOD", "auto")
-    if method == "auto":
-        platform = jax.default_backend()
-        if platform == "cpu":
-            method = "segment"
-        elif platform == "tpu":
-            # measured on the real chip (1M×28, B=64, BENCH_r02 sweep): the
-            # factored pallas kernel is ≥ parity with onehot at L≤16 and
-            # 5–14× faster at L≥64 (flat ~10–27 ms vs 130–390 ms)
-            method = "pallas_factored" if _pallas_available() else "onehot"
-        else:
-            method = "onehot"  # non-TPU accelerators: Mosaic won't lower
-    if method == "onehot":
-        hist = _hist_onehot(codes, node_id, vals, n_nodes, nbins)
-    elif method == "segment":
-        hist = _hist_segment(codes, node_id, vals, n_nodes, nbins)
-    elif method == "pallas":
-        from . import hist_pallas
-
-        hist = hist_pallas.build_histograms_pallas(codes, node_id, vals, n_nodes, nbins)
-    elif method == "pallas_factored":
-        from . import hist_pallas
-
-        rc = _factored_row_chunk(n_nodes, nbins)
-        if rc < 512:
-            # scratch would not fit VMEM at any useful chunk. Deep levels
-            # (L·B ≳ 20k) are where XLA's sorted-scatter wins: measured on
-            # the real chip (50k×12, B=21) segment is 25–78 ms flat for
-            # L=4k..64k vs 64–700 ms for the one-hot matmul paths
+    sel = resolve_method(n_nodes, nbins, method, axis_name=axis_name)
+    _record_selection(sel)
+    method = sel["method"]
+    if method == "host":
+        hist = _hist_host(codes, node_id, vals, n_nodes, nbins, pack_bits)
+    else:
+        if pack_bits:
+            # in-graph consumers take dense codes: widen in-graph. The
+            # widen is a pure function of the loop-invariant packed input,
+            # so XLA computes it once per program execution and shares the
+            # buffer across every level's histogram pass; the RESIDENT
+            # matrix stays packed
+            codes = packing.unpack_device(codes, pack_bits)
+        if method == "onehot":
+            hist = _hist_onehot(codes, node_id, vals, n_nodes, nbins)
+        elif method == "segment":
             hist = _hist_segment(codes, node_id, vals, n_nodes, nbins)
-        else:
+        elif method == "pallas":
+            from . import hist_pallas
+
+            hist = hist_pallas.build_histograms_pallas(
+                codes, node_id, vals, n_nodes, nbins)
+        elif method == "pallas_factored":
+            from . import hist_pallas
+
             hist = hist_pallas.build_histograms_pallas_factored(
                 codes.T.astype(jnp.float32), node_id, vals, n_nodes, nbins,
-                row_chunk=rc,
+                row_chunk=sel["row_chunk"],
             )
-    else:
-        raise ValueError(f"unknown histogram method {method!r}")
+        else:
+            raise ValueError(f"unknown histogram method {method!r}")
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return hist  # (n_nodes, F, nbins, 3) — [..., 0]=Σw [..., 1]=Σg [..., 2]=Σh

@@ -1,0 +1,176 @@
+"""Sub-byte bin-code packing — the device-resident compressed code matrix.
+
+The quantized (N, F) bin-code matrix is both the dominant fixed H2D cost
+(a remote-chip tunnel moves ~6 MB/s) and, once resident, the dominant
+per-level HBM read of the tree hot loop (every histogram pass streams it).
+4/5/6-bit packing cuts both 2-4x — the ELLPACK-style compressed storage of
+"XGBoost: Scalable GPU Accelerated Learning" (arXiv 1806.11248), which
+keeps bit-packed feature codes resident and decodes in-kernel.
+
+Layout: codes are packed ALONG ROWS in fixed groups so any row-slice at a
+group boundary unpacks standalone (row-chunked consumers never touch
+neighbouring groups):
+
+=====  ==========  ===========  =========================================
+bits   rows/group  bytes/group  bitstream
+=====  ==========  ===========  =========================================
+4      2           1            row codes MSB-first, 4 bits each
+5      8           5            row codes MSB-first, 5 bits each
+6      4           3            row codes MSB-first, 6 bits each
+=====  ==========  ===========  =========================================
+
+Consumers:
+
+* ``unpack_device`` — whole-matrix widening on device (the legacy
+  ``H2O3_TREE_LEGACY=1`` path: ship packed, materialize full width once).
+* ``ops/histogram.py`` — the host callback path unpacks in numpy per
+  64k-row chunk (the full-width matrix never exists); in-graph kernels
+  widen once per jitted tree program (a program-lifetime transient — the
+  resident matrix stays packed).
+* ``packed_row_values`` — the partition step's per-row selected-feature
+  code, extracted straight from the packed words (two byte gathers + a
+  shift per row).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# rows per pack group / packed bytes per group, by bit width
+GROUP_ROWS = {4: 2, 5: 8, 6: 4}
+GROUP_BYTES = {4: 1, 5: 5, 6: 3}
+
+
+def pack_bits_for(nbins: int, nrows: int) -> int:
+    """Narrowest usable packing for codes < nbins (0 = ship unpacked).
+    Rows must be a multiple of the group size (padded row counts are
+    multiples of 8)."""
+    for bits, group in ((4, 2), (5, 8), (6, 4)):
+        if nbins <= (1 << bits) and nrows % group == 0:
+            return bits
+    return 0
+
+
+def packed_nrows(packed_rows: int, bits: int) -> int:
+    """Unpacked row count of a packed array with `packed_rows` rows."""
+    return packed_rows * 8 // bits
+
+
+def pack_host(codes: np.ndarray, bits: int) -> np.ndarray:
+    """Pack uint8 bin codes < 2^bits into `bits` bits per value along rows.
+    bits ∈ {4, 5, 6}: {2, 8, 4} row-groups → {1, 5, 3} bytes."""
+    if bits == 4:
+        return (codes[0::2] << 4) | codes[1::2]
+    if bits == 5:
+        a, b, c, d, e, f, g, hh = (codes[i::8] for i in range(8))
+        out = np.empty((5 * a.shape[0],) + codes.shape[1:], np.uint8)
+        out[0::5] = (a << 3) | (b >> 2)
+        out[1::5] = ((b & 0x3) << 6) | (c << 1) | (d >> 4)
+        out[2::5] = ((d & 0xF) << 4) | (e >> 1)
+        out[3::5] = ((e & 0x1) << 7) | (f << 2) | (g >> 3)
+        out[4::5] = ((g & 0x7) << 5) | hh
+        return out
+    # 6-bit: stays uint8 end to end (max 63<<2 = 252)
+    a, b, c, d = codes[0::4], codes[1::4], codes[2::4], codes[3::4]
+    out = np.empty((3 * a.shape[0],) + codes.shape[1:], np.uint8)
+    out[0::3] = (a << 2) | (b >> 4)
+    out[1::3] = ((b & 0xF) << 4) | (c >> 2)
+    out[2::3] = ((c & 0x3) << 6) | d
+    return out
+
+
+def unpack_host(packed: np.ndarray, bits: int) -> np.ndarray:
+    """Inverse of `pack_host` on host numpy (the histogram callback's
+    per-chunk widening) — bit-exact with `unpack_device`."""
+    if bits == 4:
+        k = packed.shape[0]
+        out = np.empty((2 * k,) + packed.shape[1:], np.uint8)
+        out[0::2] = packed >> 4
+        out[1::2] = packed & 0xF
+        return out
+    if bits == 5:
+        b = [packed[i::5].astype(np.uint16) for i in range(5)]
+        k = packed.shape[0] // 5
+        out = np.empty((8 * k,) + packed.shape[1:], np.uint8)
+        out[0::8] = b[0] >> 3
+        out[1::8] = ((b[0] & 0x7) << 2) | (b[1] >> 6)
+        out[2::8] = (b[1] >> 1) & 0x1F
+        out[3::8] = ((b[1] & 0x1) << 4) | (b[2] >> 4)
+        out[4::8] = ((b[2] & 0xF) << 1) | (b[3] >> 7)
+        out[5::8] = (b[3] >> 2) & 0x1F
+        out[6::8] = ((b[3] & 0x3) << 3) | (b[4] >> 5)
+        out[7::8] = b[4] & 0x1F
+        return out
+    b0 = packed[0::3].astype(np.uint16)
+    b1 = packed[1::3].astype(np.uint16)
+    b2 = packed[2::3].astype(np.uint16)
+    k = packed.shape[0] // 3
+    out = np.empty((4 * k,) + packed.shape[1:], np.uint8)
+    out[0::4] = b0 >> 2
+    out[1::4] = ((b0 & 0x3) << 4) | (b1 >> 4)
+    out[2::4] = ((b1 & 0xF) << 2) | (b2 >> 6)
+    out[3::4] = b2 & 0x3F
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("bits",))
+def unpack_device(packed, bits: int):
+    """Inverse of pack_host, on device: one widening program."""
+    if bits == 4:
+        k = packed.shape[0]
+        out = jnp.stack([packed >> 4, packed & 0xF], axis=1)
+        return out.reshape((2 * k,) + packed.shape[1:]).astype(jnp.uint8)
+    if bits == 5:
+        b = [packed[i::5].astype(jnp.uint16) for i in range(5)]
+        k = packed.shape[0] // 5
+        vals = [
+            b[0] >> 3,
+            ((b[0] & 0x7) << 2) | (b[1] >> 6),
+            (b[1] >> 1) & 0x1F,
+            ((b[1] & 0x1) << 4) | (b[2] >> 4),
+            ((b[2] & 0xF) << 1) | (b[3] >> 7),
+            (b[3] >> 2) & 0x1F,
+            ((b[3] & 0x3) << 3) | (b[4] >> 5),
+            b[4] & 0x1F,
+        ]
+        out = jnp.stack(vals, axis=1).reshape((8 * k,) + packed.shape[1:])
+        return out.astype(jnp.uint8)
+    b0 = packed[0::3].astype(jnp.uint16)
+    b1 = packed[1::3].astype(jnp.uint16)
+    b2 = packed[2::3].astype(jnp.uint16)
+    a = b0 >> 2
+    b = ((b0 & 0x3) << 4) | (b1 >> 4)
+    c = ((b1 & 0xF) << 2) | (b2 >> 6)
+    d = b2 & 0x3F
+    k = packed.shape[0] // 3
+    out = jnp.stack([a, b, c, d], axis=1).reshape((4 * k,) + packed.shape[1:])
+    return out.astype(jnp.uint8)
+
+
+def packed_row_values(packed: jax.Array, rf: jax.Array, bits: int) -> jax.Array:
+    """codes[i, rf[i]] as int32, read straight from the packed words —
+    the per-row selected-feature code of the partition step.
+
+    A row's code spans at most two adjacent bytes of its group's
+    bitstream; two flat gathers + one shift recover it exactly. When the
+    code sits entirely in byte0 the second gather (clamped in-bounds) is
+    shifted out, so no group ever reads past its own bytes."""
+    P, F = packed.shape
+    rows_per = GROUP_ROWS[bits]
+    bytes_per = GROUP_BYTES[bits]
+    n = P * 8 // bits
+    i = jnp.arange(n, dtype=jnp.int32)
+    grp = i // rows_per
+    bit0 = (i % rows_per) * bits
+    b0 = grp * bytes_per + bit0 // 8
+    off = bit0 % 8
+    b1 = jnp.minimum(b0 + 1, P - 1)
+    flat = packed.reshape(-1).astype(jnp.int32)
+    rfi = rf.astype(jnp.int32)
+    v0 = flat[b0 * F + rfi]
+    v1 = flat[b1 * F + rfi]
+    return (((v0 << 8) | v1) >> (16 - bits - off)) & ((1 << bits) - 1)

@@ -1094,6 +1094,7 @@ class _Handler(BaseHTTPRequestHandler):
                         munge=profiler.munge_stats(),
                         training=profiler.training_stats(),
                         faults=profiler.fault_stats(),
+                        tree=profiler.tree_stats(),
                         xla=profiler.xla_stats(),
                         tracing=profiler.tracing_stats(),
                         metrics=profiler.registry_stats()))

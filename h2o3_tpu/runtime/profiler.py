@@ -103,6 +103,20 @@ def fault_stats() -> Dict:
     return out
 
 
+def tree_stats() -> Dict:
+    """Tree-kernel observability folded into the profiler surface
+    (ISSUE 7 satellite): the per-fit histogram kernel plans recorded by
+    `ops.histogram.record_fit_plan` (method, pallas row_chunk, pack bits,
+    VMEM-pressure fallbacks per level) plus the cumulative dispatch
+    counters — `build_histograms`' auto-dispatch made visible. Pure
+    counter read — never builds a histogram."""
+    from ..ops import histogram
+
+    out = histogram.kernel_stats()
+    out["active"] = bool(out["plans"]) or bool(out["dispatch"])
+    return out
+
+
 def xla_stats() -> Dict:
     """XLA compile/trace/retrace counters folded into the profiler surface
     (runtime/phases tracker): totals + per-program-signature breakdown.

@@ -1,0 +1,420 @@
+"""Fused GBM hot path (ISSUE 7) — packed-code histograms, single-pass
+split search, overlapped chunk scoring.
+
+Pins: (1) every fused lever is BIT-EXACT against the ``H2O3_TREE_LEGACY=1``
+comparator across the parity matrix (GBM/DRF, mtries, monotone,
+compact-cap, CV fold reuse, overlap on/off); (2) a warm higgs-shaped fit
+re-traces ZERO programs (the ROADMAP item 2 pin, via the PR 6 XLA
+tracker); (3) the histogram kernel auto-dispatch is observable — per-fit
+plans, dispatch counters, and the previously-silent VMEM-pressure
+fallback; (4) the forced-CPU bench floor (slow)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from h2o3_tpu.models import tree as treelib
+from h2o3_tpu.ops import histogram, packing
+
+from conftest import make_classification
+
+
+@pytest.fixture()
+def _no_legacy():
+    """Isolate the legacy/overlap env knobs per test."""
+    keys = ("H2O3_TREE_LEGACY", "H2O3_TREE_OVERLAP", "H2O3_HIST_METHOD",
+            "H2O3_HOST_HIST_MIN_ROWS")
+    prior = {k: os.environ.pop(k, None) for k in keys}
+    yield
+    for k, v in prior.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _tree_data(seed=1, N=2048, F=9, B=21):
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, B, (N, F)).astype(np.uint8)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.random(N).astype(np.float32) + 0.1
+    w = np.where(rng.random(N) > 0.05, 1.0, 0.0).astype(np.float32)
+    fm = np.ones(F, np.float32)
+    edges = np.sort(rng.normal(size=(F, B - 2)), axis=1).astype(np.float32)
+    return codes, g, h, w, fm, edges, B
+
+
+def _leaves_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# -- ops: packed consumption ------------------------------------------------
+
+def test_packed_row_values_exact():
+    rng = np.random.default_rng(0)
+    N, F = 4096, 7
+    for bits, B in ((4, 16), (5, 21), (6, 33)):
+        codes = rng.integers(0, B, (N, F)).astype(np.uint8)
+        pk = packing.pack_host(codes, bits)
+        rf = rng.integers(0, F, N).astype(np.int32)
+        got = np.asarray(packing.packed_row_values(
+            jnp.asarray(pk), jnp.asarray(rf), bits))
+        assert np.array_equal(got, codes[np.arange(N), rf])
+
+
+def test_host_histogram_bitexact_with_segment_packed_and_dense():
+    """The np.add.at host callback runs the same sequential in-order f32
+    fold as the XLA sorted scatter — bit-exact, packed or dense."""
+    rng = np.random.default_rng(2)
+    N, F, L = 4096, 6, 4
+    node = rng.integers(0, L, N).astype(np.int32)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.random(N).astype(np.float32)
+    w = (rng.random(N) > 0.1).astype(np.float32)
+    for bits, B in ((4, 16), (5, 21), (6, 33)):
+        codes = rng.integers(0, B, (N, F)).astype(np.uint8)
+        pk = packing.pack_host(codes, bits)
+        ref = np.asarray(histogram.build_histograms(
+            jnp.asarray(codes), jnp.asarray(node), jnp.asarray(g),
+            jnp.asarray(h), jnp.asarray(w), L, B, method="segment"))
+        for codes_in, pb in ((codes, 0), (pk, bits)):
+            got = np.asarray(histogram.build_histograms(
+                jnp.asarray(codes_in), jnp.asarray(node), jnp.asarray(g),
+                jnp.asarray(h), jnp.asarray(w), L, B, method="host",
+                pack_bits=pb))
+            assert np.array_equal(ref, got), (bits, pb)
+
+
+# -- build_tree: the parity matrix ------------------------------------------
+
+@pytest.mark.parametrize("variant", [
+    "fused", "packed", "packed_fused", "mtries", "monotone",
+    "alpha_lambda0",
+])
+def test_build_tree_fused_packed_parity(variant):
+    codes, g, h, w, fm, edges, B = _tree_data()
+    bits = packing.pack_bits_for(B, codes.shape[0])
+    pk = packing.pack_host(codes, bits)
+    key = jax.random.PRNGKey(3)
+    kw = dict(max_depth=4, nbins=B, min_rows=5.0, key=key)
+    if variant == "mtries":
+        kw["mtries_rate"] = jnp.float32(0.5)
+    if variant == "monotone":
+        mono = np.zeros(codes.shape[1], np.float32)
+        mono[0], mono[3] = 1.0, -1.0
+        kw["monotone"] = jnp.asarray(mono)
+    if variant == "alpha_lambda0":
+        kw.update(reg_lambda=0.0, reg_alpha=0.5)   # NaN-prone gains
+    base = treelib.build_tree(jnp.asarray(codes), g, h, w, fm, edges, **kw)
+    fused_kw = dict(kw, fused_split=True)
+    if variant != "fused":
+        got = treelib.build_tree(jnp.asarray(pk), g, h, w, fm, edges,
+                                 pack_bits=bits, **fused_kw)
+    else:
+        got = treelib.build_tree(jnp.asarray(codes), g, h, w, fm, edges,
+                                 **fused_kw)
+    assert _leaves_equal(base, got)
+
+
+def test_build_tree_compact_cap_parity_and_overflow_flag():
+    """Compact-phase split search + partition on packed/fused match the
+    legacy dense comparator, including the overflow flag the driver's
+    dense-rebuild guard consumes."""
+    codes, g, h, w, fm, edges, B = _tree_data(N=2048, F=9)
+    bits = packing.pack_bits_for(B, codes.shape[0])
+    pk = packing.pack_host(codes, bits)
+    key = jax.random.PRNGKey(5)
+    kw = dict(max_depth=8, nbins=B, min_rows=1.0, key=key)
+    base = treelib.build_tree(jnp.asarray(codes), g, h, w, fm, edges,
+                              compact_cap=64, **kw)
+    got = treelib.build_tree(jnp.asarray(pk), g, h, w, fm, edges,
+                             compact_cap=64, pack_bits=bits,
+                             fused_split=True, **kw)
+    assert _leaves_equal(base, got)
+    assert int(np.asarray(base[-1])) == int(np.asarray(got[-1]))
+    # a cap too small for the live frontier must raise the flag on BOTH
+    # paths (the driver then rebuilds densely — exactness never traded)
+    *_, ov_l = treelib.build_tree(jnp.asarray(codes), g, h, w, fm, edges,
+                                  compact_cap=4, **kw)
+    *_, ov_f = treelib.build_tree(jnp.asarray(pk), g, h, w, fm, edges,
+                                  compact_cap=4, pack_bits=bits,
+                                  fused_split=True, **kw)
+    assert int(np.asarray(ov_l)) > 0
+    assert int(np.asarray(ov_l)) == int(np.asarray(ov_f))
+
+
+# -- whole-fit parity against the legacy flag -------------------------------
+
+# ONE shared whole-fit shape: every driver-level test below uses the same
+# (row bucket, F, max_depth, nbins) so they all land on a single fused and
+# a single legacy compiled tree program — the fused body is ~2x the trace
+# work per structural config, so the suite pays it once, not per test.
+_FIT_N, _FIT_F, _FIT_DEPTH = 4096, 6, 4
+_FIT_X, _FIT_Y = make_classification(n=_FIT_N, f=_FIT_F, seed=7)
+_FIT_NAMES = [f"f{i}" for i in range(_FIT_F)] + ["label"]
+
+
+def _frame(X, y, names):
+    from h2o3_tpu.frame.frame import Frame
+
+    return Frame.from_numpy(np.column_stack([X, y]),
+                            names=names).asfactor("label")
+
+
+def _fit_gbm(legacy, X, y, names, overlap=None, **params):
+    from h2o3_tpu.models import dataset_cache
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    dataset_cache.clear()
+    os.environ.pop("H2O3_TREE_LEGACY", None)
+    if legacy:
+        os.environ["H2O3_TREE_LEGACY"] = "1"
+    if overlap is not None:
+        os.environ["H2O3_TREE_OVERLAP"] = overlap
+    else:
+        os.environ.pop("H2O3_TREE_OVERLAP", None)
+    try:
+        gbm = H2OGradientBoostingEstimator(seed=42, **params)
+        gbm.train(y="label", training_frame=_frame(X, y, names))
+    finally:
+        os.environ.pop("H2O3_TREE_LEGACY", None)
+        os.environ.pop("H2O3_TREE_OVERLAP", None)
+    return gbm
+
+
+def _assert_models_bitexact(a, b):
+    assert a.model.ntrees_built == b.model.ntrees_built
+    for k in range(len(a.model.forest)):
+        for f in treelib.Tree._fields:
+            assert np.array_equal(
+                np.asarray(getattr(a.model.forest[k], f)),
+                np.asarray(getattr(b.model.forest[k], f))), (k, f)
+    va = getattr(a.model, "varimp_table", None)
+    vb = getattr(b.model, "varimp_table", None)
+    if va is not None or vb is not None:
+        assert [r[0] for r in va] == [r[0] for r in vb]
+        np.testing.assert_array_equal([r[1] for r in va],
+                                      [r[1] for r in vb])
+
+
+def test_gbm_fit_parity_fused_vs_legacy(cloud1, _no_legacy):
+    """Whole-fit pin: packed codes × fused split × overlapped scoring with
+    early stopping produce the bit-identical forest, gain-based varimp,
+    scoring history, and predictions of the legacy path."""
+    X, y, names = _FIT_X, _FIT_Y, _FIT_NAMES
+    params = dict(ntrees=12, max_depth=_FIT_DEPTH, learn_rate=0.1,
+                  score_tree_interval=3, stopping_rounds=2,
+                  stopping_tolerance=1e-9)
+    # drop the host-kernel row floor so THIS fit exercises the full fused
+    # stack (packed codes + np.add.at host histograms + overlap) end to
+    # end; the other whole-fit tests keep the small-fit segment default
+    os.environ["H2O3_HOST_HIST_MIN_ROWS"] = "1"
+    new = _fit_gbm(False, X, y, names, **params)
+    old = _fit_gbm(True, X, y, names, **params)
+    _assert_models_bitexact(new, old)
+    h_new = [e.get("logloss") for e in new.model.scoring_history]
+    h_old = [e.get("logloss") for e in old.model.scoring_history]
+    assert h_new == h_old
+    fr = _frame(X, y, names)
+    pa = new.model.predict(fr)
+    pb = old.model.predict(fr)
+    np.testing.assert_array_equal(np.asarray(pa.vec("1").data),
+                                  np.asarray(pb.vec("1").data))
+
+
+def test_gbm_fit_parity_overlap_off(cloud1, _no_legacy):
+    """H2O3_TREE_OVERLAP=0 (no speculative chunk) is bit-identical to the
+    overlapped default — overlap is a scheduling change, not a math one."""
+    X, y, names = _FIT_X, _FIT_Y, _FIT_NAMES
+    params = dict(ntrees=10, max_depth=_FIT_DEPTH, score_tree_interval=2,
+                  stopping_rounds=1, stopping_tolerance=1e-9)
+    a = _fit_gbm(False, X, y, names, overlap="1", **params)
+    b = _fit_gbm(False, X, y, names, overlap="0", **params)
+    _assert_models_bitexact(a, b)
+
+
+def test_early_stop_discards_speculative_chunk(cloud1, _no_legacy):
+    """When the stopper FIRES with a speculative chunk in flight, the
+    chunk is discarded and the pre-dispatch state restored: tree count,
+    forest, and the training metrics computed from the restored margins
+    all match the legacy (never-speculated) path bit-for-bit."""
+    X, y, names = _FIT_X, _FIT_Y, _FIT_NAMES
+    # tiny learn rate + huge tolerance → the stopper fires mid-run
+    params = dict(ntrees=40, max_depth=_FIT_DEPTH, learn_rate=0.01,
+                  score_tree_interval=2, stopping_rounds=1,
+                  stopping_tolerance=0.5)
+    new = _fit_gbm(False, X, y, names, **params)
+    old = _fit_gbm(True, X, y, names, **params)
+    assert new.model.ntrees_built < 40, "stopper must fire for this pin"
+    _assert_models_bitexact(new, old)
+    np.testing.assert_array_equal(new.model.training_metrics.logloss(),
+                                  old.model.training_metrics.logloss())
+
+
+def test_drf_fit_parity_fused_vs_legacy(cloud1, _no_legacy):
+    """DRF: per-node mtries column sampling + OOB scoring through the
+    packed/fused path match the legacy comparator bit-for-bit."""
+    from h2o3_tpu.models import dataset_cache
+    from h2o3_tpu.models.drf import H2ORandomForestEstimator
+
+    X, y, names = _FIT_X, _FIT_Y, _FIT_NAMES
+
+    def fit(legacy):
+        dataset_cache.clear()
+        os.environ.pop("H2O3_TREE_LEGACY", None)
+        if legacy:
+            os.environ["H2O3_TREE_LEGACY"] = "1"
+        try:
+            drf = H2ORandomForestEstimator(ntrees=8, max_depth=_FIT_DEPTH,
+                                           seed=42, score_tree_interval=4)
+            drf.train(y="label", training_frame=_frame(X, y, names))
+        finally:
+            os.environ.pop("H2O3_TREE_LEGACY", None)
+        return drf
+
+    _assert_models_bitexact(fit(False), fit(True))
+
+
+def test_cv_fold_reuse_parity_fused_vs_legacy(cloud1, _no_legacy):
+    """CV fold reuse (PR 4) composes with the fused path: fold models
+    slice the parent's PACKED artifact and the cross-validated parent is
+    bit-identical to the legacy run's."""
+    X, y, names = _FIT_X, _FIT_Y, _FIT_NAMES
+    # folds inherit the parent's padded row bucket (_npad_floor), so even
+    # the fold fits reuse the shared compiled programs
+    params = dict(ntrees=6, max_depth=_FIT_DEPTH, nfolds=2,
+                  keep_cross_validation_predictions=True)
+    new = _fit_gbm(False, X, y, names, **params)
+    old = _fit_gbm(True, X, y, names, **params)
+    _assert_models_bitexact(new, old)
+    ma = new.model.cross_validation_metrics
+    mb = old.model.cross_validation_metrics
+    assert ma is not None and mb is not None
+    np.testing.assert_array_equal(ma.logloss(), mb.logloss())
+    np.testing.assert_array_equal(ma.auc(), mb.auc())
+
+
+# -- the warm-fit zero-retrace pin (ROADMAP item 2) -------------------------
+
+def test_warm_fit_retraces_zero(cloud1, _no_legacy):
+    """A warm higgs-shaped fit (same _StepCfg; scalar hyperparameters may
+    differ — they are traced, not static) must trace ZERO new programs and
+    re-trace nothing, per the PR 6 per-signature XLA tracker."""
+    from h2o3_tpu.runtime import phases
+
+    X, y, names = _FIT_X, _FIT_Y, _FIT_NAMES
+    _fit_gbm(False, X, y, names, ntrees=5, max_depth=_FIT_DEPTH,
+             learn_rate=0.1)
+    before = phases.xla_counts()
+    # warm fit: same structural shape, different traced scalar (learn_rate)
+    _fit_gbm(False, X, y, names, ntrees=5, max_depth=_FIT_DEPTH,
+             learn_rate=0.2)
+    after = phases.xla_counts()
+    assert after["retraces"] == before["retraces"], \
+        "warm fit re-traced a program signature"
+    assert after["traces"] == before["traces"], \
+        "warm fit traced a NEW program (cfg key must cover it)"
+
+
+# -- kernel-selection observability -----------------------------------------
+
+def test_fit_plan_recorded_and_profiler_fold(cloud1, _no_legacy):
+    X, y = make_classification(n=2048, f=5, seed=17)
+    names = [f"f{i}" for i in range(5)] + ["label"]
+    os.environ["H2O3_HOST_HIST_MIN_ROWS"] = "1"   # small fit, host anyway
+    _fit_gbm(False, X, y, names, ntrees=2, max_depth=3)
+    stats = histogram.kernel_stats()
+    assert stats["plans"], "fit recorded no kernel plan"
+    plan = stats["plans"][-1]
+    assert plan["hist_method"] == "host"      # the fused CPU default
+    assert plan["pack_bits"] in (4, 5, 6)
+    assert all(lv["method"] == "host" for lv in plan["levels"])
+    assert stats["dispatch"].get("host", 0) > 0
+    from h2o3_tpu.runtime import profiler
+
+    fold = profiler.tree_stats()
+    assert fold["active"] and fold["plans"]
+    # the dispatch counters reach the Prometheus scrape surface
+    from h2o3_tpu.runtime import metrics_registry
+
+    text = metrics_registry.prometheus_text()
+    assert "h2o3_tree_hist_dispatch_total" in text
+
+
+def test_vmem_fallback_counted_and_logged(_no_legacy):
+    """The previously-silent `_factored_row_chunk` < 512 fallback is
+    observable: resolve_method reports it, record_fit_plan counts it in
+    the registry and logs once per fit."""
+    from h2o3_tpu.runtime import metrics_registry
+
+    # a level too wide for any VMEM row chunk (L·B blows the scratch)
+    sel = histogram.resolve_method(1 << 16, 64, "pallas_factored",
+                                   platform="tpu")
+    assert sel == {"method": "segment", "row_chunk": None,
+                   "fallback": "vmem"}
+    # and a feasible one keeps the pallas kernel + its row chunk
+    ok = histogram.resolve_method(16, 64, "pallas_factored", platform="tpu")
+    assert ok["method"] == "pallas_factored" and ok["row_chunk"] >= 512
+    before = metrics_registry.get("h2o3_tree_hist_vmem_fallbacks").total()
+    plan = histogram.record_fit_plan(
+        "test:vmem", [("d0", 1), ("d16", 1 << 16)], 64,
+        "pallas_factored", platform="tpu")
+    after = metrics_registry.get("h2o3_tree_hist_vmem_fallbacks").total()
+    assert after == before + 1
+    assert [lv["fallback"] for lv in plan["levels"]] == [None, "vmem"]
+    # the host callback can never run under a collective program
+    sel = histogram.resolve_method(4, 21, "host", axis_name="hosts")
+    assert sel["method"] == "segment" and sel["fallback"] == "collective"
+
+
+def test_dataset_cache_keys_pack_mode(cloud1, _no_legacy):
+    """A packed and a full-width consumer never share a device artifact."""
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models import dataset_cache
+
+    dataset_cache.clear()
+    X, y = make_classification(n=512, f=4, seed=23)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "d", "label"])
+    calls = []
+    for bits in (0, 5, 5):
+        dataset_cache.device_codes(
+            fr, ["a", "b", "c", "d"], 21, "AUTO", 1, 512,
+            builder=lambda: calls.append(1) or jnp.zeros((1,)),
+            pack_bits=bits)
+    assert len(calls) == 2   # 0-bit and 5-bit miss; second 5-bit hits
+
+
+# -- the forced-CPU bench floor (acceptance) --------------------------------
+
+@pytest.mark.slow
+def test_gbm_cpu_fused_speedup_floor(cloud1, _no_legacy):
+    """BENCH_CONFIG=gbm_cpu acceptance: the fused kernel is ≥1.5× the
+    legacy kernel on the forced-CPU lane (measured ~6-9× on the dev box;
+    the floor absorbs scheduler noise)."""
+    import time
+
+    X, y = make_classification(n=60_000, f=28, seed=42, informative=8)
+    names = [f"f{i}" for i in range(28)] + ["label"]
+    params = dict(ntrees=10, max_depth=6, learn_rate=0.1,
+                  histogram_type="UniformAdaptive")
+
+    def wall(legacy, reps):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _fit_gbm(legacy, X, y, names, **params)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # best-of-2 BOTH ways: each path's rep 1 absorbs its own trace/compile,
+    # so the floor compares warm kernel against warm kernel
+    w_new = wall(False, 2)
+    w_old = wall(True, 2)
+    assert w_old / w_new >= 1.5, \
+        f"fused {w_new:.2f}s vs legacy {w_old:.2f}s — floor 1.5x missed"
