@@ -721,6 +721,12 @@ def bench_serving():
     # the warm-path pin, in the artifact: a steady-state serving window
     # must not trace a single new program
     new_traces = xla1["traces"] - xla0["traces"]
+    # leak canary (ISSUE 8): per-decile RSS/ledger samples → growth slope;
+    # past the floor the record is TAGGED (soft fail — a leak verdict must
+    # not erase the latency measurement it rode along with)
+    growth = stats.get("mem_growth_bytes_per_min")
+    floor_mb = float(os.environ.get("BENCH_MEM_GROWTH_FLOOR_MB_MIN", 64))
+    exceeded = growth is not None and growth > floor_mb * 1e6
     return (f"serving_openloop_{int(rate)}rps_p99_ms", p99,
             {"unit_override": "ms",
              "rate_rps": rate, "duration_s": duration,
@@ -730,7 +736,11 @@ def bench_serving():
              "achieved_rps": stats["achieved_rps"],
              "drain_s": stats["drain_s"],
              "p50_ms": stats["p50_ms"], "p95_ms": stats["p95_ms"],
-             "steady_state_new_traces": new_traces})
+             "steady_state_new_traces": new_traces,
+             "mem_growth_bytes_per_min": growth,
+             "ledger_growth_bytes_per_min":
+                 stats.get("ledger_growth_bytes_per_min"),
+             "mem_growth_exceeded": True if exceeded else None})
 
 
 def bench_automl():
@@ -851,6 +861,31 @@ def _observability_embed() -> dict:
         return {}
 
 
+def _memory_embed() -> dict:
+    """Memory trajectory every emitted record carries (ISSUE 8): process
+    peak RSS, the ledger's device high watermark, and the top-3 owners
+    captured at the combined peak — a memory regression is attributable
+    from the BENCH_*.json alone, like the phase/XLA embeds."""
+    out = {}
+    try:
+        import resource
+
+        out["peak_rss_bytes"] = int(resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss) * 1024   # Linux: KB
+    except Exception:
+        pass
+    try:
+        from h2o3_tpu.runtime import memory_ledger as _ml
+
+        wm = _ml.peak()
+        out["peak_device_bytes"] = int(wm["device_bytes"])
+        out["peak_ledger_bytes"] = int(wm["total_bytes"])
+        out["peak_owners"] = wm["top_owners"]
+    except Exception:
+        pass
+    return out
+
+
 def _fail_line(config: str, why: str) -> dict:
     line = {"metric": f"{config}_unavailable", "value": 0.0, "unit": "s",
             "vs_baseline": 0.0, "error": why, "backend": None}
@@ -865,6 +900,9 @@ def _fail_line(config: str, why: str) -> dict:
             line["phases"] = ph
     except Exception:
         pass
+    mem = _memory_embed()
+    if mem:
+        line["memory"] = mem
     return line
 
 
@@ -928,6 +966,9 @@ def _build_result(runs, snaps, xlas, partial: bool = False) -> dict:
     totals = _observability_embed()
     if totals:
         result["xla_process_totals"] = totals
+    mem = _memory_embed()
+    if mem:
+        result["memory"] = mem
     result.update({k: v for k, v in extra.items() if v is not None})
     return result
 

@@ -134,6 +134,59 @@ def _registry_hist():
         bounds=reg.LATENCY_MS_BOUNDS, labelnames=("mode",))
 
 
+def _read_rss_bytes() -> Optional[int]:
+    """Current resident set size — stdlib-only (/proc on Linux, ru_maxrss
+    peak as the portable fallback). None when neither is readable."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            return None
+
+
+def _ledger_total_bytes() -> Optional[int]:
+    """Ledger-attributed host+device bytes — ONLY when the platform is
+    already loaded in this process (same stance as _registry_hist: the
+    standalone CLI must stay stdlib-only). Uses the ledger's rate-limited
+    cached pass, NOT a forced walk: the sample runs in the open-loop
+    dispatch thread, and a deep accounting pass there would perturb the
+    arrival schedule whose p99 this run exists to measure."""
+    if "h2o3_tpu" not in sys.modules:
+        return None
+    try:
+        from h2o3_tpu.runtime import memory_ledger as ml
+
+        t = ml.refresh()["totals"]
+        return int(t["host_bytes"]) + int(t["device_bytes"])
+    except Exception:
+        return None
+
+
+def _growth_bytes_per_min(samples: List[Dict],
+                          field: str) -> Optional[float]:
+    """Least-squares slope of `field` over the sampled run, in bytes per
+    minute — the leak-canary verdict. None below two usable samples."""
+    pts = [(s["t_s"], s[field]) for s in samples
+           if s.get(field) is not None]
+    if len(pts) < 2 or pts[-1][0] - pts[0][0] <= 0:
+        return None
+    n = len(pts)
+    mt = sum(t for t, _ in pts) / n
+    mv = sum(v for _, v in pts) / n
+    denom = sum((t - mt) ** 2 for t, _ in pts)
+    if denom <= 0:
+        return None
+    slope = sum((t - mt) * (v - mv) for t, v in pts) / denom   # bytes/s
+    return round(slope * 60.0, 1)
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     if not sorted_vals:
         return float("nan")
@@ -221,7 +274,14 @@ def run_load_open(host: str, port: int, model: str, frame: str,
     (LATENCY_MS_BOUNDS — the same bounds the serving histograms use), so
     they are directly comparable with `GET /3/Metrics` and with every
     other loadgen/bench report; `hist_*` fields carry the raw bucket
-    vector for the bench JSON."""
+    vector for the bench JSON.
+
+    Leak canary (sustained mode): RSS + memory-ledger totals are sampled
+    once per decile of the arrival schedule, and the report carries the
+    least-squares growth slopes (`mem_growth_bytes_per_min`,
+    `ledger_growth_bytes_per_min`) — a sustained run whose memory climbs
+    is a leak verdict even when every request succeeded. RSS sampling is
+    stdlib-only; the ledger column stays None in the standalone CLI."""
     if rate <= 0:
         raise ValueError(f"open-loop rate must be > 0 req/s (got {rate})")
     url = _predict_url(host, port, model, frame)
@@ -260,8 +320,18 @@ def run_load_open(host: str, port: int, model: str, frame: str,
             with lock:
                 inflight[0] -= 1
 
+    mem_samples: List[Dict] = []
+    sample_every = max(n_arrivals // 10, 1)
+
+    def _sample_mem(t0: float) -> None:
+        mem_samples.append(dict(t_s=round(time.monotonic() - t0, 3),
+                                rss_bytes=_read_rss_bytes(),
+                                ledger_bytes=_ledger_total_bytes()))
+
     t0 = time.monotonic()
     for i in range(n_arrivals):
+        if i % sample_every == 0:
+            _sample_mem(t0)
         target = t0 + i / rate
         delay = target - time.monotonic()
         if delay > 0:
@@ -283,6 +353,7 @@ def run_load_open(host: str, port: int, model: str, frame: str,
     for t in live:
         t.join(timeout=max(deadline - time.monotonic(), 0.0))
     drain = max(time.monotonic() - t0 - wall, 0.0)
+    _sample_mem(t0)   # final sample after the drain closes the series
     summary = hist.summary()
     offered = n_arrivals
     return dict(
@@ -300,6 +371,11 @@ def run_load_open(host: str, port: int, model: str, frame: str,
                 if summary["p99"] is not None else None),
         mean_ms=summary["mean"], max_ms=summary["max"],
         hist_bounds_ms=summary["bounds"], hist_counts=summary["counts"],
+        mem_samples=mem_samples,
+        mem_growth_bytes_per_min=_growth_bytes_per_min(mem_samples,
+                                                       "rss_bytes"),
+        ledger_growth_bytes_per_min=_growth_bytes_per_min(mem_samples,
+                                                          "ledger_bytes"),
     )
 
 

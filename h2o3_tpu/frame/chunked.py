@@ -341,7 +341,27 @@ def tokenize_data(
     vectorized python tokenizer per chunk. Returns (columns, info) where
     columns are object token arrays (or float64 on the native path) and
     info = {n_chunks, n_threads, native}.
+
+    The payload is accounted to the memory ledger (`ingest:` owner) for
+    the duration of the tokenize, so a parse burst shows up in
+    `GET /3/Memory` / the pressure signal while the buffers are live.
     """
+    from ..runtime.memory_ledger import ingest_buffer
+
+    with ingest_buffer(len(data)):
+        return _tokenize_data_impl(data, sep, header, ncol, nthreads,
+                                   chunk_bytes, use_native)
+
+
+def _tokenize_data_impl(
+    data: bytes,
+    sep: str,
+    header: bool,
+    ncol: int,
+    nthreads: Optional[int],
+    chunk_bytes: Optional[int],
+    use_native: bool,
+) -> Tuple[List[np.ndarray], dict]:
     nthreads = nthreads if nthreads is not None else default_nthreads()
     chunks = plan_chunks(data, chunk_bytes)
     info = dict(n_chunks=len(chunks), n_threads=min(nthreads,

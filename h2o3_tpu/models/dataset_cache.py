@@ -42,6 +42,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..runtime import memory_ledger as _memory
+
 _LOCK = threading.RLock()
 _ENTRIES: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _STATS = dict(matrix_hits=0, matrix_misses=0, bins_hits=0, bins_misses=0,
@@ -65,7 +67,7 @@ def _caps() -> Tuple[int, int]:
 
 class _Entry:
     __slots__ = ("frame_ref", "key", "matrix", "bins", "device", "lock",
-                 "__weakref__")
+                 "owner_base", "__weakref__")
 
     def __init__(self, frame, key):
         self.frame_ref = weakref.ref(frame, lambda _: _drop(key))
@@ -74,6 +76,7 @@ class _Entry:
         self.bins: Dict[tuple, object] = {}     # bkey -> BinnedMatrix
         self.device: Dict[tuple, object] = {}   # (bkey, npad) -> jax array
         self.lock = threading.Lock()            # serializes builds per entry
+        self.owner_base = ""                    # memory-ledger owner prefix
 
     def nbytes(self) -> int:
         total = 0
@@ -86,9 +89,56 @@ class _Entry:
         return total
 
 
+_LAYERS = ("matrix", "bins", "device")
+
+
+def _register_ledger(e: "_Entry", frame) -> None:
+    """Memory-ledger owners for one cache entry: `dataset_cache:<fp>:<layer>`
+    per layer, byte callbacks through a weakref (the ledger must never pin
+    an evicted entry alive), referent = the owning frame."""
+    from ..runtime import memory_ledger as ml
+
+    e.owner_base = f"dataset_cache:{ml.fingerprint(e.key)}"
+    wr = weakref.ref(e)
+
+    def _layer_fn(layer):
+        def _bytes():
+            ent = wr()
+            if ent is None:
+                return (0, 0)
+            return ml.measure(getattr(ent, layer))
+        return _bytes
+
+    for layer in _LAYERS:
+        ml.register(f"{e.owner_base}:{layer}", kind="dataset_cache",
+                    bytes_fn=_layer_fn(layer), referent=frame,
+                    type_name=layer)
+
+
+def _release_entry(e: "_Entry", trigger: str) -> None:
+    """Unregister an entry's ledger owners + emit ONE eviction event with
+    the bytes actually freed and why (cap/pressure/weakref/clear) — cache
+    thrash becomes visible in /3/Timeline and /3/Trace instead of silent."""
+    if not e.owner_base:
+        return
+    from ..runtime import memory_ledger as ml
+
+    try:
+        freed = e.nbytes()
+    except Exception:
+        freed = 0
+    ml.record_event("evict", e.owner_base, freed, trigger=trigger,
+                    kind="dataset_cache",
+                    space="device" if e.device else "host")
+    for layer in _LAYERS:
+        ml.unregister(f"{e.owner_base}:{layer}")
+
+
 def _drop(key) -> None:
     with _LOCK:
-        _ENTRIES.pop(key, None)
+        e = _ENTRIES.pop(key, None)
+    if e is not None:
+        _release_entry(e, "weakref")
 
 
 def _frame_key(frame, x: Tuple[str, ...]) -> tuple:
@@ -107,23 +157,43 @@ def _entry_for(frame, x: Tuple[str, ...]) -> "_Entry":
             _ENTRIES.move_to_end(key)
             return e
         e = _ENTRIES[key] = _Entry(frame, key)
+        _register_ledger(e, frame)
         _evict_locked(keep=key)
         return e
 
 
+def _pop_entry_locked(key, trigger: str) -> None:
+    e = _ENTRIES.pop(key, None)
+    if e is None:
+        return
+    _STATS["evictions"] += 1
+    _release_entry(e, trigger)
+
+
 def _evict_locked(keep=None) -> None:
-    """LRU-evict entries other than `keep` until both caps are met."""
+    """LRU-evict entries other than `keep` until both caps are met, then
+    keep shedding while the memory ledger reports pressure above
+    `H2O3_MEM_EVICT_PRESSURE` (the byte-side twin of admission shedding)."""
     # Iterate snapshots: _LOCK is reentrant, so a frame's weakref death
     # callback (_drop) triggered by GC mid-iteration in THIS thread can pop
     # from _ENTRIES even while we hold the lock.
     max_entries, max_bytes = _caps()
     victims = [k for k in list(_ENTRIES) if k != keep]
     while victims and len(_ENTRIES) > max_entries:
-        _ENTRIES.pop(victims.pop(0), None)
-        _STATS["evictions"] += 1
+        _pop_entry_locked(victims.pop(0), "cap")
     while victims and sum(e.nbytes() for e in list(_ENTRIES.values())) > max_bytes:
-        _ENTRIES.pop(victims.pop(0), None)
-        _STATS["evictions"] += 1
+        _pop_entry_locked(victims.pop(0), "cap")
+    if victims:
+        from ..runtime import memory_ledger as ml
+
+        # ONE cached pressure read decides (pressure is RSS/HBM-budget
+        # dominated — it cannot drop mid-loop just because entries were
+        # unregistered, so re-reading per victim would only burn a full
+        # accounting pass under _LOCK per pop): past the threshold, shed
+        # every LRU victim, oldest first
+        if ml.pressure() >= ml.evict_threshold():
+            while victims:
+                _pop_entry_locked(victims.pop(0), "pressure")
 
 
 def _bins_key(nbins: int, histogram_type: str, seed) -> tuple:
@@ -149,6 +219,9 @@ def matrix(frame, x, builder: Callable[[], tuple]):
         # is always entry.lock → _LOCK, never reversed)
         with _LOCK:
             e.matrix = built
+        _memory.record_event("alloc", f"{e.owner_base}:matrix",
+                             int(built[0].nbytes), trigger="miss",
+                             kind="dataset_cache")
     with _LOCK:
         _evict_locked(keep=e.key)
     return e.matrix
@@ -170,6 +243,9 @@ def bins(frame, x, nbins: int, histogram_type: str, seed,
         bm = builder()
         with _LOCK:   # see matrix(): publish vs nbytes()/snapshot() races
             e.bins[bkey] = bm
+        _memory.record_event("alloc", f"{e.owner_base}:bins",
+                             int(bm.codes.nbytes), trigger="miss",
+                             kind="dataset_cache")
     with _LOCK:
         _evict_locked(keep=e.key)
     return bm
@@ -198,6 +274,10 @@ def device_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
         arr = builder()
         with _LOCK:   # see matrix(): publish vs nbytes()/snapshot() races
             e.device[dkey] = arr
+        _memory.record_event(
+            "alloc", f"{e.owner_base}:device",
+            int(np.prod(arr.shape)) * arr.dtype.itemsize,
+            trigger="miss", kind="dataset_cache", space="device")
     with _LOCK:
         _evict_locked(keep=e.key)
     return arr
@@ -215,7 +295,10 @@ def snapshot() -> Dict:
 def clear() -> None:
     """Drop every entry (tests / explicit memory release)."""
     with _LOCK:
+        doomed = list(_ENTRIES.values())
         _ENTRIES.clear()
+    for e in doomed:
+        _release_entry(e, "clear")
 
 
 def reset_stats() -> None:

@@ -62,6 +62,7 @@ INGEST_SCHEMA_NAME = "IngestMetricsV3"
 MUNGE_SCHEMA_NAME = "MungeMetricsV3"
 TRAINING_SCHEMA_NAME = "TrainingMetricsV3"
 OBSERVABILITY_SCHEMA_NAME = "ObservabilityV3"
+MEMORY_SCHEMA_NAME = "MemoryV3"
 
 # the per-subsystem JSON metrics endpoints whose counter fields must be
 # backed by central-registry metrics (metrics_registry.bind_rest_field);
@@ -71,6 +72,7 @@ METRICS_ENDPOINTS = {
     "ingest": "/3/Ingest/metrics",
     "munge": "/3/Munge/metrics",
     "training": "/3/Training/metrics",
+    "memory": "/3/Memory",
 }
 
 
@@ -98,6 +100,52 @@ def observability_schema() -> Dict:
     ]
     return dict(
         name=OBSERVABILITY_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )
+
+
+def memory_schema() -> Dict:
+    """Field metadata of the `GET /3/Memory` document (the memory
+    ledger's observability schema — docs/observability.md "Memory
+    accounting" mirrors this)."""
+    fields = [
+        ("totals", "MemoryTotals",
+         "ledger-attributed bytes: host_bytes, device_bytes,"
+         " leaked_bytes (dead owners whose buffers persist + DKV keys a"
+         " failed job left behind), unaccounted_device_bytes (device"
+         " probe minus attributed — the reconciliation remainder),"
+         " owner_count"),
+        ("owners", "list<OwnerBytes>",
+         "per-owner breakdown (owner id, kind, host/device bytes, dead"
+         " flag), largest first; owner ids follow the taxonomy"
+         " dkv:<key> / dataset_cache:<fp>:<layer> / scorer:<model>:<kind>"
+         " / ingest:<what>"),
+        ("by_kind", "map<owner_kind, KindBytes>",
+         "host/device bytes + owner count aggregated per owner kind"
+         " (frame, model, dkv, dataset_cache, scorer, ingest) — the same"
+         " aggregation scraped as h2o3_memory_bytes{owner_kind,space}"),
+        ("watermarks", "MemoryWatermarks",
+         "high watermark of host/device/total attributed bytes plus the"
+         " top-3 owners captured at the combined peak (the bench-record"
+         " memory embed reads this)"),
+        ("pressure", "MemoryPressure",
+         "pressure in [0,1]: max(host bytes vs H2O3_MEM_BUDGET_MB or"
+         " MemTotal, device bytes vs device capacity); serving admission"
+         " sheds at H2O3_SERVING_SHED_PRESSURE, dataset_cache evicts at"
+         " H2O3_MEM_EVICT_PRESSURE, crossings of"
+         " H2O3_MEM_PRESSURE_THRESHOLD are traced"),
+        ("device", "DeviceProbe",
+         "what the runtime actually holds: per-device memory_stats()"
+         " where the backend reports them, else a live-buffer census"
+         " (CPU fallback); the unattributed delta is reported as"
+         " owner_kind=unaccounted — never silently absorbed"),
+        ("leaks", "list<LeakReport>",
+         "live leak report: owners whose referent died but whose buffers"
+         " persist, and FAILED/CANCELLED jobs whose dest key still holds"
+         " a model/frame; entries clear when the bytes are released"),
+    ]
+    return dict(
+        name=MEMORY_SCHEMA_NAME,
         fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
     )
 

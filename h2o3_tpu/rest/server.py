@@ -151,6 +151,7 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Logs(?:/download)?$", "logs"),
         ("GET", r"^/3/Timeline$", "timeline"),
         ("GET", r"^/3/Metrics$", "metrics"),
+        ("GET", r"^/3/Memory$", "memory"),
         ("GET", r"^/3/Trace$", "trace"),
         ("GET", r"^/3/Profiler$", "profiler"),
         ("GET", r"^/3/Metadata/schemas$", "metadata_schemas"),
@@ -629,6 +630,12 @@ class _Handler(BaseHTTPRequestHandler):
                 Log.err(f"train {algo}: {e}")
                 job.status = "FAILED"
                 job.warnings.append(str(e))
+            finally:
+                # leak canary: a FAILED/CANCELLED job that left its dest
+                # model in the DKV surfaces in /3/Memory's leak report
+                from ..runtime import memory_ledger
+
+                memory_ledger.job_end(job.result or job.dest, job.status)
 
         threading.Thread(target=run, daemon=True).start()
         self._send(dict(job=dict(key=dict(name=job.dest), status=job.status)))
@@ -1076,6 +1083,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_raw(registry.prometheus_text().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
 
+    def h_memory(self):
+        """`GET /3/Memory[?schema=1]` — the memory ledger: per-owner
+        host/device bytes, by-kind totals, high watermarks + top owners at
+        peak, leak report, pressure vs budget, and the device probe with
+        the ledger-vs-runtime reconciliation (`unaccounted`). The same
+        numbers scrape as `h2o3_memory_*` at GET /3/Metrics and fold into
+        /3/Profiler."""
+        from ..runtime import memory_ledger
+
+        if self._flag(self._params(), "schema"):
+            self._send(schemas.memory_schema())
+            return
+        self._send(dict(__meta=dict(schema_type=schemas.MEMORY_SCHEMA_NAME),
+                        **memory_ledger.snapshot()))
+
     def h_trace(self):
         """`GET /3/Trace[?trace_id=]` — recorded spans as Chrome-trace/
         Perfetto JSON (load at ui.perfetto.dev). Without trace_id, the
@@ -1097,11 +1119,13 @@ class _Handler(BaseHTTPRequestHandler):
                         tree=profiler.tree_stats(),
                         xla=profiler.xla_stats(),
                         tracing=profiler.tracing_stats(),
+                        memory=profiler.memory_stats(),
                         metrics=profiler.registry_stats()))
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()
-                        + [schemas.observability_schema()]))
+                        + [schemas.observability_schema(),
+                           schemas.memory_schema()]))
 
     # -- uploads (PostFileHandler) ------------------------------------------
     def h_post_file(self):

@@ -11,7 +11,31 @@ key namespace and lifecycle (`put/get/remove`, leak checks in tests) match.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Dict, List, Optional
+
+
+def _owner_kind(value) -> str:
+    """Ledger owner kind for a DKV value — frames and models are first-
+    class in the `h2o3_memory_bytes{owner_kind,...}` breakdown; everything
+    else (jobs, grids, sweeps) aggregates under `dkv`."""
+    if getattr(value, "_vecs", None) is not None:
+        return "frame"
+    try:
+        from ..models.model_base import H2OModel
+
+        if isinstance(value, H2OModel):
+            return "model"
+    except Exception:
+        pass
+    try:
+        from ..mojo import MojoScorer
+
+        if isinstance(value, MojoScorer):
+            return "model"
+    except Exception:
+        pass
+    return "dkv"
 
 
 class DKV:
@@ -22,6 +46,24 @@ class DKV:
     def put(cls, key: str, value) -> None:
         with cls._lock:
             cls._store[key] = value
+        # ledger registration OUTSIDE the store lock: the ledger's refresh
+        # pass calls byte callbacks that may take cls._lock (scorer-cache
+        # owners call DKV.get), so put must never hold it while entering
+        # the ledger
+        from . import memory_ledger as ml
+
+        try:
+            wr = weakref.ref(value)
+        except TypeError:
+            wr = None
+
+        def _bytes(_wr=wr, _v=(value if wr is None else None)):
+            v = _wr() if _wr is not None else _v
+            return ml.measure(v) if v is not None else (0, 0)
+
+        ml.register(f"dkv:{key}", kind=_owner_kind(value), bytes_fn=_bytes,
+                    referent=(value if wr is not None else None),
+                    type_name=type(value).__name__)
 
     @classmethod
     def get(cls, key: str):
@@ -31,7 +73,11 @@ class DKV:
     @classmethod
     def remove(cls, key: str) -> None:
         with cls._lock:
-            cls._store.pop(key, None)
+            existed = cls._store.pop(key, None) is not None
+        if existed:
+            from . import memory_ledger as ml
+
+            ml.unregister(f"dkv:{key}", event="free", trigger="remove")
 
     @classmethod
     def keys(cls, kind: Optional[type] = None) -> List[str]:
@@ -44,57 +90,26 @@ class DKV:
     def clear(cls) -> None:
         with cls._lock:
             cls._store.clear()
+        from . import memory_ledger as ml
+
+        ml.unregister_prefix("dkv:")
 
     # -- size accounting (water.Cleaner / MemoryManager's bookkeeping role) -
     @staticmethod
     def _nbytes(value) -> int:
-        """Approximate host+device footprint of one entry."""
-        import numpy as np
+        """Approximate host+device footprint of one entry — the ledger's
+        `measure()` deep sizer, so device-resident JAX arrays and nested
+        Frame/Vec buffers count instead of reporting ~0."""
+        from . import memory_ledger as ml
 
-        seen = 0
-        vecs = getattr(value, "_vecs", None)
-        if isinstance(vecs, dict):              # Frame
-            for v in vecs.values():
-                data = getattr(v, "data", None)
-                if data is not None:
-                    seen += int(np.asarray(data).nbytes)
-                strs = getattr(v, "_strings", None)
-                if strs is not None and len(strs):
-                    # sampled estimate — a per-element Python loop would make
-                    # /3/Cloud O(total string cells)
-                    import itertools
-
-                    sample = list(itertools.islice(
-                        (s for s in strs if s is not None), 256))
-                    avg = (sum(len(str(s)) for s in sample) / len(sample)
-                           if sample else 0.0)
-                    seen += int(avg * len(strs))
-            return seen
-        pd = getattr(value, "_packed_dev", None)  # tree model, HBM pack
-        if pd is not None:
-            from ..models.shared_tree import pack_nbytes
-
-            seen += pack_nbytes(pd)
-        forest = value.__dict__.get("_forest") if hasattr(value, "__dict__") else None
-        if forest:
-            for stacked in forest:
-                for f in stacked:
-                    seen += int(np.asarray(f).nbytes)
-        return seen
+        h, d = ml.measure(value)
+        return h + d
 
     @classmethod
     def stats(cls) -> Dict:
-        """Entry counts + approximate bytes per kind — the store-level
-        accounting `water.Cleaner` keeps for its eviction decisions."""
-        with cls._lock:
-            items = list(cls._store.items())
-        out: Dict[str, Dict] = {}
-        total = 0
-        for k, v in items:
-            kind = type(v).__name__
-            b = cls._nbytes(v)
-            d = out.setdefault(kind, {"count": 0, "bytes": 0})
-            d["count"] += 1
-            d["bytes"] += b
-            total += b
-        return {"entries": len(items), "total_bytes": total, "by_kind": out}
+        """Entry counts + approximate bytes per kind — delegated to the
+        memory ledger's `dkv:` owners so the store-level accounting and
+        `GET /3/Memory` can never disagree."""
+        from . import memory_ledger as ml
+
+        return ml.dkv_stats()

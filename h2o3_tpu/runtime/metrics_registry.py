@@ -46,7 +46,7 @@ from . import env_float, env_int
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "get", "names", "snapshot", "prometheus_text", "bind_rest_field",
-           "rest_bindings", "LATENCY_MS_BOUNDS"]
+           "rest_bindings", "register_collect_hook", "LATENCY_MS_BOUNDS"]
 
 # shared fixed latency buckets (ms): serving, loadgen and REST request
 # histograms all bin into the same bounds so percentiles are comparable
@@ -459,10 +459,37 @@ def rest_bindings() -> Dict[str, Dict[str, str]]:
         return {k: dict(v) for k, v in _REST_BINDINGS.items()}
 
 
+# pull-model gauges: hooks run at the top of every scrape/snapshot so
+# subsystems that account state on demand (the memory ledger) refresh
+# their set-gauges no staler than one scrape — the Prometheus custom-
+# collector stance without per-family collector plumbing
+_COLLECT_HOOKS: List[Callable[[], None]] = []
+
+
+def register_collect_hook(fn: Callable[[], None]) -> None:
+    """Run `fn` before every prometheus_text()/snapshot() read (idempotent
+    by identity). Hooks must be cheap or self-rate-limited; a raising hook
+    is skipped, never fails the scrape."""
+    with _LOCK:
+        if fn not in _COLLECT_HOOKS:
+            _COLLECT_HOOKS.append(fn)
+
+
+def _run_collect_hooks() -> None:
+    with _LOCK:
+        hooks = list(_COLLECT_HOOKS)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass
+
+
 def snapshot() -> Dict:
     """JSON view of every family (the /3/Profiler `metrics` fold): value
     per child for counters/gauges, summary for histograms, plus 60s
     windowed rates where a time series exists."""
+    _run_collect_hooks()
     with _LOCK:
         metrics = dict(_METRICS)
     out: Dict[str, Dict] = {}
@@ -494,6 +521,7 @@ def prometheus_text() -> str:
     pair; label-less counters that never fired still expose a 0 sample so
     dashboards can alert on absence-of-traffic rather than absence-of-
     metric."""
+    _run_collect_hooks()
     with _LOCK:
         metrics = dict(_METRICS)
     lines: List[str] = []

@@ -128,6 +128,20 @@ def xla_stats() -> Dict:
     return out
 
 
+def memory_stats() -> Dict:
+    """Memory-ledger fold (ISSUE 8): per-owner host/device bytes, by-kind
+    totals, watermarks, pressure vs budget, leak report, and the device
+    probe reconciliation — the same document GET /3/Memory serves, but
+    from the rate-limited cached pass (force=False): a dashboard polling
+    /3/Profiler never pays more than one accounting walk per
+    H2O3_MEM_REFRESH_S interval."""
+    from . import memory_ledger
+
+    out = memory_ledger.snapshot(force=False)
+    out["active"] = out["totals"]["owner_count"] > 0
+    return out
+
+
 def registry_stats() -> Dict:
     """The central metrics registry's JSON view (counters/gauges/histogram
     summaries + windowed rates) — the /3/Profiler fold of the same store

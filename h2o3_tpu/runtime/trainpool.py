@@ -252,11 +252,19 @@ def _cleanup_partial(job) -> int:
     from ..models.model_base import H2OModel
     from .dkv import DKV
 
+    from . import memory_ledger
+
     removed = 0
     for k in {getattr(job, "result", None), getattr(job, "dest", None)}:
         if k and isinstance(DKV.get(k), H2OModel):
             DKV.remove(k)
             removed += 1
+        if k:
+            # leak canary: job_end no-ops when the key is gone (the normal
+            # case right after the remove above); if a future path leaves
+            # a partial model behind, it surfaces in the memory ledger's
+            # leak report instead of silently leaking into h2o.ls
+            memory_ledger.job_end(k, "FAILED")
     return removed
 
 

@@ -82,6 +82,7 @@ class ScoringEngine:
             max_wait_ms=self.config.max_wait_ms,
             max_queue=self.config.max_queue,
             model_inflight=self.config.model_inflight,
+            shed_pressure=self.config.shed_pressure,
             cache_capacity=self.config.cache_capacity,
             breaker_reset_s=self.config.breaker_reset_s,
             cpu_fallback=self.config.cpu_fallback,

@@ -40,6 +40,21 @@ class AdmissionController:
     def admit(self, model_key: str) -> None:
         """Claim a slot or raise RejectedError. Pair with release()."""
         cfg = self.config
+        # byte-side backpressure: past the ledger's shed threshold the
+        # host/device budget is nearly exhausted — shedding at the door
+        # (cheap cached read between refresh intervals) beats OOMing the
+        # process mid-batch. Same 429 + Retry-After contract as the
+        # queue bounds.
+        if cfg.shed_pressure > 0:
+            from ..runtime import memory_ledger
+
+            pr = memory_ledger.pressure()
+            if pr >= cfg.shed_pressure:
+                self.metrics.record_rejection(model_key)
+                raise RejectedError(
+                    f"memory pressure {pr:.2f} >= shed threshold "
+                    f"{cfg.shed_pressure:.2f}; retry later",
+                    cfg.retry_after_s)
         with self._lock:
             if self._total >= cfg.max_queue:
                 self.metrics.record_rejection(model_key)

@@ -32,6 +32,8 @@ class ServingConfig:
     max_queue: int = 256           # global queued+in-flight request bound
     model_inflight: int = 64       # per-model admitted request bound
     retry_after_s: float = 1.0     # Retry-After hint on 429
+    shed_pressure: float = 0.97    # memory-ledger pressure at which new
+    #                                requests shed with 429 (0 disables)
 
     # -- compiled-scorer cache (serving/model_cache.py) --------------------
     cache_capacity: int = 32       # LRU entries (model × output_kind)
@@ -52,6 +54,7 @@ class ServingConfig:
             max_queue=_env_int("H2O3_SERVING_MAX_QUEUE", 256),
             model_inflight=_env_int("H2O3_SERVING_MODEL_INFLIGHT", 64),
             retry_after_s=_env_float("H2O3_SERVING_RETRY_AFTER_S", 1.0),
+            shed_pressure=_env_float("H2O3_SERVING_SHED_PRESSURE", 0.97),
             cache_capacity=_env_int("H2O3_SERVING_CACHE_CAPACITY", 32),
             breaker_reset_s=_env_float("H2O3_SERVING_BREAKER_RESET_S", 30.0),
             cpu_fallback=os.environ.get(

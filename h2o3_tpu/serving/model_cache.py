@@ -29,10 +29,13 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+
+from ..runtime import memory_ledger as _memory
 
 # sub-SCORE_ROW_BUCKET buckets: REST predict traffic is dominated by small
 # frames (single rows to a few hundred); padding a 3-row request straight to
@@ -136,6 +139,33 @@ class ScorerCache:
         nf, dtype = scoring_signature(model)
         return (model_key, nf, dtype, output_kind)
 
+    @staticmethod
+    def _owner(key: Tuple) -> str:
+        return f"scorer:{key[0]}:{key[3]}"
+
+    @staticmethod
+    def _register_ledger(key: Tuple, entry: "CompiledScorer") -> None:
+        """Memory-ledger owner for one cache entry. The bytes attributed
+        are the wrapped model's — but ONLY while the scorer is what pins
+        it (the model no longer lives in the DKV under its key); while the
+        DKV holds the same object, the `dkv:` owner accounts it and the
+        scorer reports 0 instead of double-counting."""
+        wr = weakref.ref(entry)
+
+        def _bytes():
+            e = wr()
+            if e is None:
+                return (0, 0)
+            from ..runtime.dkv import DKV
+
+            if DKV.get(e.model_key) is e.model:
+                return (0, 0)
+            return _memory.measure(e.model)
+
+        _memory.register(ScorerCache._owner(key), kind="scorer",
+                         bytes_fn=_bytes, referent=entry,
+                         type_name=type(entry.model).__name__)
+
     def get_or_build(self, model_key: str, model,
                      output_kind: str = "predict"
                      ) -> Tuple[CompiledScorer, bool]:
@@ -156,22 +186,28 @@ class ScorerCache:
             self._entries[key] = entry
             self._entries.move_to_end(key)
             self.misses += 1
+            self._register_ledger(key, entry)
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                old_key, _old = self._entries.popitem(last=False)
                 self.evictions += 1
+                _memory.unregister(self._owner(old_key), event="evict",
+                                   trigger="cap")
             return entry, False
 
     def invalidate(self, model_key: Optional[str] = None) -> int:
         """Drop entries for one model key (or all). Returns drop count."""
         with self._lock:
             if model_key is None:
-                n = len(self._entries)
+                doomed = list(self._entries)
                 self._entries.clear()
-                return n
-            doomed = [k for k in self._entries if k[0] == model_key]
-            for k in doomed:
-                del self._entries[k]
-            return len(doomed)
+            else:
+                doomed = [k for k in self._entries if k[0] == model_key]
+                for k in doomed:
+                    del self._entries[k]
+        for k in doomed:
+            _memory.unregister(self._owner(k), event="evict",
+                               trigger="invalidate")
+        return len(doomed)
 
     def __len__(self) -> int:
         with self._lock:

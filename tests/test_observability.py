@@ -399,7 +399,7 @@ def _register_all_subsystems():
     lazily on first record; the scrape/consistency checks need the
     declarations, not traffic)."""
     from h2o3_tpu.frame import ingest_stats, munge_stats
-    from h2o3_tpu.runtime import faults, retry, trainpool
+    from h2o3_tpu.runtime import faults, memory_ledger, retry, trainpool
     from h2o3_tpu.serving import metrics as serving_metrics
 
     serving_metrics._registry()
@@ -408,6 +408,7 @@ def _register_all_subsystems():
     trainpool._registry()
     retry._reg_counter()
     faults._fired_counter(registry)
+    memory_ledger._registry()
 
 
 def test_rest_metrics_prometheus_endpoint(obs_server, cloud1):
