@@ -33,6 +33,34 @@ os.environ.setdefault("H2O3_PHASE_ACCOUNTING", "1")
 import numpy as np
 
 
+def _note_devices() -> int:
+    """Record the device count the training path actually spans — the
+    `n_devices` bench axis (ISSUE 12): 1 on a lone chip or under the
+    H2O3_TREE_SHARD=0 escape hatch, N on a mesh running sharded fits.
+    Comparing a `higgs_gbm` line across rounds without this axis conflates
+    chip speed with scale-out. Called from the bench fns (main thread,
+    backend known-good) and CACHED so `_n_devices` readers — notably the
+    watchdog thread escaping a HUNG backend — never call into jax, whose
+    backend-init lock may be held by the stuck main thread."""
+    try:
+        import jax
+
+        nd = (1 if os.environ.get("H2O3_TREE_SHARD", "").strip() == "0"
+              else int(jax.device_count()))
+    except Exception:
+        nd = 1
+    _RUN_STATE["n_devices"] = nd
+    return nd
+
+
+def _n_devices() -> int:
+    """The cached device count (`_note_devices`); 1 before any bench fn
+    has observed the backend. NEVER initializes or queries jax — safe
+    from the watchdog thread while the main thread hangs in the
+    backend."""
+    return int(_RUN_STATE.get("n_devices") or 1)
+
+
 def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 0):
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
@@ -74,6 +102,7 @@ def bench_gbm():
     updates = n_rows * X.shape[1] * max_depth * ntrees
     return (f"higgs_gbm_{n_rows//1000}k_{ntrees}trees_wall_s", wall,
             {"auc": round(float(gbm.auc()), 5),
+             "n_devices": _note_devices(),
              "hist_updates_per_s": round(updates / comp),
              "hist_stream_gbps": round(updates / comp / 1e9, 3)})
 
@@ -128,6 +157,7 @@ def bench_gbm_cpu():
     _phz_mod.reset()
     return (f"gbm_cpu_{n_rows//1000}k_{ntrees}trees_wall_s", wall_new,
             {"auc": round(auc, 5),
+             "n_devices": _note_devices(),
              "seed_wall_s": round(wall_seed, 3),
              "vs_seed": round(wall_seed / wall_new, 2),
              "phases": fused_phases or None})
@@ -433,7 +463,10 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", {nd})
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 sys.path.insert(0, {repo!r})
 from h2o3_tpu.frame.binning import build_bins
 from h2o3_tpu.models import tree as treelib
@@ -887,8 +920,16 @@ def _memory_embed() -> dict:
 
 
 def _fail_line(config: str, why: str) -> dict:
+    nd = _n_devices()
+    if nd > 1:
+        # a multi-device rep that never completes is indistinguishable
+        # from a hung collective (one participant never reached the
+        # rendezvous) — name the suspect so the record is diagnosable
+        why += (f" [n_devices={nd}: possible hung collective — "
+                "H2O3_TREE_SHARD=0 forces the single-device path]")
     line = {"metric": f"{config}_unavailable", "value": 0.0, "unit": "s",
-            "vs_baseline": 0.0, "error": why, "backend": None}
+            "vs_baseline": 0.0, "error": why, "backend": None,
+            "n_devices": nd}
     xla = _observability_embed()
     if xla:
         line["xla"] = xla
@@ -986,6 +1027,15 @@ def _cpu_rerun(config: str, deadline: float) -> "dict | None":
     if budget < 60.0:
         return None     # not enough runway for a meaningful CPU datapoint
     env = dict(os.environ, BENCH_PLATFORM="cpu", BENCH_REPEATS="1")
+    # cpu-fallback lines must stay comparable ACROSS rounds: force the
+    # rerun onto ONE device (strip any virtual-device-count flag and pin
+    # the sharded tree path off) so its n_devices axis is always 1 —
+    # a fallback that silently inherited an 8-virtual-device XLA_FLAGS
+    # would measure collective overhead, not the kernel trajectory
+    env["XLA_FLAGS"] = " ".join(
+        t for t in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in t)
+    env["H2O3_TREE_SHARD"] = "0"
     if "BENCH_ROWS" not in os.environ:
         fallback_rows = {"gbm": 100_000, "glm": 100_000,
                          "xgb_rank": 50_000, "dl": 20_000,
@@ -1040,9 +1090,15 @@ def main():
                 snaps = [ph for _r, ph, _x in _DONE_RUNS]
                 xlas = [x for _r, _ph, x in _DONE_RUNS]
                 line = _build_result(runs, snaps, xlas, partial=True)
-                line["error"] = (f"watchdog fired at {watchdog_s:.0f}s "
-                                 f"with {len(runs)} completed rep(s); "
-                                 "later reps abandoned")
+                err = (f"watchdog fired at {watchdog_s:.0f}s "
+                       f"with {len(runs)} completed rep(s); "
+                       "later reps abandoned")
+                nd = _n_devices()
+                if nd > 1:
+                    # a hung COLLECTIVE rep is tagged exactly like any
+                    # other hung rep: best completed measurement, partial
+                    err += (f" [n_devices={nd}: possible hung collective]")
+                line["error"] = err
                 _emit(line)
             else:
                 _emit(_fail_line(config,

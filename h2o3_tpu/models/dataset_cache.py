@@ -12,10 +12,11 @@ This module is the sweep-level analog: a fingerprinted three-layer cache
 
 - **matrix**: key(frame, x) → (X float64, is_categorical, domains)
 - **bins**: + (nbins, histogram_type[, seed for Random]) → `BinnedMatrix`
-- **device**: + (npad rows) → the device-resident unpacked code matrix,
-  so repeat candidates skip the pack + tunnel upload entirely
-  (single-process, single-device clouds only — sharded global arrays are
-  rebuilt per fit)
+- **device**: + (npad rows, pack mode, shard layout) → the device-resident
+  packed code matrix, so repeat candidates skip the pack + upload
+  entirely. On a single-process multi-device cloud the artifact is the
+  row-sharded jax.Array itself (per-shard placement reused across the
+  sweep, ISSUE 12); only multi-PROCESS global arrays are rebuilt per fit.
 
 Fingerprint: frame identity (id + DKV key + a weakref guard), row count,
 the frame's in-place mutation counter (`Frame._touch` bumps it), the x
@@ -252,17 +253,21 @@ def bins(frame, x, nbins: int, histogram_type: str, seed,
 
 
 def device_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
-                 builder: Callable[[], object], pack_bits: int = 0):
+                 builder: Callable[[], object], pack_bits: int = 0,
+                 n_devices: int = 1):
     """Device-resident (padded) code matrix — cached so repeat candidates
     skip the pack + H2D upload. With `pack_bits` > 0 the cached artifact
     is the `ops.packing` packed word matrix (2-4× smaller resident HBM,
-    ISSUE 7); the packing mode is part of the key so packed and
-    full-width consumers (e.g. a legacy-flag comparator run) never share
-    an entry. Single-device clouds only (the caller gates); `builder`
-    does the pack/upload and its own byte accounting on a miss."""
+    ISSUE 7); with `n_devices` > 1 it is the ROW-SHARDED jax.Array over
+    the 1-D hosts mesh (ISSUE 12) — each shard resident on its chip,
+    padded to the mesh multiple by the caller. The packing mode and the
+    shard layout are part of the key, so packed vs full-width consumers
+    (e.g. a legacy-flag comparator run) and 1-device vs N-shard consumers
+    never share an entry. `builder` does the pack/upload/placement and
+    its own byte accounting on a miss."""
     e = _entry_for(frame, tuple(x))
     dkey = (_bins_key(nbins, histogram_type, seed), int(npad),
-            int(pack_bits))
+            int(pack_bits), int(n_devices))
     with e.lock:
         arr = e.device.get(dkey)
         if arr is not None:

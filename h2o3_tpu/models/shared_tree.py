@@ -31,9 +31,11 @@ class _Phase:
     """Env-gated phase timer (H2O3_PROFILE=1) — the `water.util.Timer`
     per-stage logging analog for the training driver."""
 
+    _SUBTRACT_KEYS = _phases_acct.COMPILE_KEYS + ("collective",)
+
     def __init__(self):
         self.t = time.time()
-        self._comp0 = _phases_acct.totals(_phases_acct.COMPILE_KEYS)
+        self._comp0 = _phases_acct.totals(self._SUBTRACT_KEYS)
 
     def mark(self, name, sync=None):
         """Record a phase boundary into /3/Timeline (always); under
@@ -61,9 +63,11 @@ class _Phase:
         Timeline.record("train_phase", name, secs=round(now - self.t, 4),
                         synced=synced)
         # compile/trace time inside this interval is already accounted by
-        # the monitoring listener; subtract it so cold-run compute buckets
-        # hold execution time, not compilation
-        comp = _phases.totals(_phases.COMPILE_KEYS)
+        # the monitoring listener, and collective-fence waits by
+        # mesh.collective_fence — subtract both so the compute bucket
+        # holds execution time, not compilation or merge waits (the phase
+        # split must sum to ≤ wall, never double-count)
+        comp = _phases.totals(self._SUBTRACT_KEYS)
         _phases.add_mark(name, max(now - self.t - (comp - self._comp0), 0.0))
         self._comp0 = comp
         self.t = now
@@ -119,6 +123,30 @@ def _binom_binned_stats(margins, y_d, n, nbins: int = 400):
     return qs, npos, nneg, nll, sq
 
 
+def _event_loss_terms(margins, y_d, valid, inv_ntrees, mode: str,
+                      problem: str, dist: str):
+    """Per-row (loss·mask, mask) terms of the scoring-event mean loss —
+    the ONE source of the event math, shared by the historical whole-array
+    reduction (`_event_loss_device`) and the sharded blocked reduction
+    (`_sharded_event_loss_fn`) so the two can never diverge. Clips use
+    1e-7 (the f64 path's 1e-15 rounds to exactly 0/1 in f32, which would
+    turn a saturated probability into an inf logloss)."""
+    vf = valid.astype(jnp.float32)
+    probs = _margins_to_preds(mode, problem, dist, margins, inv_ntrees, jnp)
+    eps = 1e-7
+    if problem == "binomial":
+        pc = jnp.clip(probs[:, 1], eps, 1 - eps)
+        y = y_d[:, 0]
+        nll = -jnp.where(y > 0.5, jnp.log(pc), jnp.log1p(-pc))
+        return nll * vf, vf
+    if problem == "multinomial":
+        pc = jnp.clip(probs, eps, 1.0)
+        nll = -jnp.sum(jnp.log(pc) * y_d, axis=1)
+        return nll * vf, vf
+    sq = (probs[:, 0] - y_d[:, 0]) ** 2
+    return sq * vf, vf
+
+
 @functools.partial(jax.jit, static_argnames=("mode", "problem", "dist"))
 def _event_loss_device(margins, y_d, valid, inv_ntrees, mode: str,
                        problem: str, dist: str):
@@ -128,24 +156,53 @@ def _event_loss_device(margins, y_d, valid, inv_ntrees, mode: str,
     source model.predict uses); `inv_ntrees` is traced so every event of a
     fit reuses ONE compiled program. On a multi-process cloud the inputs
     are global sharded arrays, so the mean comes back global and
-    replicated — no separate host collective needed. Clips use 1e-7 (the
-    f64 path's 1e-15 rounds to exactly 0/1 in f32, which would turn a
-    saturated probability into an inf logloss)."""
-    vf = valid.astype(jnp.float32)
-    cnt = jnp.maximum(jnp.sum(vf), 1e-12)
-    probs = _margins_to_preds(mode, problem, dist, margins, inv_ntrees, jnp)
-    eps = 1e-7
-    if problem == "binomial":
-        pc = jnp.clip(probs[:, 1], eps, 1 - eps)
-        y = y_d[:, 0]
-        nll = -jnp.where(y > 0.5, jnp.log(pc), jnp.log1p(-pc))
-        return jnp.sum(nll * vf) / cnt
-    if problem == "multinomial":
-        pc = jnp.clip(probs, eps, 1.0)
-        nll = -jnp.sum(jnp.log(pc) * y_d, axis=1)
-        return jnp.sum(nll * vf) / cnt
-    sq = (probs[:, 0] - y_d[:, 0]) ** 2
-    return jnp.sum(sq * vf) / cnt
+    replicated — no separate host collective needed."""
+    num, den = _event_loss_terms(margins, y_d, valid, inv_ntrees, mode,
+                                 problem, dist)
+    return jnp.sum(num) / jnp.maximum(jnp.sum(den), 1e-12)
+
+
+def _sharded_event_loss_fn(cloud, shard_mode: str, n_shards: int,
+                           mode: str, problem: str, dist: str):
+    """Deterministic scoring-event loss for sharded fits: per-block partial
+    sums + `ordered_axis_fold`, mirroring the histogram merge, so the
+    early-stopping decisions an N-device fit makes are bit-identical to
+    the 1-device forced-shard lane's (a last-ulp loss difference at the
+    stopping tolerance boundary would otherwise diverge the tree COUNT,
+    not just the bits). Cached on the cloud like the step programs."""
+    from ..ops.histogram import ordered_axis_fold
+
+    local_blocks = (n_shards // cloud.size if shard_mode == "mesh"
+                    else n_shards)
+    axis = (cloudlib.ROWS_AXIS
+            if shard_mode == "mesh" and cloud.size > 1 else None)
+    key_ = ("event", local_blocks, axis, mode, problem, dist)
+    with _STEP_FNS_LOCK:
+        cache = cloud.__dict__.setdefault("_event_fns_cache", {})
+        fn = cache.get(key_)
+        if fn is not None:
+            return fn
+
+    def inner(margins, y_d, valid, inv_ntrees):
+        num, den = _event_loss_terms(margins, y_d, valid, inv_ntrees,
+                                     mode, problem, dist)
+        rows = num.shape[0] // local_blocks
+        parts = jnp.stack([
+            jnp.stack([jnp.sum(num[b * rows:(b + 1) * rows]),
+                       jnp.sum(den[b * rows:(b + 1) * rows])])
+            for b in range(local_blocks)])
+        tot = ordered_axis_fold(parts, axis)          # (2,) replicated
+        return tot[0] / jnp.maximum(tot[1], 1e-12)
+
+    if axis is not None:
+        rspec = P(cloudlib.ROWS_AXIS)
+        inner = cloudlib.shard_call(
+            inner, cloud, in_specs=(rspec, rspec, rspec, P()),
+            out_specs=P(), check_rep=False)
+    fn = jax.jit(inner)
+    with _STEP_FNS_LOCK:
+        cloud.__dict__.setdefault("_event_fns_cache", {})[key_] = fn
+    return fn
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -345,6 +402,19 @@ class _StepCfg(NamedTuple):
     compact_cap: int = 0             # deep-level active-node compaction
     pack_bits: int = 0               # device-RESIDENT sub-byte code packing
     fused_split: bool = False        # single-pass split search (ISSUE 7)
+    # sharded end-to-end training (ISSUE 12):
+    #   "off"       — single-device semantics (also the H2O3_TREE_SHARD=0
+    #                 escape hatch on a mesh: data stays on one device)
+    #   "mesh"      — shard_map over the 1-D hosts mesh, blocked
+    #                 deterministic histogram merge (all_gather + ordered
+    #                 fold), rows sharded over devices
+    #   "blocks"    — the SAME blocked reduction on one device, no mesh
+    #                 (H2O3_TREE_SHARD=1: the forced-CPU lane that is
+    #                 bit-identical to any mesh fit sharing n_shards)
+    #   "mesh_psum" — the pre-ISSUE-12 shard_map + psum path, kept for the
+    #                 legacy comparator, lossguide, and multi-process fits
+    shard_mode: str = "off"
+    n_shards: int = 0                # canonical total block count (S)
 
 
 def _pack_hp(tp, lr, colp, mtries_rate=0.0) -> "jnp.ndarray":
@@ -393,6 +463,47 @@ def tree_legacy() -> bool:
     blocking chunk-boundary scoring — as the bit-exactness comparator
     (same pattern as the ingest/munge/train legacy flags)."""
     return os.environ.get("H2O3_TREE_LEGACY", "") == "1"
+
+
+def _shard_plan(ndev: int, multiproc: bool, tp) -> tuple:
+    """(shard_mode, n_shards) for one fit — the ONE place the ISSUE 12
+    sharding decision is made (the warm-up thread and the training path
+    must agree or they would warm different programs).
+
+    Default: a multi-device single-process cloud runs the deterministic
+    sharded path ("mesh"); one device runs unsharded ("off").
+    ``H2O3_TREE_SHARD=0`` is the escape hatch (never shard — a broken mesh
+    still trains, on one device); ``H2O3_TREE_SHARD=1`` forces the blocked
+    reduction structure on a single device ("blocks") — the forced-CPU
+    lane whose fits are bit-identical to mesh fits.
+
+    n_shards (S) is the canonical block count: every row reduction runs as
+    S ordered block partials regardless of how many devices they live on,
+    so any two fits sharing S agree bitwise. S defaults to
+    ``H2O3_TREE_SHARD_BLOCKS`` (8), raised to lcm(S, ndev) so each device
+    holds a whole number of blocks — fits on 1/2/4/8 devices all share
+    S=8 and are mutually bit-stable.
+
+    Legacy comparator, lossguide growth and multi-process clouds keep the
+    pre-ISSUE-12 shard_map + psum path ("mesh_psum"). The escape hatch
+    overrides legacy/lossguide too (a broken mesh must not run THEIR
+    collectives either); only multi-process clouds ignore it — their data
+    lives on other processes, so "train on one device" is not available."""
+    import math
+
+    env = os.environ.get("H2O3_TREE_SHARD", "").strip()
+    if multiproc:
+        return ("mesh_psum" if ndev > 1 else "off"), 0
+    if env == "0":
+        return "off", 0
+    if tree_legacy() or tp.get("grow_policy", "depthwise") == "lossguide":
+        return ("mesh_psum" if ndev > 1 else "off"), 0
+    base = max(int(os.environ.get("H2O3_TREE_SHARD_BLOCKS", "8") or 8), 1)
+    if ndev > 1:
+        return "mesh", base * ndev // math.gcd(base, ndev)
+    if env == "1":
+        return "blocks", base
+    return "off", 0
 
 
 def _bucket_rows(npad: int) -> int:
@@ -469,9 +580,11 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
             lg_kwargs = dict(max_depth=cfg.max_depth, nbins=cfg.nbins,
                              max_leaves=cfg.max_leaves,
                              hist_method=cfg.hist_method)
-            if cloud.size > 1:
-                from jax import shard_map
-
+            # consult the shard PLAN, not just the cloud size: under the
+            # H2O3_TREE_SHARD=0 escape hatch the data is unsharded and
+            # padded for one device — running collectives anyway would
+            # defeat the hatch (and reject non-dividing npads)
+            if cloud.size > 1 and cfg.shard_mode == "mesh_psum":
                 rspec = P(cloudlib.ROWS_AXIS)
 
                 def inner_lg(codes, g, h, w, fm, edges, mono, hp, key):
@@ -483,14 +596,19 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                         axis_name=cloudlib.ROWS_AXIS, **lg_kwargs,
                     )
 
-                fn = shard_map(
-                    inner_lg, mesh=cloud.mesh,
+                fn = cloudlib.shard_call(
+                    inner_lg, cloud,
                     in_specs=(rspec, rspec, rspec, rspec, P(), P(), P(),
                               P(), P()),
                     out_specs=(
                         treelib.Tree(P(), P(), P(), P(), P()), rspec,
                         P(), P(),
                     ),
+                    # older jax's replication checker rejects the
+                    # fori_loop frontier carry (psum'd values re-entering
+                    # the loop); the outputs ARE replicated — newer jax
+                    # infers it, 0.4.x needs the check off
+                    check_rep=False,
                 )
                 return fn(codes, g, h, w, fm, edges, mono, hp, key)
             return treelib.build_tree_lossguide(
@@ -503,9 +621,20 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                       compact_cap=cfg.compact_cap,
                       pack_bits=cfg.pack_bits,
                       fused_split=cfg.fused_split)
-        if cloud.size > 1:
-            from jax import shard_map
-
+        use_mesh = cloud.size > 1 and cfg.shard_mode in ("mesh", "mesh_psum")
+        if use_mesh or cfg.shard_mode == "blocks":
+            # ISSUE 12: the sharded tree step. ONE inner function serves
+            # both lanes (the t5x-style cpu-fallback contract, SNIPPETS.md
+            # [1] via mesh.shard_call): on the mesh it runs under shard_map
+            # with rows sharded and S/ndev local blocks per device; on one
+            # device ("blocks") the identical body runs under plain jit
+            # with all S blocks local — bit-identical by the ordered-fold
+            # construction in ops/histogram.
+            local_blocks = (cfg.n_shards // cloud.size
+                            if cfg.shard_mode == "mesh" else
+                            cfg.n_shards if cfg.shard_mode == "blocks"
+                            else 0)
+            axis = cloudlib.ROWS_AXIS if use_mesh else None
             rspec = P(cloudlib.ROWS_AXIS)
 
             def inner(codes, g, h, w, fm, edges, mono, hp, key):
@@ -518,20 +647,25 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                     codes, g, h, w, fm, edges, key=key,
                     min_rows=hp[0], min_split_improvement=hp[1],
                     reg_lambda=hp[2], reg_alpha=hp[3], max_abs_leaf=hp[7],
-                    axis_name=cloudlib.ROWS_AXIS, **kw,
+                    axis_name=axis, n_shard_blocks=local_blocks, **kw,
                 )
 
             out_specs = (
                 treelib.Tree(P(), P(), P(), P(), P()), rspec, P(), P(),
             )
             if cfg.compact_cap:
-                # overflow flag: derived from psum'd histograms, so it is
-                # identical (replicated) on every shard
+                # overflow flag: derived from the merged histograms, so it
+                # is identical (replicated) on every shard
                 out_specs = out_specs + (P(),)
-            fn = shard_map(
-                inner, mesh=cloud.mesh,
-                in_specs=(rspec, rspec, rspec, rspec, P(), P(), P(), P(), P()),
+            fn = cloudlib.shard_call(
+                inner, cloud,
+                in_specs=(rspec, rspec, rspec, rspec, P(), P(), P(), P(),
+                          P()),
                 out_specs=out_specs,
+                # the deterministic merge replicates via all_gather + fold,
+                # which shard_map cannot statically infer; the psum legacy
+                # path keeps the static check
+                check_rep=(cfg.shard_mode == "mesh_psum"),
             )
             return fn(codes, g, h, w, fm, edges, mono, hp, key)
         if cfg.has_monotone:
@@ -1161,14 +1295,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
         return mtries
 
     def _make_step_cfg(self, tp, npad, K, F, nbins, problem, dist,
-                       pack_bits: int = 0,
-                       single_dev: bool = True) -> _StepCfg:
+                       pack_bits: int = 0, shard_mode: str = "off",
+                       n_shards: int = 0) -> _StepCfg:
         """The structural step config, derivable before any device upload —
         built identically by the early warm-up thread and the training path
         so both hit the same cached program. `pack_bits` is the resident
-        code packing the caller resolved (0 = full-width); `single_dev`
-        gates the host-callback histogram default (it cannot run under a
-        collective program)."""
+        code packing the caller resolved (0 = full-width);
+        `shard_mode`/`n_shards` come from `_shard_plan` — the host-callback
+        histogram default is gated to the collective-free modes (a
+        pure_callback cannot run under a collective program; the mesh lane
+        keeps the in-graph scatter, which is pinned bit-exact with it)."""
+        host_ok = shard_mode in ("off", "blocks")
         mtries = self._resolved_mtries(tp, F, problem)
         colp = tp["col_sample_rate"] * tp["col_sample_rate_per_tree"]
         legacy = tree_legacy()
@@ -1188,7 +1325,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # they keep the cacheable segment program.
         hist_method = os.environ.get(
             "H2O3_HIST_METHOD", tp.get("hist_method", "auto"))
-        if (hist_method == "auto" and not legacy and single_dev
+        if (hist_method == "auto" and not legacy and host_ok
                 and jax.default_backend() == "cpu"
                 and npad >= int(os.environ.get(
                     "H2O3_HOST_HIST_MIN_ROWS", 32768))):
@@ -1210,6 +1347,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
             max_leaves=int(tp.get("max_leaves", 0)),
             pack_bits=int(pack_bits),
             fused_split=not legacy,
+            shard_mode=shard_mode,
+            n_shards=int(n_shards),
             # deep trees switch wide levels to active-node compaction
             # (measured: DRF depth-17 levels carry ~700 live nodes of 131k
             # heap cells). Off for monotone (needs per-node bounds) and
@@ -1499,22 +1638,31 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
         cloud = cloudlib.cloud()
         ndev = cloud.size
+        # ISSUE 12: the ONE sharding decision for this fit. `ndev_eff` is
+        # the device count the data will actually span — 1 under the
+        # H2O3_TREE_SHARD=0 escape hatch even on a mesh (everything lands
+        # on the default device, exactly the 1-device code path).
+        shard_mode, n_shards = _shard_plan(ndev, multiproc, tp)
+        ndev_eff = ndev if shard_mode in ("mesh", "mesh_psum") else 1
+        # every mesh shard AND every deterministic reduction block must be
+        # an equal, 8-row-aligned slice (pack groups divide 8)
+        row_mult = max(ndev_eff * 8, n_shards * 8, 8)
         if multiproc:
             quota = distdata.local_quota(n)
             npad = quota * jax.process_count()
             pad = quota - n          # LOCAL padding (zero-weight rows)
         else:
-            npad = cloudlib.pad_to_multiple(n, max(ndev * 8, 8))
+            npad = cloudlib.pad_to_multiple(n, row_mult)
             # row-count bucketing (the ntrees-bucketing trick, applied to
             # rows): CV folds and near-same-size frames land on a shared
             # padded shape, so they reuse ONE compiled tree program instead
             # of paying a compile-cache load each (~4-10 s through a remote
             # chip tunnel). ≤12.5% extra zero-weight rows — exact no-ops.
             # bucket values are (2^k/8)·{8..16} — divisible by any power-of-
-            # two shard count but not e.g. a 6-device mesh, so round back up
-            # to the mesh multiple to keep shard_map's equal-shard invariant
-            npad = cloudlib.pad_to_multiple(
-                _bucket_rows(npad), max(ndev * 8, 8))
+            # two shard count but not e.g. a 6-device mesh or the blocked
+            # reduction's S·8 grid, so round back up to the row multiple to
+            # keep shard_map's equal-shard (and equal-block) invariant
+            npad = cloudlib.pad_to_multiple(_bucket_rows(npad), row_mult)
             # CV fold fits inherit the parent fit's padded row count
             # (_npad_floor): the fold then reuses the parent's ALREADY-LOADED
             # executable instead of paying a second compile-cache load for
@@ -1523,7 +1671,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # active-node compaction made deep fold compute cheap, so one
             # shared program beats a second multi-second program load)
             floor = int(self._parms.get("_npad_floor") or 0)
-            if floor > npad and floor % max(ndev * 8, 8) == 0:
+            if floor > npad and floor % row_mult == 0:
                 npad = floor
             pad = npad - n
 
@@ -1551,7 +1699,6 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 and tp.get("grow_policy", "depthwise") != "lossguide"
                 and nbins <= 256):
             resident_bits = _pack_bits_for(nbins, npad)
-        single_dev = not multiproc and ndev == 1
 
         # ---- background program warm-up ----------------------------------
         # The first dispatch of the tree-step program pays trace + XLA
@@ -1569,7 +1716,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 and os.environ.get("H2O3_WARM_THREAD", "1") != "0":
             cfg_early = self._make_step_cfg(tp, npad, K, F, nbins, problem,
                                             dist, pack_bits=resident_bits,
-                                            single_dev=single_dev)
+                                            shard_mode=shard_mode,
+                                            n_shards=n_shards)
             # sweep-warm reuse: when this config's step program is already
             # built in-process (a CV fold after its parent, or a repeat
             # grid/AutoML candidate), the dummy warm execution is pure
@@ -1604,7 +1752,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         jax.random.PRNGKey(0),
                         np.int32(0),
                     ]
-                    if ndev > 1:
+                    if ndev_eff > 1:
                         # shard exactly the args the real call shards
                         # (mono/hp/key stay uncommitted there — committing
                         # them here would compile a different executable)
@@ -1625,7 +1773,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     # (VERDICT r03 #2 — warm ALL programs of a config, not
                     # just the first tree program)
                     if (problem == "binomial" and dist == "bernoulli"
-                            and self._mode == "gbm" and ndev == 1):
+                            and self._mode == "gbm" and ndev == 1
+                            and shard_mode == "off"):
                         _binom_binned_stats(
                             jnp.zeros((npad, K), jnp.float32),
                             jnp.zeros((npad, K), jnp.float32),
@@ -1663,13 +1812,29 @@ class H2OSharedTreeEstimator(H2OEstimator):
 
             def _build_codes_dev():
                 codes_p = padr(bm.codes)
+                rs_codes = (cloud.row_sharding() if ndev_eff > 1 else None)
                 if resident_bits:
                     # fused path: ship packed AND keep it packed in HBM —
                     # the resident matrix is 2-4× smaller and the tree
-                    # kernels consume the packed words directly
+                    # kernels consume the packed words directly. On a mesh
+                    # the artifact is ROW-SHARDED at build time, straight
+                    # from HOST memory (packed word groups align with the
+                    # 8-row shard grid): each chip receives only its
+                    # slice — staging the whole matrix on one device and
+                    # resharding would make per-chip HBM peak equal the
+                    # GLOBAL matrix, defeating the scale-out win.
                     packed = _pack_host(codes_p, resident_bits)
                     _phases_mod.add("h2d", 0.0, packed.nbytes)
+                    if rs_codes is not None:
+                        return jax.device_put(packed, rs_codes)
                     return jnp.asarray(packed)
+                if rs_codes is not None:
+                    # full-width sharded upload (rare: nbins>256 / dart /
+                    # checkpoint on a mesh): per-shard host→chip transfers;
+                    # the pack-for-transfer trick below targets the single
+                    # slow tunnel and would stage everything on one chip
+                    _phases_mod.add("h2d", 0.0, codes_p.nbytes)
+                    return jax.device_put(codes_p, rs_codes)
                 pack_bits = (_pack_bits_for(nbins, codes_p.shape[0])
                              if codes_p.dtype == np.uint8 else 0)
                 if pack_bits:
@@ -1682,15 +1847,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 _phases_mod.add("h2d", 0.0, codes_p.nbytes)
                 return jnp.asarray(codes_p)
 
-            if use_cache and ndev == 1:
+            if use_cache and (ndev_eff == 1 or shard_mode == "mesh"):
                 # sweep-level reuse: every candidate sharing this
                 # (frame, x, nbins, histogram) trains off ONE device-resident
                 # code matrix — the pack + tunnel upload happens once. The
-                # packing mode keys the cache entry: a packed and a
-                # full-width consumer never share an artifact.
+                # packing mode AND the shard layout key the cache entry: a
+                # packed and a full-width consumer (or a 1-device and an
+                # 8-shard consumer) never share an artifact.
                 codes_d = _dsc.device_codes(
                     train, x, nbins, tp["histogram_type"], seed, npad,
-                    builder=_build_codes_dev, pack_bits=resident_bits)
+                    builder=_build_codes_dev, pack_bits=resident_bits,
+                    n_devices=ndev_eff)
             else:
                 codes_d = _build_codes_dev()
             if yk.size and bool(np.all((yk >= 0) & (yk <= 255)
@@ -1713,7 +1880,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             _phases_mod.add("h2d", 0.0, edges.nbytes)
             edges_d = jnp.asarray(edges)
 
-            if ndev > 1:
+            if ndev_eff > 1:
                 rs = cloud.row_sharding()
                 codes_d = jax.device_put(codes_d, rs)
                 y_d = jax.device_put(y_d, rs)
@@ -1723,7 +1890,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             margins = jnp.broadcast_to(jnp.asarray(f0)[None, :], (npad, K)).astype(jnp.float32)
             if offset is not None:
                 margins = margins + jnp.asarray(padr(offset))[:, None]
-            if ndev > 1:
+            if ndev_eff > 1:
                 margins = jax.device_put(margins, cloud.row_sharding())
 
         # real-row mask for device-side event metrics (pads excluded); on a
@@ -1733,6 +1900,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 np.ones(n, np.float32), quota, cloud)
         else:
             row_mask_d = (jnp.arange(npad) < n).astype(jnp.float32)
+            if ndev_eff > 1:
+                row_mask_d = jax.device_put(row_mask_d, cloud.row_sharding())
 
         # checkpoint= continue-training: restore the prior forest and fast-
         # forward margins (SharedTree checkpoint restart — `_parms.checkpoint`
@@ -1803,7 +1972,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                         margins, jnp.int32(k), tp["max_depth"])
                 if offset is not None:
                     margins = margins + jnp.asarray(padr(offset))[:, None]
-                if ndev > 1:
+                if ndev_eff > 1:
                     codes_d = jax.device_put(codes_d, cloud.row_sharding())
                     edges_d = jax.device_put(edges_d, cloud.replicated())
                     margins = jax.device_put(margins, cloud.row_sharding())
@@ -1900,7 +2069,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         mono_vec = getattr(self, "_monotone_vec", None)
         cfg = self._make_step_cfg(tp, npad, K, F, nbins, problem, dist,
                                   pack_bits=resident_bits,
-                                  single_dev=single_dev)
+                                  shard_mode=shard_mode, n_shards=n_shards)
         # per-fit kernel plan (ISSUE 7 satellite): resolve + record which
         # histogram kernel each level will actually run (method, pallas
         # row_chunk, VMEM-pressure fallbacks — logged once per fit) into
@@ -1916,7 +2085,28 @@ class H2OSharedTreeEstimator(H2OEstimator):
             f"{getattr(self, 'algo', self._mode)}:{K}x{tp['ntrees']}t"
             f"_d{cfg.max_depth}", plan_levels, nbins, cfg.hist_method,
             pack_bits=cfg.pack_bits,
-            axis_name=cloudlib.ROWS_AXIS if ndev > 1 else None)
+            axis_name=cloudlib.ROWS_AXIS if ndev_eff > 1 else None,
+            n_shards=cfg.n_shards, n_devices=ndev_eff)
+        # fit trace span: a dashboard reading /3/Trace sees how many chips
+        # (and reduction blocks) this fit actually spanned
+        try:
+            from ..runtime import tracing as _tracing
+
+            _sp = _tracing.current()
+            if _sp is not None:
+                _sp.annotate(n_devices=ndev_eff, n_shards=cfg.n_shards,
+                             pack_bits=cfg.pack_bits,
+                             shard_mode=cfg.shard_mode)
+        except Exception:
+            pass
+        # sharded fits score through the blocked deterministic loss (the
+        # early-stop decision must be bit-stable across device counts);
+        # unsharded fits keep the historical whole-array reduction
+        loss_fn = None
+        if cfg.shard_mode in ("mesh", "blocks"):
+            loss_fn = _sharded_event_loss_fn(
+                cloud, cfg.shard_mode, cfg.n_shards, self._mode, problem,
+                dist)
         if warm_thread is not None:
             warm_thread.join()
         _tree_jit, _single_jit = _tree_step_fns(cfg, cloud)
@@ -1956,7 +2146,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # stays EAGER: a jitted multi-arg combine has been observed to
             # interleave with in-flight collective tree programs on the
             # XLA:CPU thunk pool and deadlock the all-reduce rendezvous.
-            if distdata.multiprocess() or ndev == 1:
+            if distdata.multiprocess() or ndev_eff == 1:
                 # single REAL device has no collective programs in flight,
                 # so the jitted combine is safe there too — and it turns
                 # ~2·nsteps eager dispatches per chunk (each paying the
@@ -2006,7 +2196,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         else:
             rate_d = jnp.full(npad, np.float32(tp["sample_rate"]))
         row_sampled = tp["sample_rate"] < 1.0 or bool(srpc)
-        if ndev > 1 and not multiproc:
+        if ndev_eff > 1 and not multiproc:
             rate_d = jax.device_put(rate_d, cloud.row_sharding())
         # DRF OOB accumulators (out-of-bag prediction sums / counts per row)
         if self._mode == "drf":
@@ -2018,7 +2208,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
             else:
                 oob_sum = jnp.zeros((npad, K), jnp.float32)
                 oob_cnt = jnp.zeros(npad, jnp.float32)
-                if ndev > 1:
+                if ndev_eff > 1:
                     oob_sum = jax.device_put(oob_sum, cloud.row_sharding())
                     oob_cnt = jax.device_put(oob_cnt, cloud.row_sharding())
         elif multiproc:
@@ -2267,7 +2457,8 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     # ENQUEUE the device loss program(s) now; block later
                     fin = self._score_event_async(
                         problem, dist, margins, y_d, w_d, n,
-                        built + n_prior, row_mask=row_mask_d)
+                        built + n_prior, row_mask=row_mask_d,
+                        loss_fn=loss_fn)
                 vfin = None
                 if valid_state is not None:
                     vfin = self._score_event_async(
@@ -2445,8 +2636,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # training metrics straight from the final margins (already on device)
         # instead of a fresh forest re-predict — saves transfers + a compile
         _ph.mark("forest_unpack")
+        # sharded fits take the host metrics path: the binned-AUC reduction
+        # is a whole-array scatter whose sharded lowering is not bit-stable
+        # across device counts, and the margins D2H is local on a CPU mesh
         device_auc = (not multiproc and problem == "binomial"
-                      and dist == "bernoulli" and self._mode == "gbm")
+                      and dist == "bernoulli" and self._mode == "gbm"
+                      and cfg.shard_mode not in ("mesh", "blocks"))
         if device_auc:
             # binomial GBM/XGB: the whole training-metric reduction runs on
             # device (AUC2 binned design) — no margin D2H, no host rank sort
@@ -2585,7 +2780,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         return "logloss" if problem in ("binomial", "multinomial") else "deviance"
 
     def _score_event_async(self, problem, dist, margins, y_d, w_d, n,
-                           ntrees, row_mask=None):
+                           ntrees, row_mask=None, loss_fn=None):
         """Dispatch a scoring-history event and return a FINALIZER.
 
         Device path: the loss-reduction program is enqueued immediately
@@ -2594,11 +2789,25 @@ class H2OSharedTreeEstimator(H2OEstimator):
         chunk m+1's tree programs between dispatch and finalize, so the
         device crunches the next chunk while the host waits on chunk m's
         metric and runs the early-stopping decision. Host paths compute
-        eagerly and return a constant finalizer."""
+        eagerly and return a constant finalizer.
+
+        `loss_fn` (sharded fits) is the blocked deterministic loss program
+        (`_sharded_event_loss_fn`) replacing the whole-array reduction. It
+        is the only loss program containing collectives, so it alone is
+        fenced after dispatch (at most one collective executable in flight
+        on a CPU mesh; no-op elsewhere) — the collective-free events
+        (validation frames, the escape hatch) stay fully async so the
+        overlapped speculative chunk keeps the device busy behind them."""
         if row_mask is not None and not isinstance(margins, np.ndarray):
-            val_dev = _event_loss_device(
-                margins, y_d, row_mask, jnp.float32(1.0 / max(ntrees, 1)),
-                self._mode, problem, dist)
+            if loss_fn is not None:
+                val_dev = loss_fn(margins, y_d, row_mask,
+                                  jnp.float32(1.0 / max(ntrees, 1)))
+                cloudlib.collective_fence(val_dev)
+            else:
+                val_dev = _event_loss_device(
+                    margins, y_d, row_mask,
+                    jnp.float32(1.0 / max(ntrees, 1)),
+                    self._mode, problem, dist)
 
             def _fin() -> Dict:
                 val = float(val_dev)

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import packing
-from ..ops.histogram import build_histograms
+from ..ops.histogram import build_histograms, ordered_axis_fold
 
 
 class Tree(NamedTuple):
@@ -110,6 +110,41 @@ def _row_feature_value(codes: jax.Array, rf: jax.Array) -> jax.Array:
             codes, rf[:, None].astype(jnp.int32), axis=1)[:, 0].astype(jnp.int32)
     feat_oh = rf[:, None] == jnp.arange(F, dtype=jnp.int32)[None, :]
     return jnp.where(feat_oh, codes.astype(jnp.int32), 0).sum(axis=1)
+
+
+def _leaf_totals(ids, vals3, nseg: int, axis_name, n_shard_blocks: int,
+                 onehot_ok: bool):
+    """Exact per-cell {Σw, Σg·w, Σh·w} totals of the final tree level —
+    (nseg, 3). With ``n_shard_blocks`` the accumulation runs per contiguous
+    row block and folds deterministically (`ordered_axis_fold`), so leaf
+    values are bit-stable across device counts; otherwise the historical
+    single-pass + psum formulation is preserved bit-for-bit.
+
+    `onehot_ok` selects the small-heap MXU one-hot matmul (Precision.
+    HIGHEST — the TPU default would truncate the per-leaf g/h sums to
+    bf16); the selection depends only on nseg, so every block (and every
+    device count) runs the same kernel."""
+    use_oh = onehot_ok and nseg <= 2 * _ONEHOT_LOOKUP_MAX
+
+    def one(ids_b, vals_b):
+        if use_oh:
+            oh = (ids_b[:, None] == jnp.arange(nseg, dtype=jnp.int32)[None, :]
+                  ).astype(jnp.float32)
+            return jnp.dot(vals_b, oh, preferred_element_type=jnp.float32,
+                           precision=jax.lax.Precision.HIGHEST).T
+        return jax.ops.segment_sum(vals_b.T, ids_b, num_segments=nseg)
+
+    if n_shard_blocks > 0:
+        n = ids.shape[0]
+        rows = n // n_shard_blocks
+        parts = [one(ids[b * rows:(b + 1) * rows],
+                     vals3[:, b * rows:(b + 1) * rows])
+                 for b in range(n_shard_blocks)]
+        return ordered_axis_fold(jnp.stack(parts), axis_name)
+    tot = one(ids, vals3)
+    if axis_name is not None:
+        tot = jax.lax.psum(tot, axis_name)
+    return tot
 
 
 def _fused_level_best(hist, node_ok, feat_mask, keep, nbins: int, min_rows,
@@ -206,7 +241,7 @@ def value_at(table: jax.Array, idx: jax.Array) -> jax.Array:
     jax.jit,
     static_argnames=(
         "max_depth", "nbins", "hist_method", "axis_name", "mtries",
-        "compact_cap", "pack_bits", "fused_split",
+        "compact_cap", "pack_bits", "fused_split", "n_shard_blocks",
     ),
 )
 def build_tree(
@@ -236,6 +271,7 @@ def build_tree(
     compact_cap: int = 0,
     pack_bits: int = 0,
     fused_split: bool = False,
+    n_shard_blocks: int = 0,
 ):
     """Build one tree; returns (Tree, final_leaf_heap_idx (N,),
     gain_per_feature (F,), cover (T,) — Σ training row weights per heap node,
@@ -272,6 +308,15 @@ def build_tree(
     single-pass scan-argmax (`_fused_level_best`, bit-exact with the
     legacy flat argmax); False keeps the seed formulation — the
     ``H2O3_TREE_LEGACY=1`` comparator.
+
+    n_shard_blocks > 0 (ISSUE 12) makes every row reduction (histograms
+    and final leaf totals) use the shard-invariant blocked fold of
+    `ops.histogram` — this call's rows are accumulated in that many
+    contiguous blocks whose partials merge in a fixed order, across
+    devices via `all_gather` when `axis_name` is set. An N-device
+    shard_map'd call with S/N local blocks is then bit-identical to a
+    1-device call with S blocks. 0 preserves the historical single-fold
+    (+ psum) formulation bit-for-bit.
     """
     if pack_bits:
         F = codes.shape[1]
@@ -312,6 +357,7 @@ def build_tree(
             hist = build_histograms(
                 codes, idx, g, h, w, L, nbins, method=hist_method,
                 axis_name=axis_name, pack_bits=pack_bits,
+                n_shard_blocks=n_shard_blocks,
             )  # (L, F, B, 3)
         else:
             # sibling subtraction (the gpu_hist/LightGBM trick): build only
@@ -321,7 +367,7 @@ def build_tree(
             hist_left = build_histograms(
                 codes, idx // 2, g, h, w * is_left.astype(w.dtype),
                 L // 2, nbins, method=hist_method, axis_name=axis_name,
-                pack_bits=pack_bits,
+                pack_bits=pack_bits, n_shard_blocks=n_shard_blocks,
             )  # (L/2, F, B, 3) indexed by parent
             hist_right = hist_prev - hist_left
             hist = jnp.stack([hist_left, hist_right], axis=1).reshape(
@@ -476,19 +522,11 @@ def build_tree(
         # reduction tree differs.
         Lf = 2 ** max_depth
         basef = Lf - 1
-        if Lf <= 2 * _ONEHOT_LOOKUP_MAX:
-            oh = (idx[:, None] == jnp.arange(Lf, dtype=jnp.int32)[None, :]
-                  ).astype(jnp.float32)
-            vals = jnp.stack([w, g * w, h * w])                  # (3, N)
-            # Precision.HIGHEST: TPU's default matmul truncates f32 operands
-            # to bf16, which would round the per-leaf g/h sums (leaf values)
-            tot = jnp.dot(vals, oh, preferred_element_type=jnp.float32,
-                          precision=jax.lax.Precision.HIGHEST).T
-        else:
-            vals = jnp.stack([w, g * w, h * w], axis=1)
-            tot = jax.ops.segment_sum(vals, idx, num_segments=Lf)  # (Lf, 3)
-        if axis_name is not None:
-            tot = jax.lax.psum(tot, axis_name)
+        # Precision.HIGHEST inside (small heaps): TPU's default matmul
+        # truncates f32 operands to bf16, which would round the per-leaf
+        # g/h sums (leaf values)
+        tot = _leaf_totals(idx, jnp.stack([w, g * w, h * w]), Lf,
+                           axis_name, n_shard_blocks, onehot_ok=True)
         gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
         leaf_val = (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)
         if max_abs_leaf is not None:
@@ -526,7 +564,7 @@ def build_tree(
     slot_hist = build_histograms(
         codes, row_slot, g, h, w * (row_slot < CAP).astype(w.dtype),
         CAP + 1, nbins, method=hist_method, axis_name=axis_name,
-        pack_bits=pack_bits)
+        pack_bits=pack_bits, n_shard_blocks=n_shard_blocks)
 
     pad_edges_c = jnp.concatenate(
         [edges.astype(jnp.float32), jnp.full((F, 1), jnp.inf, jnp.float32)],
@@ -636,7 +674,8 @@ def build_tree(
         wl = w * ((~go_right) & rs_do).astype(w.dtype)
         hl = build_histograms(codes, row_slot, g, h, wl, CAP + 1, nbins,
                               method=hist_method, axis_name=axis_name,
-                              pack_bits=pack_bits)
+                              pack_bits=pack_bits,
+                              n_shard_blocks=n_shard_blocks)
         prc = jnp.minimum(pr, CAP)
         hl_p = hl[prc]
         hp_p = slot_hist[prc]
@@ -650,10 +689,8 @@ def build_tree(
     # final level: exact per-slot totals (dead rows sit in the trash slot)
     basef = 2 ** max_depth - 1
     valid = (slot_node >= 0) & (slot_iota < CAP)
-    vals = jnp.stack([w, g * w, h * w], axis=1)
-    tot = jax.ops.segment_sum(vals, row_slot, num_segments=CAP + 1)
-    if axis_name is not None:
-        tot = jax.lax.psum(tot, axis_name)
+    tot = _leaf_totals(row_slot, jnp.stack([w, g * w, h * w]), CAP + 1,
+                       axis_name, n_shard_blocks, onehot_ok=False)
     gthr_f = jnp.sign(tot[:, 1]) * jnp.maximum(
         jnp.abs(tot[:, 1]) - reg_alpha, 0.0)
     leaf_val = (-gthr_f / (tot[:, 2] + reg_lambda + 1e-12)).astype(jnp.float32)

@@ -42,6 +42,20 @@ The cross-host combine (ScoreBuildHistogram2.reduce / Rabit allreduce) is a
 single `lax.psum` over the ``hosts`` mesh axis, applied by the caller inside
 `shard_map` — see `h2o3_tpu/models/tree.py`.
 
+Sharded determinism (ISSUE 12): with ``n_shard_blocks`` > 0 the rows are
+accumulated as per-block PARTIAL histograms (each block a contiguous,
+equal-sized row range) that are gathered into global block order
+(`lax.all_gather`, device-major == row order) and folded LEFT-TO-RIGHT —
+a fixed reduction tree independent of how many devices the blocks live
+on. An N-device fit and a 1-device fit configured with the same total
+block count therefore produce BIT-IDENTICAL histograms: each block
+partial is the same sequential in-order f32 fold over the same rows
+(`host` np.add.at and the XLA `segment` scatter are pinned bit-exact, so
+the mesh lane's in-graph scatter matches the forced-CPU lane's callback),
+and the cross-block fold order is pinned by the expression tree. This is
+what makes "8-device fit == 1-device fused fit" a bit-stability pin
+rather than an allclose hope.
+
 Kernel-selection observability (ISSUE 7): every dispatch records the chosen
 method (and the VMEM-pressure pallas→segment fallbacks) into the central
 metrics registry, and the tree driver records a per-fit level plan via
@@ -180,7 +194,8 @@ def _record_selection(sel: dict, vmem: bool = False) -> None:
 
 def record_fit_plan(tag: str, levels, nbins: int, hist_method: str,
                     pack_bits: int = 0, axis_name: Optional[str] = None,
-                    platform: Optional[str] = None) -> dict:
+                    platform: Optional[str] = None, n_shards: int = 0,
+                    n_devices: int = 1) -> dict:
     """Resolve + record the per-level kernel plan of one tree fit.
 
     `levels` is a sequence of (label, n_nodes) histogram passes the fit
@@ -201,6 +216,7 @@ def record_fit_plan(tag: str, levels, nbins: int, hist_method: str,
             fellback.append((label, int(n_nodes)))
     plan = dict(tag=tag, ts=_time.time(), nbins=int(nbins),
                 hist_method=hist_method, pack_bits=int(pack_bits),
+                n_shards=int(n_shards), n_devices=int(n_devices),
                 levels=plan_levels)
     if fellback:
         from ..runtime.log import Log
@@ -334,6 +350,65 @@ def _hist_host(codes, node_id, vals, n_nodes: int, nbins: int,
         codes, node_id, vals)
 
 
+def ordered_axis_fold(parts: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """Deterministic sum of per-block partials: gather the (local_blocks,
+    ...) stack into GLOBAL block order (`all_gather` is device-major, which
+    matches row order for contiguous row sharding) and fold left-to-right —
+    the association is pinned by the expression tree, so the result is
+    independent of how the blocks are distributed over devices. The
+    shard-invariant replacement for `lax.psum` on the deterministic tree
+    path (psum's reduction order is implementation-defined)."""
+    if axis_name is not None:
+        parts = jax.lax.all_gather(parts, axis_name, axis=0, tiled=False)
+        parts = parts.reshape((-1,) + parts.shape[2:])
+    acc = parts[0]
+    for i in range(1, parts.shape[0]):
+        acc = acc + parts[i]
+    return acc
+
+
+def _run_kernel(sel: dict, codes, node_id, vals, n_nodes: int, nbins: int,
+                pack_bits: int):
+    """One resolved kernel invocation over one contiguous row range."""
+    method = sel["method"]
+    if method == "host":
+        return _hist_host(codes, node_id, vals, n_nodes, nbins, pack_bits)
+    if pack_bits:
+        # in-graph consumers take dense codes: widen in-graph. The widen is
+        # a pure function of the loop-invariant packed input, so XLA
+        # computes it once per program execution and shares the buffer
+        # across every level's histogram pass; the RESIDENT matrix stays
+        # packed
+        codes = packing.unpack_device(codes, pack_bits)
+    if method == "onehot":
+        return _hist_onehot(codes, node_id, vals, n_nodes, nbins)
+    if method == "segment":
+        return _hist_segment(codes, node_id, vals, n_nodes, nbins)
+    if method == "pallas":
+        from . import hist_pallas
+
+        return hist_pallas.build_histograms_pallas(
+            codes, node_id, vals, n_nodes, nbins)
+    if method == "pallas_factored":
+        from . import hist_pallas
+
+        return hist_pallas.build_histograms_pallas_factored(
+            codes.T.astype(jnp.float32), node_id, vals, n_nodes, nbins,
+            row_chunk=sel["row_chunk"],
+        )
+    raise ValueError(f"unknown histogram method {method!r}")
+
+
+def _packed_row_slice(codes, r0: int, r1: int, pack_bits: int):
+    """Rows [r0, r1) of a (possibly packed) code matrix. Block boundaries
+    are multiples of 8 rows, so they always align with pack groups."""
+    if not pack_bits:
+        return codes[r0:r1]
+    group = packing.GROUP_ROWS[pack_bits]
+    gbytes = packing.GROUP_BYTES[pack_bits]
+    return codes[r0 // group * gbytes: r1 // group * gbytes]
+
+
 def build_histograms(
     codes: jax.Array,
     node_id: jax.Array,
@@ -345,49 +420,44 @@ def build_histograms(
     method: str = "auto",
     axis_name: Optional[str] = None,
     pack_bits: int = 0,
+    n_shard_blocks: int = 0,
 ) -> jax.Array:
     """Histogram of {Σw, Σg, Σh} per (tree-node, feature, bin).
 
     Rows with w==0 (padding, row-sampling dropouts, OOB) contribute nothing —
     g/h/w must already be masked by the caller. `axis_name` triggers the
-    cross-host psum (the MRTask.reduce step) when called under shard_map.
+    cross-host merge (the MRTask.reduce step) when called under shard_map.
 
     With ``pack_bits`` in {4, 5, 6}, `codes` is the `ops.packing` packed
     matrix; the host and pallas paths consume it directly (per-row-chunk
     unpack), other paths widen in-graph before accumulating.
+
+    ``n_shard_blocks`` > 0 switches to the shard-invariant blocked
+    reduction (see module docstring): this call's rows are split into that
+    many equal contiguous blocks, each accumulated independently by the
+    SAME kernel, and the partials fold deterministically across blocks and
+    (under `axis_name`) across devices. The caller guarantees rows divide
+    evenly (padded row counts are multiples of blocks·8).
     """
     vals = jnp.stack([w, g * w, h * w]).astype(jnp.float32)  # (3, N)
     sel = resolve_method(n_nodes, nbins, method, axis_name=axis_name)
     _record_selection(sel)
-    method = sel["method"]
-    if method == "host":
-        hist = _hist_host(codes, node_id, vals, n_nodes, nbins, pack_bits)
-    else:
-        if pack_bits:
-            # in-graph consumers take dense codes: widen in-graph. The
-            # widen is a pure function of the loop-invariant packed input,
-            # so XLA computes it once per program execution and shares the
-            # buffer across every level's histogram pass; the RESIDENT
-            # matrix stays packed
-            codes = packing.unpack_device(codes, pack_bits)
-        if method == "onehot":
-            hist = _hist_onehot(codes, node_id, vals, n_nodes, nbins)
-        elif method == "segment":
-            hist = _hist_segment(codes, node_id, vals, n_nodes, nbins)
-        elif method == "pallas":
-            from . import hist_pallas
-
-            hist = hist_pallas.build_histograms_pallas(
-                codes, node_id, vals, n_nodes, nbins)
-        elif method == "pallas_factored":
-            from . import hist_pallas
-
-            hist = hist_pallas.build_histograms_pallas_factored(
-                codes.T.astype(jnp.float32), node_id, vals, n_nodes, nbins,
-                row_chunk=sel["row_chunk"],
-            )
-        else:
-            raise ValueError(f"unknown histogram method {method!r}")
+    if n_shard_blocks > 0:
+        n = node_id.shape[0]
+        if n % n_shard_blocks:
+            raise ValueError(
+                f"{n} rows do not divide into {n_shard_blocks} shard blocks")
+        rows = n // n_shard_blocks
+        parts = []
+        for b in range(n_shard_blocks):
+            parts.append(_run_kernel(
+                sel, _packed_row_slice(codes, b * rows, (b + 1) * rows,
+                                       pack_bits),
+                node_id[b * rows:(b + 1) * rows],
+                vals[:, b * rows:(b + 1) * rows],
+                n_nodes, nbins, pack_bits))
+        return ordered_axis_fold(jnp.stack(parts), axis_name)
+    hist = _run_kernel(sel, codes, node_id, vals, n_nodes, nbins, pack_bits)
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return hist  # (n_nodes, F, nbins, 3) — [..., 0]=Σw [..., 1]=Σg [..., 2]=Σh

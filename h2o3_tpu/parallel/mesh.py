@@ -24,6 +24,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # 0.4.x keeps it experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the ONE version-compat import — graft/test callers re-use this instead
+# of duplicating the try/except (a future jax rename is a one-line fix)
+shard_map = _shard_map
+
 ROWS_AXIS = "hosts"  # the one inter-node axis H2O has: row/data parallelism
 
 _lock = threading.Lock()
@@ -133,6 +142,25 @@ def reset() -> None:
         _cloud = None
 
 
+def shard_call(fn, cloud: "Cloud", in_specs, out_specs, check_rep=True):
+    """t5x-style cpu-fallback-to-jit wrapper (SNIPPETS.md [1], `t5x
+    partitioning.pjit`): on a multi-device cloud, wrap `fn` in `shard_map`
+    over the 1-D ``hosts`` mesh; on a 1-device cloud return `fn` UNCHANGED
+    so the caller's plain `jit` runs the IDENTICAL function body — the
+    forced-CPU test lane exercises the same sharded code path (blocked
+    histogram reduction included) without a mesh, and a parity pin between
+    the two lanes compares one implementation against itself.
+
+    `check_rep=False` is required for bodies whose replicated outputs come
+    from an `all_gather` + explicit fold (the deterministic histogram
+    merge) rather than a `psum` — shard_map cannot statically infer the
+    replication there, but the fold IS replicated by construction."""
+    if cloud.size > 1:
+        return _shard_map(fn, mesh=cloud.mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_rep)
+    return fn
+
+
 def collective_fence(x) -> None:
     """Serialize multi-device collective programs on the CPU backend.
 
@@ -144,12 +172,27 @@ def collective_fence(x) -> None:
     on the 8-virtual-device test cloud of a 1-core host). Blocking on the
     previous program's output before dispatching the next collective keeps
     at most one collective executable in flight. TPU streams already
-    serialize executions, so this is a no-op there."""
+    serialize executions, so this is a no-op there.
+
+    The blocked time is booked to the ``collective`` phase bucket
+    (runtime/phases): on a CPU mesh it is the wait for collective-program
+    completion, so bench records decompose a sharded fit's wall into
+    {h2d, compute, collective, ...} instead of hiding the merge cost in
+    compute."""
+    import time as _time
+
     import jax
 
     c = _cloud
     if c is not None and c.size > 1 and jax.default_backend() == "cpu":
+        t0 = _time.perf_counter()
         jax.block_until_ready(x)
+        try:
+            from ..runtime import phases as _phases
+
+            _phases.add("collective", _time.perf_counter() - t0)
+        except Exception:
+            pass
 
 
 _training_lock = threading.RLock()

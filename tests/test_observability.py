@@ -493,8 +493,22 @@ def test_trace_id_propagation_client_job_candidate_batch(obs_server, cloud1):
         grid = DKV.get(DKV.get(job_key).result)   # in-process server: DKV
         mid = grid.models[0].model.model_id
         conn.post(f"/3/Predictions/models/{mid}/frames/{fr.key}")
-    out, _ = _http("GET", obs_server.port, f"/3/Trace?trace_id={tid}")
-    evs = [e for e in out["traceEvents"] if e.get("ph") == "X"]
+    # a request span records when the HANDLER finishes writing the
+    # response, which legitimately races the client's next request — poll
+    # briefly until the final request span (the batch span's parent) has
+    # landed in the ring before pinning the tree shape
+    import time as _time
+
+    deadline = _time.time() + 5.0
+    while True:
+        out, _ = _http("GET", obs_server.port, f"/3/Trace?trace_id={tid}")
+        evs = [e for e in out["traceEvents"] if e.get("ph") == "X"]
+        _ids = {e["args"]["span_id"] for e in evs}
+        if all(e["args"]["parent_id"] in _ids for e in evs
+               if e["args"]["parent_id"] is not None) \
+                or _time.time() > deadline:
+            break
+        _time.sleep(0.05)
     kinds = {e["cat"] for e in evs}
     assert {"request", "job", "candidate", "batch"} <= kinds, kinds
     assert all(e["args"]["trace_id"] == tid for e in evs)

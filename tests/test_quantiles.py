@@ -5,11 +5,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.ops.quantiles import distributed_quantiles
 from h2o3_tpu.parallel import mesh as cloudlib
+from h2o3_tpu.parallel.mesh import shard_map  # version-compat export
 
 
 def test_single_device_matches_numpy(cloud1):
