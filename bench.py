@@ -90,6 +90,7 @@ def bench_gbm():
         ntrees=ntrees, max_depth=max_depth, learn_rate=0.1,
         histogram_type="UniformAdaptive", seed=42,
     )
+    lane_seq0 = _lane_seq()
     t0 = time.time()
     gbm.train(y="label", training_frame=fr)
     wall = time.time() - t0
@@ -103,6 +104,7 @@ def bench_gbm():
     return (f"higgs_gbm_{n_rows//1000}k_{ntrees}trees_wall_s", wall,
             {"auc": round(float(gbm.auc()), 5),
              "n_devices": _note_devices(),
+             "collective_skew_ms": _skew_embed(lane_seq0),
              "hist_updates_per_s": round(updates / comp),
              "hist_stream_gbps": round(updates / comp / 1e9, 3)})
 
@@ -150,14 +152,19 @@ def bench_gbm_cpu():
     # the record embeds the FUSED reps' phase split only — buckets mixed
     # across comparator paths decompose nothing
     _phz_mod.reset()
+    lane_seq0 = _lane_seq()
     wall_new, auc = run(False, reps=2)
     fused_phases = _phz_mod.snapshot()
+    # snapshot BEFORE the legacy comparator reps: the embed describes the
+    # fused measurement's fences only
+    skew = _skew_embed(lane_seq0)
     _phz_mod.reset()
     wall_seed, _ = run(True, reps=2)
     _phz_mod.reset()
     return (f"gbm_cpu_{n_rows//1000}k_{ntrees}trees_wall_s", wall_new,
             {"auc": round(auc, 5),
              "n_devices": _note_devices(),
+             "collective_skew_ms": skew,
              "seed_wall_s": round(wall_seed, 3),
              "vs_seed": round(wall_seed / wall_new, 2),
              "phases": fused_phases or None})
@@ -894,6 +901,50 @@ def _observability_embed() -> dict:
         return {}
 
 
+def _lane_seq() -> int:
+    """Fence-sequence cursor: capture before the measured fit(s) and pass
+    to `_skew_embed` so the embed covers exactly the fences the
+    measurement recorded — not warm-up fits or comparator reps."""
+    try:
+        from h2o3_tpu.parallel import mesh as _mesh
+
+        return _mesh.lane_seq()
+    except Exception:
+        return 0
+
+
+def _skew_embed(since_seq: int = 0):
+    """Per-lane collective skew of the measured fit (ISSUE 13): p50/max
+    fence skew + the worst lane, from the mesh lane-timing recorder. None
+    when the fit recorded no instrumented fences (single-device lanes, or
+    a fit that never ran a scoring event — the event-loss fence is the
+    only instrumented collective) — like every other extra, a None embed
+    is dropped from the record."""
+    try:
+        from h2o3_tpu.parallel import mesh as _mesh
+
+        s = _mesh.lane_summary(since_seq)
+        if s.get("fences"):
+            return {"p50": s["skew_p50_ms"], "max": s["skew_max_ms"],
+                    "fences": s["fences"], "worst_lane": s["worst_lane"]}
+    except Exception:
+        pass
+    return None
+
+
+def _lane_waits_embed():
+    """Last observed per-lane fence waits — host-side dict only, safe
+    from the watchdog thread while the backend hangs: a hung collective's
+    partial/fail line names the suspect lane (the one MISSING from, or
+    slowest in, the last recorded fence)."""
+    try:
+        from h2o3_tpu.parallel import mesh as _mesh
+
+        return _mesh.lane_last_waits() or None
+    except Exception:
+        return None
+
+
 def _memory_embed() -> dict:
     """Memory trajectory every emitted record carries (ISSUE 8): process
     peak RSS, the ledger's device high watermark, and the top-3 owners
@@ -930,6 +981,12 @@ def _fail_line(config: str, why: str) -> dict:
     line = {"metric": f"{config}_unavailable", "value": 0.0, "unit": "s",
             "vs_baseline": 0.0, "error": why, "backend": None,
             "n_devices": nd}
+    lw = _lane_waits_embed()
+    if lw:
+        # the last fence's per-lane waits: on a hung collective the lane
+        # everyone was waiting on is the one with the largest wait here
+        # (or the one missing from the dict entirely)
+        line["lane_waits_ms"] = lw
     xla = _observability_embed()
     if xla:
         line["xla"] = xla
@@ -1099,6 +1156,9 @@ def main():
                     # other hung rep: best completed measurement, partial
                     err += (f" [n_devices={nd}: possible hung collective]")
                 line["error"] = err
+                lw = _lane_waits_embed()
+                if lw:
+                    line["lane_waits_ms"] = lw
                 _emit(line)
             else:
                 _emit(_fail_line(config,

@@ -187,6 +187,61 @@ def _growth_bytes_per_min(samples: List[Dict],
     return round(slope * 60.0, 1)
 
 
+def fleet_summary(host: str, port: int,
+                  timeout_s: float = 10.0) -> Optional[Dict]:
+    """Fleet fold of an aggregator target (`GET /3/Fleet`, stdlib-only):
+    fleet-merged request/error totals + predict p99 and per-replica
+    liveness/error counts. None when the target has no fleet surface (an
+    older or single-process server) — the report simply omits the fleet
+    section rather than failing the run."""
+    url = f"http://{host}:{port}/3/Fleet"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            doc = json.loads(r.read().decode())
+    except Exception:
+        return None
+    if "peers" not in doc:
+        return None
+    return dict(
+        requests=doc.get("fleet", {}).get("requests"),
+        errors=doc.get("fleet", {}).get("errors"),
+        rejections=doc.get("fleet", {}).get("rejections"),
+        predict_p99_ms=doc.get("fleet", {}).get("predict_p99_ms"),
+        replicas_up=doc.get("totals", {}).get("up"),
+        replicas=doc.get("totals", {}).get("peers"),
+        per_replica=[dict(name=p.get("name"), up=p.get("up"),
+                          requests=p.get("requests"),
+                          errors=p.get("errors"),
+                          rejections=p.get("rejections"),
+                          predict_p99_ms=p.get("predict_p99_ms"))
+                     for p in doc.get("peers", [])],
+    )
+
+
+def _fleet_delta_report(before: Optional[Dict], after: Optional[Dict],
+                        wall_s: float) -> Optional[Dict]:
+    """The loadgen summary's fleet section: the AFTER snapshot (liveness,
+    per-replica error counts, fleet predict p99 over merged buckets) plus
+    a fleet-scope throughput computed from the before/after request-count
+    delta over this run's wall — counters are cumulative, so the delta is
+    what THIS run drove through the fleet."""
+    if after is None:
+        return None
+    out = dict(after)
+    if (before is not None and before.get("requests") is not None
+            and after.get("requests") is not None and wall_s > 0):
+        # fleet totals only sum currently-REACHABLE replicas, so a peer
+        # dying mid-run can shrink the after-snapshot below the before
+        # one — floor deltas at 0 (a rate cannot be negative); the
+        # replicas_up / per_replica fields carry the peer-loss signal
+        out["throughput_rps"] = round(
+            max(after["requests"] - before["requests"], 0) / wall_s, 2)
+        for fld in ("errors", "rejections"):
+            if before.get(fld) is not None and after.get(fld) is not None:
+                out[f"{fld}_delta"] = max(after[fld] - before[fld], 0)
+    return out
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     if not sorted_vals:
         return float("nan")
@@ -397,9 +452,15 @@ def main() -> int:
     ap.add_argument("--max-inflight", type=int, default=256,
                     help="open-loop: arrivals beyond this many in flight "
                          "are dropped (overload safety valve)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="target is a fleet aggregator: report fleet-"
+                         "scope throughput/p99 and per-replica error "
+                         "counts from GET /3/Fleet in the summary")
     args = ap.parse_args()
     if args.rate is not None and args.rate <= 0:
         ap.error("--rate must be > 0 (requests per second)")
+    fleet_before = (fleet_summary(args.host, args.port)
+                    if args.fleet else None)
     if args.rate is not None:
         stats = run_load_open(args.host, args.port, args.model, args.frame,
                               rate=args.rate,
@@ -409,6 +470,10 @@ def main() -> int:
         stats = run_load(args.host, args.port, args.model, args.frame,
                          threads=args.threads, requests=args.requests,
                          duration_s=args.duration_s)
+    if args.fleet:
+        stats["fleet"] = _fleet_delta_report(
+            fleet_before, fleet_summary(args.host, args.port),
+            stats.get("wall_s") or 0.0)
     print(json.dumps(stats, indent=2))
     return 0 if stats["completed"] else 1
 

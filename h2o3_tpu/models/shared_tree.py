@@ -191,7 +191,11 @@ def _sharded_event_loss_fn(cloud, shard_mode: str, n_shards: int,
             jnp.stack([jnp.sum(num[b * rows:(b + 1) * rows]),
                        jnp.sum(den[b * rows:(b + 1) * rows])])
             for b in range(local_blocks)])
-        tot = ordered_axis_fold(parts, axis)          # (2,) replicated
+        # the ONE instrumented fence of the fit (ISSUE 13): the event-loss
+        # program runs once per scoring interval, so per-lane arrival
+        # stamps here profile collective skew without touching the
+        # per-level histogram hot path
+        tot = ordered_axis_fold(parts, axis, timing_tag="event_loss")
         return tot[0] / jnp.maximum(tot[1], 1e-12)
 
     if axis is not None:
@@ -663,9 +667,14 @@ def _build_tree_step_fns(cfg: _StepCfg, cloud):
                           P()),
                 out_specs=out_specs,
                 # the deterministic merge replicates via all_gather + fold,
-                # which shard_map cannot statically infer; the psum legacy
-                # path keeps the static check
-                check_rep=(cfg.shard_mode == "mesh_psum"),
+                # which shard_map cannot statically infer — and on the
+                # psum path jax 0.4.x's replication checker rejects the
+                # level loop's carry ("Scan carry ... mismatched
+                # replication types": psum'd values re-entering the scan),
+                # exactly the lossguide failure fixed in ISSUE 12. The
+                # outputs ARE replicated on every path; the static check
+                # stays off (newer jax infers it correctly anyway).
+                check_rep=False,
             )
             return fn(codes, g, h, w, fm, edges, mono, hp, key)
         if cfg.has_monotone:
@@ -2081,12 +2090,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
                                                       cfg.compact_cap)
         else:
             plan_levels = [("lossguide_node", 1)]
+        plan_tag = (f"{getattr(self, 'algo', self._mode)}:{K}x{tp['ntrees']}t"
+                    f"_d{cfg.max_depth}")
         _record_fit_plan(
-            f"{getattr(self, 'algo', self._mode)}:{K}x{tp['ntrees']}t"
-            f"_d{cfg.max_depth}", plan_levels, nbins, cfg.hist_method,
+            plan_tag, plan_levels, nbins, cfg.hist_method,
             pack_bits=cfg.pack_bits,
             axis_name=cloudlib.ROWS_AXIS if ndev_eff > 1 else None,
             n_shards=cfg.n_shards, n_devices=ndev_eff)
+        # per-lane collective skew of THIS fit (ISSUE 13): fences recorded
+        # after this sequence point belong to this fit (training is
+        # serialized on meshes via training_guard)
+        lane_seq0 = cloudlib.lane_seq()
         # fit trace span: a dashboard reading /3/Trace sees how many chips
         # (and reduction blocks) this fit actually spanned
         try:
@@ -2705,6 +2719,25 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 model.validation_metrics = _metrics_for(problem, valid.vec(y), probs_v)
             else:
                 model.validation_metrics = model._make_metrics(valid)
+        # per-fit collective-skew summary (ISSUE 13): fold the fences this
+        # fit recorded into the plan ring (/3/Profiler `tree`) and the fit
+        # trace, so a dashboard sees which lane a sharded fit waited on
+        if cfg.shard_mode == "mesh" and ndev_eff > 1:
+            try:
+                skew = cloudlib.lane_summary(lane_seq0)
+                if skew.get("fences"):
+                    from ..ops.histogram import attach_fit_skew
+
+                    attach_fit_skew(plan_tag, skew)
+                    from ..runtime import tracing as _tracing
+
+                    _tracing.event(
+                        "collective_skew", fences=skew["fences"],
+                        skew_p50_ms=skew["skew_p50_ms"],
+                        skew_max_ms=skew["skew_max_ms"],
+                        worst_lane=skew["worst_lane"])
+            except Exception:
+                pass
         return model
 
     def _probs_from_margins(self, problem, dist, m: np.ndarray, ntrees: int) -> np.ndarray:

@@ -230,6 +230,18 @@ def record_fit_plan(tag: str, levels, nbins: int, hist_method: str,
     return plan
 
 
+def attach_fit_skew(tag: str, skew: dict) -> None:
+    """Attach a finished fit's collective-skew summary (mesh.lane_summary)
+    to its recorded plan — the plan rings at /3/Profiler `tree` then carry
+    per-fit {fences, skew_p50_ms, skew_max_ms, worst_lane} next to the
+    kernel plan (ISSUE 13: per-fit skew summaries in the tree fold)."""
+    with _SEL_LOCK:
+        for plan in reversed(_FIT_PLANS):
+            if plan["tag"] == tag:
+                plan["collective_skew"] = dict(skew)
+                return
+
+
 def kernel_stats() -> dict:
     """Per-fit kernel plans + cumulative dispatch counters (the /3/Profiler
     `tree` fold). Pure counter read."""
@@ -350,15 +362,29 @@ def _hist_host(codes, node_id, vals, n_nodes: int, nbins: int,
         codes, node_id, vals)
 
 
-def ordered_axis_fold(parts: jax.Array, axis_name: Optional[str]) -> jax.Array:
+def ordered_axis_fold(parts: jax.Array, axis_name: Optional[str],
+                      timing_tag: Optional[str] = None) -> jax.Array:
     """Deterministic sum of per-block partials: gather the (local_blocks,
     ...) stack into GLOBAL block order (`all_gather` is device-major, which
     matches row order for contiguous row sharding) and fold left-to-right —
     the association is pinned by the expression tree, so the result is
     independent of how the blocks are distributed over devices. The
     shard-invariant replacement for `lax.psum` on the deterministic tree
-    path (psum's reduction order is implementation-defined)."""
+    path (psum's reduction order is implementation-defined).
+
+    ``timing_tag`` attaches the per-lane collective skew instrument
+    (`mesh.lane_mark`, ISSUE 13): each lane stamps a host timestamp the
+    moment its partial is ready, barrier-ordered before the all_gather, so
+    the fence's per-lane waits are observable. Values are untouched (the
+    mark is an identity + io_callback), preserving the bit-stability
+    contract above. Only the per-scoring-interval callers pass a tag —
+    the per-level histogram passes stay uninstrumented."""
     if axis_name is not None:
+        if timing_tag is not None:
+            from ..parallel import mesh as _mesh
+
+            if _mesh.lane_timing_enabled():
+                parts = _mesh.lane_mark(parts, axis_name, timing_tag)
         parts = jax.lax.all_gather(parts, axis_name, axis=0, tiled=False)
         parts = parts.reshape((-1,) + parts.shape[2:])
     acc = parts[0]

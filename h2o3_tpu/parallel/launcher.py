@@ -52,12 +52,31 @@ def initialize_multihost(
             jax.distributed.initialize()
         except (ValueError, RuntimeError):
             pass  # single-process (no pod metadata): 1-node cloud
-    return dict(
+    facts = dict(
         process_index=jax.process_index(),
         process_count=jax.process_count(),
         local_devices=len(jax.local_devices()),
         global_devices=len(jax.devices()),
     )
+    # fleet-observability hook (ISSUE 13): a rank that also serves REST
+    # announces itself to the aggregator so `GET /3/Metrics?scope=fleet`
+    # there covers the whole pod — opt-in via env, and soft-fail: cloud
+    # bring-up order must not depend on the aggregator being up yet
+    agg = os.environ.get("H2O3_FLEET_AGGREGATOR")
+    self_url = os.environ.get("H2O3_FLEET_SELF_URL")
+    if agg and self_url:
+        from ..runtime import fleet
+
+        if fleet.same_origin(agg, self_url):
+            # this rank IS the aggregator (shared pod env points every
+            # rank at rank0): it already answers the fleet scrape as
+            # `self` — registering its own URL as a peer would merge its
+            # registry twice and double-count every fleet total
+            facts["fleet_registered"] = "self"
+        else:
+            facts["fleet_registered"] = fleet.register_with(
+                agg, f"rank{facts['process_index']}", self_url)
+    return facts
 
 
 def main(argv=None):

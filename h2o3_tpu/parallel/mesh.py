@@ -249,3 +249,283 @@ def pad_to_multiple(n: int, k: int) -> int:
     """Rows are padded so each mesh shard is equal-sized (XLA needs static,
     uniform shards; H2O chunks could be ragged — ours cannot)."""
     return ((n + k - 1) // k) * k
+
+
+# -- per-lane collective skew profiling + straggler detection (ISSUE 13) ------
+#
+# A slow lane in a sharded fit (the classic data-parallel-boosting
+# straggler) was invisible: `collective_fence` books only the DRIVER'S
+# total wait. The instrument here records, per collective fence, WHEN each
+# lane arrived at the rendezvous: `lane_mark(x, axis, tag)` inserts an
+# `io_callback` into the sharded program (ordered before the all_gather by
+# an optimization_barrier data dependency), so each lane stamps a host
+# timestamp the moment its local partial is ready. The fence's per-lane
+# wait is each lane's arrival lag behind the FIRST arriver — the time the
+# collective spent waiting on that lane. All bookkeeping is host-side
+# dicts; nothing blocks device work, and the instrument is only attached
+# to the per-scoring-interval programs (the event-loss fence), NEVER the
+# per-level histogram hot path.
+#
+# The same callback is the injection point for the `mesh.lane_delay`
+# fault (runtime/faults, latency-only): arming it with lane=N sleeps N's
+# arrival callback, delaying that lane's rendezvous entry for real — the
+# detector below must then flag exactly lane N (pinned in
+# tests/test_tree_sharded.py and exercised by dryrun_multichip).
+#
+# Straggler detection: a lane whose per-fence wait persistently (>=
+# H2O3_STRAGGLER_FENCES consecutive fences) exceeds
+# max(median_wait * H2O3_STRAGGLER_FACTOR, H2O3_STRAGGLER_MIN_MS) fires
+# `h2o3_stragglers_total{lane}`, a Timeline event and a zero-duration
+# trace span — once per streak, re-armed when the lane recovers.
+
+import functools as _functools
+import time as _time
+from collections import deque as _deque
+
+_LANE_LOCK = threading.Lock()
+_LANE_SEQ = 0                      # monotone fence counter
+_LANE_OPEN: dict = {}              # tag -> {lane: t_arrive}
+_LANE_RECORDS: "_deque" = _deque(maxlen=256)
+_LANE_LAST: dict = {}              # lane -> wait_ms of the most recent fence
+_LANE_STREAK: dict = {}            # lane -> consecutive flagged fences
+_LANE_FIRED: dict = {}             # lane -> total straggler firings
+_LANE_REG: dict = {}
+_F32_ZERO = np.float32(0.0)
+
+
+def lane_timing_enabled() -> bool:
+    """Per-lane timing is on by default for mesh-sharded programs;
+    H2O3_LANE_TIMING=0 is the escape hatch. Evaluated at TRACE time — the
+    cached sharded programs bake the choice in for their lifetime."""
+    return os.environ.get("H2O3_LANE_TIMING", "1").lower() not in (
+        "0", "false", "no")
+
+
+def _lane_registry() -> dict:
+    """Memoized central-registry families (the usual memoization stance:
+    recording a fence must not take the registry registration lock)."""
+    if not _LANE_REG:
+        from ..runtime import metrics_registry as _reg
+
+        _LANE_REG["skew"] = _reg.histogram(
+            "h2o3_collective_skew_ms",
+            "per-fence collective skew (ms): slowest lane's arrival lag "
+            "behind the first arriver, per instrumented fence tag",
+            labelnames=("tag",))
+        _LANE_REG["lane_wait"] = _reg.histogram(
+            "h2o3_collective_lane_wait_ms",
+            "per-lane collective wait (ms): how long each fence waited on "
+            "this lane (arrival lag behind the first arriver)",
+            labelnames=("lane",))
+        _LANE_REG["fences"] = _reg.counter(
+            "h2o3_collective_fences",
+            "instrumented collective fences recorded")
+        _LANE_REG["stragglers"] = _reg.counter(
+            "h2o3_stragglers",
+            "straggler detections: fences streaks where one lane's wait "
+            "persistently exceeded the median by H2O3_STRAGGLER_FACTOR",
+            labelnames=("lane",))
+    return _LANE_REG
+
+
+def _lane_arrive_cb(tag: str, lane) -> np.float32:
+    """io_callback target: runs ON the lane's execution thread the moment
+    its local partial is ready. Stamps the arrival; flushes the fence
+    record when every lane of the cloud has reported (or when a lane
+    reports twice — a new fence started before a peer's callback landed)."""
+    lane = int(lane)
+    try:
+        from ..runtime import faults as _faults
+
+        _faults.check("mesh.lane_delay", lane=lane)
+    except Exception:
+        pass   # latency-only point; an injected error class is a misconfig
+    t = _time.perf_counter()
+    actions = None
+    with _LANE_LOCK:
+        open_ = _LANE_OPEN.setdefault(tag, {})
+        if lane in open_:
+            actions = _flush_locked(tag)
+            _LANE_OPEN[tag] = open_ = {}
+        open_[lane] = t
+        c = _cloud
+        if c is not None and len(open_) >= c.size:
+            acts2 = _flush_locked(tag)
+            actions = (actions or []) + acts2 if acts2 else actions
+    if actions:
+        _run_lane_actions(actions)
+    return _F32_ZERO
+
+
+def _flush_locked(tag: str):
+    """Fold one fence's arrivals into a record (+ detector update). Caller
+    holds _LANE_LOCK; returns deferred registry/timeline actions so the
+    lock never nests into other subsystems' locks."""
+    global _LANE_SEQ
+    arrivals = _LANE_OPEN.pop(tag, None)
+    if not arrivals or len(arrivals) < 2:
+        return None
+    tmin = min(arrivals.values())
+    waits = {lane: (t - tmin) * 1e3 for lane, t in arrivals.items()}
+    skew = max(waits.values())
+    _LANE_SEQ += 1
+    rec = dict(seq=_LANE_SEQ, ts=_time.time(), tag=tag,
+               waits_ms={str(lv): round(w, 3) for lv, w in sorted(waits.items())},
+               skew_ms=round(skew, 3))
+    _LANE_RECORDS.append(rec)
+    _LANE_LAST.clear()
+    _LANE_LAST.update(waits)
+    # straggler detection on this fence
+    from ..runtime import env_float, env_int
+
+    factor = env_float("H2O3_STRAGGLER_FACTOR", 4.0)
+    floor_ms = env_float("H2O3_STRAGGLER_MIN_MS", 25.0)
+    persist = env_int("H2O3_STRAGGLER_FENCES", 3)
+    srt = sorted(waits.values())
+    # LOWER median: the threshold must come from a typical healthy lane.
+    # The upper middle would, on a 2-lane mesh, be the straggler's own
+    # wait (threshold = 4x itself — the detector could never fire), and
+    # on any even mesh where half the lanes are slow it would inflate
+    # the threshold by the very skew being detected.
+    median = srt[(len(srt) - 1) // 2]
+    threshold = max(median * factor, floor_ms)
+    actions = [("fence", tag, skew, dict(waits))]
+    for lane, w in waits.items():
+        if w > threshold:
+            _LANE_STREAK[lane] = _LANE_STREAK.get(lane, 0) + 1
+            if _LANE_STREAK[lane] == persist:
+                _LANE_FIRED[lane] = _LANE_FIRED.get(lane, 0) + 1
+                actions.append(("straggler", tag, lane,
+                                dict(wait_ms=round(w, 1),
+                                     median_ms=round(median, 1),
+                                     factor=factor, fences=persist)))
+        else:
+            _LANE_STREAK[lane] = 0
+    return actions
+
+
+def _run_lane_actions(actions) -> None:
+    try:
+        reg = _lane_registry()
+    except Exception:
+        return
+    for act in actions:
+        if act[0] == "fence":
+            _, tag, skew, waits = act
+            reg["fences"].inc()
+            reg["skew"].observe(skew, tag)
+            for lane, w in waits.items():
+                reg["lane_wait"].observe(w, str(lane))
+        else:
+            _, tag, lane, info = act
+            reg["stragglers"].inc(1, str(lane))
+            try:
+                from ..runtime import tracing as _tracing
+                from ..runtime.timeline import Timeline
+
+                Timeline.record(
+                    "straggler",
+                    f"lane {lane} waited {info['wait_ms']}ms at '{tag}' "
+                    f"fences (median {info['median_ms']}ms, "
+                    f"factor {info['factor']})", lane=lane, **info)
+                _tracing.record_span(f"straggler:lane{lane}", 0.0,
+                                     kind="collective", lane=lane,
+                                     tag=tag, **info)
+            except Exception:
+                pass
+
+
+def lane_mark(x, axis_name: str, tag: str):
+    """Attach the per-lane arrival stamp to `x` inside a sharded program:
+    an io_callback carrying this lane's index, ordered BEFORE the
+    downstream collective via an optimization_barrier data dependency
+    (pure_callback would be DCE'd — its result is unused by the math).
+    Identity on the values; returns `x` barrier-tied to the stamp."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    lane = jax.lax.axis_index(axis_name)
+    t = io_callback(_functools.partial(_lane_arrive_cb, tag),
+                    jax.ShapeDtypeStruct((), jnp.float32), lane,
+                    ordered=False)
+    x, _ = jax.lax.optimization_barrier((x, t))
+    return x
+
+
+def lane_seq() -> int:
+    with _LANE_LOCK:
+        return _LANE_SEQ
+
+
+def lane_last_waits() -> dict:
+    """{lane: wait_ms} naming the suspect lane of a hung collective —
+    host-side dicts only (safe from the bench watchdog thread while the
+    backend hangs). A fence currently OPEN (some lanes arrived, the
+    collective still waiting on the rest) takes priority: its partial
+    arrivals are reported, so the lanes MISSING from the dict are exactly
+    the ones the fence is hung on. With no open fence, the most recent
+    COMPLETED fence's waits."""
+    with _LANE_LOCK:
+        for open_ in _LANE_OPEN.values():
+            if open_:
+                tmin = min(open_.values())
+                return {int(lv): round((t - tmin) * 1e3, 3)
+                        for lv, t in sorted(open_.items())}
+        return {int(lv): round(w, 3) for lv, w in _LANE_LAST.items()}
+
+
+def lane_records(since_seq: int = 0) -> list:
+    with _LANE_LOCK:
+        return [dict(r) for r in _LANE_RECORDS if r["seq"] > since_seq]
+
+
+def lane_summary(since_seq: int = 0) -> dict:
+    """Fold the fences recorded after `since_seq` into one summary (the
+    per-fit skew embed: record_fit_plan tree fold, bench records, fit
+    trace events): fence count, skew p50/max, and the worst lane."""
+    recs = lane_records(since_seq)
+    if not recs:
+        return dict(fences=0)
+    skews = sorted(r["skew_ms"] for r in recs)
+    per_lane: dict = {}
+    for r in recs:
+        for lv, w in r["waits_ms"].items():
+            per_lane.setdefault(lv, []).append(w)
+    worst = max(per_lane, key=lambda lv: max(per_lane[lv]))
+    return dict(
+        fences=len(recs),
+        skew_p50_ms=round(skews[len(skews) // 2], 3),
+        skew_max_ms=round(skews[-1], 3),
+        worst_lane=int(worst),
+        per_lane_max_ms={lv: round(max(ws), 3)
+                         for lv, ws in sorted(per_lane.items())},
+    )
+
+
+def lane_stats() -> dict:
+    """The full lane-timing snapshot (the /3/Profiler `tree`-adjacent
+    fold + dryrun assertions): enabled flag, totals, last fence, per-lane
+    straggler streaks and firing counts, recent records tail."""
+    with _LANE_LOCK:
+        return dict(
+            enabled=lane_timing_enabled(),
+            fences=_LANE_SEQ,
+            last={str(lv): round(w, 3) for lv, w in _LANE_LAST.items()},
+            streaks={str(lv): n for lv, n in _LANE_STREAK.items() if n},
+            stragglers={str(lv): n for lv, n in _LANE_FIRED.items()},
+            records=[dict(r) for r in list(_LANE_RECORDS)[-8:]],
+        )
+
+
+def lane_reset() -> None:
+    """Drop lane-timing state (tests). Registry families are monotone and
+    stay — only the host-side rings/streaks reset."""
+    global _LANE_SEQ
+    with _LANE_LOCK:
+        _LANE_SEQ = 0
+        _LANE_OPEN.clear()
+        _LANE_RECORDS.clear()
+        _LANE_LAST.clear()
+        _LANE_STREAK.clear()
+        _LANE_FIRED.clear()

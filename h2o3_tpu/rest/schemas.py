@@ -73,6 +73,7 @@ METRICS_ENDPOINTS = {
     "munge": "/3/Munge/metrics",
     "training": "/3/Training/metrics",
     "memory": "/3/Memory",
+    "fleet": "/3/Fleet?probe=0",
 }
 
 
@@ -97,6 +98,23 @@ def observability_schema() -> Dict:
          "client-minted (or server-minted when absent) trace id,"
          " propagated into Jobs/candidates/batches and echoed on every"
          " response"),
+        ("GET /3/Metrics?scope=fleet", "text/plain",
+         "fleet-merged Prometheus exposition: every registered peer"
+         " scraped (RetryPolicy) and merged — counters summed, histogram"
+         " buckets summed (exact fleet percentiles), gauges per-replica"
+         " under a replica label, unreachable peers as explicit"
+         " h2o3_fleet_peer_up 0 series"),
+        ("GET /3/Metrics?format=json", "JSON",
+         "lossless registry export (labelnames, raw label tuples, raw"
+         " histogram buckets + sum/min/max) — the payload fleet"
+         " aggregators scrape and merge"),
+        ("GET /3/Trace?scope=fleet", "TraceEventsJSON",
+         "every replica's span export merged into one Chrome-trace"
+         " timeline, one process_name track per replica"),
+        ("GET/POST/DELETE /3/Fleet", "FleetV3",
+         "peer registry + fleet fold: per-replica liveness, serving"
+         " counters and predict p99, fleet-merged totals (the loadgen"
+         " --fleet report source)"),
     ]
     return dict(
         name=OBSERVABILITY_SCHEMA_NAME,

@@ -153,6 +153,9 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Metrics$", "metrics"),
         ("GET", r"^/3/Memory$", "memory"),
         ("GET", r"^/3/Trace$", "trace"),
+        ("GET", r"^/3/Fleet$", "fleet_get"),
+        ("POST", r"^/3/Fleet$", "fleet_set"),
+        ("DELETE", r"^/3/Fleet$", "fleet_delete"),
         ("GET", r"^/3/Profiler$", "profiler"),
         ("GET", r"^/3/Metadata/schemas$", "metadata_schemas"),
         ("POST", r"^/3/Frames/([^/]+)/export$", "frame_export"),
@@ -921,7 +924,9 @@ class _Handler(BaseHTTPRequestHandler):
             count=int(p["count"]) if p.get("count") not in (None, "")
             else None,
             latency_ms=float(p.get("latency_ms", 0.0) or 0.0),
-            seed=int(p.get("seed", 0) or 0))
+            seed=int(p.get("seed", 0) or 0),
+            lane=int(p["lane"]) if p.get("lane") not in (None, "")
+            else None)
         self._send(out)
 
     def h_faults_delete(self):
@@ -1076,9 +1081,25 @@ class _Handler(BaseHTTPRequestHandler):
         subsystem (serving, ingest, munge, training, retry, faults, REST,
         XLA compile/retrace) in one scrape. `?schema=1` returns the
         ObservabilityV3 field metadata as JSON instead (the sibling
-        /3/*/metrics convention)."""
-        if self._flag(self._params(), "schema"):
+        /3/*/metrics convention). `?format=json` returns the LOSSLESS
+        family export (label tuples, raw histogram buckets) that fleet
+        aggregators consume, and `?scope=fleet` answers for the WHOLE
+        fleet: every registered peer scraped and merged (counters summed,
+        histogram buckets summed, gauges per-replica, unreachable peers
+        as explicit h2o3_fleet_peer_up 0 series — docs/observability.md
+        "Fleet scope")."""
+        p = self._params()
+        if self._flag(p, "schema"):
             self._send(schemas.observability_schema())
+            return
+        if p.get("scope") == "fleet":
+            from ..runtime import fleet
+
+            self._send_raw(fleet.fleet_metrics_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if p.get("format") == "json":
+            self._send(registry.export_state())
             return
         self._send_raw(registry.prometheus_text().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
@@ -1099,11 +1120,54 @@ class _Handler(BaseHTTPRequestHandler):
                         **memory_ledger.snapshot()))
 
     def h_trace(self):
-        """`GET /3/Trace[?trace_id=]` — recorded spans as Chrome-trace/
-        Perfetto JSON (load at ui.perfetto.dev). Without trace_id, the
-        whole span ring exports; with it, one correlated request tree."""
+        """`GET /3/Trace[?trace_id=][&scope=fleet]` — recorded spans as
+        Chrome-trace/Perfetto JSON (load at ui.perfetto.dev). Without
+        trace_id, the whole span ring exports; with it, one correlated
+        request tree. `scope=fleet` pulls every registered peer's export
+        too and merges them into one timeline with a process track per
+        replica (X-H2O3-Trace-Id already crosses the client, so a
+        trace_id-scoped fleet pull is one workflow across processes)."""
         p = self._params()
-        self._send(tracing.export_chrome(p.get("trace_id") or None))
+        tid = p.get("trace_id") or None
+        if p.get("scope") == "fleet":
+            from ..runtime import fleet
+
+            self._send(fleet.fleet_trace(tid))
+            return
+        self._send(tracing.export_chrome(tid))
+
+    # -- fleet aggregation (runtime/fleet — docs/observability.md) ----------
+    def h_fleet_get(self):
+        """`GET /3/Fleet[?probe=0]` — the fleet fold: per-replica liveness
+        + serving counters + predict p99, fleet-merged totals. Scrapes
+        peers by default; `probe=0` reports registration state only."""
+        from ..runtime import fleet
+
+        p = self._params()
+        probe = p.get("probe") not in ("0", "false", "no")
+        self._send(dict(__meta=dict(schema_type="FleetV3"),
+                        **fleet.snapshot(scrape=probe)))
+
+    def h_fleet_set(self):
+        """`POST /3/Fleet` — register one peer replica: params `name`,
+        `url` (REST origin). Replicas self-register through this (the
+        launcher hook, fleet.register_with)."""
+        from ..runtime import fleet
+
+        p = self._params()
+        self._send(fleet.register_peer(str(p.get("name") or ""),
+                                       str(p.get("url") or "")))
+
+    def h_fleet_delete(self):
+        """`DELETE /3/Fleet?name=` — unregister one peer."""
+        from ..runtime import fleet
+
+        p = self._params()
+        name = p.get("name")
+        if not name:
+            raise ValueError("name is required")
+        self._send(dict(removed=bool(fleet.remove_peer(str(name))),
+                        name=name))
 
     def h_profiler(self):
         from ..runtime import profiler
@@ -1120,6 +1184,7 @@ class _Handler(BaseHTTPRequestHandler):
                         xla=profiler.xla_stats(),
                         tracing=profiler.tracing_stats(),
                         memory=profiler.memory_stats(),
+                        fleet=profiler.fleet_stats(),
                         metrics=profiler.registry_stats()))
 
     def h_metadata_schemas(self):

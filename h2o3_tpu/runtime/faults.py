@@ -16,6 +16,11 @@ Wired points (each named like the layer it lives in):
 ``client.request``          raises before the remote client's HTTP round-trip
 ``trainpool.candidate``     raises before a sweep candidate's build fn runs
 ``serving.scorer``          raises inside the compiled scorer's device call
+``mesh.lane_delay``         sleeps ``latency_ms`` inside ONE mesh lane's
+                            collective-arrival callback (``lane=N`` selects
+                            the lane) — the deterministic straggler
+                            injection the skew profiler/detector is proven
+                            against (parallel/mesh lane timing, ISSUE 13)
 ==========================  ==================================================
 
 Arming — programmatic, env, or REST:
@@ -78,10 +83,11 @@ ERROR_KINDS = {
 
 class _Point:
     __slots__ = ("name", "kind", "rate", "count", "latency_ms", "seed",
-                 "checks", "fires", "_rng")
+                 "lane", "checks", "fires", "_rng")
 
     def __init__(self, name: str, kind: str, rate: float,
-                 count: Optional[int], latency_ms: float, seed: int):
+                 count: Optional[int], latency_ms: float, seed: int,
+                 lane: Optional[int] = None):
         if kind not in ERROR_KINDS:
             raise ValueError(f"unknown fault error kind {kind!r} "
                              f"(one of {sorted(ERROR_KINDS)})")
@@ -91,6 +97,9 @@ class _Point:
         self.count = None if count in (None, "") else int(count)
         self.latency_ms = float(latency_ms)
         self.seed = int(seed)
+        # lane-scoped points (mesh.lane_delay): only checks carrying this
+        # lane index fire — the deterministic per-lane straggler injection
+        self.lane = None if lane in (None, "") else int(lane)
         self.checks = 0
         self.fires = 0
         self._rng = None    # built lazily; numpy import stays off hot path
@@ -113,7 +122,8 @@ class _Point:
     def describe(self) -> Dict:
         return dict(point=self.name, error=self.kind, rate=self.rate,
                     count=self.count, latency_ms=self.latency_ms,
-                    seed=self.seed, checks=self.checks, fires=self.fires)
+                    seed=self.seed, lane=self.lane, checks=self.checks,
+                    fires=self.fires)
 
 
 _LOCK = threading.Lock()
@@ -141,17 +151,18 @@ def _env_parse() -> None:
                 rate=float(kw.get("rate", 1.0)),
                 count=int(kw["count"]) if kw.get("count") else None,
                 latency_ms=float(kw.get("latency_ms", 0.0)),
-                seed=int(kw.get("seed", 0)))
+                seed=int(kw.get("seed", 0)),
+                lane=int(kw["lane"]) if kw.get("lane") else None)
         except (ValueError, TypeError) as e:
             raise ValueError(f"bad {k}={v!r}: {e}") from None
 
 
 def arm(point: str, error: str = "io", rate: float = 1.0,
         count: Optional[int] = None, latency_ms: float = 0.0,
-        seed: int = 0) -> Dict:
+        seed: int = 0, lane: Optional[int] = None) -> Dict:
     """Arm one fault point; returns its description."""
     global _ACTIVE
-    p = _Point(point, error, rate, count, latency_ms, seed)
+    p = _Point(point, error, rate, count, latency_ms, seed, lane=lane)
     with _LOCK:
         _POINTS[point] = p
         _ACTIVE = True
@@ -178,16 +189,20 @@ def active() -> bool:
     return _ACTIVE
 
 
-def check(point: str, detail: str = "") -> None:
+def check(point: str, detail: str = "", lane: Optional[int] = None) -> None:
     """The wired call sites' hook: no-op unless `point` is armed; sleeps
     the configured latency, then raises the configured error class when
-    the deterministic schedule says so."""
+    the deterministic schedule says so. `lane` scopes the check to a
+    lane-armed point (mesh.lane_delay): a point armed with ``lane=N``
+    only fires for checks carrying lane N."""
     if not _ACTIVE:             # unlocked fast path: default-off is free
         return
     global _TOTAL_FIRES
     with _LOCK:
         p = _POINTS.get(point)
         if p is None:
+            return
+        if p.lane is not None and (lane is None or int(lane) != p.lane):
             return
         p.checks += 1
         fire = p.should_fire()

@@ -45,8 +45,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from . import env_float, env_int
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
-           "get", "names", "snapshot", "prometheus_text", "bind_rest_field",
-           "rest_bindings", "register_collect_hook", "LATENCY_MS_BOUNDS"]
+           "get", "names", "snapshot", "prometheus_text", "export_state",
+           "bind_rest_field", "rest_bindings", "register_collect_hook",
+           "bucket_percentile", "LATENCY_MS_BOUNDS"]
 
 # shared fixed latency buckets (ms): serving, loadgen and REST request
 # histograms all bin into the same bounds so percentiles are comparable
@@ -64,6 +65,36 @@ _RING_INTERVAL_S = env_float("H2O3_METRICS_RING_INTERVAL_S", 1.0)
 # resolution is what saturates)
 _MAX_SERIES = env_int("H2O3_METRICS_MAX_SERIES", 256)
 _OVERFLOW = "_overflow"
+
+
+def bucket_percentile(bounds, counts, n, q, vmin=None, vmax=None):
+    """Bucket-interpolated q-quantile (q in [0,1]) over raw (bounds,
+    counts): linear interpolation within the owning bucket, min/max
+    clamping the open-ended buckets. The ONE estimator — shared by
+    `Histogram.percentile` and the fleet aggregator's merged-bucket
+    percentiles (runtime/fleet), so a per-replica p99 and the fleet p99
+    can never disagree on identical data."""
+    if not n:
+        return None
+    rank = q * (n - 1)
+    cum = 0
+    for i, cnt in enumerate(counts):
+        if cnt == 0:
+            continue
+        if rank < cum + cnt:
+            lo = bounds[i - 1] if i > 0 else (
+                vmin if vmin is not None else 0.0)
+            hi = bounds[i] if i < len(bounds) else (
+                vmax if vmax is not None else lo)
+            lo = max(lo, vmin) if vmin is not None else lo
+            hi = min(hi, vmax) if vmax is not None else hi
+            if hi <= lo:
+                return float(lo)
+            frac = (rank - cum + 1) / cnt if cnt > 1 else 0.5
+            frac = min(max(frac, 0.0), 1.0)
+            return float(lo + (hi - lo) * frac)
+        cum += cnt
+    return vmax
 
 
 def _sanitize_name(name: str) -> str:
@@ -246,6 +277,16 @@ class Gauge(_Metric):
     def set(self, v: float, *labelvalues) -> None:
         self._child(tuple(str(x) for x in labelvalues))._set(v)
 
+    def remove_series(self, *labelvalues) -> bool:
+        """Drop one labeled series. A gauge is CURRENT state — a series
+        whose subject no longer exists (a deregistered fleet peer, say)
+        must leave the scrape rather than freeze at its last value.
+        Counters stay monotone for the life of the process; only gauges
+        expose removal."""
+        key = tuple(str(x) for x in labelvalues)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
     def value(self, *labelvalues) -> float:
         if self._fn is not None:
             try:
@@ -337,27 +378,7 @@ class Histogram(_Metric):
         """Estimate the q-quantile (q in [0,1]) by linear interpolation
         within the owning bucket; min/max clamp the open-ended buckets."""
         counts, n, _total, vmin, vmax = self._counts(*labelvalues)
-        if n == 0:
-            return None
-        rank = q * (n - 1)
-        cum = 0
-        for i, cnt in enumerate(counts):
-            if cnt == 0:
-                continue
-            if rank < cum + cnt:
-                lo = self.bounds[i - 1] if i > 0 else (
-                    vmin if vmin is not None else 0.0)
-                hi = self.bounds[i] if i < len(self.bounds) else (
-                    vmax if vmax is not None else lo)
-                lo = max(lo, vmin) if vmin is not None else lo
-                hi = min(hi, vmax) if vmax is not None else hi
-                if hi <= lo:
-                    return float(lo)
-                frac = (rank - cum + 1) / cnt if cnt > 1 else 0.5
-                frac = min(max(frac, 0.0), 1.0)
-                return float(lo + (hi - lo) * frac)
-            cum += cnt
-        return vmax
+        return bucket_percentile(self.bounds, counts, n, q, vmin, vmax)
 
     def summary(self, *labelvalues) -> Dict:
         """The legacy LatencyHistogram.snapshot() shape + percentiles, so
@@ -510,6 +531,42 @@ def snapshot() -> Dict:
                     d["rate_1m"] = round(r, 3)
                 ser[",".join(lv) or ""] = d
             fam["series"] = ser
+        out[name] = fam
+    return out
+
+
+def export_state() -> Dict:
+    """LOSSLESS JSON view of every family — the cross-process aggregation
+    payload (``GET /3/Metrics?format=json``, consumed by runtime/fleet).
+
+    Unlike `snapshot()` (a human/profiler fold whose label tuples are
+    joined into display strings and whose histograms carry derived
+    percentiles), this export preserves exactly what merging needs:
+    labelnames + raw per-child label value lists, raw counter/gauge
+    values, and histogram bounds + per-bucket counts + sum/min/max — so an
+    aggregator can SUM counters, bucket-merge histograms (keeping
+    p50/p95/p99 exact over the merged buckets) and keep gauges per-peer."""
+    _run_collect_hooks()
+    with _LOCK:
+        metrics = dict(_METRICS)
+    out: Dict[str, Dict] = {}
+    for name, m in sorted(metrics.items()):
+        fam: Dict = dict(kind=m.kind, help=m.help,
+                         labelnames=list(m.labelnames))
+        if isinstance(m, Histogram):
+            fam["bounds"] = list(m.bounds)
+            series = []
+            for lv, c in sorted(m.children().items()):
+                with c._lock:
+                    series.append(dict(labels=list(lv),
+                                       counts=list(c.counts), n=c.n,
+                                       sum=c.total, min=c.vmin, max=c.vmax))
+            fam["series"] = series
+        elif isinstance(m, Gauge) and m._fn is not None:
+            fam["series"] = [dict(labels=[], value=m.value())]
+        else:
+            fam["series"] = [dict(labels=list(lv), value=c.value())
+                             for lv, c in sorted(m.children().items())]
         out[name] = fam
     return out
 

@@ -142,6 +142,17 @@ def memory_stats() -> Dict:
     return out
 
 
+def fleet_stats() -> Dict:
+    """Fleet-aggregation fold (ISSUE 13): registered peers + last scrape
+    status + scrape counters. `scrape=False` — a profiler read must never
+    block on peer HTTP round-trips; GET /3/Fleet is the probing surface."""
+    from . import fleet
+
+    out = fleet.snapshot(scrape=False)
+    out["active"] = bool(out["totals"]["peers"])
+    return out
+
+
 def registry_stats() -> Dict:
     """The central metrics registry's JSON view (counters/gauges/histogram
     summaries + windowed rates) — the /3/Profiler fold of the same store

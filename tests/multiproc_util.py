@@ -19,7 +19,20 @@ WORKER_PRELUDE = textwrap.dedent("""
     sys.path.insert(0, {repo!r})
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices config option — the
+        # XLA_FLAGS run_workers exports is the same lever there
+        pass
+    try:
+        # jax 0.4.x CPU backend: cross-process collectives need the gloo
+        # implementation selected explicitly (default "none" raises
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend"); newer jax selects a working default itself
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
     jax.distributed.initialize(
         coordinator_address=os.environ["H2O3_TEST_COORD"],
         num_processes=int(os.environ["H2O3_TEST_NPROCS"]),
@@ -41,7 +54,11 @@ def run_workers(n: int, body: str, extra_env=None, timeout=300):
     coord = f"127.0.0.1:{free_port()}"
     script = WORKER_PRELUDE.format(repo=REPO) + textwrap.dedent(body)
     env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
+    # exactly ONE cpu device per worker: replace the parent suite's
+    # 8-virtual-device XLA_FLAGS rather than inheriting it (on jax < 0.5
+    # this flag is also the only working lever — the jax_num_cpu_devices
+    # config option does not exist there, see WORKER_PRELUDE)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["PALLAS_AXON_POOL_IPS"] = ""   # disable the axon TPU hook
     env["JAX_PLATFORMS"] = "cpu"
     env["H2O3_TEST_COORD"] = coord

@@ -1,0 +1,426 @@
+"""Fleet-scope observability (ISSUE 13): cross-process metric
+aggregation and trace merging.
+
+Tier-1 section: the merge semantics as PURE functions (counters sum,
+histogram buckets sum so percentiles stay exact, gauges keep per-replica
+series, unreachable peers become explicit `h2o3_fleet_peer_up 0`, trace
+merges get one process track per replica), plus the REST face against a
+canned stub peer — no subprocesses, no jax work, tier-1-cheap by design
+(the tier-1 budget is ~826 s of the 870 s timeout).
+
+Slow section: the real thing — two LIVE peer processes each running a
+full REST server, scraped and merged by an in-process aggregator, then
+one peer killed mid-flight (the acceptance pin: summed counters,
+bucket-merged latency histograms, killed peer marked down)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from h2o3_tpu.runtime import fleet
+from h2o3_tpu.runtime import metrics_registry as registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet():
+    fleet.reset()
+    yield
+    fleet.reset()
+
+
+def _counter(value_by_labels, labelnames=("k",)):
+    return dict(kind="counter", help="h", labelnames=list(labelnames),
+                series=[dict(labels=list(lv), value=v)
+                        for lv, v in value_by_labels.items()])
+
+
+def _hist(bounds, series):
+    return dict(kind="histogram", help="h", labelnames=["k"],
+                bounds=list(bounds), series=series)
+
+
+# -- merge semantics (pure) --------------------------------------------------
+
+def test_merge_counters_sum_across_replicas():
+    sA = {"h2o3_x": _counter({("a",): 3.0, ("b",): 1.0})}
+    sB = {"h2o3_x": _counter({("a",): 4.0})}
+    m = fleet.merge_states([("r1", sA), ("r2", sB)])
+    fam = m["families"]["h2o3_x"]
+    assert fam["kind"] == "counter"
+    by = {tuple(s["labels"]): s["value"] for s in fam["series"]}
+    assert by == {("a",): 7.0, ("b",): 1.0}
+    # rendered as one fleet total, no replica label on counters
+    text = fleet.render_prometheus(m)
+    assert 'h2o3_x_total{k="a"} 7' in text
+    assert "replica" not in text.split("h2o3_x_total", 1)[1].split("\n")[0]
+
+
+def test_merge_histogram_buckets_sum_and_percentiles_stay_exact():
+    bounds = [1.0, 10.0, 100.0]
+    sA = {"h2o3_ms": _hist(bounds, [dict(labels=["m"], counts=[2, 3, 0, 0],
+                                         n=5, sum=20.0, min=0.5, max=9.0)])}
+    sB = {"h2o3_ms": _hist(bounds, [dict(labels=["m"], counts=[0, 1, 4, 0],
+                                         n=5, sum=220.0, min=2.0,
+                                         max=95.0)])}
+    m = fleet.merge_states([("r1", sA), ("r2", sB)])
+    s = m["families"]["h2o3_ms"]["series"][0]
+    # bucket-wise sums: the merged histogram is EXACTLY the histogram of
+    # the union of observations, so any percentile computed from it is
+    # the true fleet percentile (not an average of per-replica quantiles)
+    assert s["counts"] == [2, 4, 4, 0]
+    assert s["n"] == 10 and s["sum"] == 240.0
+    assert s["min"] == 0.5 and s["max"] == 95.0
+    p50 = fleet._bucket_percentile(bounds, s["counts"], s["n"], 0.50,
+                                   s["min"], s["max"])
+    assert 1.0 <= p50 <= 10.0          # rank 4.5 lands in the (1,10] bucket
+    p99 = fleet._bucket_percentile(bounds, s["counts"], s["n"], 0.99,
+                                   s["min"], s["max"])
+    assert 10.0 <= p99 <= 95.0         # clamped by the fleet max
+    # exposition: cumulative buckets + +Inf + _sum/_count
+    text = fleet.render_prometheus(m)
+    assert 'h2o3_ms_bucket{k="m",le="1"} 2' in text
+    assert 'h2o3_ms_bucket{k="m",le="10"} 6' in text
+    assert 'h2o3_ms_bucket{k="m",le="+Inf"} 10' in text
+    assert 'h2o3_ms_count{k="m"} 10' in text
+
+
+def test_merge_gauges_keep_per_replica_series():
+    sA = {"h2o3_g": dict(kind="gauge", help="h", labelnames=[],
+                         series=[dict(labels=[], value=0.25)])}
+    sB = {"h2o3_g": dict(kind="gauge", help="h", labelnames=[],
+                         series=[dict(labels=[], value=0.75)])}
+    m = fleet.merge_states([("r1", sA), ("r2", sB)])
+    fam = m["families"]["h2o3_g"]
+    assert fam["labelnames"] == ["replica"]
+    by = {tuple(s["labels"]): s["value"] for s in fam["series"]}
+    # NOT summed: a gauge is process state, attributed per replica
+    assert by == {("r1",): 0.25, ("r2",): 0.75}
+    text = fleet.render_prometheus(m)
+    assert 'h2o3_g{replica="r1"} 0.25' in text
+
+
+def test_unreachable_peer_is_explicit_peer_up_zero():
+    m = fleet.merge_states([("r1", {"h2o3_x": _counter({("a",): 1.0})}),
+                            ("dead", None)])
+    assert m["peer_up"] == {"r1": 1, "dead": 0}
+    text = fleet.render_prometheus(m)
+    assert 'h2o3_fleet_peer_up{replica="dead"} 0' in text
+    assert 'h2o3_fleet_peer_up{replica="r1"} 1' in text
+    # the down peer did not shrink the scrape: r1's data is still there
+    assert 'h2o3_x_total{k="a"} 1' in text
+
+
+def test_merge_conflicting_shapes_drop_not_corrupt():
+    sA = {"h2o3_ms": _hist([1, 10], [dict(labels=["m"], counts=[1, 0, 0],
+                                          n=1, sum=0.5, min=0.5, max=0.5)])}
+    sB = {"h2o3_ms": _hist([1, 10, 100],          # version-skewed bounds
+                           [dict(labels=["m"], counts=[0, 1, 0, 0],
+                                 n=1, sum=5.0, min=5.0, max=5.0)])}
+    m = fleet.merge_states([("r1", sA), ("r2", sB)])
+    s = m["families"]["h2o3_ms"]["series"][0]
+    assert s["n"] == 1 and s["counts"] == [1, 0, 0]   # first shape kept
+    assert m["dropped_series"] == 1                    # loudly counted
+
+
+def test_merge_conflicting_label_arity_drops_not_zips():
+    # version-skewed LABELS: same name+kind, an extra labelname on r2 —
+    # zipping ["get","200"] against ["op"] would silently truncate into
+    # a duplicate {op="get"} series; it must drop + count instead
+    sA = {"h2o3_x": _counter({("get",): 3.0}, labelnames=("op",))}
+    sB = {"h2o3_x": _counter({("get", "200"): 4.0},
+                             labelnames=("op", "status"))}
+    m = fleet.merge_states([("r1", sA), ("r2", sB)])
+    assert [s["value"] for s in m["families"]["h2o3_x"]["series"]] == [3.0]
+    assert m["dropped_series"] == 1
+
+
+def test_remove_peer_clears_liveness_series():
+    from h2o3_tpu.runtime import metrics_registry as registry
+
+    fleet.reset()
+    fleet.register_peer("gone", "http://127.0.0.1:1")
+    fleet.scrape_states()                       # marks peer_up{gone} 0
+    assert 'h2o3_fleet_peer_up{replica="gone"} 0' in registry.prometheus_text()
+    assert fleet.remove_peer("gone")
+    # a decommissioned replica's LIVENESS series leaves the scrape — a
+    # frozen peer_up 0 would alert forever for a peer that no longer
+    # exists (the monotone scrape counters keep their history, correctly)
+    text = registry.prometheus_text()
+    assert 'h2o3_fleet_peer_up{replica="gone"}' not in text
+    assert 'h2o3_fleet_scrapes_total{replica="gone"} 1' in text
+    fleet.reset()
+
+
+def test_trace_merge_one_process_track_per_replica():
+    trA = dict(traceEvents=[
+        dict(name="GET /3/Ping", cat="request", ph="X", ts=1.0, dur=2.0,
+             pid=4242, tid=1, args={}),
+    ])
+    trB = dict(traceEvents=[
+        dict(name="job:gbm", cat="job", ph="X", ts=2.0, dur=5.0,
+             pid=777, tid=3, args={}),
+    ])
+    merged = fleet.merge_traces([("router", trA), ("worker", trB),
+                                 ("gone", None)])
+    tracks = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("name") == "process_name"}
+    assert tracks == {1: "replica:router", 2: "replica:worker"}
+    # span events were re-pid'd onto their replica's track
+    spans = {e["name"]: e["pid"] for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans == {"GET /3/Ping": 1, "job:gbm": 2}
+    assert merged["otherData"]["unreachable"] == ["gone"]
+
+
+def test_export_state_is_lossless_for_merging():
+    """The registry's own export feeds the merge unchanged: one peer's
+    export merged alone must reproduce its counters/buckets exactly."""
+    c = registry.counter("h2o3_fleet_test_ctr", "t", labelnames=("k",))
+    c.inc(5, "x")
+    h = registry.histogram("h2o3_fleet_test_ms", "t", bounds=(1, 10),
+                           labelnames=("k",))
+    h.observe(0.5, "x")
+    h.observe(7.0, "x")
+    state = registry.export_state()
+    m = fleet.merge_states([("solo", state)])
+    ctr = m["families"]["h2o3_fleet_test_ctr"]
+    assert any(s["labels"] == ["x"] and s["value"] == 5.0
+               for s in ctr["series"])
+    hs = [s for s in m["families"]["h2o3_fleet_test_ms"]["series"]
+          if s["labels"] == ["x"]][0]
+    assert hs["counts"] == [1, 1, 0] and hs["n"] == 2
+    assert hs["min"] == 0.5 and hs["max"] == 7.0
+
+
+# -- REST face against a canned stub peer (tier-1 cheap) ---------------------
+
+PEER_BOUNDS = list(registry.LATENCY_MS_BOUNDS)
+
+
+def _stub_state():
+    return {
+        "h2o3_rest_requests": dict(
+            kind="counter", help="x", labelnames=["handler", "status"],
+            series=[dict(labels=["ping", "200"], value=11.0)]),
+        "h2o3_rest_request_ms": dict(
+            kind="histogram", help="x", labelnames=["handler"],
+            bounds=PEER_BOUNDS,
+            series=[dict(labels=["predict"],
+                         counts=[0] * 4 + [6] + [0] * (len(PEER_BOUNDS) - 4),
+                         n=6, sum=48.0, min=6.0, max=9.5)]),
+        "h2o3_memory_pressure_stub": dict(
+            kind="gauge", help="x", labelnames=[],
+            series=[dict(labels=[], value=0.42)]),
+    }
+
+
+class _StubPeer(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if "/3/Metrics" in self.path:
+            body = json.dumps(_stub_state()).encode()
+        else:
+            body = json.dumps(dict(traceEvents=[
+                dict(name="peer_span", cat="job", ph="X", ts=1.0, dur=2.0,
+                     pid=9, tid=1, args={})])).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def stub_peer():
+    srv = HTTPServer(("127.0.0.1", 0), _StubPeer)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture(scope="module")
+def fleet_server():
+    from h2o3_tpu.rest.server import start_server
+
+    srv = start_server(port=0)
+    yield srv
+    srv.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.read().decode()
+
+
+def _post(port, path, data):
+    import urllib.parse
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=urllib.parse.urlencode(data).encode())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.read().decode()
+
+
+def test_rest_fleet_scrape_merges_and_marks_downed_peer(fleet_server,
+                                                       stub_peer):
+    _post(fleet_server.port, "/3/Fleet",
+          dict(name="r1", url=f"http://127.0.0.1:{stub_peer.server_port}"))
+    registry.counter("h2o3_rest_requests", "x",
+                     labelnames=("handler", "status")).inc(4, "ping", "200")
+    local = registry.get("h2o3_rest_requests").value("ping", "200")
+    text = _get(fleet_server.port, "/3/Metrics?scope=fleet")
+    # summed counter: stub's 11 + everything this process counted
+    line = [l for l in text.splitlines()
+            if l.startswith('h2o3_rest_requests_total{handler="ping"')][0]
+    assert float(line.rsplit(" ", 1)[1]) == local + 11.0
+    assert 'h2o3_fleet_peer_up{replica="r1"} 1' in text
+    # per-replica gauge attribution
+    assert 'h2o3_memory_pressure_stub{replica="r1"} 0.42' in text
+    # the /3/Fleet fold sees the peer's serving essentials
+    doc = json.loads(_get(fleet_server.port, "/3/Fleet"))
+    row = [r for r in doc["peers"] if r["name"] == "r1"][0]
+    assert row["up"] == 1 and row["predict_count"] == 6
+    assert 6.0 <= row["predict_p99_ms"] <= 9.5
+    # kill the peer: the next scrape marks it down EXPLICITLY
+    stub_peer.shutdown()
+    stub_peer.server_close()
+    text2 = _get(fleet_server.port, "/3/Metrics?scope=fleet")
+    assert 'h2o3_fleet_peer_up{replica="r1"} 0' in text2
+    doc2 = json.loads(_get(fleet_server.port, "/3/Fleet"))
+    row2 = [r for r in doc2["peers"] if r["name"] == "r1"][0]
+    assert row2["up"] == 0 and row2["last_error"]
+    # unregister
+    assert json.loads(_get(fleet_server.port, "/3/Fleet?probe=0"))[
+        "totals"]["peers"] == 1
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{fleet_server.port}/3/Fleet?name=r1",
+        method="DELETE")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read())["removed"] is True
+
+
+def test_rest_fleet_trace_scope_tracks(fleet_server, stub_peer):
+    _post(fleet_server.port, "/3/Fleet",
+          dict(name="r1", url=f"http://127.0.0.1:{stub_peer.server_port}"))
+    doc = json.loads(_get(fleet_server.port, "/3/Trace?scope=fleet"))
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"replica:self", "replica:r1"}
+    assert any(e.get("name") == "peer_span" for e in doc["traceEvents"])
+
+
+def test_rest_metrics_format_json_is_lossless(fleet_server):
+    registry.histogram("h2o3_fleet_test_ms2", "t",
+                       bounds=(1, 10)).observe(3.0)
+    doc = json.loads(_get(fleet_server.port, "/3/Metrics?format=json"))
+    fam = doc["h2o3_fleet_test_ms2"]
+    assert fam["kind"] == "histogram" and fam["bounds"] == [1.0, 10.0]
+    assert fam["series"][0]["counts"] == [0, 1, 0]
+
+
+def test_profiler_carries_fleet_fold(fleet_server):
+    fleet.register_peer("rp", "http://127.0.0.1:1")
+    doc = json.loads(_get(fleet_server.port, "/3/Profiler"))
+    assert doc["fleet"]["totals"]["peers"] >= 1
+    # profiler fold never scrapes (no blocking on dead peers): the row is
+    # registration state only
+    assert any(p["name"] == "rp" for p in doc["fleet"]["peers"])
+
+
+# -- the real thing: two live peer PROCESSES (slow lane) ---------------------
+
+PEER_BODY = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["H2O3_REPLICA_NAME"] = {name!r}
+from h2o3_tpu.rest.server import start_server
+import urllib.request
+srv = start_server(port={port})
+for _ in range(5):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/3/Ping", timeout=10) as r:
+        r.read()
+print("READY", flush=True)
+time.sleep(120)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_fleet_scrape_two_live_peer_processes(fleet_server):
+    """The acceptance pin: an aggregator with >= 2 live peer PROCESSES
+    returns summed counters and bucket-merged latency histograms labelled
+    per replica; a killed peer reports as h2o3_fleet_peer_up 0."""
+    ports = [_free_port(), _free_port()]
+    procs = []
+    try:
+        for i, port in enumerate(ports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 PEER_BODY.format(repo=REPO, name=f"p{i + 1}", port=port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        for i, p in enumerate(procs):
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                line = p.stdout.readline()
+                if "READY" in line:
+                    break
+                if p.poll() is not None:
+                    raise AssertionError(
+                        f"peer {i} died: {p.stdout.read()[-2000:]}")
+            else:
+                raise AssertionError(f"peer {i} never came up")
+        for i, port in enumerate(ports):
+            _post(fleet_server.port, "/3/Fleet",
+                  dict(name=f"p{i + 1}", url=f"http://127.0.0.1:{port}"))
+        text = _get(fleet_server.port, "/3/Metrics?scope=fleet")
+        # counters sum: each live peer drove 5 pings through itself
+        line = [l for l in text.splitlines()
+                if l.startswith('h2o3_rest_requests_total{handler="ping"')]
+        assert line, text[:2000]
+        local = registry.get("h2o3_rest_requests")
+        local_pings = local.value("ping", "200") if local else 0.0
+        assert float(line[0].rsplit(" ", 1)[1]) == local_pings + 10.0
+        # bucket-merged latency histogram, fleet-wide count covers both
+        cnt = [l for l in text.splitlines()
+               if l.startswith('h2o3_rest_request_ms_count'
+                               '{handler="ping"}')]
+        assert cnt and float(cnt[0].rsplit(" ", 1)[1]) >= 10
+        assert 'h2o3_fleet_peer_up{replica="p1"} 1' in text
+        assert 'h2o3_fleet_peer_up{replica="p2"} 1' in text
+        # the merged histogram really is bucket series, not a summary
+        assert 'h2o3_rest_request_ms_bucket{handler="ping",le="+Inf"}' \
+            in text
+        # kill one replica: explicit down-marking, no silent shrink
+        procs[1].kill()
+        procs[1].wait(timeout=30)
+        text2 = _get(fleet_server.port, "/3/Metrics?scope=fleet")
+        assert 'h2o3_fleet_peer_up{replica="p2"} 0' in text2
+        assert 'h2o3_fleet_peer_up{replica="p1"} 1' in text2
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -3,7 +3,27 @@ jax.distributed (the reference's multi-JVM loopback cloud, SURVEY.md §4)
 must reproduce the single-process model within tolerance — VERDICT r01
 item 5. Ingest is per-process byte ranges (distributed_parse), so these
 tests exercise the full distributed path: parse → global domains → global
-row-sharded arrays → collective training math."""
+row-sharded arrays → collective training math.
+
+SLOW LANE (ISSUE 13 triage): this whole module runs `slow`. The suite
+was the tier-1 baseline's 18-failure block — a jax-version skew in the
+worker prelude (`jax_num_cpu_devices` does not exist on jax < 0.5) made
+every spawn die at import; the prelude now falls back to XLA_FLAGS
+(multiproc_util.WORKER_PRELUDE) and the tests pass again. They stay out
+of tier-1 because each spawns 2-4 fresh interpreters that pay a full
+jax + platform import and an end-to-end train (~40-150 s per test on
+the 1-core CI box, ~3.5 min for the module) against a tier-1 budget
+that is already ~826 s of the 870 s timeout. The spawn machinery itself
+keeps a tier-1 canary (tests/test_distributed_parse.py::
+test_two_process_bit_identical runs run_workers in ~1.5 s), the
+8-virtual-device mesh suite (tests/test_tree_sharded.py) covers the
+collective lowering, and the fleet-aggregation tests
+(tests/test_fleet.py) cover real multi-process scraping; full
+cross-process training parity runs here in the slow lane and in the
+MULTICHIP dryrun. Two fixes made the suite green again: gloo CPU
+collectives selected explicitly (jax 0.4.x default "none" cannot run
+multiprocess programs) and check_rep=False on the mesh_psum tree step
+(the 0.4.x replication checker rejects the level loop's psum carry)."""
 
 import csv
 
@@ -11,6 +31,8 @@ import numpy as np
 import pytest
 
 from tests.multiproc_util import run_workers
+
+pytestmark = pytest.mark.slow
 
 
 def _write_glm_csv(path, n=4000, seed=11):

@@ -399,7 +399,9 @@ def _register_all_subsystems():
     lazily on first record; the scrape/consistency checks need the
     declarations, not traffic)."""
     from h2o3_tpu.frame import ingest_stats, munge_stats
-    from h2o3_tpu.runtime import faults, memory_ledger, retry, trainpool
+    from h2o3_tpu.parallel import mesh
+    from h2o3_tpu.runtime import faults, fleet, memory_ledger, retry, \
+        trainpool
     from h2o3_tpu.serving import metrics as serving_metrics
 
     serving_metrics._registry()
@@ -409,6 +411,8 @@ def _register_all_subsystems():
     retry._reg_counter()
     faults._fired_counter(registry)
     memory_ledger._registry()
+    fleet._registry()          # fleet families + /3/Fleet bindings
+    mesh._lane_registry()      # collective-skew/straggler families
 
 
 def test_rest_metrics_prometheus_endpoint(obs_server, cloud1):

@@ -358,3 +358,182 @@ def test_sharded_device_codes_cached_per_shard_layout(_shard_env):
         est.train(y="label", training_frame=fr)
     snap = dataset_cache.snapshot()
     assert snap["device_hits"] >= 1, snap
+
+
+# -- per-lane collective skew + straggler detection (ISSUE 13) ---------------
+
+def test_lane_recorder_flush_and_straggler_detection(monkeypatch):
+    """Host-level contract of the lane-timing recorder: 8 concurrent
+    arrival callbacks flush one fence record; a lane whose arrival is
+    delayed by the `mesh.lane_delay` fault persistently past the median
+    fires the straggler counter for EXACTLY that lane. Runs the real
+    callback path (faults.check inside _lane_arrive_cb) without device
+    programs — tier-1 cheap."""
+    import threading
+
+    from h2o3_tpu.runtime import faults, metrics_registry as registry
+
+    # explicit 8-device cloud: the fence flushes when every lane of the
+    # CURRENT cloud has reported (the session cloud8 fixture's global
+    # cloud is reset between tests — init fresh, don't depend on order)
+    cloudlib.init(jax.devices())
+    cloudlib.lane_reset()
+    monkeypatch.setenv("H2O3_STRAGGLER_FENCES", "2")
+    faults.arm("mesh.lane_delay", error="none", latency_ms=150, lane=2)
+    try:
+        for _fence in range(3):
+            ts = [threading.Thread(target=cloudlib._lane_arrive_cb,
+                                   args=("t", lane)) for lane in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        st = cloudlib.lane_stats()
+        assert st["fences"] == 3
+        rec = st["records"][-1]
+        assert len(rec["waits_ms"]) == 8
+        worst = max(rec["waits_ms"], key=rec["waits_ms"].get)
+        assert worst == "2", rec
+        assert rec["skew_ms"] >= 100
+        # fired once per streak (at the 2nd consecutive flagged fence),
+        # for the delayed lane ONLY
+        assert st["stragglers"] == {"2": 1}, st
+        c = registry.get("h2o3_stragglers")
+        assert c.value("2") >= 1
+        # fence + skew surfaces reached the scrape
+        text = registry.prometheus_text()
+        assert 'h2o3_stragglers_total{lane="2"}' in text
+        assert "h2o3_collective_skew_ms_bucket" in text
+    finally:
+        faults.reset()
+        cloudlib.lane_reset()
+
+
+def test_straggler_fires_on_two_lane_mesh(monkeypatch):
+    """Lower-median threshold: with only 2 lanes the healthy lane sets
+    the baseline — the upper middle would be the straggler's own wait
+    (threshold = factor x itself, unfirable)."""
+    import threading
+
+    from h2o3_tpu.runtime import faults
+
+    cloudlib.init(jax.devices()[:2])
+    cloudlib.lane_reset()
+    monkeypatch.setenv("H2O3_STRAGGLER_FENCES", "2")
+    faults.arm("mesh.lane_delay", error="none", latency_ms=120, lane=1)
+    try:
+        for _fence in range(2):
+            ts = [threading.Thread(target=cloudlib._lane_arrive_cb,
+                                   args=("t", lane)) for lane in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        assert cloudlib.lane_stats()["stragglers"] == {"1": 1}
+    finally:
+        faults.reset()
+        cloudlib.lane_reset()
+
+
+def test_lane_summary_and_last_waits():
+    """lane_summary folds only the fences after `since_seq` (the per-fit
+    attribution window) and lane_last_waits is the watchdog's host-only
+    read."""
+    import threading
+
+    cloudlib.init(jax.devices())
+    cloudlib.lane_reset()
+    try:
+        def fence():
+            ts = [threading.Thread(target=cloudlib._lane_arrive_cb,
+                                   args=("t", lane)) for lane in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        fence()
+        seq0 = cloudlib.lane_seq()
+        assert seq0 == 1
+        fence()
+        s = cloudlib.lane_summary(seq0)
+        assert s["fences"] == 1            # only the post-seq0 fence
+        assert set(s["per_lane_max_ms"]) == {str(i) for i in range(8)}
+        lw = cloudlib.lane_last_waits()
+        assert len(lw) == 8 and all(isinstance(k, int) for k in lw)
+        # a hung fence (lanes 6,7 never arrive) takes priority in the
+        # watchdog read: the MISSING lanes are the suspects
+        for lane in range(6):
+            cloudlib._lane_arrive_cb("t", lane)
+        hung = cloudlib.lane_last_waits()
+        assert set(hung) == set(range(6)), hung
+    finally:
+        cloudlib.lane_reset()
+
+
+@pytest.mark.slow
+def test_injected_lane_delay_fires_straggler_on_exact_lane(_shard_env,
+                                                          monkeypatch):
+    """The acceptance pin: a WHOLE sharded GBM fit with an injected
+    `mesh.lane_delay` fault on lane 5 fires the straggler detector on
+    exactly lane 5, deterministically; the fit plan carries the skew
+    summary naming the same lane."""
+    from h2o3_tpu.models import dataset_cache
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.runtime import faults, metrics_registry as registry
+
+    dataset_cache.clear()
+    cloudlib.reset()
+    cloudlib.init(jax.devices())
+    cloudlib.lane_reset()
+    monkeypatch.setenv("H2O3_STRAGGLER_FENCES", "2")
+    before = registry.get("h2o3_stragglers")
+    before5 = before.value("5") if before else 0.0
+    faults.arm("mesh.lane_delay", error="none", latency_ms=120, lane=5)
+    try:
+        est = H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=3,
+                                           score_tree_interval=1)
+        est.train(y="label", training_frame=_frame())
+        st = cloudlib.lane_stats()
+        assert st["fences"] >= 3, st
+        assert set(st["stragglers"]) == {"5"}, st
+        c = registry.get("h2o3_stragglers")
+        assert c.value("5") == before5 + 1
+        plan = histogram.kernel_stats()["plans"][-1]
+        skew = plan.get("collective_skew")
+        assert skew and skew["worst_lane"] == 5, plan
+        assert skew["skew_max_ms"] >= 100
+        assert skew["fences"] == st["fences"]
+    finally:
+        faults.reset()
+        cloudlib.lane_reset()
+        dataset_cache.clear()
+
+
+@pytest.mark.slow
+def test_lane_timing_quiet_without_fault_and_off_hot_path(_shard_env):
+    """Without injected latency an 8-device fit records fences whose skew
+    is benign and fires NO straggler; fences count scoring events, not
+    levels (the instrument must stay off the per-level hot path)."""
+    from h2o3_tpu.models import dataset_cache
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    dataset_cache.clear()
+    cloudlib.reset()
+    cloudlib.init(jax.devices())
+    cloudlib.lane_reset()
+    try:
+        ntrees, interval = 8, 2
+        est = H2OGradientBoostingEstimator(ntrees=ntrees, max_depth=3,
+                                           seed=3,
+                                           score_tree_interval=interval)
+        est.train(y="label", training_frame=_frame())
+        st = cloudlib.lane_stats()
+        assert st["fences"] >= 1
+        # one instrumented fence per scoring event (+ warm-up), NEVER one
+        # per level: depth-3 x 8 trees would be >= 24 level passes
+        assert st["fences"] <= ntrees // interval + 2, st
+        assert st["stragglers"] == {}, st
+    finally:
+        cloudlib.lane_reset()
+        dataset_cache.clear()
