@@ -296,6 +296,89 @@ def bench_score():
             {"auc": round(float(perf.auc()), 5)})
 
 
+def bench_oversubscription():
+    """Out-of-core streaming lane (ISSUE 14): a GBM fit whose packed code
+    matrix is ~10× the stream budget, measured three ways in one record —
+    STREAMED (`H2O3_TREE_OOC=1`, blocked host↔device double buffering),
+    the IN-CORE comparator (`H2O3_TREE_OOC=0` + the matching blocked
+    reduction — the bit-identical baseline), and streamed with
+    gradient-based SAMPLING on (`goss=True`: later trees stream a fraction
+    of the bytes). Forced-CPU like gbm_cpu, so the lane keeps measuring
+    the streaming machinery when the accelerator tunnel is down and stays
+    comparable round over round; the budget is forced small
+    (`H2O3_STREAM_BUDGET_MB` = matrix/10) so oversubscription is real on
+    any host. The record embeds streamed bytes, the resident-block peak
+    (asserted ≤ budget) and block counters next to the memory embeds."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 120_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 12))
+    max_depth = int(os.environ.get("BENCH_DEPTH", 5))
+    n_feat = 16
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.dataset_cache import clear as _cache_clear
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    X, y = make_higgs_like(n_rows, n_feat=n_feat)
+    names = [f"f{i}" for i in range(n_feat)] + ["label"]
+    # 5-bit pack at the default nbins=20 → ~n·F·5/8 packed bytes; force
+    # the budget to a tenth of that so the fit is genuinely out of core
+    budget_mb = max(n_rows * n_feat * 5 / 8 / 1e6 / 10, 0.05)
+    keys = ("H2O3_TREE_OOC", "H2O3_STREAM_BUDGET_MB", "H2O3_TREE_SHARD",
+            "H2O3_TREE_SHARD_BLOCKS", "H2O3_STREAM_BLOCKS",
+            "H2O3_WARM_THREAD")
+
+    def run(env, goss=False):
+        _cache_clear()
+        saved = {k: os.environ.pop(k, None) for k in keys}
+        os.environ.update(env)
+        try:
+            fr = Frame.from_numpy(np.column_stack([X, y]),
+                                  names=names).asfactor("label")
+            gbm = H2OGradientBoostingEstimator(
+                ntrees=ntrees, max_depth=max_depth, learn_rate=0.1,
+                histogram_type="UniformAdaptive", seed=42,
+                score_tree_interval=max(ntrees // 4, 1),
+                **(dict(goss=True, goss_start_tree=max(ntrees // 4, 1))
+                   if goss else {}))
+            t0 = time.perf_counter()
+            gbm.train(y="label", training_frame=fr)
+            return time.perf_counter() - t0, gbm
+        finally:
+            for k in keys:
+                os.environ.pop(k, None)
+                if saved.get(k) is not None:
+                    os.environ[k] = saved[k]
+
+    budget = f"{budget_mb:.3f}"
+    wall_stream, m_stream = run({"H2O3_TREE_OOC": "1",
+                                 "H2O3_STREAM_BUDGET_MB": budget})
+    st = getattr(m_stream.model, "_stream_stats", {}) or {}
+    # in-core comparator shares the streamed fit's block grid so the two
+    # walls bracket the same bit-identical computation
+    blocks = str(st.get("blocks", 8))
+    # warm thread off for the comparator: streamed fits already skip it,
+    # and on 1-core hosts it can futex-hang the in-core pure_callback
+    # host kernel at >= 32768 padded rows (docs/perf.md) — this lane must
+    # never wedge on the comparator rep
+    wall_incore, _ = run({"H2O3_TREE_OOC": "0", "H2O3_TREE_SHARD": "1",
+                          "H2O3_TREE_SHARD_BLOCKS": blocks,
+                          "H2O3_WARM_THREAD": "0"})
+    wall_goss, m_goss = run({"H2O3_TREE_OOC": "1",
+                             "H2O3_STREAM_BUDGET_MB": budget}, goss=True)
+    gs = getattr(m_goss.model, "_stream_stats", {}) or {}
+    return (f"oversub_{n_rows//1000}k_{ntrees}trees_wall_s", wall_stream,
+            {"auc": round(float(m_stream.auc()), 5),
+             "n_devices": _note_devices(),
+             "stream_budget_mb": float(budget),
+             "incore_wall_s": round(wall_incore, 3),
+             "goss_wall_s": round(wall_goss, 3),
+             "vs_incore": round(wall_incore / wall_stream, 3),
+             "goss_vs_streamed": round(wall_stream / wall_goss, 3),
+             "streamed_bytes": st.get("streamed_bytes"),
+             "goss_streamed_bytes": gs.get("streamed_bytes"),
+             "resident_block_peak": st.get("resident_block_peak"),
+             "stream": st or None})
+
+
 from contextlib import contextmanager
 
 
@@ -967,6 +1050,19 @@ def _memory_embed() -> dict:
         out["peak_owners"] = wm["top_owners"]
     except Exception:
         pass
+    try:
+        # out-of-core stream totals (ISSUE 14): ride next to the memory
+        # embeds in every record when the streamed path ran this process
+        import sys as _sys
+
+        bs = _sys.modules.get("h2o3_tpu.models.block_store")
+        if bs is not None:
+            st = bs.process_totals()
+            if st.get("streamed_bytes"):
+                out["streamed_bytes"] = int(st["streamed_bytes"])
+                out["resident_block_peak"] = int(st["resident_block_peak"])
+    except Exception:
+        pass
     return out
 
 
@@ -1176,8 +1272,8 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     cpu_fallback_reason = None
     forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
-    if config in ("scaling", "munge", "chaos", "serving", "gbm_cpu") \
-            or forced:
+    if config in ("scaling", "munge", "chaos", "serving", "gbm_cpu",
+                  "oversubscription") or forced:
         # the scaling curve runs in CPU subprocesses, the munge bench is
         # pure host numpy, the chaos/serving lanes measure FAILOVER/SLO
         # behavior (CPU is representative), and gbm_cpu IS the forced-CPU
@@ -1243,7 +1339,8 @@ def main():
           "score": bench_score, "scaling": bench_scaling,
           "ingest": bench_ingest, "munge": bench_munge,
           "grid": bench_grid, "chaos": bench_chaos,
-          "serving": bench_serving, "gbm_cpu": bench_gbm_cpu}[config]
+          "serving": bench_serving, "gbm_cpu": bench_gbm_cpu,
+          "oversubscription": bench_oversubscription}[config]
     # cold is strictly one run: repeats within a process share the live
     # executable cache, so any second run would be warm yet labeled cold
     repeats = 1 if cold else int(os.environ.get(

@@ -169,6 +169,21 @@ def _ledger_total_bytes() -> Optional[int]:
         return None
 
 
+def _stream_totals() -> Optional[Dict]:
+    """Out-of-core stream totals (`streamed_bytes`, `resident_block_peak`)
+    — ONLY when the platform already streamed in this process (same
+    stdlib-only stance as _ledger_total_bytes); None otherwise so the
+    standalone CLI report is unchanged."""
+    bs = sys.modules.get("h2o3_tpu.models.block_store")
+    if bs is None:
+        return None
+    try:
+        t = bs.process_totals()
+        return dict(t) if t.get("streamed_bytes") else None
+    except Exception:
+        return None
+
+
 def _growth_bytes_per_min(samples: List[Dict],
                           field: str) -> Optional[float]:
     """Least-squares slope of `field` over the sampled run, in bytes per
@@ -431,6 +446,7 @@ def run_load_open(host: str, port: int, model: str, frame: str,
                                                        "rss_bytes"),
         ledger_growth_bytes_per_min=_growth_bytes_per_min(mem_samples,
                                                           "ledger_bytes"),
+        stream=_stream_totals(),
     )
 
 

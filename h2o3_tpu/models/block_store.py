@@ -1,0 +1,356 @@
+"""BlockStore — the row-blocked packed code matrix of the out-of-core path.
+
+"Fits in HBM" stops being the dataset ceiling (ROADMAP item 2, following
+"Out-of-Core GPU Gradient Boosting", arXiv 2005.09148): the sub-byte packed
+bin-code matrix lives on HOST as equal row-blocks, and only a bounded
+RESIDENT SET of blocks lives on device at any moment. The streamed tree
+driver (`models/tree_stream.py`) walks blocks in canonical order — block
+boundaries are the PR 9 deterministic-reduction block grid, so a streamed
+histogram pass folds the same per-block partials in the same order as the
+in-core ``shard_mode="blocks"`` fit and stays BIT-IDENTICAL to it.
+
+Accounting and shedding:
+
+- the store is a **memory-ledger owner** (``block_store:<id>`` standalone,
+  or folded into its ``dataset_cache:<fp>:blocks`` layer when the dataset
+  cache holds it): host block bytes and resident device bytes are
+  attributed like every other subsystem's.
+- the resident set is LRU-bounded by a byte budget
+  (``H2O3_STREAM_BUDGET_MB``, default: half the device capacity the ledger
+  sees) and **sheds device blocks first** when
+  ``memory_ledger.pressure()`` crosses ``H2O3_MEM_EVICT_PRESSURE`` — the
+  `_evict_locked`-style response, except a shed block costs only a future
+  re-upload (the host copy remains), so it is always the cheapest byte to
+  give back. Every eviction lands in the Timeline/trace as a ``memory``
+  event (owner, bytes, trigger), mirroring the dataset-cache events.
+- uploads are double-buffer friendly: ``prefetch(b+1)`` dispatches the
+  next block's H2D while the caller's kernel consumes block ``b`` (the
+  `_score_event_async` dispatch-before-block pattern); transfer seconds
+  land in the new ``h2d_stream`` phase bucket and upload/evict/reuse
+  counters + streamed bytes feed the Prometheus scrape and the per-fit
+  tree fold at ``/3/Profiler``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ops import packing
+from ..runtime import env_float
+from ..runtime import memory_ledger as _ml
+from ..runtime import phases as _phases
+
+_TOTALS_LOCK = threading.Lock()
+# process-lifetime stream totals — the bench/loadgen record embed next to
+# the memory embeds (`streamed_bytes`, `resident_block_peak`)
+_TOTALS = {"streamed_bytes": 0, "resident_block_peak": 0}
+
+_REG: Dict = {}
+
+
+def _registry() -> Dict:
+    """Memoized registry families (the usual lazy-memoization stance)."""
+    if not _REG:
+        from ..runtime import metrics_registry as reg
+
+        _REG["blocks"] = reg.counter(
+            "h2o3_tree_stream_blocks",
+            "out-of-core code blocks by lifecycle event "
+            "(uploaded/evicted/reused)",
+            labelnames=("event",))
+        _REG["bytes"] = reg.counter(
+            "h2o3_tree_stream_bytes",
+            "bytes streamed host->device by the out-of-core tree path")
+        _REG["resident_peak"] = reg.gauge(
+            "h2o3_tree_stream_resident_peak_bytes",
+            "high watermark of device-resident out-of-core block bytes")
+    return _REG
+
+
+def stream_budget_bytes() -> int:
+    """The resident-set byte budget of the out-of-core path:
+    ``H2O3_STREAM_BUDGET_MB`` when set, else half the device capacity the
+    memory ledger sees (``memory_stats()`` limit on real chips;
+    ``H2O3_DEVICE_BUDGET_MB`` / host budget on census backends) — the
+    other half stays free for margins, histograms and the forest pack."""
+    mb = env_float("H2O3_STREAM_BUDGET_MB", 0.0)
+    if mb > 0:
+        return int(mb * 1e6)
+    return max(_ml.device_capacity_bytes() // 2, 1)
+
+
+def process_totals() -> Dict:
+    """Cumulative stream totals for record embeds (0s when never used)."""
+    with _TOTALS_LOCK:
+        return dict(_TOTALS)
+
+
+def _account_totals(nbytes: int = 0, resident: int = 0) -> None:
+    with _TOTALS_LOCK:
+        _TOTALS["streamed_bytes"] += int(nbytes)
+        if resident > _TOTALS["resident_block_peak"]:
+            _TOTALS["resident_block_peak"] = int(resident)
+
+
+class BlockStore:
+    """Host-resident packed row-blocks + a bounded LRU device resident set."""
+
+    _IDS = iter(range(1 << 62))
+
+    def __init__(self, host_blocks: List[np.ndarray], block_rows: int,
+                 pack_bits: int, owner: str = "",
+                 budget_bytes: Optional[int] = None, register: bool = True):
+        self.host_blocks = list(host_blocks)
+        self.n_blocks = len(self.host_blocks)
+        self.block_rows = int(block_rows)
+        self.pack_bits = int(pack_bits)
+        self.owner = owner or f"block_store:{next(self._IDS)}"
+        # resolved ONCE: the default consults the memory ledger's device
+        # probe (an O(live-arrays) census walk on CPU backends) — far too
+        # heavy for the per-miss hot path in get()
+        self._budget = (int(budget_bytes) if budget_bytes is not None
+                        else stream_budget_bytes())
+        self._lock = threading.Lock()
+        self._resident: "OrderedDict[int, object]" = OrderedDict()
+        self._resident_bytes = 0
+        self._window_peak = 0
+        self.counters = dict(uploaded=0, evicted=0, reused=0,
+                             bytes_streamed=0)
+        self.resident_peak_bytes = 0
+        self._registered = False
+        if register:
+            # standalone owner (cache-disabled fits): the referent is the
+            # store itself, so a dropped store retires its owner
+            wr = weakref.ref(self)
+
+            def _bytes():
+                st = wr()
+                if st is None:
+                    return (0, 0)
+                return st.host_bytes(), st.resident_bytes()
+
+            _ml.register(self.owner, kind="block_store", bytes_fn=_bytes,
+                         referent=self, type_name="blocks")
+            self._registered = True
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_codes(cls, codes: np.ndarray, n_blocks: int, pack_bits: int,
+                   **kw) -> "BlockStore":
+        """Blocked (and sub-byte packed) store from a padded full-width
+        code matrix. Each block is packed independently via
+        `ops.packing.pack_host_range` — O(block) transients, the
+        streaming-ingest contract — and, with ``pack_bits=0`` (nbins too
+        wide to pack), blocks are contiguous row copies."""
+        n = codes.shape[0]
+        if n % n_blocks:
+            raise ValueError(f"{n} rows do not divide into {n_blocks} blocks")
+        rows = n // n_blocks
+        if pack_bits and rows % packing.GROUP_ROWS[pack_bits]:
+            raise ValueError(
+                f"block rows {rows} not aligned to the {pack_bits}-bit "
+                "pack group")
+        blocks = []
+        for b in range(n_blocks):
+            if pack_bits:
+                blocks.append(packing.pack_host_range(
+                    codes, pack_bits, b * rows, (b + 1) * rows))
+            else:
+                blocks.append(np.ascontiguousarray(codes[b * rows:
+                                                         (b + 1) * rows]))
+        return cls(blocks, rows, pack_bits, **kw)
+
+    # -- sizes -------------------------------------------------------------
+
+    def host_bytes(self) -> int:
+        return sum(int(hb.nbytes) for hb in self.host_blocks)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident_bytes
+
+    def nbytes_total(self) -> int:
+        return self.host_bytes() + self.resident_bytes()
+
+    def budget_bytes(self) -> int:
+        """Resident budget, floored at two blocks so the double buffer
+        (consume b, prefetch b+1) always fits."""
+        floor = 2 * max((int(hb.nbytes) for hb in self.host_blocks),
+                        default=0)
+        return max(self._budget, floor)
+
+    def peak_window_start(self) -> None:
+        """Reset the per-window resident peak — a fit sharing a cached
+        store marks its own window so `peak_window_bytes()` reports THIS
+        fit's watermark, not the store-lifetime one."""
+        with self._lock:
+            self._window_peak = self._resident_bytes
+
+    def peak_window_bytes(self) -> int:
+        with self._lock:
+            return self._window_peak
+
+    # -- resident-set management -------------------------------------------
+
+    def _evict_locked(self, b: int, trigger: str) -> None:
+        arr = self._resident.pop(b, None)
+        if arr is None:
+            return
+        nbytes = int(self.host_blocks[b].nbytes)
+        self._resident_bytes -= nbytes
+        self.counters["evicted"] += 1
+        try:
+            _registry()["blocks"].inc(1, "evicted")
+        except Exception:
+            pass
+        _ml.record_event("evict", f"{self.owner}:block{b}", nbytes,
+                         trigger=trigger, space="device", kind="block_store")
+
+    def shed(self, keep=(), trigger: str = "pressure") -> int:
+        """Drop device blocks (LRU first) except `keep` — the
+        pressure-shedding hook. Host copies remain; cost is a future
+        re-upload, so device blocks are always the first bytes returned
+        when `memory_ledger.pressure()` crosses the eviction threshold."""
+        dropped = 0
+        with self._lock:
+            for b in [b for b in list(self._resident) if b not in keep]:
+                self._evict_locked(b, trigger)
+                dropped += 1
+        return dropped
+
+    def _upload(self, b: int):
+        import jax
+
+        hb = self.host_blocks[b]
+
+        def _put():
+            return jax.device_put(hb)
+
+        t0 = time.perf_counter()
+        arr = _put()
+        if _phases.ENABLED:
+            # accounted transfer: a tiny D2H is the only reliable barrier
+            # through a remote tunnel (see phases.accounted_h2d)
+            try:
+                np.asarray(arr.ravel()[:1])
+            except Exception:
+                jax.block_until_ready(arr)
+            _phases.add("h2d_stream", time.perf_counter() - t0, hb.nbytes)
+        else:
+            _phases.add("h2d_stream", 0.0, hb.nbytes)
+        return arr
+
+    def get(self, b: int):
+        """Device array of block `b`: LRU hit, or evict-then-upload."""
+        with self._lock:
+            arr = self._resident.get(b)
+            if arr is not None:
+                self._resident.move_to_end(b)
+                self.counters["reused"] += 1
+                try:
+                    _registry()["blocks"].inc(1, "reused")
+                except Exception:
+                    pass
+                return arr
+        # pressure shed BEFORE growing the resident set: past the ledger's
+        # eviction threshold only the double buffer stays resident
+        try:
+            if _ml.pressure() >= _ml.evict_threshold():
+                self.shed(keep={b, (b + 1) % self.n_blocks},
+                          trigger="pressure")
+        except Exception:
+            pass
+        hb_bytes = int(self.host_blocks[b].nbytes)
+        with self._lock:
+            arr = self._resident.get(b)
+            if arr is not None:
+                self._resident.move_to_end(b)
+                self.counters["reused"] += 1
+                return arr
+            budget = self.budget_bytes()
+            while self._resident and self._resident_bytes + hb_bytes > budget:
+                self._evict_locked(next(iter(self._resident)), "cap")
+        arr = self._upload(b)
+        with self._lock:
+            cur = self._resident.get(b)
+            if cur is not None:
+                # lost a concurrent-miss race (a shared cached store can
+                # be streamed by several sweep candidates): the transfer
+                # happened and is counted, but the resident entry — and
+                # its bytes — stay singular; our duplicate array is
+                # dropped to the GC
+                self._resident.move_to_end(b)
+                self.counters["uploaded"] += 1
+                self.counters["bytes_streamed"] += hb_bytes
+                peak = self._resident_bytes
+                arr = cur
+            else:
+                self._resident[b] = arr
+                self._resident_bytes += hb_bytes
+                self.counters["uploaded"] += 1
+                self.counters["bytes_streamed"] += hb_bytes
+                if self._resident_bytes > self.resident_peak_bytes:
+                    self.resident_peak_bytes = self._resident_bytes
+                if self._resident_bytes > self._window_peak:
+                    self._window_peak = self._resident_bytes
+                peak = self._resident_bytes
+        try:
+            reg = _registry()
+            reg["blocks"].inc(1, "uploaded")
+            reg["bytes"].inc(hb_bytes)
+            reg["resident_peak"].set(
+                max(self.resident_peak_bytes,
+                    reg["resident_peak"].value() or 0))
+        except Exception:
+            pass
+        _account_totals(hb_bytes, peak)
+        return arr
+
+    def prefetch(self, b: int) -> None:
+        """Dispatch block `b`'s H2D now so the upload overlaps the
+        caller's compute on the previous block (double buffering). The
+        device_put is async on real backends; `get(b)` then finds it
+        resident."""
+        try:
+            self.get(b)
+        except Exception:
+            pass   # advisory; the blocking get reports real failures
+
+    def account_external_bytes(self, nbytes: int) -> None:
+        """Fold an out-of-band H2D (e.g. a GOSS compact-sample upload)
+        into the stream byte counters so `streamed_bytes` reflects every
+        byte the out-of-core path actually moved."""
+        with self._lock:
+            self.counters["bytes_streamed"] += int(nbytes)
+        try:
+            _registry()["bytes"].inc(int(nbytes))
+        except Exception:
+            pass
+        _account_totals(int(nbytes))
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            out = dict(self.counters)
+        out.update(n_blocks=self.n_blocks, block_rows=self.block_rows,
+                   pack_bits=self.pack_bits,
+                   host_bytes=self.host_bytes(),
+                   resident_bytes=self.resident_bytes(),
+                   resident_peak_bytes=self.resident_peak_bytes,
+                   budget_bytes=self.budget_bytes())
+        return out
+
+    def close(self) -> None:
+        self.shed(trigger="clear")
+        if self._registered:
+            _ml.unregister(self.owner)
+            self._registered = False

@@ -48,7 +48,8 @@ from ..runtime import memory_ledger as _memory
 _LOCK = threading.RLock()
 _ENTRIES: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _STATS = dict(matrix_hits=0, matrix_misses=0, bins_hits=0, bins_misses=0,
-              device_hits=0, device_misses=0, evictions=0)
+              device_hits=0, device_misses=0, blocks_hits=0,
+              blocks_misses=0, evictions=0)
 
 
 def enabled() -> bool:
@@ -67,8 +68,8 @@ def _caps() -> Tuple[int, int]:
 
 
 class _Entry:
-    __slots__ = ("frame_ref", "key", "matrix", "bins", "device", "lock",
-                 "owner_base", "__weakref__")
+    __slots__ = ("frame_ref", "key", "matrix", "bins", "device", "blocks",
+                 "lock", "owner_base", "__weakref__")
 
     def __init__(self, frame, key):
         self.frame_ref = weakref.ref(frame, lambda _: _drop(key))
@@ -76,6 +77,7 @@ class _Entry:
         self.matrix = None                      # (X, is_cat, doms)
         self.bins: Dict[tuple, object] = {}     # bkey -> BinnedMatrix
         self.device: Dict[tuple, object] = {}   # (bkey, npad) -> jax array
+        self.blocks: Dict[tuple, object] = {}   # (bkey, npad, ...) -> BlockStore
         self.lock = threading.Lock()            # serializes builds per entry
         self.owner_base = ""                    # memory-ledger owner prefix
 
@@ -87,10 +89,12 @@ class _Entry:
             total += int(bm.codes.nbytes)
         for arr in self.device.values():
             total += int(np.prod(arr.shape)) * arr.dtype.itemsize
+        for st in self.blocks.values():
+            total += int(st.nbytes_total())
         return total
 
 
-_LAYERS = ("matrix", "bins", "device")
+_LAYERS = ("matrix", "bins", "device", "blocks")
 
 
 def _register_ledger(e: "_Entry", frame) -> None:
@@ -184,17 +188,22 @@ def _evict_locked(keep=None) -> None:
         _pop_entry_locked(victims.pop(0), "cap")
     while victims and sum(e.nbytes() for e in list(_ENTRIES.values())) > max_bytes:
         _pop_entry_locked(victims.pop(0), "cap")
-    if victims:
-        from ..runtime import memory_ledger as ml
+    from ..runtime import memory_ledger as ml
 
-        # ONE cached pressure read decides (pressure is RSS/HBM-budget
-        # dominated — it cannot drop mid-loop just because entries were
-        # unregistered, so re-reading per victim would only burn a full
-        # accounting pass under _LOCK per pop): past the threshold, shed
-        # every LRU victim, oldest first
-        if ml.pressure() >= ml.evict_threshold():
-            while victims:
-                _pop_entry_locked(victims.pop(0), "pressure")
+    # ONE cached pressure read decides (pressure is RSS/HBM-budget
+    # dominated — it cannot drop mid-loop just because entries were
+    # unregistered, so re-reading per victim would only burn a full
+    # accounting pass under _LOCK per pop): past the threshold, DEVICE
+    # blocks shed FIRST (ISSUE 14 — a shed block keeps its host copy and
+    # costs only a re-upload, the cheapest byte to give back), then every
+    # LRU victim entry, oldest first
+    if (victims or any(e.blocks for e in list(_ENTRIES.values()))) \
+            and ml.pressure() >= ml.evict_threshold():
+        for e in list(_ENTRIES.values()):
+            for st in list(e.blocks.values()):
+                st.shed(trigger="pressure")
+        while victims:
+            _pop_entry_locked(victims.pop(0), "pressure")
 
 
 def _bins_key(nbins: int, histogram_type: str, seed) -> tuple:
@@ -286,6 +295,40 @@ def device_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
     with _LOCK:
         _evict_locked(keep=e.key)
     return arr
+
+
+def blocked_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
+                  builder: Callable[[], object], pack_bits: int = 0,
+                  n_blocks: int = 0):
+    """Row-BLOCKED packed code artifact (a `models.block_store.BlockStore`)
+    — the out-of-core materialization of `device_codes` (ISSUE 14): packed
+    sub-byte blocks live on host, a bounded LRU resident set lives on
+    device, and the whole store is accounted through this entry's
+    ``dataset_cache:<fp>:blocks`` ledger layer (the store itself does not
+    register a second owner). Cached per (bins key, npad, pack mode, block
+    grid) so a sweep's candidates share ONE blocked pack; the block grid
+    aligns with the PR 9 shard layout, so a later sharded consumer shares
+    block boundaries. `builder` packs the blocks on a miss."""
+    e = _entry_for(frame, tuple(x))
+    dkey = (_bins_key(nbins, histogram_type, seed), int(npad),
+            int(pack_bits), int(n_blocks))
+    with e.lock:
+        st = e.blocks.get(dkey)
+        if st is not None:
+            with _LOCK:
+                _STATS["blocks_hits"] += 1
+            return st
+        with _LOCK:
+            _STATS["blocks_misses"] += 1
+        st = builder()
+        with _LOCK:   # see matrix(): publish vs nbytes()/snapshot() races
+            e.blocks[dkey] = st
+        _memory.record_event("alloc", f"{e.owner_base}:blocks",
+                             int(st.host_bytes()), trigger="miss",
+                             kind="dataset_cache")
+    with _LOCK:
+        _evict_locked(keep=e.key)
+    return st
 
 
 def snapshot() -> Dict:

@@ -45,6 +45,16 @@ class H2OGradientBoostingEstimator(H2OSharedTreeEstimator):
         calibration_frame=None,
         calibration_method="AUTO",
         monotone_constraints=None,
+        # gradient-based sampling on the out-of-core streamed path
+        # (ISSUE 14, GOSS-shaped — "Out-of-Core GPU Gradient Boosting"
+        # §sampling): after goss_start_tree trees, keep the top
+        # goss_top_rate fraction of rows by |gradient| plus a random
+        # goss_other_rate fraction of the rest amplified by
+        # (1-top)/other, so later trees stream a fraction of the blocks
+        goss=False,
+        goss_top_rate=0.2,
+        goss_other_rate=0.1,
+        goss_start_tree=None,   # default: max(1, ntrees // 10)
         score_tree_interval=0,
         balance_classes=False,
         class_sampling_factors=None,

@@ -1285,6 +1285,19 @@ class H2OSharedTreeEstimator(H2OEstimator):
             reg_alpha=float(p.get("reg_alpha") or 0.0) if "reg_alpha" in p else 0.0,
             max_abs_leaf=float(p.get("max_abs_leafnode_pred") or np.inf)
             if "max_abs_leafnode_pred" in p else np.inf,
+            # gradient-based sampling (ISSUE 14, GOSS-shaped): opt-in, only
+            # meaningful on the out-of-core streamed path — later trees
+            # stream the top-|g| rows plus an amplified random rest
+            goss=bool(p.get("goss", False)),
+            # `is None` (not `or`): an explicit 0.0 must reach the
+            # validator's 0 < rate < 1 check, not be swapped for the default
+            goss_top_rate=float(
+                0.2 if p.get("goss_top_rate") is None
+                else p["goss_top_rate"]),
+            goss_other_rate=float(
+                0.1 if p.get("goss_other_rate") is None
+                else p["goss_other_rate"]),
+            goss_start_tree=p.get("goss_start_tree"),
         )
 
     def _resolved_mtries(self, tp, F, problem) -> int:
@@ -1402,6 +1415,87 @@ class H2OSharedTreeEstimator(H2OEstimator):
         mt = tp.get("mtries", 0)
         if mt not in (-2, -1, 0) and mt < 1:
             bad(f"mtries must be -2, -1, or >= 1, got {mt}")
+        if tp.get("goss"):
+            a, b = tp["goss_top_rate"], tp["goss_other_rate"]
+            if not (0.0 < a < 1.0 and 0.0 < b < 1.0 and a + b <= 1.0):
+                bad("goss rates must satisfy 0 < goss_top_rate < 1, "
+                    f"0 < goss_other_rate < 1, sum <= 1 (got {a}, {b})")
+            st = tp.get("goss_start_tree")
+            if st is not None and int(st) < 1:
+                bad(f"goss_start_tree must be >= 1, got {st} (the first "
+                    "trees must train unsampled to seed the gradients)")
+
+    def _ooc_plan(self, tp, npad, F, nbins, resident_bits, shard_mode,
+                  n_shards, K):
+        """(n_blocks, goss_cfg) — the ONE out-of-core decision per fit
+        (ISSUE 14). ``H2O3_TREE_OOC`` gates it: ``0`` never streams (the
+        escape hatch — bit-identical to a plain in-core fit), ``1``
+        always streams, ``auto`` (default) streams when the packed code
+        matrix exceeds the stream budget (``H2O3_STREAM_BUDGET_MB``,
+        default half the ledger's device capacity). The block count S is
+        a multiple of ``H2O3_TREE_SHARD_BLOCKS`` — the PR 9 deterministic
+        reduction grid — sized so a block is ~budget/4 (double buffer +
+        headroom); ``H2O3_STREAM_BLOCKS`` forces it (tests pin the
+        streamed-vs-in-core bit identity by sharing S).
+
+        Ineligible fits (legacy comparator, mesh-sharded, checkpoint,
+        DART, custom objectives, lossguide, monotone, nbins > 256) train
+        in-core exactly as before; a goss request on an ineligible fit
+        warns and trains unsampled."""
+        env = (os.environ.get("H2O3_TREE_OOC", "auto").strip() or "auto")
+        goss_cfg = None
+        if tp.get("goss"):
+            if self._mode != "gbm" or K != 1:
+                raise ValueError(
+                    "goss requires a GBM fit with a single margin "
+                    "(binomial or regression response)")
+            if tp["sample_rate"] < 1.0 \
+                    or self._parms.get("sample_rate_per_class"):
+                raise ValueError(
+                    "goss replaces row sampling; keep sample_rate=1.0")
+            start = tp.get("goss_start_tree")
+            if start is None:
+                start = max(1, int(tp["ntrees"]) // 10)
+            goss_cfg = dict(top_rate=float(tp["goss_top_rate"]),
+                            other_rate=float(tp["goss_other_rate"]),
+                            start_tree=int(start))
+        eligible = (env != "0" and not tree_legacy()
+                    and shard_mode in ("off", "blocks")
+                    and self._parms.get("checkpoint") is None
+                    and not tp.get("dart")
+                    and getattr(self, "_objective_fn", None) is None
+                    and tp.get("grow_policy", "depthwise") != "lossguide"
+                    and getattr(self, "_monotone_vec", None) is None
+                    and nbins <= 256)
+        if not eligible:
+            if goss_cfg is not None:
+                from ..runtime.log import Log
+
+                Log.warn("goss: this fit is not eligible for the "
+                         "out-of-core streamed path (see docs/perf.md); "
+                         "training unsampled in-core")
+            return 0, None
+        codes_bytes = (npad * F * resident_bits // 8 if resident_bits
+                       else npad * F)
+        from . import block_store as _bs
+
+        budget = _bs.stream_budget_bytes()
+        if env != "1" and goss_cfg is None and codes_bytes <= budget:
+            return 0, None
+        base = max(int(os.environ.get("H2O3_TREE_SHARD_BLOCKS", "8") or 8),
+                   1)
+        if n_shards:
+            # a forced-blocks fit keeps its grid a multiple of its S, so
+            # the streamed reduction stays bit-compatible with it
+            base = max(base, n_shards)
+        forced = int(os.environ.get("H2O3_STREAM_BLOCKS", "0") or 0)
+        if forced > 0:
+            S = forced
+        else:
+            target = max(budget // 4, 1)
+            needed = max(-(-codes_bytes // target), base)
+            S = -(-needed // base) * base
+        return max(min(S, max(npad // 8, 1)), 1), goss_cfg
 
     # -- CV fold reuse (model_base._run_cv fast path) -----------------------
     def _cv_can_reuse(self) -> bool:
@@ -1709,6 +1803,39 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 and nbins <= 256):
             resident_bits = _pack_bits_for(nbins, npad)
 
+        # ---- out-of-core streaming (ISSUE 14 tentpole) -------------------
+        # When the packed code matrix exceeds the stream budget (or
+        # H2O3_TREE_OOC=1 forces it), the fit streams host-resident blocks
+        # through a bounded device set instead of uploading the matrix. A
+        # streamed fit IS an S-block deterministic reduction: cfg takes
+        # the shard_mode="blocks" decisions (histogram dispatch, blocked
+        # scoring-event loss, host metrics path), so the in-core
+        # comparator (H2O3_TREE_OOC=0 with H2O3_TREE_SHARD=1 sharing S)
+        # is bit-identical by construction — pinned in
+        # tests/test_tree_stream.py.
+        ooc_blocks, goss_cfg = 0, None
+        if not multiproc and shard_mode in ("off", "blocks"):
+            ooc_blocks, goss_cfg = self._ooc_plan(
+                tp, npad, F, nbins, resident_bits, shard_mode, n_shards, K)
+        elif tp.get("goss"):
+            # mesh/multi-process fits never stream, but a goss request
+            # must fail/warn IDENTICALLY to the 1-device path — not be
+            # silently dropped by the shard gate
+            self._ooc_plan(tp, npad, F, nbins, resident_bits, shard_mode,
+                           n_shards, K)
+        if ooc_blocks:
+            shard_mode, n_shards = "blocks", ooc_blocks
+            row_mult = max(n_shards * 8, 8)
+            npad = cloudlib.pad_to_multiple(
+                _bucket_rows(cloudlib.pad_to_multiple(n, row_mult)),
+                row_mult)
+            floor = int(self._parms.get("_npad_floor") or 0)
+            if floor > npad and floor % row_mult == 0:
+                npad = floor
+            pad = npad - n
+            if resident_bits:
+                resident_bits = _pack_bits_for(nbins, npad)
+
         # ---- background program warm-up ----------------------------------
         # The first dispatch of the tree-step program pays trace + XLA
         # compile-cache load (~3 s through a remote-TPU tunnel) in the
@@ -1721,7 +1848,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
         warm_thread = None
         if self._parms.get("checkpoint") is None \
                 and getattr(self, "_objective_fn", None) is None \
-                and not multiproc \
+                and not multiproc and not ooc_blocks \
                 and os.environ.get("H2O3_WARM_THREAD", "1") != "0":
             cfg_early = self._make_step_cfg(tp, npad, K, F, nbins, problem,
                                             dist, pack_bits=resident_bits,
@@ -1856,7 +1983,29 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 _phases_mod.add("h2d", 0.0, codes_p.nbytes)
                 return jnp.asarray(codes_p)
 
-            if use_cache and (ndev_eff == 1 or shard_mode == "mesh"):
+            ooc_store = None
+            if ooc_blocks:
+                # out-of-core: the matrix NEVER uploads whole. Packed
+                # blocks are built O(block) from the padded codes and live
+                # on host; the bounded device resident set fills lazily as
+                # the streamed level loop walks them. Cached like
+                # device_codes so a sweep packs the blocks once.
+                def _build_store():
+                    from . import block_store as _bs
+
+                    return _bs.BlockStore.from_codes(
+                        padr(bm.codes), n_blocks=ooc_blocks,
+                        pack_bits=resident_bits, register=not use_cache)
+
+                if use_cache:
+                    ooc_store = _dsc.blocked_codes(
+                        train, x, nbins, tp["histogram_type"], seed, npad,
+                        builder=_build_store, pack_bits=resident_bits,
+                        n_blocks=ooc_blocks)
+                else:
+                    ooc_store = _build_store()
+                codes_d = None
+            elif use_cache and (ndev_eff == 1 or shard_mode == "mesh"):
                 # sweep-level reuse: every candidate sharing this
                 # (frame, x, nbins, histogram) trains off ONE device-resident
                 # code matrix — the pack + tunnel upload happens once. The
@@ -2079,6 +2228,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
         cfg = self._make_step_cfg(tp, npad, K, F, nbins, problem, dist,
                                   pack_bits=resident_bits,
                                   shard_mode=shard_mode, n_shards=n_shards)
+        if ooc_blocks and cfg.compact_cap:
+            # the streamed level loop is dense-only; deep streamed fits
+            # keep exactness by skipping active-node compaction (the
+            # in-core comparator must match — docs/perf.md)
+            cfg = cfg._replace(compact_cap=0)
         # per-fit kernel plan (ISSUE 7 satellite): resolve + record which
         # histogram kernel each level will actually run (method, pallas
         # row_chunk, VMEM-pressure fallbacks — logged once per fit) into
@@ -2123,7 +2277,22 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 dist)
         if warm_thread is not None:
             warm_thread.join()
-        _tree_jit, _single_jit = _tree_step_fns(cfg, cloud)
+        stream0 = None
+        if ooc_blocks:
+            # the streamed out-of-core step replaces the monolithic jitted
+            # tree program: same call contract, per-block programs inside
+            # (models/tree_stream.py). custom objectives / DART / compact
+            # never reach here (gated in _ooc_plan), so _single_jit is
+            # unused on this path.
+            from . import tree_stream as _tstream
+
+            _tree_jit = _tstream.StreamedTreeStep(cfg, ooc_store,
+                                                  seed=seed, goss=goss_cfg)
+            _single_jit = None
+            stream0 = dict(ooc_store.counters)
+            ooc_store.peak_window_start()   # THIS fit's resident watermark
+        else:
+            _tree_jit, _single_jit = _tree_step_fns(cfg, cloud)
         mono_d = (jnp.asarray(mono_vec) if mono_vec is not None
                   else jnp.zeros(F, jnp.float32))
         hp_d = _pack_hp(
@@ -2303,9 +2472,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # compact-cap fits (their overflow-flag pull is a host sync, so a
         # "speculative" chunk would complete synchronously before the stop
         # decision — strictly worse than the sequential path).
+        # (Out-of-core fits skip chunk-level speculation: the streamed step
+        # is host-driven, so a "speculative" chunk would consume real
+        # stream bandwidth synchronously before the stop decision — the
+        # double buffer lives INSIDE its level loop instead.)
         overlap = (not tree_legacy() and not multiproc
                    and custom_obj is None and not dart
-                   and not cfg.compact_cap
+                   and not cfg.compact_cap and not ooc_blocks
                    and not (self._mode == "drf" and row_sampled)
                    and os.environ.get("H2O3_TREE_OVERLAP", "1") != "0")
         spec = None        # speculatively dispatched next chunk (+ nsteps)
@@ -2719,6 +2892,27 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 model.validation_metrics = _metrics_for(problem, valid.vec(y), probs_v)
             else:
                 model.validation_metrics = model._make_metrics(valid)
+        # per-fit stream summary (ISSUE 14): blocks uploaded/evicted/reused
+        # and bytes streamed per tree land on the recorded kernel plan
+        # (/3/Profiler `tree` fold) and on the model, so "how many bytes
+        # did this fit move" is a read, not a rerun
+        if ooc_blocks and stream0 is not None:
+            delta = {k2: ooc_store.counters[k2] - stream0.get(k2, 0)
+                     for k2 in ooc_store.counters}
+            stream_stats = dict(
+                blocks=int(ooc_blocks),
+                blocks_uploaded=delta["uploaded"],
+                blocks_evicted=delta["evicted"],
+                blocks_reused=delta["reused"],
+                streamed_bytes=delta["bytes_streamed"],
+                bytes_per_tree=int(delta["bytes_streamed"]
+                                   / max(model.ntrees_built, 1)),
+                resident_block_peak=int(ooc_store.peak_window_bytes()),
+                goss=bool(goss_cfg))
+            from ..ops.histogram import attach_fit_stream
+
+            attach_fit_stream(plan_tag, stream_stats)
+            model._stream_stats = stream_stats
         # per-fit collective-skew summary (ISSUE 13): fold the fences this
         # fit recorded into the plan ring (/3/Profiler `tree`) and the fit
         # trace, so a dashboard sees which lane a sharded fit waited on

@@ -923,6 +923,27 @@ def predict_codes(tree: Tree, codes: jax.Array, max_depth: int) -> jax.Array:
     return tree.value[node]
 
 
+def predict_codes_packed(tree: Tree, packed: jax.Array, bits: int,
+                         max_depth: int) -> jax.Array:
+    """Leaf value per row, traversing straight on the `ops.packing` packed
+    word matrix (the streamed/GOSS margin-update path, ISSUE 14): each
+    level reads the row's split-feature code via `packed_row_values` (two
+    byte gathers + a shift) instead of widening the block. With bits=0
+    `packed` is a full-width code matrix and this is `predict_codes`."""
+    if not bits:
+        return predict_codes(tree, packed, max_depth)
+    N = packing.packed_nrows(packed.shape[0], bits)
+    node = jnp.zeros(N, jnp.int32)
+    for _ in range(max_depth):
+        f = tree.feat[node]
+        b = tree.bin[node]
+        s = tree.is_split[node]
+        c = packing.packed_row_values(packed, f, bits)
+        child = 2 * node + 1 + ((c > b) & s).astype(jnp.int32)
+        node = jnp.where(s, child, node)
+    return tree.value[node]
+
+
 def predict_raw(tree: Tree, X: jax.Array, max_depth: int) -> jax.Array:
     """Leaf value per row on raw features (scoring path; NaN → right,
     mirroring the NA-bin-is-last training semantics)."""

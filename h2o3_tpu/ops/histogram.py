@@ -230,6 +230,20 @@ def record_fit_plan(tag: str, levels, nbins: int, hist_method: str,
     return plan
 
 
+def attach_fit_stream(tag: str, stream: dict) -> None:
+    """Attach a finished fit's out-of-core stream summary (blocks
+    uploaded/evicted/reused, bytes streamed, bytes per tree, resident
+    peak) to its recorded plan — the ISSUE 14 observability contract:
+    the tree fold at /3/Profiler carries the streaming trajectory next
+    to the kernel plan, so 'how many bytes did this fit move per tree'
+    is a read, not a rerun."""
+    with _SEL_LOCK:
+        for plan in reversed(_FIT_PLANS):
+            if plan["tag"] == tag:
+                plan["stream"] = dict(stream)
+                return
+
+
 def attach_fit_skew(tag: str, skew: dict) -> None:
     """Attach a finished fit's collective-skew summary (mesh.lane_summary)
     to its recorded plan — the plan rings at /3/Profiler `tree` then carry
@@ -360,6 +374,57 @@ def _hist_host(codes, node_id, vals, n_nodes: int, nbins: int,
     return jax.pure_callback(
         cb, jax.ShapeDtypeStruct((n_nodes, F, nbins, 3), jnp.float32),
         codes, node_id, vals)
+
+
+# -- dedicated host-histogram worker (ISSUE 14 satellite) -------------------
+#
+# The in-graph `pure_callback` route has a known failure mode on 1-core
+# sandboxes: with the warm-up thread racing the real fit, XLA's callback
+# thread can futex-deadlock at >= ~32768 padded rows (pre-existing,
+# reproduced on pristine code — see docs/perf.md, H2O3_HOST_HIST_MIN_ROWS).
+# The STREAMED tree path never goes through pure_callback at all: its
+# per-block host histograms run `_host_hist_cb` directly on ONE dedicated
+# worker thread — same math (bit-exact with the XLA segment scatter), no
+# XLA callback machinery to hang, and serialization keeps numpy's
+# indexed-add fast path from thrashing a 1-core host.
+
+_HOST_WORKER_LOCK = threading.Lock()
+_HOST_WORKER = [None]
+
+
+def _host_worker():
+    if _HOST_WORKER[0] is None:
+        with _HOST_WORKER_LOCK:
+            if _HOST_WORKER[0] is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _HOST_WORKER[0] = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="h2o3-host-hist")
+    return _HOST_WORKER[0]
+
+
+def host_hist_direct(codes: np.ndarray, node_id: np.ndarray,
+                     vals: np.ndarray, n_nodes: int, nbins: int,
+                     pack_bits: int) -> np.ndarray:
+    """One host-histogram accumulate, routed through the single dedicated
+    callback worker (never `pure_callback`). Bit-exact with `_hist_host`
+    / the `segment` scatter — the streamed-block host path."""
+    return _host_worker().submit(
+        _host_hist_cb, codes, node_id, vals,
+        n_nodes=n_nodes, nbins=nbins, pack_bits=pack_bits).result()
+
+
+def run_block_kernel(method: str, codes, node_id, vals, n_nodes: int,
+                     nbins: int, pack_bits: int = 0,
+                     row_chunk: "Optional[int]" = None):
+    """One resolved kernel over one contiguous row block — the public
+    entry the streamed out-of-core driver jits per block. Identical to
+    each per-block partial of the blocked in-core reduction
+    (`build_histograms` with ``n_shard_blocks``), which is what makes a
+    streamed fit bit-identical to the in-core blocks fit."""
+    return _run_kernel({"method": method, "row_chunk": row_chunk,
+                        "fallback": None},
+                       codes, node_id, vals, n_nodes, nbins, pack_bits)
 
 
 def ordered_axis_fold(parts: jax.Array, axis_name: Optional[str],

@@ -83,6 +83,22 @@ def pack_host(codes: np.ndarray, bits: int) -> np.ndarray:
     return out
 
 
+def pack_host_range(codes: np.ndarray, bits: int, r0: int, r1: int) -> np.ndarray:
+    """Pack rows ``[r0, r1)`` of a full-width code matrix — the block-wise
+    ingest half of the out-of-core path (ISSUE 14): building one streamed
+    block touches O(block) host memory (a view slice plus the packed block
+    output), never a whole-matrix packed transient. `r0`/`r1` must sit on
+    pack-group boundaries (block grids are multiples of 8 rows, and every
+    group size divides 8), so the block's bitstream is byte-identical to
+    the corresponding slice of a whole-matrix `pack_host`."""
+    group = GROUP_ROWS[bits]
+    if r0 % group or r1 % group:
+        raise ValueError(
+            f"block [{r0}, {r1}) is not aligned to the {group}-row pack "
+            f"group of {bits}-bit codes")
+    return pack_host(codes[r0:r1], bits)
+
+
 def unpack_host(packed: np.ndarray, bits: int) -> np.ndarray:
     """Inverse of `pack_host` on host numpy (the histogram callback's
     per-chunk widening) — bit-exact with `unpack_device`."""

@@ -58,7 +58,7 @@ from . import env_float, env_int
 __all__ = ["register", "unregister", "unregister_prefix", "record_event",
            "measure", "refresh", "snapshot", "totals", "pressure", "peak",
            "owners", "dkv_stats", "job_end", "ingest_buffer",
-           "evict_threshold", "clear"]
+           "evict_threshold", "device_capacity_bytes", "clear"]
 
 # how stale a cached refresh may be before a read recomputes (scrape-time
 # collect hooks and the admission-path pressure() both ride this)
@@ -195,6 +195,16 @@ def _rss_bytes() -> int:
 def evict_threshold() -> float:
     """Pressure above which byte caches (dataset_cache) shed LRU entries."""
     return env_float("H2O3_MEM_EVICT_PRESSURE", 0.9)
+
+
+def device_capacity_bytes() -> int:
+    """Device byte capacity as the ledger sees it: ``memory_stats()``
+    limit where the backend reports one, else the census fallback's cap
+    (``H2O3_DEVICE_BUDGET_MB`` / host budget). The out-of-core streaming
+    layer derives its resident budget from this — one authoritative
+    number instead of a guessed HBM cap (ISSUE 14)."""
+    cap = int(_probe_device().get("capacity_bytes", 0))
+    return cap or _host_budget_bytes()
 
 
 def _probe_device() -> Dict:
