@@ -8,7 +8,7 @@ shares ONE (frame, x) pair — exactly the waste XGBoost's `gpu_hist` avoids
 by quantizing once and reusing the compressed binned matrix across all
 boosting work ("XGBoost: Scalable GPU Accelerated Learning", PAPERS.md).
 
-This module is the sweep-level analog: a fingerprinted three-layer cache
+This module is the sweep-level analog: a fingerprinted multi-layer cache
 
 - **matrix**: key(frame, x) → (X float64, is_categorical, domains)
 - **bins**: + (nbins, histogram_type[, seed for Random]) → `BinnedMatrix`
@@ -17,6 +17,14 @@ This module is the sweep-level analog: a fingerprinted three-layer cache
   entirely. On a single-process multi-device cloud the artifact is the
   row-sharded jax.Array itself (per-shard placement reused across the
   sweep, ISSUE 12); only multi-PROCESS global arrays are rebuilt per fit.
+- **std**: + a caller-supplied standardization key (standardize /
+  use_all_factor_levels / impute / intercept / pad grid, see
+  `models/estimator_engine.py`) → the standardized float design matrix
+  the non-tree estimators iterate on — the fitted `DataInfo` plus either
+  the host float32 matrix or the device-resident (possibly row-sharded)
+  design array (ISSUE 15). GLM, K-Means, PCA, GLRM and DeepLearning —
+  and every CV fold and sweep candidate sharing a frame — reuse ONE
+  upload instead of re-extracting and re-uploading per fit.
 
 Fingerprint: frame identity (id + DKV key + a weakref guard), row count,
 the frame's in-place mutation counter (`Frame._touch` bumps it), the x
@@ -49,7 +57,7 @@ _LOCK = threading.RLock()
 _ENTRIES: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _STATS = dict(matrix_hits=0, matrix_misses=0, bins_hits=0, bins_misses=0,
               device_hits=0, device_misses=0, blocks_hits=0,
-              blocks_misses=0, evictions=0)
+              blocks_misses=0, std_hits=0, std_misses=0, evictions=0)
 
 
 def enabled() -> bool:
@@ -67,9 +75,26 @@ def _caps() -> Tuple[int, int]:
     return max(ents, 1), int(mb * 1e6)
 
 
+class _StdArtifact:
+    """One cached standardized-design artifact (ISSUE 15): the fitted
+    DataInfo-equivalent `aux` plus the matrix itself (host np.ndarray or a
+    device jax.Array — `space` says which side of the link the bytes live
+    on, for the ledger's host/device split)."""
+
+    __slots__ = ("value", "_nbytes", "space")
+
+    def __init__(self, value, nbytes: int, space: str = "host"):
+        self.value = value
+        self._nbytes = int(nbytes)
+        self.space = space
+
+    def nbytes(self) -> int:
+        return self._nbytes
+
+
 class _Entry:
     __slots__ = ("frame_ref", "key", "matrix", "bins", "device", "blocks",
-                 "lock", "owner_base", "__weakref__")
+                 "std", "lock", "owner_base", "__weakref__")
 
     def __init__(self, frame, key):
         self.frame_ref = weakref.ref(frame, lambda _: _drop(key))
@@ -78,6 +103,7 @@ class _Entry:
         self.bins: Dict[tuple, object] = {}     # bkey -> BinnedMatrix
         self.device: Dict[tuple, object] = {}   # (bkey, npad) -> jax array
         self.blocks: Dict[tuple, object] = {}   # (bkey, npad, ...) -> BlockStore
+        self.std: Dict[tuple, _StdArtifact] = {}  # skey -> _StdArtifact
         self.lock = threading.Lock()            # serializes builds per entry
         self.owner_base = ""                    # memory-ledger owner prefix
 
@@ -91,10 +117,12 @@ class _Entry:
             total += int(np.prod(arr.shape)) * arr.dtype.itemsize
         for st in self.blocks.values():
             total += int(st.nbytes_total())
+        for art in self.std.values():
+            total += art.nbytes()
         return total
 
 
-_LAYERS = ("matrix", "bins", "device", "blocks")
+_LAYERS = ("matrix", "bins", "device", "blocks", "std")
 
 
 def _register_ledger(e: "_Entry", frame) -> None:
@@ -143,7 +171,13 @@ def _drop(key) -> None:
     with _LOCK:
         e = _ENTRIES.pop(key, None)
     if e is not None:
-        _release_entry(e, "weakref")
+        try:
+            _release_entry(e, "weakref")
+        except Exception:
+            # interpreter teardown: a frame dying at exit fires this
+            # weakref callback after module globals are gone — nothing
+            # left to account to
+            pass
 
 
 def _frame_key(frame, x: Tuple[str, ...]) -> tuple:
@@ -329,6 +363,37 @@ def blocked_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
     with _LOCK:
         _evict_locked(keep=e.key)
     return st
+
+
+def std_artifact(frame, x, skey: tuple, builder: Callable[[], tuple]):
+    """Standardized-design artifact for (frame, x, skey) — cached (ISSUE
+    15). `skey` carries the standardization/impute/expansion parameters
+    (composed by `models/estimator_engine.py` — the ONE place the key
+    layout lives); `builder` returns ``(value, nbytes, space)`` on a miss,
+    where `value` is whatever the engine wants back (typically a
+    ``(DataInfo, matrix)`` pair) and `space` is ``"host"`` or ``"device"``
+    for the ledger's split. Every estimator fit and CV fold sharing the
+    (frame, x, params) triple then reuses one extraction + one upload."""
+    e = _entry_for(frame, tuple(x))
+    skey = tuple(skey)
+    with e.lock:
+        art = e.std.get(skey)
+        if art is not None:
+            with _LOCK:
+                _STATS["std_hits"] += 1
+            return art.value
+        with _LOCK:
+            _STATS["std_misses"] += 1
+        value, nbytes, space = builder()
+        art = _StdArtifact(value, nbytes, space)
+        with _LOCK:   # see matrix(): publish vs nbytes()/snapshot() races
+            e.std[skey] = art
+        _memory.record_event("alloc", f"{e.owner_base}:std", int(nbytes),
+                             trigger="miss", kind="dataset_cache",
+                             space=space)
+    with _LOCK:
+        _evict_locked(keep=e.key)
+    return art.value
 
 
 def snapshot() -> Dict:

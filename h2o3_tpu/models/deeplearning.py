@@ -255,9 +255,19 @@ class H2ODeepLearningEstimator(H2OEstimator):
             # fewer tunnel bytes than the dense f32 upload, losslessly).
             # Single-device only: a multi-device mesh needs the
             # shard-straight-from-host upload so no unsharded intermediate
-            # lands on device 0.
+            # lands on device 0. The artifact rides the dataset cache's
+            # std layer (ISSUE 15): a sweep's DL candidates (and AutoML's
+            # three DeepLearning steps) expand + upload ONCE per frame.
             X = None
-            X_dev_pre = dinfo.device_design(train, fit=True)
+            from . import estimator_engine as _est
+
+            if _est.cache_enabled():
+                dinfo, X_dev_pre = _est.design_matrix(
+                    train, x,
+                    standardize=bool(p.get("standardize", True)),
+                    use_all=bool(p.get("use_all_factor_levels", True)))
+            else:
+                X_dev_pre = dinfo.device_design(train, fit=True)
             n, nfeat = train.nrow, int(X_dev_pre.shape[1])
         else:
             X = dinfo.fit_transform(train)

@@ -1,0 +1,390 @@
+"""Device-resident iteration engine for the non-tree estimators (ISSUE 15).
+
+The tree path got fused device-resident kernels (PR 7), deterministic mesh
+sharding (PR 9) and streaming (PR 11); GLM, K-Means, PCA/GLRM and
+DeepLearning stayed seed-shaped: every fit re-extracted and re-uploaded its
+float matrix and iterated in a host Python loop with a blocking device sync
+per iteration. This module is the shared spine that routes the same
+treatment to them:
+
+- **One matrix, one upload** — `host_matrix` / `device_matrix` /
+  `design_matrix` resolve the standardized float design through the
+  dataset cache's new ``std`` layer (keyed by frame fingerprint + x +
+  standardization/impute/expansion params + pad/shard grid), so every CV
+  fold and sweep candidate sharing a frame reuses ONE extraction and ONE
+  device artifact instead of paying `fit_transform` + H2D per fit.
+- **Shard plan** — `shard_plan()` is the one mode decision (mirroring
+  `shared_tree._shard_plan`): a multi-device single-process cloud runs row
+  reductions as S canonical ordered blocks merged by
+  `ops.histogram.ordered_axis_fold` ("mesh"); ``H2O3_EST_SHARD=1`` forces
+  the identical blocked structure on one device ("blocks") so an N-device
+  fit is bit-identical to the 1-device forced-shard lane; ``=0`` is the
+  escape hatch. Multi-process clouds and ``H2O3_EST_LEGACY=1`` keep the
+  pre-engine paths.
+- **Observability** — per-fit plans (`record_fit`: algo, path, iterations,
+  converged-on-device, matrix cache hit/miss, n_shards) in a bounded ring
+  surfaced at /3/Profiler's ``est`` fold, `h2o3_est_dispatch{algo,path}` /
+  `h2o3_est_iterations{algo}` registry families, and the fused iteration
+  wall booked into the ``est_iter`` phase bucket (`iter_phase`).
+
+The estimators' fused whole-iteration programs themselves (GLM IRLS as a
+`lax.while_loop`, K-Means Lloyd, PCA power iteration, GLRM alternating
+solves, DL's `lax.scan` epochs) live in their own modules; this engine
+holds what they share.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def legacy() -> bool:
+    """``H2O3_EST_LEGACY=1`` restores the host per-iteration estimator
+    paths as the bench/parity comparator (for GLM lambda search that is
+    the host IRLS loop — the pre-device-program shape)."""
+    return os.environ.get("H2O3_EST_LEGACY", "").lower() in ("1", "true",
+                                                            "yes")
+
+
+def shard_blocks() -> int:
+    return max(int(os.environ.get("H2O3_EST_SHARD_BLOCKS", "8") or 8), 1)
+
+
+def shard_plan(ndev: int, multiproc: bool) -> Tuple[str, int]:
+    """(shard_mode, n_shards) for one estimator fit — the ONE place the
+    decision is made (mirrors `shared_tree._shard_plan`).
+
+    "mesh": multi-device single-process cloud — S ordered blocks spread
+    over the lanes, merged by `ordered_axis_fold`. "blocks": 1 device,
+    ``H2O3_EST_SHARD=1`` — the same S-block structure forced on one chip
+    (the bit-identity comparator lane). "off": plain full-row reductions
+    (1 device default — bit-exact with the pre-engine math). Multi-process
+    clouds and the legacy comparator always report "off": their fits take
+    the pre-engine paths."""
+    env = os.environ.get("H2O3_EST_SHARD", "").strip()
+    if multiproc or legacy() or env == "0":
+        return "off", 0
+    base = shard_blocks()
+    if ndev > 1:
+        return "mesh", base * ndev // math.gcd(base, ndev)
+    if env == "1":
+        return "blocks", base
+    return "off", 0
+
+
+def pad_rows(n: int, n_shards: int) -> int:
+    """Rows padded to the canonical block grid (zero-filled, zero-weight
+    rows — exact no-ops in every weighted reduction)."""
+    if n_shards <= 0:
+        return n
+    from ..parallel.mesh import pad_to_multiple
+
+    return pad_to_multiple(n, n_shards)
+
+
+def local_plan(cloud, shard_mode: str, n_shards: int):
+    """(local_blocks, axis_name) for one fused program under the shard
+    plan — the ONE derivation of 'how many ordered blocks does THIS
+    lane/device compute, and over which mesh axis do partials gather'
+    (mesh: n_shards spread over the lanes; blocks: all on one device;
+    off: 0 = plain full-row reductions)."""
+    from ..parallel.mesh import ROWS_AXIS
+
+    local_blocks = (n_shards // cloud.size if shard_mode == "mesh"
+                    else n_shards)
+    axis = (ROWS_AXIS if shard_mode == "mesh" and cloud.size > 1 else None)
+    return local_blocks, axis
+
+
+def block_slices(nrows: int, local_blocks: int):
+    """The canonical per-block row slices of one lane's rows — every
+    estimator's blocked partials must cut the same grid or two fits
+    sharing S would not be bit-comparable."""
+    rows = nrows // local_blocks
+    return [slice(i * rows, (i + 1) * rows) for i in range(local_blocks)]
+
+
+def fold_blocks(parts, axis_name: Optional[str], tag: Optional[str] = None):
+    """Deterministic ordered merge of per-block partials — the PR 9
+    blocked-fold contract, re-exported so estimator programs and the tree
+    path can never drift apart."""
+    from ..ops.histogram import ordered_axis_fold
+
+    return ordered_axis_fold(parts, axis_name, timing_tag=tag)
+
+
+# -- cached matrices through the dataset cache's std layer --------------------
+
+def cache_enabled() -> bool:
+    from . import dataset_cache
+
+    return dataset_cache.enabled() and not legacy()
+
+
+def _expansion_key(frame, x, use_all: bool) -> bool:
+    """use_all_factor_levels only changes the design when a categorical
+    column exists — normalize it out of the key for all-numeric frames so
+    GLM (use_all=False) and K-Means (use_all=True) share one artifact."""
+    if not use_all:
+        return False
+    return any(frame.vec(c).type == "enum" for c in x)
+
+
+def host_matrix(frame, x, *, standardize: bool, use_all: bool = False,
+                impute: bool = True):
+    """(DataInfo, standardized float32 host matrix) for (frame, x) —
+    cached. The host artifact backs K-Means init draws and is the parent
+    of `device_matrix`."""
+    from .model_base import DataInfo
+
+    ua = _expansion_key(frame, x, use_all)
+
+    def build():
+        dinfo = DataInfo(frame, x, standardize=standardize,
+                         use_all_factor_levels=ua, impute_missing=impute)
+        X = dinfo.fit_transform(frame)
+        return (dinfo, X), int(X.nbytes), "host"
+
+    if not cache_enabled():
+        return build()[0]
+    from . import dataset_cache
+
+    return dataset_cache.std_artifact(
+        frame, x, ("host", bool(standardize), ua, bool(impute)), build)
+
+
+def device_matrix(frame, x, *, standardize: bool, use_all: bool = False,
+                  impute: bool = True, n_shards: int = 0, n_devices: int = 1):
+    """(DataInfo, device design matrix) — the cached host matrix uploaded
+    ONCE (padded to the block grid, row-sharded over the mesh when
+    n_devices > 1). Consumers that iterate on the plain standardized
+    matrix (K-Means, PCA, GLRM's quadratic path) share this artifact; the
+    numbers are bitwise the `fit_transform` values the legacy paths use,
+    so "off"-mode fused fits stay bit-comparable."""
+    ua = _expansion_key(frame, x, use_all)
+    npad = pad_rows(frame.nrow, n_shards)
+    # resolve the host layer OUTSIDE the device layer's build: std_artifact
+    # holds the cache entry's (non-reentrant) lock around the builder, and
+    # both layers live on the same entry
+    dinfo, X = host_matrix(frame, x, standardize=standardize, use_all=ua,
+                           impute=impute)
+
+    def build():
+        Xp = X
+        if npad != X.shape[0]:
+            Xp = np.concatenate(
+                [X, np.zeros((npad - X.shape[0], X.shape[1]), X.dtype)])
+        from ..runtime import phases as _phases
+
+        def _put():
+            import jax
+            import jax.numpy as jnp
+
+            if n_devices > 1:
+                from ..parallel import mesh as cloudlib
+
+                return jax.device_put(jnp.asarray(Xp),
+                                      cloudlib.cloud().row_sharding())
+            return jnp.asarray(Xp)
+
+        Xd = _phases.accounted_h2d(_put, int(Xp.nbytes))
+        return (dinfo, Xd), int(Xp.nbytes), "device"
+
+    if not cache_enabled():
+        return build()[0]
+    from . import dataset_cache
+
+    return dataset_cache.std_artifact(
+        frame, x, ("dev", bool(standardize), ua, bool(impute),
+                   int(npad), int(n_devices)), build)
+
+
+def design_matrix(frame, x, *, standardize: bool, use_all: bool = False,
+                  add_intercept: bool = False, n_shards: int = 0,
+                  n_devices: int = 1):
+    """(DataInfo, device design matrix) via `DataInfo.device_design` — the
+    compact-upload + on-device-expansion path GLM and DeepLearning already
+    run (small-range integer columns travel at 1-2 bytes/value, the dense
+    one-hot never crosses the link), now cached so a sweep expands and
+    uploads once. Bitwise the same artifact those estimators built per-fit
+    before."""
+    from .model_base import DataInfo
+
+    ua = _expansion_key(frame, x, use_all)
+    npad = pad_rows(frame.nrow, n_shards)
+
+    def build():
+        import jax
+
+        dinfo = DataInfo(frame, x, standardize=standardize,
+                         use_all_factor_levels=ua, impute_missing=True)
+        if n_devices > 1:
+            from ..parallel import mesh as cloudlib
+
+            cloud = cloudlib.cloud()
+            # stats fit on host first (device_design sharded assembly
+            # requires fitted stats); compact packs shard straight from
+            # host — no unsharded intermediate on device 0
+            dinfo.fit_transform(frame)
+            Xd = dinfo.device_design(frame, fit=False,
+                                     add_intercept=add_intercept,
+                                     cloud=cloud, quota=npad)
+        else:
+            Xd = dinfo.device_design(frame, fit=True,
+                                     add_intercept=add_intercept,
+                                     row_bucket=n_shards or 0)
+        nbytes = int(np.prod(Xd.shape)) * Xd.dtype.itemsize
+        return (dinfo, Xd), nbytes, "device"
+
+    if not cache_enabled():
+        return build()[0]
+    from . import dataset_cache
+
+    return dataset_cache.std_artifact(
+        frame, x, ("design", bool(standardize), ua, bool(add_intercept),
+                   int(npad), int(n_devices)), build)
+
+
+# -- per-cloud fused-program cache --------------------------------------------
+
+_PROG_LOCK = threading.Lock()
+
+
+def cached_program(cloud, key: tuple, build):
+    """Get-or-build one fused estimator program, cached on the cloud (like
+    `shared_tree._sharded_event_loss_fn`) so sweep candidates share traces
+    and a mesh rebuild drops the stale executables with the old cloud."""
+    with _PROG_LOCK:
+        cache = cloud.__dict__.setdefault("_est_fns_cache", {})
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = build()
+        return fn
+
+
+# -- observability ------------------------------------------------------------
+
+_PLAN_LOCK = threading.Lock()
+_PLANS: "deque" = deque(maxlen=16)
+_REG: dict = {}
+
+
+def _registry() -> dict:
+    """Memoized registry families (the usual stance: recording a fit must
+    not take the registry registration lock)."""
+    if not _REG:
+        from ..runtime import metrics_registry as _reg
+
+        _REG["dispatch"] = _reg.counter(
+            "h2o3_est_dispatch",
+            "estimator-engine fit dispatches by algo and resolved path "
+            "(fused/fused_blocks/fused_mesh/legacy/host)",
+            labelnames=("algo", "path"))
+        _REG["iterations"] = _reg.counter(
+            "h2o3_est_iterations",
+            "estimator iterations executed inside fused device programs "
+            "(whole-fit loops — the host observed only the final state)",
+            labelnames=("algo",))
+    return _REG
+
+
+def record_fit(algo: str, path: str, *, iterations: Optional[int] = None,
+               converged: Optional[bool] = None,
+               matrix_cache: Optional[str] = None, n_shards: int = 0,
+               n_devices: int = 1, wall_s: Optional[float] = None,
+               **extra) -> dict:
+    """Record one estimator fit's plan: how it dispatched (fused vs
+    legacy, shard mode), how many device iterations it ran, whether the
+    on-device convergence test fired, and whether the standardized matrix
+    came out of the cache. Ring + counters; the ring is the /3/Profiler
+    ``est`` fold."""
+    plan = dict(algo=algo, ts=time.time(), path=path,
+                n_shards=int(n_shards), n_devices=int(n_devices))
+    if iterations is not None:
+        plan["iterations"] = int(iterations)
+    if converged is not None:
+        plan["converged"] = bool(converged)
+    if matrix_cache is not None:
+        plan["matrix_cache"] = matrix_cache
+    if wall_s is not None:
+        plan["wall_s"] = round(float(wall_s), 4)
+    plan.update(extra)
+    with _PLAN_LOCK:
+        _PLANS.append(plan)
+    try:
+        reg = _registry()
+        reg["dispatch"].inc(1, algo, path)
+        if iterations:
+            reg["iterations"].inc(int(iterations), algo)
+    except Exception:
+        pass
+    try:
+        from ..runtime import tracing as _tracing
+
+        _tracing.event("est_fit", algo=algo, path=path,
+                       iterations=iterations, n_shards=n_shards)
+    except Exception:
+        pass
+    return plan
+
+
+def matrix_cache_state(before: dict) -> str:
+    """"hit"/"miss" verdict for the std layer between two
+    `dataset_cache.snapshot()` reads around a fit's matrix resolution."""
+    from . import dataset_cache
+
+    after = dataset_cache.snapshot()
+    if after.get("std_misses", 0) > before.get("std_misses", 0):
+        return "miss"
+    if after.get("std_hits", 0) > before.get("std_hits", 0):
+        return "hit"
+    return "off"
+
+
+def est_stats() -> dict:
+    """Per-fit plans + cumulative dispatch/iteration counters (the
+    /3/Profiler ``est`` fold). Pure counter read — never fits anything."""
+    with _PLAN_LOCK:
+        plans = list(_PLANS)
+    out = dict(plans=plans, dispatch={}, iterations={})
+    try:
+        reg = _registry()
+        out["dispatch"] = {"/".join(lv): c.value()
+                           for lv, c in reg["dispatch"].children().items()}
+        out["iterations"] = {lv[0]: c.value()
+                             for lv, c in reg["iterations"].children().items()}
+    except Exception:
+        pass
+    return out
+
+
+def reset_plans() -> None:
+    """Drop the plan ring (tests). Registry counters are monotone and stay."""
+    with _PLAN_LOCK:
+        _PLANS.clear()
+
+
+@contextmanager
+def iter_phase():
+    """Book a fused iteration loop's wall into the ``est_iter`` phase
+    bucket (compile/trace time the first call triggers is subtracted —
+    it is already accounted by the monitoring listener)."""
+    from ..runtime import phases as _phases
+
+    _phases.install_listener()
+    comp0 = _phases.totals(_phases.COMPILE_KEYS)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        el = (time.perf_counter() - t0
+              - (_phases.totals(_phases.COMPILE_KEYS) - comp0))
+        _phases.add("est_iter", max(el, 0.0))

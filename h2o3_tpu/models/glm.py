@@ -22,15 +22,18 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import PartitionSpec as P
+
 from ..frame.frame import Frame
 from ..parallel import distdata
 from ..parallel import mesh as cloudlib
+from . import estimator_engine as _est
 from .metrics import (
     ModelMetricsBinomial,
     ModelMetricsMultinomial,
@@ -147,6 +150,39 @@ def _gram_step(X, y, w, beta, family: str, tweedie_p: float = 1.5):
     return gram, xy
 
 
+def _solve_pen_device(gram, xy, lam, alpha, n_obs, pen_mask, beta_prev,
+                      non_negative: bool):
+    """Penalized IRLS-quadratic solve ON DEVICE — Cholesky for ridge,
+    500-step projected ISTA when l1>0 or non_negative (the same quadratic
+    COORDINATE_DESCENT iterates on). Shared by the lambda-path program and
+    the fused single-lambda IRLS loop so the two can never drift."""
+    pdim = gram.shape[0]
+    l2 = lam * (1.0 - alpha) * n_obs
+    l1 = lam * alpha * n_obs
+    A = gram + jnp.diag(pen_mask * l2)
+
+    def ridge(_):
+        return jnp.linalg.solve(
+            A + 1e-6 * jnp.eye(pdim, dtype=jnp.float32), xy)
+
+    def ista(_):
+        L = jnp.linalg.eigvalsh(A)[-1] + 1e-8
+        thr = l1 / L * pen_mask
+
+        def body(i, b):
+            b_new = b - (A @ b - xy) / L
+            b_new = jnp.sign(b_new) * jnp.maximum(
+                jnp.abs(b_new) - thr, 0.0)
+            if non_negative:
+                b_new = b_new.at[:pdim - 1].set(
+                    jnp.maximum(b_new[:pdim - 1], 0.0))
+            return b_new
+
+        return jax.lax.fori_loop(0, 500, body, beta_prev)
+
+    return jax.lax.cond((l1 > 0) | non_negative, ista, ridge, None)
+
+
 @functools.partial(jax.jit, static_argnames=("family", "max_iter",
                                               "non_negative", "tweedie_p"))
 def _glm_path_device(X, y, w, Xe, ye, we, lams, alpha, n_obs, beta0,
@@ -160,35 +196,24 @@ def _glm_path_device(X, y, w, Xe, ye, we, lams, alpha, n_obs, beta0,
     when given, else training. Replaces ~nlambda·iters host round-trips
     (gram D2H + host solve each) with ONE dispatch; the caller re-solves
     the chosen λ on host in f64 for the reported coefficients
-    (hex/glm/GLM.java lambda search, computeSubmodel loop)."""
-    P = X.shape[1]
-    pen_mask = jnp.ones(P, jnp.float32).at[P - 1].set(0.0)
+    (hex/glm/GLM.java lambda search, computeSubmodel loop). For gaussian
+    the IRLS weights don't depend on β, so the Gram/xy are computed ONCE
+    and reused across the whole path (ISSUE 15 warm-start contract) —
+    same values every iteration recomputed before, at ~1/iters the
+    einsum cost."""
+    pdim = X.shape[1]
+    pen_mask = jnp.ones(pdim, jnp.float32).at[pdim - 1].set(0.0)
 
     def solve_pen(gram, xy, lam, beta_prev):
-        l2 = lam * (1.0 - alpha) * n_obs
-        l1 = lam * alpha * n_obs
-        A = gram + jnp.diag(pen_mask * l2)
+        return _solve_pen_device(gram, xy, lam, alpha, n_obs, pen_mask,
+                                 beta_prev, non_negative)
 
-        def ridge(_):
-            return jnp.linalg.solve(
-                A + 1e-6 * jnp.eye(P, dtype=jnp.float32), xy)
-
-        def ista(_):
-            L = jnp.linalg.eigvalsh(A)[-1] + 1e-8
-            thr = l1 / L * pen_mask
-
-            def body(i, b):
-                b_new = b - (A @ b - xy) / L
-                b_new = jnp.sign(b_new) * jnp.maximum(
-                    jnp.abs(b_new) - thr, 0.0)
-                if non_negative:
-                    b_new = b_new.at[:P - 1].set(
-                        jnp.maximum(b_new[:P - 1], 0.0))
-                return b_new
-
-            return jax.lax.fori_loop(0, 500, body, beta_prev)
-
-        return jax.lax.cond((l1 > 0) | non_negative, ista, ridge, None)
+    if family == "gaussian":
+        Wg = jnp.ones_like(y) * w
+        gram_g = jnp.einsum("np,n,nq->pq", X, Wg, X,
+                            precision=jax.lax.Precision.HIGHEST)
+        xy_g = jnp.einsum("np,n->p", X, Wg * y,
+                          precision=jax.lax.Precision.HIGHEST)
 
     def deviance(beta):
         eta = jnp.matmul(Xe, beta, precision=jax.lax.Precision.HIGHEST)
@@ -202,14 +227,17 @@ def _glm_path_device(X, y, w, Xe, ye, we, lams, alpha, n_obs, beta0,
 
         def body(state):
             it, b, _ = state
-            eta = jnp.matmul(X, b, precision=jax.lax.Precision.HIGHEST)
-            mu = _linkinv(family, eta)
-            W, z = _irls_weights(family, eta, mu, y, tweedie_p)
-            Ww = W * w
-            gram = jnp.einsum("np,n,nq->pq", X, Ww, X,
-                              precision=jax.lax.Precision.HIGHEST)
-            xy = jnp.einsum("np,n->p", X, Ww * z,
-                            precision=jax.lax.Precision.HIGHEST)
+            if family == "gaussian":
+                gram, xy = gram_g, xy_g
+            else:
+                eta = jnp.matmul(X, b, precision=jax.lax.Precision.HIGHEST)
+                mu = _linkinv(family, eta)
+                W, z = _irls_weights(family, eta, mu, y, tweedie_p)
+                Ww = W * w
+                gram = jnp.einsum("np,n,nq->pq", X, Ww, X,
+                                  precision=jax.lax.Precision.HIGHEST)
+                xy = jnp.einsum("np,n->p", X, Ww * z,
+                                precision=jax.lax.Precision.HIGHEST)
             nb = solve_pen(gram, xy, lam, b)
             return it + 1, nb, jnp.max(jnp.abs(nb - b))
 
@@ -225,6 +253,92 @@ def _glm_path_device(X, y, w, Xe, ye, we, lams, alpha, n_obs, beta0,
 
     _, (betas, devs) = jax.lax.scan(fit_one, beta0, lams)
     return betas, devs
+
+
+def _irls_device_fn(cloud, shard_mode: str, n_shards: int, family: str,
+                    non_negative: bool, one_step: bool):
+    """The fused single-λ IRLS fit as ONE device program (ISSUE 15):
+    `lax.while_loop` with the convergence test (max|Δβ| < β_eps) ON
+    DEVICE — the host reads only the final (β, iterations, Δ) triple,
+    replacing the per-iteration gram D2H + host solve round-trip.
+
+    Row reductions (the Gram X'WX and X'Wz) run as `local_blocks` ordered
+    block partials merged by `ordered_axis_fold` under the shard plan —
+    mesh-sharded on a multi-device cloud, the same blocked structure
+    forced on one device — so an N-device IRLS fit is bit-identical to
+    the 1-device forced-shard lane (the PR 9 contract). `one_step` marks
+    gaussian with α·λ = 0, whose single solve mirrors the host loop's
+    unconditional gaussian break — including under non_negative, where
+    both paths do exactly one projected-ISTA pass; plain gaussian hoists
+    the β-independent Gram out of the loop. Cached per cloud via the
+    engine program cache."""
+    local_blocks, axis = _est.local_plan(cloud, shard_mode, n_shards)
+    key = ("glm_irls", family, local_blocks, axis, bool(non_negative),
+           bool(one_step))
+
+    def build():
+        def inner(X, y, w, beta0, lam, alpha, n_obs, max_iter, beta_eps,
+                  tweedie_p):
+            pdim = X.shape[1]
+            pen_mask = jnp.ones(pdim, jnp.float32).at[pdim - 1].set(0.0)
+
+            def gram_xy(b):
+                eta = X @ b
+                mu = _linkinv(family, eta)
+                W, z = _irls_weights(family, eta, mu, y, tweedie_p)
+                Ww = W * w
+                if local_blocks:
+                    # ONE augmented gemm per block — (WwX)' @ [X | z]
+                    # yields gram AND xy from the same dot: the
+                    # gemm-shaped form lowers identically inside a lane's
+                    # shard_map body and inside the S-block single-device
+                    # program (a separate gemv for xy did NOT — its
+                    # accumulation fused differently per context), which
+                    # is what makes blocks==mesh bit-identical
+                    Xw = X * Ww[:, None]
+                    Xz = jnp.concatenate([X, z[:, None]], axis=1)
+                    sl = _est.block_slices(X.shape[0], local_blocks)
+                    gz = _est.fold_blocks(
+                        jnp.stack([Xw[s].T @ Xz[s] for s in sl]), axis)
+                    return gz[:, :-1], gz[:, -1]
+                return (jnp.einsum("np,n,nq->pq", X, Ww, X),
+                        jnp.einsum("np,n->p", X, Ww * z))
+
+            def solve(gram, xy, bprev):
+                return _solve_pen_device(gram, xy, lam, alpha, n_obs,
+                                         pen_mask, bprev, non_negative)
+
+            if one_step or family == "gaussian":
+                gram_g, xy_g = gram_xy(beta0)   # gaussian: β-independent
+            if one_step:
+                beta = solve(gram_g, xy_g, beta0)
+                return beta, jnp.int32(1), jnp.max(jnp.abs(beta - beta0))
+
+            def cond(state):
+                it, b, delta = state
+                return (it < max_iter) & (delta >= beta_eps)
+
+            def body(state):
+                it, b, _ = state
+                gram, xy = ((gram_g, xy_g) if family == "gaussian"
+                            else gram_xy(b))
+                nb = solve(gram, xy, b)
+                return it + 1, nb, jnp.max(jnp.abs(nb - b))
+
+            it, beta, delta = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), beta0, jnp.float32(jnp.inf)))
+            return beta, it, delta
+
+        if axis is not None:
+            rspec = P(cloudlib.ROWS_AXIS)
+            rep = P()
+            inner = cloudlib.shard_call(
+                inner, cloud,
+                in_specs=(rspec, rspec, rspec) + (rep,) * 7,
+                out_specs=(rep, rep, rep), check_rep=False)
+        return jax.jit(inner)
+
+    return _est.cached_program(cloud, key, build)
 
 
 def _solve_penalized(gram, xy, lam, alpha, n_obs, intercept_idx, beta0,
@@ -495,9 +609,8 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             family = {"binomial": "binomial", "multinomial": "multinomial"}.get(
                 problem, "gaussian"
             )
-        dinfo = DataInfo(train, x, standardize=bool(p.get("standardize", True)))
+        std_flag = bool(p.get("standardize", True))
         n = train.nrow
-        nfeat = len(dinfo.coef_names)
         w = (
             train.vec(p["weights_column"]).numeric_np()
             if p.get("weights_column")
@@ -522,9 +635,29 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         beta_eps = float(p.get("beta_epsilon", 1e-4))
 
         cloud = cloudlib.cloud()
+        multiproc = distdata.multiprocess()
+        # -- estimator-engine dispatch (ISSUE 15) -----------------------------
+        # engine on: cached standardized design (one upload per sweep) +
+        # fused whole-fit IRLS; gated off for the exotic corners — legacy
+        # comparator, multi-process clouds (their data lives elsewhere),
+        # and the mesh path for multinomial / degenerate row counts.
+        engine_on = not _est.legacy() and not multiproc
+        shard_mode, n_shards = (_est.shard_plan(cloud.size, multiproc)
+                                if engine_on else ("off", 0))
+        if shard_mode == "mesh" and (n < cloud.size
+                                     or family == "multinomial"):
+            shard_mode, n_shards = "off", 0
+        use_cached_design = engine_on and (cloud.size == 1
+                                           or shard_mode == "mesh")
+        cache0 = None
+        if use_cached_design:
+            from . import dataset_cache as _dc
+
+            cache0 = _dc.snapshot()
         yd = jnp.asarray(yarr if family != "multinomial" else yarr.astype(np.float32))
         wd = jnp.asarray(w)
-        if distdata.multiprocess():
+        if multiproc:
+            dinfo = DataInfo(train, x, standardize=std_flag)
             # multi-host cloud: this process holds only its ingest shard —
             # assemble global row-sharded arrays homed where the data was
             # parsed (MRTask compute-where-the-chunks-live), zero-weight
@@ -540,7 +673,26 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             n = int(getattr(train, "dist").global_nrow
                     if getattr(train, "dist", None) else
                     distdata.global_sum(np.asarray([n]))[0])
+        elif use_cached_design:
+            ndev_eff = cloud.size if shard_mode == "mesh" else 1
+            dinfo, Xd = _est.design_matrix(
+                train, x, standardize=std_flag, add_intercept=True,
+                n_shards=n_shards, n_devices=ndev_eff)
+            npad = int(Xd.shape[0])
+            if npad != n or ndev_eff > 1:
+                ypad = np.concatenate([np.asarray(
+                    yarr if family != "multinomial"
+                    else yarr.astype(np.float32), np.float32),
+                    np.zeros(npad - n, np.float32)])
+                wpad = np.concatenate([w, np.zeros(npad - n, np.float32)])
+                if ndev_eff > 1:
+                    rs = cloud.row_sharding()
+                    yd = jax.device_put(jnp.asarray(ypad), rs)
+                    wd = jax.device_put(jnp.asarray(wpad), rs)
+                else:
+                    yd, wd = jnp.asarray(ypad), jnp.asarray(wpad)
         elif cloud.size > 1 and n >= cloud.size:
+            dinfo = DataInfo(train, x, standardize=std_flag)
             X = dinfo.fit_transform(train)
             Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
             npad = cloudlib.pad_to_multiple(n, cloud.size)
@@ -553,7 +705,10 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         else:
             # compact upload + on-device one-hot expansion (the dense design
             # matrix never crosses the host↔device link)
+            dinfo = DataInfo(train, x, standardize=std_flag)
             Xd = dinfo.device_design(train, fit=True, add_intercept=True)
+        nfeat = len(dinfo.coef_names)
+        fitplan: Dict[str, object] = dict(path="legacy")
 
         full_path = None
         stderr = None
@@ -604,11 +759,17 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                                  jnp.asarray(wv))
                 beta, lam_best, full_path = self._lambda_path(
                     Xd, yd, wd, family, alpha, n, nfeat, max_iter, beta_eps,
-                    tweedie_p, p, vdata=vdata,
+                    tweedie_p, p, vdata=vdata, fitplan=fitplan,
                 )
             else:
                 lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
-                beta = self._irls(Xd, yd, wd, family, lam_v, alpha, max_iter, beta_eps, tweedie_p)
+                if engine_on:
+                    beta = self._irls_fused(
+                        Xd, yd, wd, family, lam_v, alpha, max_iter,
+                        beta_eps, tweedie_p, cloud, shard_mode, n_shards,
+                        fitplan, y_host=yarr, w_host=w)
+                else:
+                    beta = self._irls(Xd, yd, wd, family, lam_v, alpha, max_iter, beta_eps, tweedie_p)
                 lam_best = lam_v
             if p.get("compute_p_values") and (lam_best == 0):
                 gram, _ = _gram_step(Xd, yd, wd, jnp.asarray(beta), family, tweedie_p)
@@ -646,16 +807,29 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                     cov = None
                     stderr = None
 
+        _est.record_fit(
+            "glm", str(fitplan.get("path", "legacy")),
+            iterations=fitplan.get("iterations"),
+            converged=fitplan.get("converged"),
+            matrix_cache=(_est.matrix_cache_state(cache0)
+                          if cache0 is not None else None),
+            # the λ-path program ("fused_path") runs plain full-row
+            # einsums — only the blocked IRLS paths really sharded
+            n_shards=n_shards if fitplan.get("path") in (
+                "fused", "fused_blocks", "fused_mesh") else 0,
+            n_devices=cloud.size if shard_mode == "mesh" else 1,
+            family=family)
         model = GLMModel(self, x, y, dinfo, family, beta, domain,
                          lambda_best=lam_best, stderr=stderr, full_path=full_path)
         model.covmat = cov  # (p+1)² dispersion-scaled covariance (p-values)
         return attach_linear_artifacts(model, train, valid, Xd, cloud.size, n)
 
-    def _irls(self, Xd, yd, wd, family, lam, alpha, max_iter, beta_eps, tweedie_p):
-        pdim = Xd.shape[1]
-        # device reductions: global + replicated under a multi-host mesh,
-        # where a host np.asarray of the sharded arrays would not be
-        n_obs, wy = (float(v) for v in _wsums(yd, wd))
+    @staticmethod
+    def _beta_from_sums(wy: float, n_obs: float, family: str,
+                        pdim: int) -> np.ndarray:
+        """β₀ with the family's intercept warm start from (Σw·y, Σw) — the
+        ONE copy of the formula; host-loop and fused inits both call it so
+        they can never desynchronize."""
         beta = np.zeros(pdim, np.float64)
         if family in ("binomial", "quasibinomial", "fractionalbinomial"):
             mu0 = wy / (n_obs + 1e-12)
@@ -663,6 +837,61 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             beta[-1] = np.log(mu0 / (1 - mu0))
         elif family in ("poisson", "gamma", "tweedie"):
             beta[-1] = np.log(max(wy / (n_obs + 1e-12), 1e-6))
+        return beta
+
+    def _beta_init(self, yd, wd, family, pdim) -> Tuple[np.ndarray, float]:
+        """(β₀, Σw) with the sums reduced ON DEVICE — global + replicated
+        under a multi-host mesh, where a host np.asarray of the sharded
+        arrays would not be."""
+        n_obs, wy = (float(v) for v in _wsums(yd, wd))
+        return self._beta_from_sums(wy, n_obs, family, pdim), n_obs
+
+    def _irls_fused(self, Xd, yd, wd, family, lam, alpha, max_iter,
+                    beta_eps, tweedie_p, cloud, shard_mode, n_shards,
+                    fitplan, y_host=None, w_host=None):
+        """Fused whole-fit IRLS (ISSUE 15): one device program, convergence
+        on device, host reads final state only. Falls back to the f64 host
+        loop if the f32 program diverged (separation-shaped data)."""
+        pdim = int(Xd.shape[1])
+        if y_host is not None and w_host is not None:
+            # HOST init sums: a device jnp.sum over a row-sharded array
+            # reduces in psum order, which would break the blocks==mesh
+            # bit-identity contract at the very first β
+            wts = np.asarray(w_host, np.float64)
+            n_obs = float(wts.sum())
+            wy = float((wts * np.asarray(y_host, np.float64)).sum())
+            beta0 = self._beta_from_sums(wy, n_obs, family, pdim)
+        else:
+            beta0, n_obs = self._beta_init(yd, wd, family, pdim)
+        one_step = (family == "gaussian" and lam >= 0 and alpha * lam == 0)
+        fn = _irls_device_fn(cloud, shard_mode, n_shards, family,
+                             bool(self._parms.get("non_negative")), one_step)
+        with _est.iter_phase():
+            beta_d, it_d, delta_d = fn(
+                Xd, yd, wd, jnp.asarray(beta0, jnp.float32),
+                jnp.float32(lam), jnp.float32(alpha), jnp.float32(n_obs),
+                jnp.int32(max_iter), jnp.float32(beta_eps),
+                jnp.float32(tweedie_p))
+            cloudlib.collective_fence(beta_d)
+            beta = np.asarray(beta_d, np.float64)
+        if not np.isfinite(beta).all():
+            # f32 divergence — the robust host loop is the answer, and the
+            # plan records that the fused program did not stick
+            fitplan.update(path="host_fallback")
+            return self._irls(Xd, yd, wd, family, lam, alpha, max_iter,
+                              beta_eps, tweedie_p)
+        iters = int(it_d)
+        fitplan.update(
+            path={"mesh": "fused_mesh", "blocks": "fused_blocks"}.get(
+                shard_mode, "fused"),
+            iterations=iters,
+            converged=bool(one_step or float(delta_d) < beta_eps
+                           or iters < max_iter))
+        return beta
+
+    def _irls(self, Xd, yd, wd, family, lam, alpha, max_iter, beta_eps, tweedie_p):
+        pdim = Xd.shape[1]
+        beta, n_obs = self._beta_init(yd, wd, family, pdim)
         for it in range(max_iter):
             gram, xy = _gram_step(Xd, yd, wd, jnp.asarray(beta, jnp.float32), family, tweedie_p)
             new_beta = _solve_penalized(
@@ -679,12 +908,13 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         return beta
 
     def _lambda_path(self, Xd, yd, wd, family, alpha, n, nfeat, max_iter,
-                     beta_eps, tweedie_p, p, vdata=None):
+                     beta_eps, tweedie_p, p, vdata=None, fitplan=None):
         """lambda_search: geometric path from lambda_max down, warm starts
         (hex/glm/GLM.java regularization path). `lambda_best` is chosen by
         VALIDATION deviance when a validation_frame was given (the reference
         selects on held-out deviance; training deviance otherwise, which
         favours the smallest lambda)."""
+        fitplan = fitplan if fitplan is not None else {}
         gram0, xy0 = _gram_step(
             Xd, yd, wd, jnp.zeros(Xd.shape[1], jnp.float32), family, tweedie_p
         )
@@ -698,21 +928,24 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         lams = lam_max * np.power(ratio, np.linspace(0, 1, nlam))
         from ..parallel import mesh as cloudlib
 
-        if cloudlib.cloud().size == 1:
+        if cloudlib.cloud().size == 1 and not _est.legacy():
             # the whole path runs as ONE device program (f32); the chosen λ
-            # is then re-solved on host in f64 for the reported coefficients
+            # is then re-solved on host in f64 for the reported coefficients.
+            # H2O3_EST_LEGACY=1 takes the host IRLS loop below instead (the
+            # per-λ gram-D2H + host-solve shape, the engine comparator)
             Xe, ye, we = vdata if vdata is not None else (Xd, yd, wd)
-            betas, devs = _glm_path_device(
-                Xd, jnp.asarray(yd, jnp.float32), jnp.asarray(wd, jnp.float32),
-                Xe, jnp.asarray(ye, jnp.float32), jnp.asarray(we, jnp.float32),
-                jnp.asarray(lams, jnp.float32), float(alpha),
-                float(np.asarray(wd).sum()),
-                jnp.zeros(Xd.shape[1], jnp.float32), float(beta_eps),
-                float(tweedie_p), family=family, max_iter=int(max_iter),
-                non_negative=bool(self._parms.get("non_negative")),
-            )
-            betas = np.asarray(betas, np.float64)
-            devs = np.asarray(devs, np.float64)
+            with _est.iter_phase():
+                betas, devs = _glm_path_device(
+                    Xd, jnp.asarray(yd, jnp.float32), jnp.asarray(wd, jnp.float32),
+                    Xe, jnp.asarray(ye, jnp.float32), jnp.asarray(we, jnp.float32),
+                    jnp.asarray(lams, jnp.float32), float(alpha),
+                    float(np.asarray(wd).sum()),
+                    jnp.zeros(Xd.shape[1], jnp.float32), float(beta_eps),
+                    float(tweedie_p), family=family, max_iter=int(max_iter),
+                    non_negative=bool(self._parms.get("non_negative")),
+                )
+                betas = np.asarray(betas, np.float64)
+                devs = np.asarray(devs, np.float64)
             finite = np.isfinite(devs)
             if finite.any():
                 path = [(float(lv), betas[i]) for i, lv in enumerate(lams)]
@@ -721,12 +954,15 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 beta = self._irls_warm(Xd, yd, wd, family, lam_best, alpha,
                                        max_iter, beta_eps, tweedie_p,
                                        betas[best_i].copy())
+                fitplan.update(path="fused_path", converged=True,
+                               iterations=len(lams))
                 return beta, lam_best, path
             # every λ diverged in f32 — fall through to the robust host loop
 
         # host path: multi-host mesh (the fused device path's closure-
         # captured group tensors would embed non-addressable arrays in the
-        # HLO; vdata itself is row-sharded and fine), or f32 divergence
+        # HLO; vdata itself is row-sharded and fine), the H2O3_EST_LEGACY
+        # comparator, or f32 divergence
         beta = np.zeros(Xd.shape[1], np.float64)
         path = []
         best = (None, np.inf, 0.0)

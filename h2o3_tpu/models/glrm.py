@@ -9,12 +9,20 @@ NAs are simply excluded from the loss (which is what makes GLRM an imputer);
 
 TPU shape: each alternating step is a masked least-squares solve — the
 (k×k) normal equations per row/column batch as einsums under jit (MXU),
-host Cholesky on the tiny systems. Quadratic loss + L2 regularization in
-round 1; the proximal-operator structure is in place for the loss zoo.
+batched device solves on the tiny systems. Quadratic loss + L2
+regularization in round 1; the proximal-operator structure is in place for
+the loss zoo. The WHOLE alternating loop runs as one jitted
+`lax.while_loop` with the objective-convergence test (checked every 5th
+iteration, like the host loop did) ON DEVICE — the host reads only the
+final (X, Y, objective, iterations) (ISSUE 15); ``H2O3_EST_LEGACY=1``
+restores the per-iteration host loop, and the NaN-masked expansion is
+cached through the dataset cache's std layer so sweep candidates and CV
+folds share one extraction.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -22,8 +30,67 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..frame.frame import Frame
+from ..parallel import distdata
+from ..parallel import mesh as cloudlib
+from . import estimator_engine as _est
 from .metrics import ModelMetricsBase
 from .model_base import DataInfo, H2OEstimator, H2OModel
+
+
+def _glrm_fit_fn(cloud):
+    """The whole GLRM alternating fit as ONE device program (ISSUE 15):
+    `lax.while_loop` over fused (update_X; update_Y) masked normal-equation
+    solves, the objective-delta convergence test every 5th iteration (the
+    host loop's cadence) evaluated ON DEVICE via `lax.cond` so off-cycle
+    iterations never pay the residual pass. Returns the final
+    (X, Y, objective, iterations, converged). Cached per cloud; shapes
+    (n, p, k) key the traced program as usual."""
+
+    def build():
+        def inner(A, M, X0, Y0, gx, gy, max_it, tol):
+            kk = X0.shape[1]
+            AM = A * M
+            eyek = jnp.eye(kk)
+
+            def update_X(Xc, Yc):
+                G = jnp.einsum("kp,np,lp->nkl", Yc, M, Yc) + gx * eyek[None]
+                b = jnp.einsum("kp,np->nk", Yc, AM)
+                return jax.vmap(jnp.linalg.solve)(G, b)
+
+            def update_Y(Xc, Yc):
+                G = jnp.einsum("nk,np,nl->pkl", Xc, M, Xc) + gy * eyek[None]
+                b = jnp.einsum("nk,np->pk", Xc, AM)
+                return jax.vmap(jnp.linalg.solve)(G, b).T
+
+            def objective(Xc, Yc):
+                R = (A - Xc @ Yc) * M
+                return (jnp.sum(R * R) + gx * jnp.sum(Xc * Xc)
+                        + gy * jnp.sum(Yc * Yc))
+
+            def cond(state):
+                _, _, _, it, done = state
+                return (~done) & (it < max_it)
+
+            def body(state):
+                Xc, Yc, prev, it, _ = state
+                Xc = update_X(Xc, Yc)
+                Yc = update_Y(Xc, Yc)
+                do_check = ((it % 5) == 4) | (it == max_it - 1)
+                obj = jax.lax.cond(do_check,
+                                   lambda _: objective(Xc, Yc),
+                                   lambda _: prev, None)
+                done = do_check & (jnp.abs(prev - obj)
+                                   < tol * jnp.maximum(jnp.abs(prev), 1.0))
+                return Xc, Yc, obj, it + 1, done
+
+            X, Y, obj, it, done = jax.lax.while_loop(
+                cond, body, (X0, Y0, jnp.float32(jnp.inf), jnp.int32(0),
+                             jnp.asarray(False)))
+            return X, Y, obj, it, done
+
+        return jax.jit(inner)
+
+    return _est.cached_program(cloud, ("glrm_fit",), build)
 
 
 class GLRMModel(H2OModel):
@@ -99,6 +166,37 @@ class H2OGeneralizedLowRankEstimator(H2OEstimator):
         period=1,
     )
 
+    def _expand_masked(self, train: Frame, x, transform: str):
+        """(dinfo, zero-filled A float32, observation mask float32) — the
+        NaN-masked standardized expansion, cached through the dataset
+        cache's std layer (keyed by the transform) so every sweep
+        candidate/CV fold sharing the frame extracts once."""
+
+        def build():
+            dinfo = DataInfo(train, x,
+                             standardize=transform in ("STANDARDIZE",
+                                                       "NORMALIZE"),
+                             use_all_factor_levels=True, impute_missing=False)
+            A_raw = dinfo._expand(train, fit=True)
+            if dinfo.standardize:
+                dinfo.means = np.nanmean(A_raw, axis=0)
+                sd = np.nanstd(A_raw, axis=0)
+                dinfo.stds = np.where(sd < 1e-10, 1.0, sd)
+                A_raw = (A_raw - dinfo.means) / dinfo.stds
+            elif transform == "DEMEAN":
+                dinfo.means = np.nanmean(A_raw, axis=0)
+                dinfo.stds = np.ones(A_raw.shape[1])
+                A_raw = A_raw - dinfo.means
+            mask = (~np.isnan(A_raw)).astype(np.float32)
+            A = np.nan_to_num(A_raw, nan=0.0).astype(np.float32)
+            return ((dinfo, A, mask), int(A.nbytes + mask.nbytes), "host")
+
+        if not _est.cache_enabled():
+            return build()[0]
+        from . import dataset_cache as _dc
+
+        return _dc.std_artifact(train, x, ("glrm", str(transform)), build)
+
     def _fit(self, x, y, train: Frame, valid: Optional[Frame]) -> GLRMModel:
         from .model_base import warn_host_solver
 
@@ -107,22 +205,9 @@ class H2OGeneralizedLowRankEstimator(H2OEstimator):
         seed = p["_actual_seed"]
         k = int(p.get("k", 1))
         transform = p.get("transform", "NONE")
-        dinfo = DataInfo(train, x, standardize=transform in ("STANDARDIZE", "NORMALIZE"),
-                         use_all_factor_levels=True, impute_missing=False)
-        A_raw = dinfo._expand(train, fit=True)
-        if dinfo.standardize:
-            dinfo.means = np.nanmean(A_raw, axis=0)
-            dinfo.stds = np.where(np.nanstd(A_raw, axis=0) < 1e-10, 1.0,
-                                  np.nanstd(A_raw, axis=0))
-            A_raw = (A_raw - dinfo.means) / dinfo.stds
-        elif transform == "DEMEAN":
-            dinfo.means = np.nanmean(A_raw, axis=0)
-            dinfo.stds = np.ones(A_raw.shape[1])
-            A_raw = A_raw - dinfo.means
-        n, pd = A_raw.shape
+        dinfo, A, mask = self._expand_masked(train, x, transform)
+        n, pd = A.shape
         k = min(k, min(n, pd))
-        mask = (~np.isnan(A_raw)).astype(np.float32)
-        A = np.nan_to_num(A_raw, nan=0.0).astype(np.float32)
 
         gx = float(p.get("gamma_x", 0.0)) + 1e-6
         gy = float(p.get("gamma_y", 0.0)) + 1e-6
@@ -140,39 +225,61 @@ class H2OGeneralizedLowRankEstimator(H2OEstimator):
 
         Aj = jnp.asarray(A)
         Mj = jnp.asarray(mask)
-
-        @jax.jit
-        def update_X(Xc, Yc):
-            # row-wise masked normal equations, batched: G_i = Y M_i Y' (k,k)
-            G = jnp.einsum("kp,np,lp->nkl", Yc, Mj, Yc) + gx * jnp.eye(k)[None]
-            b = jnp.einsum("kp,np->nk", Yc, Aj * Mj)
-            return jax.vmap(jnp.linalg.solve)(G, b)
-
-        @jax.jit
-        def update_Y(Xc, Yc):
-            G = jnp.einsum("nk,np,nl->pkl", Xc, Mj, Xc) + gy * jnp.eye(k)[None]
-            b = jnp.einsum("nk,np->pk", Xc, Aj * Mj)
-            return jax.vmap(jnp.linalg.solve)(G, b).T
-
-        @jax.jit
-        def objective(Xc, Yc):
-            R = (Aj - Xc @ Yc) * Mj
-            return jnp.sum(R * R) + gx * jnp.sum(Xc * Xc) + gy * jnp.sum(Yc * Yc)
-
-        Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
-        prev = np.inf
         iters = min(int(p.get("max_iterations", 1000)), 300)
-        for it in range(iters):
-            Xj = update_X(Xj, Yj)
-            Yj = update_Y(Xj, Yj)
-            if it % 5 == 4 or it == iters - 1:
-                obj = float(objective(Xj, Yj))
-                if abs(prev - obj) < 1e-8 * max(abs(prev), 1):
-                    break
-                prev = obj
+        engine_on = (not _est.legacy() and not distdata.multiprocess()
+                     and iters > 0)
+
+        if engine_on:
+            # the WHOLE alternating loop as one device program: while_loop
+            # over (update_X; update_Y) pairs, the objective-delta test
+            # every 5th iteration ON DEVICE (the host loop's cadence)
+            fn = _glrm_fit_fn(cloudlib.cloud())
+            t0 = time.perf_counter()
+            with _est.iter_phase():
+                Xj, Yj, obj_d, it_d, done_d = fn(
+                    Aj, Mj, jnp.asarray(X), jnp.asarray(Y),
+                    jnp.float32(gx), jnp.float32(gy), jnp.int32(iters),
+                    jnp.float32(1e-8))
+                obj = float(obj_d)
+            _est.record_fit("glrm", "fused", iterations=int(it_d),
+                            converged=bool(done_d),
+                            wall_s=time.perf_counter() - t0)
+        else:
+            @jax.jit
+            def update_X(Xc, Yc):
+                # row-wise masked normal equations, batched: G_i = Y M_i Y'
+                G = jnp.einsum("kp,np,lp->nkl", Yc, Mj, Yc) + gx * jnp.eye(k)[None]
+                b = jnp.einsum("kp,np->nk", Yc, Aj * Mj)
+                return jax.vmap(jnp.linalg.solve)(G, b)
+
+            @jax.jit
+            def update_Y(Xc, Yc):
+                G = jnp.einsum("nk,np,nl->pkl", Xc, Mj, Xc) + gy * jnp.eye(k)[None]
+                b = jnp.einsum("nk,np->pk", Xc, Aj * Mj)
+                return jax.vmap(jnp.linalg.solve)(G, b).T
+
+            @jax.jit
+            def objective(Xc, Yc):
+                R = (Aj - Xc @ Yc) * Mj
+                return jnp.sum(R * R) + gx * jnp.sum(Xc * Xc) + gy * jnp.sum(Yc * Yc)
+
+            Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
+            prev = np.inf
+            it_done = 0
+            for it in range(iters):
+                Xj = update_X(Xj, Yj)
+                Yj = update_Y(Xj, Yj)
+                it_done = it + 1
+                if it % 5 == 4 or it == iters - 1:
+                    obj = float(objective(Xj, Yj))
+                    if abs(prev - obj) < 1e-8 * max(abs(prev), 1):
+                        break
+                    prev = obj
+            obj = float(objective(Xj, Yj))
+            _est.record_fit("glrm", "legacy", iterations=it_done)
 
         model = GLRMModel(self, x, dinfo, np.asarray(Xj), np.asarray(Yj), k,
-                          float(objective(Xj, Yj)))
+                          obj)
         mm = ModelMetricsBase(nobs=n)
         mm.description = f"objective={model.objective:.6g}"
         model.training_metrics = mm

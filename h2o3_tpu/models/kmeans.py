@@ -5,23 +5,40 @@ iterations with k-means|| (parallel) initialization, `init` ∈
 {Random, PlusPlus, Furthest, User}, standardization, categorical one-hot;
 estimator surface `h2o-py/h2o/estimators/kmeans.py`.
 
-TPU shape: one Lloyd iteration = a single jitted program — pairwise
-distances ride the MXU (‖x−c‖² expanded to x·cᵀ), assignment is an argmin,
-and the centroid update is a segment-sum; with rows sharded over ``hosts``
-the per-cluster sums/counts psum across hosts exactly like the reference's
-MRTask reduce (`KMeans.Lloyds`).
+TPU shape (ISSUE 15): the WHOLE Lloyd fit is one jitted program — a
+`lax.while_loop` whose body fuses distance→assign→update (pairwise
+distances ride the MXU via ‖x−c‖² expanded to x·cᵀ, assignment is an
+argmin, the centroid update a segment-sum) and whose WSS-convergence test
+runs ON DEVICE, so the host reads only the final (centers, wss,
+iterations) instead of paying a dispatch + sync per iteration. The
+standardized matrix comes from the dataset cache's std layer (one
+extraction + one upload per sweep), and under the estimator shard plan the
+per-cluster sums/counts/WSS reduce as S canonical ordered blocks
+(`ordered_axis_fold`) so an N-device fit is bit-identical to the 1-device
+forced-shard lane. ``H2O3_EST_LEGACY=1`` restores the host per-iteration
+loop; user-supplied init points and multi-process clouds stay on it.
+
+k-means++/Furthest seeding keeps a RUNNING min-distance vector — O(k·n·p)
+total instead of the former O(k²·n·p) recompute-all-centers-per-draw —
+with draws bitwise identical to the old code (min over the same per-center
+distance arrays, folded incrementally).
 """
 
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ..frame.frame import Frame
+from ..parallel import distdata
+from ..parallel import mesh as cloudlib
+from . import estimator_engine as _est
 from .metrics import ModelMetricsClustering
 from .model_base import DataInfo, H2OEstimator, H2OModel
 
@@ -40,6 +57,102 @@ def _lloyd_step(X, cents, w, k: int):
     new_cents = jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts[:, None], 1e-12), cents)
     wss = jnp.sum(jnp.maximum(mind2, 0.0) * w)
     return new_cents, assign, wss, cnts
+
+
+def _lloyd_fit_fn(cloud, shard_mode: str, n_shards: int, k: int):
+    """The whole Lloyd fit as ONE device program (ISSUE 15): while_loop
+    over fused distance→assign→update steps, WSS convergence on device
+    (|WSSₜ₋₁ − WSSₜ| < tol·max(|WSSₜ₋₁|, 1), the host loop's test). Row
+    reductions run as `local_blocks` ordered block partials merged by
+    `ordered_axis_fold` under the shard plan. Every mode (including
+    "off") uses the one-hot-matmul cluster reduction, whose f32
+    accumulation order differs from `_lloyd_step`'s segment-sum — fused
+    vs legacy is a TOLERANCE comparison (pinned), while blocks vs mesh
+    stays bitwise. Cached per cloud."""
+    local_blocks, axis = _est.local_plan(cloud, shard_mode, n_shards)
+    key = ("kmeans_lloyd", k, local_blocks, axis)
+
+    def build():
+        def inner(X, w, cents0, max_iter, tol):
+            xsq = jnp.sum(X * X, axis=1)
+            karange = jnp.arange(k, dtype=jnp.int32)[None, :]
+
+            def step(cents):
+                d2 = (xsq[:, None] - 2.0 * X @ cents.T
+                      + jnp.sum(cents * cents, axis=1)[None, :])
+                assign = jnp.argmin(d2, axis=1)
+                mind2 = jnp.maximum(jnp.min(d2, axis=1), 0.0)
+                # per-cluster sums/counts as a ONE-HOT MATMUL instead of a
+                # segment-sum scatter: ~4x faster on CPU (100k scalar
+                # scatter-adds become one (k,n)@(n,p) gemm) and MXU-shaped
+                # on TPU; per-block partials stay deterministic
+                oh = ((assign[:, None] == karange).astype(jnp.float32)
+                      * w[:, None])
+                if local_blocks:
+                    sl = _est.block_slices(X.shape[0], local_blocks)
+                    sums = _est.fold_blocks(jnp.stack(
+                        [oh[s].T @ X[s] for s in sl]), axis)
+                    cnts = _est.fold_blocks(jnp.stack(
+                        [jnp.sum(oh[s], axis=0) for s in sl]), axis)
+                    wss = _est.fold_blocks(jnp.stack(
+                        [jnp.sum((mind2 * w)[s])[None] for s in sl]),
+                        axis)[0]
+                else:
+                    sums = oh.T @ X
+                    cnts = jnp.sum(oh, axis=0)
+                    wss = jnp.sum(mind2 * w)
+                new_cents = jnp.where(cnts[:, None] > 0,
+                                      sums / jnp.maximum(cnts[:, None], 1e-12),
+                                      cents)
+                return new_cents, wss
+
+            def cond(state):
+                cents, prev, it, done = state
+                return (~done) & (it < max_iter)
+
+            def body(state):
+                cents, prev, it, _ = state
+                new_cents, wss = step(cents)
+                done = jnp.abs(prev - wss) < tol * jnp.maximum(
+                    jnp.abs(prev), 1.0)
+                return new_cents, wss, it + 1, done
+
+            cents, wss, it, done = jax.lax.while_loop(
+                cond, body,
+                (cents0, jnp.float32(jnp.inf), jnp.int32(0),
+                 jnp.asarray(False)))
+            return cents, wss, it, done
+
+        if axis is not None:
+            rspec = P(cloudlib.ROWS_AXIS)
+            rep = P()
+            inner = cloudlib.shard_call(
+                inner, cloud, in_specs=(rspec, rspec, rep, rep, rep),
+                out_specs=(rep, rep, rep, rep), check_rep=False)
+        return jax.jit(inner)
+
+    return _est.cached_program(cloud, key, build)
+
+
+def _seed_centers(X, k: int, init: str, rng) -> np.ndarray:
+    """PlusPlus / Furthest seeding (k-means|| degenerate single pass) with
+    a RUNNING min-distance vector: each draw folds only the NEW center's
+    distances into d², O(k·n·p) total — the recompute-every-center form
+    was O(k²·n·p). np.minimum folds the identical per-center distance
+    arrays the old np.min-over-list computed, so draws (and therefore
+    centers) are bitwise unchanged for a given seed."""
+    n = X.shape[0]
+    cents = [X[rng.integers(n)]]
+    d2 = np.sum((X - cents[0]) ** 2, axis=1)
+    for _ in range(k - 1):
+        if init == "Furthest":
+            c = X[int(d2.argmax())]
+        else:
+            probs = d2 / max(d2.sum(), 1e-12)
+            c = X[rng.choice(n, p=probs)]
+        cents.append(c)
+        d2 = np.minimum(d2, np.sum((X - c) ** 2, axis=1))
+    return np.asarray(cents, np.float32)
 
 
 class KMeansModel(H2OModel):
@@ -116,44 +229,90 @@ class H2OKMeansEstimator(H2OEstimator):
         p = self._parms
         seed = p["_actual_seed"]
         k = int(p.get("k", 1))
-        dinfo = DataInfo(train, x, standardize=bool(p.get("standardize", True)),
-                         use_all_factor_levels=True)
-        X = dinfo.fit_transform(train)
-        n = X.shape[0]
-        rng = np.random.default_rng(seed)
+        std = bool(p.get("standardize", True))
+        max_iter = int(p.get("max_iterations", 10))
         init = p.get("init", "Furthest")
+        rng = np.random.default_rng(seed)
+        cloud = cloudlib.cloud()
+        multiproc = distdata.multiprocess()
+        # engine gate: legacy comparator, multi-process clouds and USER
+        # init points keep the host per-iteration loop (ISSUE 15 corners)
+        engine_on = (not _est.legacy() and not multiproc
+                     and p.get("user_points") is None)
+        shard_mode, n_shards = (_est.shard_plan(cloud.size, multiproc)
+                                if engine_on else ("off", 0))
+        if shard_mode == "mesh" and train.nrow < cloud.size:
+            shard_mode, n_shards = "off", 0
+            engine_on = cloud.size == 1 and engine_on
 
-        if p.get("user_points") is not None:
-            up = p["user_points"]
-            cents = np.asarray(up.to_numpy() if isinstance(up, Frame) else up, np.float32)
-        elif init == "Random":
-            cents = X[rng.choice(n, k, replace=False)]
+        if not engine_on:
+            dinfo = DataInfo(train, x, standardize=std,
+                             use_all_factor_levels=True)
+            X = dinfo.fit_transform(train)
+            n = X.shape[0]
+            if p.get("user_points") is not None:
+                up = p["user_points"]
+                cents = np.asarray(up.to_numpy() if isinstance(up, Frame) else up, np.float32)
+            elif init == "Random":
+                cents = X[rng.choice(n, k, replace=False)]
+            else:
+                cents = _seed_centers(X, k, init, rng)
+            Xd = jnp.asarray(X)
+            wd = jnp.ones(n, jnp.float32)
+            cd = jnp.asarray(cents, jnp.float32)
+            prev = np.inf
+            iters = 0
+            for it in range(max_iter):
+                cd, assign, wss, cnts = _lloyd_step(Xd, cd, wd, k)
+                wss = float(wss)
+                iters = it + 1
+                if abs(prev - wss) < 1e-7 * max(abs(prev), 1):
+                    break
+                prev = wss
+            _est.record_fit("kmeans", "legacy", iterations=iters,
+                            n_shards=0, n_devices=1)
+            model = KMeansModel(self, x, dinfo, np.asarray(cd), k)
         else:
-            # PlusPlus / Furthest seeding (k-means|| degenerate single pass)
-            cents = [X[rng.integers(n)]]
-            for _ in range(k - 1):
-                d2 = np.min(
-                    [(np.sum((X - c) ** 2, axis=1)) for c in cents], axis=0
-                )
-                if init == "Furthest":
-                    cents.append(X[int(d2.argmax())])
-                else:
-                    probs = d2 / max(d2.sum(), 1e-12)
-                    cents.append(X[rng.choice(n, p=probs)])
-            cents = np.asarray(cents, np.float32)
+            from . import dataset_cache as _dc
 
-        Xd = jnp.asarray(X)
-        wd = jnp.ones(n, jnp.float32)
-        cd = jnp.asarray(cents, jnp.float32)
-        prev = np.inf
-        for it in range(int(p.get("max_iterations", 10))):
-            cd, assign, wss, cnts = _lloyd_step(Xd, cd, wd, k)
-            wss = float(wss)
-            if abs(prev - wss) < 1e-7 * max(abs(prev), 1):
-                break
-            prev = wss
+            cache0 = _dc.snapshot() if _est.cache_enabled() else None
+            ndev_eff = cloud.size if shard_mode == "mesh" else 1
+            # host matrix backs the init draws; the device artifact is its
+            # one cached upload (padded to the block grid, zero-weight)
+            dinfo, X = _est.host_matrix(train, x, standardize=std,
+                                        use_all=True)
+            _, Xd = _est.device_matrix(train, x, standardize=std,
+                                       use_all=True, n_shards=n_shards,
+                                       n_devices=ndev_eff)
+            n = X.shape[0]
+            npad = int(Xd.shape[0])
+            if init == "Random":
+                cents = X[rng.choice(n, k, replace=False)]
+            else:
+                cents = _seed_centers(X, k, init, rng)
+            w = np.zeros(npad, np.float32)
+            w[:n] = 1.0
+            wd = (jax.device_put(jnp.asarray(w), cloud.row_sharding())
+                  if ndev_eff > 1 else jnp.asarray(w))
+            fn = _lloyd_fit_fn(cloud, shard_mode, n_shards, k)
+            t0 = time.perf_counter()
+            with _est.iter_phase():
+                cd, wss_d, it_d, done_d = fn(
+                    Xd, wd, jnp.asarray(cents, jnp.float32),
+                    jnp.int32(max_iter), jnp.float32(1e-7))
+                cloudlib.collective_fence(cd)
+                cents_out = np.asarray(cd)
+            _est.record_fit(
+                "kmeans",
+                {"mesh": "fused_mesh", "blocks": "fused_blocks"}.get(
+                    shard_mode, "fused"),
+                iterations=int(it_d), converged=bool(done_d),
+                matrix_cache=(_est.matrix_cache_state(cache0)
+                              if cache0 is not None else None),
+                n_shards=n_shards, n_devices=ndev_eff,
+                wall_s=time.perf_counter() - t0)
+            model = KMeansModel(self, x, dinfo, cents_out, k)
 
-        model = KMeansModel(self, x, dinfo, np.asarray(cd), k)
         model.training_metrics = model._make_metrics(train)
         if valid is not None:
             model.validation_metrics = model._make_metrics(valid)

@@ -1181,6 +1181,7 @@ class _Handler(BaseHTTPRequestHandler):
                         training=profiler.training_stats(),
                         faults=profiler.fault_stats(),
                         tree=profiler.tree_stats(),
+                        est=profiler.est_stats(),
                         xla=profiler.xla_stats(),
                         tracing=profiler.tracing_stats(),
                         memory=profiler.memory_stats(),

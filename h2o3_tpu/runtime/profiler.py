@@ -117,6 +117,20 @@ def tree_stats() -> Dict:
     return out
 
 
+def est_stats() -> Dict:
+    """Estimator-engine observability folded into the profiler surface
+    (ISSUE 15): the per-fit plans recorded by
+    `models.estimator_engine.record_fit` (algo, fused/legacy path,
+    on-device iterations, converged flag, standardized-matrix cache
+    hit/miss, shard count) plus the cumulative dispatch/iteration
+    counters. Pure counter read — never fits anything."""
+    from ..models import estimator_engine
+
+    out = estimator_engine.est_stats()
+    out["active"] = bool(out["plans"]) or bool(out["dispatch"])
+    return out
+
+
 def xla_stats() -> Dict:
     """XLA compile/trace/retrace counters folded into the profiler surface
     (runtime/phases tracker): totals + per-program-signature breakdown.

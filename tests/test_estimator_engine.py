@@ -1,0 +1,468 @@
+"""Fused device-resident estimator engine (ISSUE 15) — legacy-vs-fused
+parity, the standardized-matrix cache contract (one upload per sweep, zero
+new traces on the second candidate), blocks==mesh bit-identity, the
+k-means++ seeding determinism pin, and the observability surfaces."""
+
+import os
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models import dataset_cache
+from h2o3_tpu.models import estimator_engine as est
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
+from h2o3_tpu.models.kmeans import H2OKMeansEstimator, _seed_centers
+from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache():
+    dataset_cache.clear()
+    dataset_cache.reset_stats()
+    yield
+    dataset_cache.clear()
+    os.environ.pop("H2O3_EST_LEGACY", None)
+    os.environ.pop("H2O3_EST_SHARD", None)
+
+
+def _legacy(on: bool):
+    if on:
+        os.environ["H2O3_EST_LEGACY"] = "1"
+    else:
+        os.environ.pop("H2O3_EST_LEGACY", None)
+
+
+def _glm_frame(n=1500, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    beta = np.linspace(1.5, -1.5, f)
+    eta = X @ beta
+    return X, eta, rng
+
+
+# -- GLM family x solver parity matrix ---------------------------------------
+
+GLM_CASES = [
+    ("gaussian", 0.1, 0.0),     # ridge
+    ("gaussian", 0.05, 1.0),    # lasso
+    ("binomial", 0.1, 0.0),
+    ("binomial", 0.05, 1.0),
+    ("poisson", 0.1, 0.0),
+    ("poisson", 0.05, 1.0),
+    ("tweedie", 0.1, 0.0),
+    ("tweedie", 0.05, 1.0),
+]
+
+
+@pytest.mark.parametrize("family,lam,alpha", GLM_CASES)
+def test_glm_fused_matches_legacy(cloud1, family, lam, alpha):
+    """Fused whole-fit IRLS (f32 on-device solves) reproduces the host f64
+    loop's de-standardized coefficients at tolerance, family × ridge/lasso
+    (ISSUE 15 parity matrix)."""
+    X, eta, rng = _glm_frame()
+    if family == "binomial":
+        y = (rng.random(len(eta)) < 1 / (1 + np.exp(-eta))).astype(float)
+    elif family == "poisson":
+        y = rng.poisson(np.exp(np.clip(eta / 3, -3, 3))).astype(float)
+    elif family == "tweedie":
+        y = np.abs(eta) + rng.random(len(eta))
+    else:
+        y = eta + 0.1 * rng.normal(size=len(eta))
+    names = [f"x{i}" for i in range(X.shape[1])] + ["y"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names)
+    if family == "binomial":
+        fr = fr.asfactor("y")
+
+    def coefs(legacy):
+        _legacy(legacy)
+        g = H2OGeneralizedLinearEstimator(family=family, lambda_=lam,
+                                          alpha=alpha, seed=7)
+        g.train(y="y", training_frame=fr)
+        return np.asarray(list(g.coef().values()), np.float64)
+
+    fused = coefs(False)
+    plan = est.est_stats()["plans"][-1]
+    assert plan["algo"] == "glm" and plan["path"] == "fused"
+    assert plan["iterations"] >= 1 and plan["converged"]
+    legacy = coefs(True)
+    scale = max(np.abs(legacy).max(), 1e-3)
+    assert np.abs(fused - legacy).max() < 5e-3 * scale, (fused, legacy)
+
+
+def test_glm_lambda_search_legacy_comparator(cloud1):
+    """H2O3_EST_LEGACY=1 routes lambda_search through the host IRLS loop;
+    both paths select comparable lambdas and coefficients."""
+    X, eta, rng = _glm_frame(1200, 6)
+    y = (rng.random(len(eta)) < 1 / (1 + np.exp(-eta))).astype(float)
+    names = [f"x{i}" for i in range(6)] + ["y"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names).asfactor("y")
+
+    def fit(legacy):
+        _legacy(legacy)
+        g = H2OGeneralizedLinearEstimator(family="binomial",
+                                          lambda_search=True, nlambdas=8,
+                                          alpha=0.5, seed=7)
+        g.train(y="y", training_frame=fr)
+        return g
+
+    gf = fit(False)
+    assert est.est_stats()["plans"][-1]["path"] == "fused_path"
+    gl = fit(True)
+    assert est.est_stats()["plans"][-1]["path"] == "legacy"
+    cf = np.asarray(list(gf.coef().values()))
+    cl = np.asarray(list(gl.coef().values()))
+    assert np.abs(cf - cl).max() < 5e-2 * max(np.abs(cl).max(), 1e-3)
+    assert abs(gf.auc() - gl.auc()) < 0.02
+
+
+# -- K-Means ------------------------------------------------------------------
+
+def _blob_frame(n=900, k=3, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, (k, f))
+    X = np.concatenate([c + rng.normal(size=(n // k, f)) for c in centers])
+    return Frame.from_numpy(X, names=[f"c{i}" for i in range(f)]), X
+
+
+def test_kmeans_fused_matches_legacy(cloud1):
+    fr, _ = _blob_frame()
+    def fit(legacy):
+        _legacy(legacy)
+        km = H2OKMeansEstimator(k=3, max_iterations=20, seed=1,
+                                init="PlusPlus")
+        km.train(training_frame=fr)
+        return km
+    kf = fit(False)
+    plan = est.est_stats()["plans"][-1]
+    assert plan["path"] == "fused" and plan["iterations"] >= 1
+    kl = fit(True)
+    assert np.abs(kf.model.centers() - kl.model.centers()).max() < 1e-4
+    assert kf.model.tot_withinss() == pytest.approx(
+        kl.model.tot_withinss(), rel=1e-5)
+
+
+def test_kmeans_plusplus_seeding_running_min_pin(cloud1):
+    """The O(k·n·p) running-min seeding draws BITWISE the same centers as
+    the former O(k²·n·p) recompute-all-centers form, for both PlusPlus and
+    Furthest (the seed-determinism pin)."""
+    _, X = _blob_frame(600, 4, 5, seed=3)
+    X = X.astype(np.float32)
+
+    def reference(X, k, init, rng):
+        cents = [X[rng.integers(len(X))]]
+        for _ in range(k - 1):
+            d2 = np.min([np.sum((X - c) ** 2, axis=1) for c in cents],
+                        axis=0)
+            if init == "Furthest":
+                cents.append(X[int(d2.argmax())])
+            else:
+                probs = d2 / max(d2.sum(), 1e-12)
+                cents.append(X[rng.choice(len(X), p=probs)])
+        return np.asarray(cents, np.float32)
+
+    for init in ("PlusPlus", "Furthest"):
+        got = _seed_centers(X, 4, init, np.random.default_rng(11))
+        want = reference(X, 4, init, np.random.default_rng(11))
+        assert np.array_equal(got, want), init
+
+
+def test_kmeans_user_points_stay_legacy(cloud1):
+    fr, X = _blob_frame()
+    pts = X[:3].copy()
+    km = H2OKMeansEstimator(k=3, max_iterations=5, standardize=False,
+                            user_points=pts, seed=1)
+    km.train(training_frame=fr)
+    assert est.est_stats()["plans"][-1]["path"] == "legacy"
+    assert km.model.tot_withinss() < km.model.totss()
+
+
+# -- PCA / GLRM ---------------------------------------------------------------
+
+def test_pca_gramsvd_fused_bitwise_matches_legacy(cloud1):
+    """Unsharded fused GramSVD computes the same device Gram + host f64
+    eigh the legacy path did — bitwise-equal eigenpairs."""
+    rng = np.random.default_rng(4)
+    X = np.column_stack([3 * rng.normal(size=700), rng.normal(size=700),
+                         0.1 * rng.normal(size=700)])
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    def fit(legacy):
+        _legacy(legacy)
+        p = H2OPrincipalComponentAnalysisEstimator(
+            k=3, transform="STANDARDIZE")
+        p.train(training_frame=fr)
+        return p
+    pf, pl = fit(False), fit(True)
+    assert np.array_equal(np.asarray(pf.model.eigenvalues),
+                          np.asarray(pl.model.eigenvalues))
+    assert np.array_equal(np.asarray(pf.model.eigenvectors),
+                          np.asarray(pl.model.eigenvectors))
+
+
+def test_pca_randomized_fused_close_to_exact(cloud1):
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(600, 10)) @ np.diag([5, 3] + [0.1] * 8)
+    fr = Frame.from_numpy(X)
+    pr = H2OPrincipalComponentAnalysisEstimator(
+        k=2, pca_method="Randomized", transform="DEMEAN", seed=6)
+    pr.train(training_frame=fr)
+    plan = est.est_stats()["plans"][-1]
+    assert plan["path"] == "fused" and plan["method"] == "Randomized"
+    sd = pr.model.importance["Standard deviation"]
+    assert sd[0] == pytest.approx(5.0, rel=0.15)
+    assert sd[1] == pytest.approx(3.0, rel=0.15)
+
+
+def test_glrm_fused_matches_legacy(cloud1):
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(150, 6))
+    X[rng.random(X.shape) < 0.1] = np.nan
+    fr = Frame.from_numpy(X)
+    def fit(legacy):
+        _legacy(legacy)
+        g = H2OGeneralizedLowRankEstimator(k=2, max_iterations=40, seed=1)
+        g.train(training_frame=fr)
+        return g
+    gf, gl = fit(False), fit(True)
+    assert gf.model.objective == pytest.approx(gl.model.objective, rel=1e-4)
+    pf = est.est_stats()["plans"]
+    assert [p["path"] for p in pf[-2:]] == ["fused", "legacy"]
+    assert pf[-2]["iterations"] == pf[-1]["iterations"]
+
+
+# -- the sweep contract: one matrix, one upload, zero retraces ----------------
+
+def test_second_candidate_hits_matrix_cache_zero_new_traces(cloud1):
+    """Two sweep candidates on the same frame: the second fit's
+    standardized design comes out of the std cache layer (zero new H2D
+    bytes) and traces ZERO new programs (the ISSUE 15 acceptance pin)."""
+    from h2o3_tpu.runtime import phases
+
+    X, eta, rng = _glm_frame(2000, 6, seed=9)
+    y = (rng.random(len(eta)) < 1 / (1 + np.exp(-eta))).astype(float)
+    names = [f"x{i}" for i in range(6)] + ["y"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names).asfactor("y")
+    g = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.05,
+                                      seed=1)
+    g.train(y="y", training_frame=fr)
+    snap0 = dataset_cache.snapshot()
+    xla0 = phases.xla_counts()
+    bytes0 = phases.snapshot().get("bytes_h2d", 0)
+    g2 = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.05,
+                                       seed=2)
+    g2.train(y="y", training_frame=fr)
+    snap1 = dataset_cache.snapshot()
+    xla1 = phases.xla_counts()
+    bytes1 = phases.snapshot().get("bytes_h2d", 0)
+    assert snap1["std_hits"] > snap0["std_hits"]
+    assert snap1["std_misses"] == snap0["std_misses"]
+    assert xla1["traces"] == xla0["traces"], "second fit must not trace"
+    assert bytes1 == bytes0, "second fit must not re-upload the design"
+    assert est.est_stats()["plans"][-1]["matrix_cache"] == "hit"
+
+
+def test_kmeans_pca_share_std_matrix(cloud1):
+    """K-Means and PCA on one all-numeric frame share the SAME std-layer
+    artifacts (use_all_factor_levels is normalized out of the key when no
+    categorical column exists)."""
+    fr, _ = _blob_frame(600, 3, 4, seed=5)
+    km = H2OKMeansEstimator(k=3, max_iterations=5, seed=1)
+    km.train(training_frame=fr)
+    snap0 = dataset_cache.snapshot()
+    p = H2OPrincipalComponentAnalysisEstimator(k=2, transform="STANDARDIZE")
+    p.train(training_frame=fr)
+    snap1 = dataset_cache.snapshot()
+    assert snap1["std_misses"] == snap0["std_misses"]
+    assert snap1["std_hits"] > snap0["std_hits"]
+
+
+def test_est_legacy_disables_engine_and_cache(cloud1):
+    _legacy(True)
+    fr, _ = _blob_frame(300, 3, 4)
+    km = H2OKMeansEstimator(k=3, max_iterations=5, seed=1)
+    km.train(training_frame=fr)
+    assert dataset_cache.snapshot()["std_misses"] == 0
+    assert est.est_stats()["plans"][-1]["path"] == "legacy"
+
+
+# -- shard plan: blocks == mesh bit-identity ----------------------------------
+
+def test_kmeans_blocks_equals_mesh_bitwise(cloud8):
+    """An 8-device mesh K-Means fit is BIT-IDENTICAL to the 1-device
+    forced-shard (H2O3_EST_SHARD=1) fit sharing S — the PR 9 contract
+    routed to the estimators (ISSUE 15 acceptance)."""
+    import jax
+
+    from h2o3_tpu.parallel import mesh
+
+    fr, _ = _blob_frame(640, 3, 4, seed=7)
+    mesh.init(jax.devices()[:1])
+    os.environ["H2O3_EST_SHARD"] = "1"
+    km1 = H2OKMeansEstimator(k=3, max_iterations=15, seed=1)
+    km1.train(training_frame=fr)
+    assert est.est_stats()["plans"][-1]["path"] == "fused_blocks"
+    del os.environ["H2O3_EST_SHARD"]
+    dataset_cache.clear()
+    mesh.reset()
+    mesh.init(jax.devices())
+    km8 = H2OKMeansEstimator(k=3, max_iterations=15, seed=1)
+    km8.train(training_frame=fr)
+    plan = est.est_stats()["plans"][-1]
+    assert plan["path"] == "fused_mesh" and plan["n_devices"] == 8
+    assert np.array_equal(np.asarray(km1.model.centers_std),
+                          np.asarray(km8.model.centers_std))
+
+
+def test_pca_blocks_equals_mesh_bitwise(cloud8):
+    import jax
+
+    from h2o3_tpu.parallel import mesh
+
+    fr, _ = _blob_frame(640, 3, 4, seed=8)
+    mesh.init(jax.devices()[:1])
+    os.environ["H2O3_EST_SHARD"] = "1"
+    p1 = H2OPrincipalComponentAnalysisEstimator(k=3,
+                                                transform="STANDARDIZE")
+    p1.train(training_frame=fr)
+    del os.environ["H2O3_EST_SHARD"]
+    dataset_cache.clear()
+    mesh.reset()
+    mesh.init(jax.devices())
+    p8 = H2OPrincipalComponentAnalysisEstimator(k=3,
+                                                transform="STANDARDIZE")
+    p8.train(training_frame=fr)
+    assert np.array_equal(np.asarray(p1.model.eigenvalues),
+                          np.asarray(p8.model.eigenvalues))
+    assert np.array_equal(np.asarray(p1.model.eigenvectors),
+                          np.asarray(p8.model.eigenvectors))
+
+
+def test_est_shard_escape_hatch(cloud8):
+    """H2O3_EST_SHARD=0 on a mesh cloud: fits run unsharded ("off"),
+    bit-equal to a plain 1-device fused fit."""
+    import jax
+
+    from h2o3_tpu.parallel import mesh
+
+    fr, _ = _blob_frame(320, 3, 4, seed=9)
+    mesh.init(jax.devices()[:1])
+    km1 = H2OKMeansEstimator(k=3, max_iterations=10, seed=1)
+    km1.train(training_frame=fr)
+    dataset_cache.clear()
+    mesh.reset()
+    mesh.init(jax.devices())
+    os.environ["H2O3_EST_SHARD"] = "0"
+    km0 = H2OKMeansEstimator(k=3, max_iterations=10, seed=1)
+    km0.train(training_frame=fr)
+    assert est.est_stats()["plans"][-1]["path"] == "fused"
+    assert np.array_equal(np.asarray(km1.model.centers_std),
+                          np.asarray(km0.model.centers_std))
+
+
+@pytest.mark.slow
+def test_glm_blocks_equals_mesh_bitwise_slow(cloud8):
+    import jax
+
+    from h2o3_tpu.parallel import mesh
+
+    X, eta, rng = _glm_frame(640, 4, seed=12)
+    y = (rng.random(len(eta)) < 1 / (1 + np.exp(-eta))).astype(float)
+    names = [f"x{i}" for i in range(4)] + ["y"]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=names).asfactor("y")
+    for fam, lam, alpha in (("binomial", 0.01, 0.5),
+                            ("gaussian", 0.01, 0.0)):
+        frx = fr
+        if fam == "gaussian":
+            frx = Frame.from_numpy(
+                np.column_stack([X, eta]), names=names)
+        mesh.reset()
+        mesh.init(jax.devices()[:1])
+        os.environ["H2O3_EST_SHARD"] = "1"
+        dataset_cache.clear()
+        g1 = H2OGeneralizedLinearEstimator(family=fam, lambda_=lam,
+                                           alpha=alpha)
+        g1.train(y="y", training_frame=frx)
+        del os.environ["H2O3_EST_SHARD"]
+        dataset_cache.clear()
+        mesh.reset()
+        mesh.init(jax.devices())
+        g8 = H2OGeneralizedLinearEstimator(family=fam, lambda_=lam,
+                                           alpha=alpha)
+        g8.train(y="y", training_frame=frx)
+        assert np.array_equal(np.asarray(g1.model.beta),
+                              np.asarray(g8.model.beta)), fam
+
+
+# -- observability -------------------------------------------------------------
+
+def test_est_observability_surfaces(cloud1):
+    from h2o3_tpu.runtime import metrics_registry, phases, profiler
+
+    fr, _ = _blob_frame(300, 3, 4)
+    before = phases.snapshot().get("est_iter_s", 0.0)
+    km = H2OKMeansEstimator(k=3, max_iterations=5, seed=1)
+    km.train(training_frame=fr)
+    # est_iter phase bucket accumulated the fused loop's wall
+    assert phases.snapshot().get("est_iter_s", 0.0) >= before
+    stats = profiler.est_stats()
+    assert stats["active"] and stats["plans"]
+    assert any(p["algo"] == "kmeans" for p in stats["plans"])
+    assert stats["dispatch"].get("kmeans/fused", 0) >= 1
+    assert stats["iterations"].get("kmeans", 0) >= 1
+    # Prometheus families on the scrape surface
+    text = metrics_registry.prometheus_text()
+    assert "h2o3_est_dispatch" in text
+    assert "h2o3_est_iterations" in text
+
+
+def test_profiler_rest_carries_est_fold(cloud1):
+    from h2o3_tpu.client import H2OConnection
+    from h2o3_tpu.rest.server import start_server
+
+    fr, _ = _blob_frame(300, 3, 4)
+    km = H2OKMeansEstimator(k=3, max_iterations=5, seed=1)
+    km.train(training_frame=fr)
+    srv = start_server(port=0)
+    try:
+        # a direct connection object — h2o.connect() would make this
+        # throwaway server the process-wide default and poison every
+        # later test once it stops
+        conn = H2OConnection(f"http://127.0.0.1:{srv.port}")
+        prof = conn.get("/3/Profiler")
+        assert "est" in prof and prof["est"]["plans"]
+        assert prof["est"]["plans"][-1]["algo"] == "kmeans"
+    finally:
+        srv.stop()
+
+
+# -- AutoML heterogeneous pool -------------------------------------------------
+
+def test_automl_heterogeneous_parallel_leaderboard_identical(cloud1):
+    """The PR 4 leaderboard-parallelism invariant holds over the NEW
+    engine-backed candidates: an AutoML pool of GLM + DRF + XRT produces
+    an identical leaderboard at parallelism 1 and 2 (ISSUE 15
+    acceptance)."""
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    rng = np.random.default_rng(21)
+    X = rng.normal(size=(400, 5))
+    yv = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+          + 0.3 * rng.normal(size=400) > 0).astype(float)
+    names = [f"f{i}" for i in range(5)] + ["y"]
+    fr = Frame.from_numpy(np.column_stack([X, yv]),
+                          names=names).asfactor("y")
+
+    def lb(par):
+        # max_models=2 → one GLM + one DRF wave: a genuinely mixed pool
+        # without two CONCURRENT tree fits (pathologically slow on a
+        # 1-core host, with or without the engine)
+        aml = H2OAutoML(max_models=2, seed=5, nfolds=2, parallelism=par,
+                        include_algos=["GLM", "DRF"])
+        aml.train(y="y", training_frame=fr)
+        return [(r["algo"], round(r["auc"], 12))
+                for r in aml.leaderboard.rows]
+
+    l1, l2 = lb(1), lb(2)
+    assert l1 == l2, (l1, l2)
+    assert len({r[0] for r in l1}) >= 2, "pool must be heterogeneous"
