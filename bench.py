@@ -937,6 +937,14 @@ def bench_serving():
     growth = stats.get("mem_growth_bytes_per_min")
     floor_mb = float(os.environ.get("BENCH_MEM_GROWTH_FLOOR_MB_MIN", 64))
     exceeded = growth is not None and growth > floor_mb * 1e6
+    # router self-description (ISSUE 16): every serving-path record embeds
+    # the router's shed counters next to the memory canary — zeros when no
+    # router ran in this process (peek, never instantiate)
+    from h2o3_tpu.serving import peek_router
+
+    rt = peek_router()
+    rt_totals = rt.snapshot(probe=False)["totals"] if rt is not None \
+        else {}
     return (f"serving_openloop_{int(rate)}rps_p99_ms", p99,
             {"unit_override": "ms",
              "rate_rps": rate, "duration_s": duration,
@@ -950,7 +958,170 @@ def bench_serving():
              "mem_growth_bytes_per_min": growth,
              "ledger_growth_bytes_per_min":
                  stats.get("ledger_growth_bytes_per_min"),
-             "mem_growth_exceeded": True if exceeded else None})
+             "mem_growth_exceeded": True if exceeded else None,
+             "router_shed": rt_totals.get("shed", 0),
+             "router_rollbacks": rt_totals.get("rollbacks", 0),
+             "router_failovers": rt_totals.get("failovers", 0)})
+
+
+# each fleet_serving replica is a real subprocess serving the same
+# deterministic GBM: the router's failover claim is only meaningful across
+# process boundaries (a thread-backed "replica" shares the scorer cache and
+# the GIL with the router)
+_FLEET_REPLICA_BODY = """
+import sys, os, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["H2O3_REPLICA_NAME"] = {name!r}
+import numpy as np
+from h2o3_tpu.frame.frame import Frame
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.rest.server import start_server
+from h2o3_tpu.runtime.dkv import DKV
+rng = np.random.default_rng(7)
+X = rng.normal(size=({rows}, 8))
+w = rng.normal(size=8)
+y = (X @ w + 0.5 * rng.normal(size={rows}) > 0).astype(float)
+names = [f"f{{i}}" for i in range(8)] + ["label"]
+fr = Frame.from_numpy(np.column_stack([X, y]), names=names) \\
+    .asfactor("label")
+gbm = H2OGradientBoostingEstimator(ntrees=10, max_depth=4, seed=42)
+gbm.train(y="label", training_frame=fr)
+DKV.put("fleet_gbm", gbm.model)
+sf = Frame({{n: fr.vec(n) for n in names[:-1]}})
+sf.key = "fleet_frame"
+DKV.put(sf.key, sf)
+srv = start_server(port={port})
+import urllib.request
+for _ in range(2):   # warm the scorer cache before the measured window
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/3/Predictions/models/fleet_gbm"
+        "/frames/fleet_frame", data=b"")
+    urllib.request.urlopen(req, timeout=120).read()
+print("READY", flush=True)
+time.sleep(600)
+"""
+
+
+def bench_fleet_serving():
+    """Fleet-serving lane (ISSUE 16): open-loop loadgen through the
+    serving ROUTER fronting 3 replica processes, with one replica killed
+    mid-run via the fault registry (`serving.scorer` crash at rate 1.0 —
+    every request it receives 500s deterministically). The router must
+    drain the victim and retry its in-flight work on peers: USER errors
+    stay 0, and the post-drain p99 is the headline. Reports the reroute
+    latency blip (post/pre p99 ratio), router shed/failover/drain
+    counters and the fleet-merged predict p99. Wired through the same
+    watchdog/partial machinery as every lane — an assertion here raises,
+    it never emits a value-0.0 line."""
+    import socket
+    import subprocess
+    import sys as _sys
+    import urllib.request
+
+    n_rows = int(os.environ.get("BENCH_ROWS", 2_000))
+    rate = float(os.environ.get("BENCH_FLEET_RATE", 15))
+    window = float(os.environ.get("BENCH_FLEET_WINDOW_S", 6))
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "deploy"))
+    from loadgen import fleet_summary, run_load_open
+
+    from h2o3_tpu.rest.server import start_server
+    from h2o3_tpu.runtime import fleet
+    from h2o3_tpu.serving import reset_router
+    from h2o3_tpu.serving.router import RouterConfig
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port() for _ in range(3)]
+    procs = []
+    srv = None
+    try:
+        for i, port in enumerate(ports):
+            procs.append(subprocess.Popen(
+                [_sys.executable, "-c", _FLEET_REPLICA_BODY.format(
+                    repo=repo, name=f"r{i + 1}", port=port, rows=n_rows)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        for i, p in enumerate(procs):
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                line = p.stdout.readline()
+                if "READY" in line:
+                    break
+                if p.poll() is not None:
+                    raise AssertionError(
+                        f"replica {i} died: {p.stdout.read()[-2000:]}")
+            else:
+                raise AssertionError(f"replica {i} never came up")
+        fleet.reset()
+        for i, port in enumerate(ports):
+            fleet.register_peer(f"r{i + 1}", f"http://127.0.0.1:{port}")
+        # long drain cooldown: the poisoned victim must STAY out of the
+        # ring for the whole post-kill window, not resurface as a probe
+        router = reset_router(RouterConfig(
+            refresh_s=0.5, drain_errors=2, drain_cooldown_s=60.0,
+            max_attempts=3))
+        srv = start_server(port=0)
+        t0 = time.time()
+        pre = run_load_open("127.0.0.1", srv.port, "fleet_gbm",
+                            "fleet_frame", rate=rate, duration_s=window,
+                            router=True)
+        # the mid-run kill, via the fault registry: every predict on the
+        # victim now raises InjectedCrash (NOT a device error, so the
+        # replica's CPU-fallback failover cannot mask it — it 500s)
+        victim = f"http://127.0.0.1:{ports[-1]}/3/Faults"
+        body = "point=serving.scorer&error=crash&rate=1.0".encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                victim, data=body), timeout=30) as r:
+            r.read()
+        post = run_load_open("127.0.0.1", srv.port, "fleet_gbm",
+                             "fleet_frame", rate=rate, duration_s=window,
+                             router=True)
+        wall = time.time() - t0
+        totals = router.snapshot(probe=False)["totals"]
+        fsum = fleet_summary("127.0.0.1", srv.port) or {}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                pass
+        if srv is not None:
+            srv.stop()
+    errors = pre["errors"] + post["errors"]
+    assert errors == 0, \
+        f"router must hide the killed replica (pre={pre} post={post})"
+    p99_pre, p99_post = pre["p99_ms"], post["p99_ms"]
+    assert p99_post is not None and np.isfinite(p99_post), \
+        "post-kill p99 must be measurable"
+    assert totals["failovers"] >= 1 and totals["drains"] >= 1, \
+        f"the kill must be visible in the router counters: {totals}"
+    blip = (round(p99_post / p99_pre, 3)
+            if p99_pre and p99_post is not None else None)
+    return (f"fleet_serving_3rep_{int(rate)}rps_p99_ms", p99_post,
+            {"unit_override": "ms", "wall_s": round(wall, 3),
+             "rate_rps": rate, "window_s": window,
+             "p99_pre_kill_ms": p99_pre, "p99_post_kill_ms": p99_post,
+             "reroute_blip_ratio": blip,
+             "offered": pre["offered"] + post["offered"],
+             "completed": pre["completed"] + post["completed"],
+             "errors": errors,
+             "shed_429": pre["shed_429"] + post["shed_429"],
+             "router_shed": totals.get("shed", 0),
+             "router_retries": totals.get("retries", 0),
+             "router_failovers": totals.get("failovers", 0),
+             "router_drains": totals.get("drains", 0),
+             "fleet_predict_p99_ms": fsum.get("predict_p99_ms"),
+             "replicas_up": fsum.get("replicas_up")})
 
 
 def bench_automl():
@@ -998,7 +1169,8 @@ R02_BASELINE = {
 # (first run also absorbs executable deserialization for later ones).
 DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
                    "scaling": 1, "ingest": 2, "munge": 2, "grid": 1,
-                   "chaos": 1, "serving": 1, "gbm_cpu": 1, "estimators": 1}
+                   "chaos": 1, "serving": 1, "gbm_cpu": 1, "estimators": 1,
+                   "fleet_serving": 1}
 
 
 def _probe_accelerator(timeout_s: float):
@@ -1360,7 +1532,8 @@ def main():
     cpu_fallback_reason = None
     forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
     if config in ("scaling", "munge", "chaos", "serving", "gbm_cpu",
-                  "oversubscription", "estimators") or forced:
+                  "oversubscription", "estimators",
+                  "fleet_serving") or forced:
         # the scaling curve runs in CPU subprocesses, the munge bench is
         # pure host numpy, the chaos/serving lanes measure FAILOVER/SLO
         # behavior (CPU is representative), and gbm_cpu IS the forced-CPU
@@ -1428,7 +1601,8 @@ def main():
           "grid": bench_grid, "chaos": bench_chaos,
           "serving": bench_serving, "gbm_cpu": bench_gbm_cpu,
           "oversubscription": bench_oversubscription,
-          "estimators": bench_estimators}[config]
+          "estimators": bench_estimators,
+          "fleet_serving": bench_fleet_serving}[config]
     # cold is strictly one run: repeats within a process share the live
     # executable cache, so any second run would be warm yet labeled cold
     repeats = 1 if cold else int(os.environ.get(

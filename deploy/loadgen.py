@@ -29,6 +29,8 @@ Usage:
         --threads 8 --requests 50               # closed-loop
     python deploy/loadgen.py --port 54321 --model gbm_1 --frame fr_1 \\
         --rate 50 --duration-s 10               # open-loop, 50 req/s
+    python deploy/loadgen.py --port 54321 --model gbm_1 --frame fr_1 \\
+        --rate 50 --duration-s 10 --router      # fleet router entry point
 
 Importable: `run_load(...)` / `run_load_open(...)` return the stats dict
 (the smoke tests in tests/test_serving.py and tests/test_observability.py
@@ -257,6 +259,89 @@ def _fleet_delta_report(before: Optional[Dict], after: Optional[Dict],
     return out
 
 
+def router_summary(host: str, port: int,
+                   timeout_s: float = 10.0) -> Optional[Dict]:
+    """Router fold of a fleet-router target (`GET /3/Router?probe=0`,
+    stdlib-only): shed/failover/rollback counters, ring liveness, and the
+    per-model live/canary/shadow version pointers. None when the target
+    has no router surface — the report omits the section, same stance as
+    fleet_summary."""
+    url = f"http://{host}:{port}/3/Router?probe=0"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            doc = json.loads(r.read().decode())
+    except Exception:
+        return None
+    if "ring" not in doc:
+        return None
+    totals = doc.get("totals") or {}
+    return dict(
+        totals=totals,
+        replicas=len(doc.get("ring") or []),
+        replicas_up=sum(1 for p in doc.get("ring") or [] if p.get("up")),
+        drained=sum(1 for p in doc.get("ring") or [] if p.get("drained")),
+        versions={m: dict(live=e.get("live"), canary=e.get("canary"),
+                          canary_pct=e.get("canary_pct"),
+                          shadow=e.get("shadow"))
+                  for m, e in (doc.get("models") or {}).items()},
+        canary_health=doc.get("canary_health") or {},
+    )
+
+
+def _router_lane_p99(host: str, port: int,
+                     timeout_s: float = 10.0) -> Optional[Dict]:
+    """Per-lane (live/canary/unversioned) p99 from the router's
+    `h2o3_router_request_ms` histogram, via the JSON registry export —
+    the per-version latency split of the run (stdlib bucket
+    interpolation over the same shared bounds)."""
+    url = f"http://{host}:{port}/3/Metrics?format=json"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            doc = json.loads(r.read().decode())
+    except Exception:
+        return None
+    fam = doc.get("h2o3_router_request_ms")   # export_state is the
+    if not fam:                               # family map itself
+        return None
+    out = {}
+    for s in fam.get("series") or ():
+        labels = s.get("labels") or []
+        lane = labels[0] if labels else "all"
+        h = _BucketHist(fam.get("bounds") or LATENCY_MS_BOUNDS)
+        h.counts = list(s.get("counts") or h.counts)
+        h.n = int(s.get("n") or 0)
+        h.vmin, h.vmax = s.get("min"), s.get("max")
+        out[lane] = dict(n=h.n, p99_ms=h.percentile(0.99))
+    return out or None
+
+
+def _router_delta_report(before: Optional[Dict], after: Optional[Dict],
+                         wall_s: float, offered: int = 0,
+                         lane_p99: Optional[Dict] = None) -> Optional[Dict]:
+    """The loadgen summary's router section: the AFTER snapshot plus
+    counter deltas over this run — shed rate vs offered load, failover/
+    retry/drain counts, and rollback EVENTS (a rollback delta > 0 means a
+    canary was auto-aborted mid-run). `lane_p99` carries the per-version
+    latency split when the registry export is reachable."""
+    if after is None:
+        return None
+    out = dict(after)
+    bt = (before or {}).get("totals") or {}
+    at = after.get("totals") or {}
+    deltas = {}
+    for fld in ("shed", "errors", "retries", "failovers", "drains",
+                "rollbacks"):
+        if at.get(fld) is not None:
+            deltas[fld] = max(at[fld] - (bt.get(fld) or 0), 0)
+    out["deltas"] = deltas
+    if offered > 0 and "shed" in deltas:
+        out["shed_rate"] = round(deltas["shed"] / offered, 4)
+    out["rollback_events"] = deltas.get("rollbacks", 0)
+    if lane_p99:
+        out["lane_p99_ms"] = lane_p99
+    return out
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     if not sorted_vals:
         return float("nan")
@@ -264,8 +349,12 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[i]
 
 
-def _predict_url(host: str, port: int, model: str, frame: str) -> str:
-    return (f"http://{host}:{port}/3/Predictions/models/"
+def _predict_url(host: str, port: int, model: str, frame: str,
+                 router: bool = False) -> str:
+    # router mode drives the fleet entry point (version split + failover)
+    # instead of one replica's /3/Predictions
+    base = "/3/Router/models/" if router else "/3/Predictions/models/"
+    return (f"http://{host}:{port}{base}"
             f"{urllib.parse.quote(model)}/frames/"
             f"{urllib.parse.quote(frame)}")
 
@@ -273,12 +362,14 @@ def _predict_url(host: str, port: int, model: str, frame: str) -> str:
 def run_load(host: str, port: int, model: str, frame: str,
              threads: int = 8, requests: int = 50,
              duration_s: Optional[float] = None,
-             timeout_s: float = 60.0) -> Dict:
+             timeout_s: float = 60.0, router: bool = False) -> Dict:
     """Drive the predict route closed-loop; returns the stats dict.
 
     `duration_s` caps wall-clock instead of request count when set (each
-    thread stops issuing new requests once the deadline passes)."""
-    url = _predict_url(host, port, model, frame)
+    thread stops issuing new requests once the deadline passes).
+    `router=True` drives the fleet router entry point instead of a
+    replica's predict route."""
+    url = _predict_url(host, port, model, frame, router=router)
     lock = threading.Lock()
     lat_s: List[float] = []
     shed = [0]
@@ -329,7 +420,8 @@ def run_load(host: str, port: int, model: str, frame: str,
 
 def run_load_open(host: str, port: int, model: str, frame: str,
                   rate: float = 20.0, duration_s: float = 10.0,
-                  timeout_s: float = 60.0, max_inflight: int = 256) -> Dict:
+                  timeout_s: float = 60.0, max_inflight: int = 256,
+                  router: bool = False) -> Dict:
     """Drive the predict route open-loop at a fixed arrival rate.
 
     One dispatcher thread fires a request thread at each scheduled arrival
@@ -354,7 +446,7 @@ def run_load_open(host: str, port: int, model: str, frame: str,
     stdlib-only; the ledger column stays None in the standalone CLI."""
     if rate <= 0:
         raise ValueError(f"open-loop rate must be > 0 req/s (got {rate})")
-    url = _predict_url(host, port, model, frame)
+    url = _predict_url(host, port, model, frame, router=router)
     n_arrivals = max(int(rate * duration_s), 1)
     lock = threading.Lock()
     # per-run local histogram over the SAME shared bounds: the report must
@@ -472,24 +564,41 @@ def main() -> int:
                     help="target is a fleet aggregator: report fleet-"
                          "scope throughput/p99 and per-replica error "
                          "counts from GET /3/Fleet in the summary")
+    ap.add_argument("--router", action="store_true",
+                    help="drive the fleet router entry point "
+                         "(/3/Router/models/..) instead of a replica's "
+                         "/3/Predictions, and report shed rate, per-"
+                         "version p99 split and rollback events from "
+                         "GET /3/Router in the summary")
     args = ap.parse_args()
     if args.rate is not None and args.rate <= 0:
         ap.error("--rate must be > 0 (requests per second)")
     fleet_before = (fleet_summary(args.host, args.port)
                     if args.fleet else None)
+    router_before = (router_summary(args.host, args.port)
+                     if args.router else None)
     if args.rate is not None:
         stats = run_load_open(args.host, args.port, args.model, args.frame,
                               rate=args.rate,
                               duration_s=args.duration_s or 10.0,
-                              max_inflight=args.max_inflight)
+                              max_inflight=args.max_inflight,
+                              router=args.router)
     else:
         stats = run_load(args.host, args.port, args.model, args.frame,
                          threads=args.threads, requests=args.requests,
-                         duration_s=args.duration_s)
+                         duration_s=args.duration_s, router=args.router)
     if args.fleet:
         stats["fleet"] = _fleet_delta_report(
             fleet_before, fleet_summary(args.host, args.port),
             stats.get("wall_s") or 0.0)
+    if args.router:
+        offered = stats.get("offered") or (
+            stats.get("completed", 0) + stats.get("shed_429", 0)
+            + stats.get("errors", 0))
+        stats["router"] = _router_delta_report(
+            router_before, router_summary(args.host, args.port),
+            stats.get("wall_s") or 0.0, offered=offered,
+            lane_p99=_router_lane_p99(args.host, args.port))
     print(json.dumps(stats, indent=2))
     return 0 if stats["completed"] else 1
 

@@ -63,6 +63,7 @@ MUNGE_SCHEMA_NAME = "MungeMetricsV3"
 TRAINING_SCHEMA_NAME = "TrainingMetricsV3"
 OBSERVABILITY_SCHEMA_NAME = "ObservabilityV3"
 MEMORY_SCHEMA_NAME = "MemoryV3"
+ROUTER_SCHEMA_NAME = "RouterV3"
 
 # the per-subsystem JSON metrics endpoints whose counter fields must be
 # backed by central-registry metrics (metrics_registry.bind_rest_field);
@@ -74,6 +75,7 @@ METRICS_ENDPOINTS = {
     "training": "/3/Training/metrics",
     "memory": "/3/Memory",
     "fleet": "/3/Fleet?probe=0",
+    "router": "/3/Router?probe=0",
 }
 
 
@@ -164,6 +166,44 @@ def memory_schema() -> Dict:
     ]
     return dict(
         name=MEMORY_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )
+
+
+def router_schema() -> Dict:
+    """Field metadata of the `GET /3/Router` document (the serving fleet
+    router's observability schema — docs/serving.md "Fleet serving"
+    mirrors this)."""
+    fields = [
+        ("ring", "list<ReplicaState>",
+         "the dispatch ring: per-replica name/url, up (from the fleet"
+         " scrape, the h2o3_fleet_peer_up source), drained flag,"
+         " router-local inflight count, consecutive_errors, scraped"
+         " memory pressure and predict p99 — the least-loaded ordering"
+         " ranks on (up, drained, inflight, pressure, p99)"),
+        ("inflight", "int",
+         "requests currently inside the router's fleet-wide token budget"
+         " (sheds with 429 at H2O3_ROUTER_MAX_INFLIGHT)"),
+        ("totals", "RouterTotals",
+         "cumulative router counters: requests/errors (per-lane in the"
+         " registry), shed (budget/pressure/no_replicas), retries,"
+         " failovers, drains, rollbacks, warm_loads, shadow_* — every"
+         " field is bind_rest_field-backed by an h2o3_router_* family"),
+        ("models", "map<model, VersionTable>",
+         "the registry fold: per-model live/canary/shadow pointers,"
+         " canary_pct, and every version's state (published → warm →"
+         " canary → live → retired/failed), artifact path and per-replica"
+         " warm-load reports"),
+        ("canary_health", "map<model, CanaryWindow>",
+         "while a canary runs: per-lane (live vs canary) request/error"
+         " counts and bucket p99 since the canary started — the inputs of"
+         " the auto-rollback verdict"),
+        ("config", "RouterConfig",
+         "the H2O3_ROUTER_* knobs in effect (admission budget, drain"
+         " thresholds, canary ratios, shadow compare depth)"),
+    ]
+    return dict(
+        name=ROUTER_SCHEMA_NAME,
         fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
     )
 

@@ -209,6 +209,11 @@ class _Handler(BaseHTTPRequestHandler):
         ("POST", r"^/3/Word2VecTransform$", "w2v_transform"),
         ("GET", r"^/3/Metadata/endpoints$", "metadata_endpoints"),
         ("POST", r"^/3/UnlockKeys$", "unlock_keys"),
+        ("GET", r"^/3/Router$", "router_get"),
+        ("POST", r"^/3/Router$", "router_post"),
+        ("POST", r"^/3/Router/models/([^/]+)/frames/([^/]+)$",
+         "router_predict"),
+        ("POST", r"^/3/Serving/warm$", "serving_warm"),
     ]
 
     def log_message(self, fmt, *args):  # route access logs into our Log
@@ -908,7 +913,8 @@ class _Handler(BaseHTTPRequestHandler):
     def h_faults_set(self):
         """`POST /3/Faults` — arm one fault point (the REST face of
         `faults.arm`): params point (required), error (io/conn/device/
-        crash/none), rate, count, latency_ms, seed. Chaos drills against a
+        crash/none), rate, count, latency_ms, seed, lane, match (substring
+        of the check detail — version-targeted faults). Chaos drills against a
         live serving cluster use this instead of a restart with
         H2O3_FAULT_* env vars."""
         from ..runtime import faults
@@ -926,7 +932,8 @@ class _Handler(BaseHTTPRequestHandler):
             latency_ms=float(p.get("latency_ms", 0.0) or 0.0),
             seed=int(p.get("seed", 0) or 0),
             lane=int(p["lane"]) if p.get("lane") not in (None, "")
-            else None)
+            else None,
+            match=str(p["match"]) if p.get("match") else None)
         self._send(out)
 
     def h_faults_delete(self):
@@ -1169,6 +1176,132 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(dict(removed=bool(fleet.remove_peer(str(name))),
                         name=name))
 
+    # -- serving fleet router (serving/router.py — docs/serving.md) ---------
+    def h_router_get(self):
+        """`GET /3/Router[?probe=1]` — the RouterV3 document: replica ring
+        (liveness/drain/inflight/pressure/p99), per-model versions +
+        live/canary/shadow pointers + split, canary health windows, shed/
+        failover/rollback counters, config. `probe=1` forces a fleet
+        scrape first; the default reads cached ring state (the
+        metrics-consistency walk hits `?probe=0` — no HTTP fan-out)."""
+        from ..serving import get_router
+
+        p = self._params()
+        if self._flag(p, "schema"):
+            self._send(schemas.router_schema())
+            return
+        probe = self._flag(p, "probe")
+        self._send(dict(__meta=dict(schema_type=schemas.ROUTER_SCHEMA_NAME),
+                        **get_router().snapshot(probe=probe)))
+
+    def h_router_post(self):
+        """`POST /3/Router` — rollout control, one `action` per call:
+
+        * ``publish`` (model, version[, path]) — export the DKV model (or
+          copy the mojo at `path`) into the registry, atomically;
+        * ``warm`` (model, version[, frame]) — fan the artifact out to
+          every replica's scorer cache before any traffic flips;
+        * ``canary`` (model, version[, pct]) — split pct% of traffic;
+        * ``promote`` (model, version) — atomic hot-swap to live;
+        * ``rollback`` (model[, reason]) — abort the canary (no-op with
+          no canary, still timeline-logged);
+        * ``shadow`` (model[, version]) — mirror-only scoring (empty
+          version stops shadowing);
+        * ``retire`` (model, version)."""
+        from ..serving import get_router
+
+        p = self._params()
+        action = str(p.get("action") or "")
+        model = str(p.get("model") or "")
+        version = str(p.get("version") or "")
+        if not action or not model:
+            raise ValueError("action and model are required")
+        router = get_router()
+        reg = router.registry
+        if action == "publish":
+            path = p.get("path") or None
+            out = reg.publish(model, version,
+                              model=None if path else DKV.get(model),
+                              source_path=path)
+        elif action == "warm":
+            out = router.warm(model, version, frame=p.get("frame") or None)
+        elif action == "canary":
+            pct = float(p.get("pct", router.config.canary_pct) or 0.0)
+            out = reg.set_canary(model, version, pct)
+        elif action == "promote":
+            out = reg.promote(model, version)
+        elif action == "rollback":
+            out = reg.rollback(model, reason=str(p.get("reason") or ""))
+        elif action == "shadow":
+            out = reg.set_shadow(model, version or None)
+        elif action == "retire":
+            out = reg.retire(model, version)
+        else:
+            raise ValueError(f"unknown action {action!r} (publish/warm/"
+                             "canary/promote/rollback/shadow/retire)")
+        self._send(dict(action=action, **out))
+
+    def h_router_predict(self, model_key, frame_key):
+        """`POST /3/Router/models/{m}/frames/{f}` — the fleet scoring
+        entry point: version split + least-loaded dispatch + failover.
+        Mirrors the chosen replica's /3/Predictions response; sheds with
+        429 + Retry-After; replica 4xx/exhausted-5xx pass through with
+        their original status."""
+        import urllib.error
+
+        from ..serving import RejectedError, get_router
+
+        p = self._params()
+        try:
+            doc = get_router().route(model_key, frame_key, params=p,
+                                     trace_id=getattr(self, "_trace_id",
+                                                      None))
+        except RejectedError as e:
+            retry = str(max(1, int(-(-e.retry_after_s // 1))))
+            self._send(dict(__meta=dict(schema_type="H2OError"),
+                            msg=str(e), http_status=429), 429,
+                       headers={"Retry-After": retry})
+            return
+        except urllib.error.HTTPError as e:
+            # mirror the replica's verdict (its body was already drained)
+            self._send(dict(__meta=dict(schema_type="H2OError"),
+                            msg=f"replica error: {e}",
+                            http_status=e.code), e.code)
+            return
+        self._send(doc)
+
+    def h_serving_warm(self):
+        """`POST /3/Serving/warm` — the replica side of the router's warm
+        fan-out: load the mojo artifact at `path` into the DKV under
+        `model` (the versioned key) and, when `frame` names a DKV frame,
+        prime the compiled-scorer cache by scoring it through the engine.
+        Returns the XLA trace delta of the priming score — the registry
+        records it per replica and the warm-load pin asserts the LIVE
+        first predict traces nothing new."""
+        from ..mojo import load_model
+        from ..runtime import phases
+        from ..serving import get_engine
+
+        p = self._params()
+        path, model_key = p.get("path"), p.get("model")
+        if not path or not model_key:
+            raise ValueError("path and model are required")
+        scorer = load_model(str(path))
+        DKV.put(str(model_key), scorer)
+        out = dict(model=str(model_key), loaded=True, primed=False)
+        frame_key = p.get("frame")
+        fr = DKV.get(str(frame_key)) if frame_key else None
+        if isinstance(fr, Frame):
+            before = phases.xla_counts()
+            pred = get_engine().score(str(model_key), scorer, fr)
+            after = phases.xla_counts()
+            pred.key = f"warm_{model_key}_{frame_key}"
+            DKV.put(pred.key, pred)
+            out.update(primed=True, frame=str(frame_key),
+                       traces=after.get("traces", 0)
+                       - before.get("traces", 0))
+        self._send(out)
+
     def h_profiler(self):
         from ..runtime import profiler
 
@@ -1186,12 +1319,14 @@ class _Handler(BaseHTTPRequestHandler):
                         tracing=profiler.tracing_stats(),
                         memory=profiler.memory_stats(),
                         fleet=profiler.fleet_stats(),
+                        router=profiler.router_stats(),
                         metrics=profiler.registry_stats()))
 
     def h_metadata_schemas(self):
         self._send(dict(schemas=schemas.all_schemas()
                         + [schemas.observability_schema(),
-                           schemas.memory_schema()]))
+                           schemas.memory_schema(),
+                           schemas.router_schema()]))
 
     # -- uploads (PostFileHandler) ------------------------------------------
     def h_post_file(self):

@@ -37,7 +37,11 @@ retry-then-succeed shape tests pin); ``rate=p`` draws from a dedicated
 same fire sequence. Fault points are DEFAULT-OFF; `reset()` disarms all.
 
 ``latency_ms`` injects sleep without (or in addition to) an error — the
-injected-latency fault of the issue spec.
+injected-latency fault of the issue spec. ``match=substr`` scopes a point
+to checks whose detail string contains the substring — e.g.
+``arm("serving.scorer", error="crash", match="m@v2")`` fails exactly one
+model VERSION's traffic (how the canary-rollback pin poisons the
+candidate while live traffic keeps flowing).
 """
 
 from __future__ import annotations
@@ -83,11 +87,11 @@ ERROR_KINDS = {
 
 class _Point:
     __slots__ = ("name", "kind", "rate", "count", "latency_ms", "seed",
-                 "lane", "checks", "fires", "_rng")
+                 "lane", "match", "checks", "fires", "_rng")
 
     def __init__(self, name: str, kind: str, rate: float,
                  count: Optional[int], latency_ms: float, seed: int,
-                 lane: Optional[int] = None):
+                 lane: Optional[int] = None, match: Optional[str] = None):
         if kind not in ERROR_KINDS:
             raise ValueError(f"unknown fault error kind {kind!r} "
                              f"(one of {sorted(ERROR_KINDS)})")
@@ -100,6 +104,10 @@ class _Point:
         # lane-scoped points (mesh.lane_delay): only checks carrying this
         # lane index fire — the deterministic per-lane straggler injection
         self.lane = None if lane in (None, "") else int(lane)
+        # detail-scoped points: only checks whose detail string contains
+        # `match` fire — e.g. arm("serving.scorer", match="m@v2") fails
+        # exactly one model version's traffic (the canary-rollback pin)
+        self.match = match or None
         self.checks = 0
         self.fires = 0
         self._rng = None    # built lazily; numpy import stays off hot path
@@ -122,8 +130,8 @@ class _Point:
     def describe(self) -> Dict:
         return dict(point=self.name, error=self.kind, rate=self.rate,
                     count=self.count, latency_ms=self.latency_ms,
-                    seed=self.seed, lane=self.lane, checks=self.checks,
-                    fires=self.fires)
+                    seed=self.seed, lane=self.lane, match=self.match,
+                    checks=self.checks, fires=self.fires)
 
 
 _LOCK = threading.Lock()
@@ -152,17 +160,22 @@ def _env_parse() -> None:
                 count=int(kw["count"]) if kw.get("count") else None,
                 latency_ms=float(kw.get("latency_ms", 0.0)),
                 seed=int(kw.get("seed", 0)),
-                lane=int(kw["lane"]) if kw.get("lane") else None)
+                lane=int(kw["lane"]) if kw.get("lane") else None,
+                match=kw.get("match") or None)
         except (ValueError, TypeError) as e:
             raise ValueError(f"bad {k}={v!r}: {e}") from None
 
 
 def arm(point: str, error: str = "io", rate: float = 1.0,
         count: Optional[int] = None, latency_ms: float = 0.0,
-        seed: int = 0, lane: Optional[int] = None) -> Dict:
-    """Arm one fault point; returns its description."""
+        seed: int = 0, lane: Optional[int] = None,
+        match: Optional[str] = None) -> Dict:
+    """Arm one fault point; returns its description. `match` scopes the
+    point to checks whose detail contains the substring (version-targeted
+    canary faults)."""
     global _ACTIVE
-    p = _Point(point, error, rate, count, latency_ms, seed, lane=lane)
+    p = _Point(point, error, rate, count, latency_ms, seed, lane=lane,
+               match=match)
     with _LOCK:
         _POINTS[point] = p
         _ACTIVE = True
@@ -203,6 +216,8 @@ def check(point: str, detail: str = "", lane: Optional[int] = None) -> None:
         if p is None:
             return
         if p.lane is not None and (lane is None or int(lane) != p.lane):
+            return
+        if p.match is not None and p.match not in (detail or ""):
             return
         p.checks += 1
         fire = p.should_fire()

@@ -167,6 +167,21 @@ def fleet_stats() -> Dict:
     return out
 
 
+def router_stats() -> Dict:
+    """Serving-fleet-router fold (ISSUE 16): ring + version table + shed/
+    rollback counters. Peeks — a profiler read must never instantiate a
+    routing layer (or fan out to replicas) just to report there isn't
+    one; `probe=False` keeps it scrape-free like fleet_stats."""
+    from ..serving.router import peek_router
+
+    r = peek_router()
+    if r is None:
+        return dict(active=False)
+    out = r.snapshot(probe=False)
+    out["active"] = bool(out["ring"]) or bool(out["models"])
+    return out
+
+
 def registry_stats() -> Dict:
     """The central metrics registry's JSON view (counters/gauges/histogram
     summaries + windowed rates) — the /3/Profiler fold of the same store

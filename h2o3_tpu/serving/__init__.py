@@ -17,6 +17,12 @@ Four cooperating pieces behind one `ScoringEngine` facade:
 The REST `/3/Predictions` route scores through `get_engine().score(...)`;
 direct in-process `model.predict()` stays untouched for training
 workflows (docs/serving.md has the architecture + knob matrix).
+
+Above single-replica serving sits the serving FLEET (`registry.py` +
+`router.py`): a versioned model registry with atomic publish/hot-swap and
+a pressure-aware router fronting N replicas — canary/shadow rollout,
+fleet-wide admission, cross-replica failover (`GET/POST /3/Router`;
+docs/serving.md "Fleet serving").
 """
 
 from __future__ import annotations
@@ -29,6 +35,10 @@ from .batcher import MicroBatcher
 from .config import ServingConfig
 from .metrics import ServingMetrics
 from .model_cache import ScorerCache
+from .registry import (ModelRegistry, get_registry,  # noqa: F401
+                       peek_registry, reset_registry, versioned_key)
+from .router import (Router, RouterConfig, get_router,  # noqa: F401
+                     peek_router, reset_router)
 
 
 class ScoringEngine:

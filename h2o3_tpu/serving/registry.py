@@ -1,0 +1,340 @@
+"""Versioned model registry — atomic publish / warm / canary / live.
+
+The artifact half of the serving fleet (docs/serving.md "Fleet serving"):
+`publish(model, version)` exports the model as a mojo zip THROUGH the
+persist layer (so persist fault points and retries cover the write) into
+the registry directory, then atomically renames it into place — a publish
+that dies mid-write leaves only a ``.part`` file that no replica will
+ever load, and `live()` never names a half-published artifact.
+
+Version lifecycle (every transition is a Timeline event)::
+
+    publish → published → (warm) → canary → live → retired
+                  └──────────────────────────┘ rollback / retire
+
+State is router-process-local (the router owns rollout policy); the
+ARTIFACTS live in a shared directory (``H2O3_REGISTRY_DIR``) replicas
+warm-load from via ``POST /3/Serving/warm``. Atomicity contract, pinned
+by tests:
+
+* a publish whose artifact write fails is never visible to `live()`;
+* double-publish of the same (model, version) is idempotent — the first
+  artifact wins, the record is returned unchanged;
+* `promote` flips the live pointer under the registry lock — a routing
+  decision sees the old version or the new one, never a mix;
+* `rollback` with no canary is a no-op that still logs a timeline event
+  (an operator's "roll back now" must leave an audit trail even when
+  there was nothing to do).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..runtime.timeline import Timeline
+
+__all__ = ["ModelRegistry", "get_registry", "peek_registry",
+           "reset_registry", "versioned_key"]
+
+# version states, in lifecycle order
+STATES = ("published", "warm", "canary", "live", "retired", "failed")
+
+
+def versioned_key(model: str, version: str) -> str:
+    """The DKV key a warm-loaded artifact serves under — the model key the
+    router rewrites requests to (`m@v2`), and the detail string
+    `serving.scorer` fault checks carry (so a `match=`-scoped fault can
+    target exactly one version's traffic)."""
+    return f"{model}@{version}"
+
+
+class _Version:
+    __slots__ = ("model", "version", "state", "artifact", "published_ts",
+                 "warmed", "events")
+
+    def __init__(self, model: str, version: str, artifact: str):
+        self.model = model
+        self.version = version
+        self.state = "published"
+        self.artifact = artifact
+        self.published_ts = time.time()
+        self.warmed: Dict[str, Dict] = {}    # replica -> warm-load report
+        self.events: List[str] = ["published"]
+
+    def describe(self) -> Dict:
+        return dict(model=self.model, version=self.version, state=self.state,
+                    artifact=self.artifact, published_ts=self.published_ts,
+                    key=versioned_key(self.model, self.version),
+                    warmed=dict(self.warmed), events=list(self.events))
+
+
+class ModelRegistry:
+    """Per-model version table + live/canary/shadow pointers."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get("H2O3_REGISTRY_DIR") \
+            or os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                            "h2o3_registry")
+        self._lock = threading.Lock()
+        # model -> {versions: {v: _Version}, live, canary, canary_pct,
+        #           shadow}
+        self._models: Dict[str, Dict] = {}
+
+    # -- internals -----------------------------------------------------------
+    def _entry(self, model: str) -> Dict:
+        # callers hold self._lock
+        e = self._models.get(model)
+        if e is None:
+            e = self._models[model] = dict(versions={}, live=None,
+                                           canary=None, canary_pct=0.0,
+                                           shadow=None)
+        return e
+
+    def _event(self, kind: str, model: str, version: Optional[str],
+               **extra) -> None:
+        detail = versioned_key(model, version) if version else model
+        Timeline.record("registry", f"{kind} {detail}", **extra)
+        from ..runtime import tracing
+
+        tracing.event(f"registry_{kind}", model=model,
+                      **(dict(version=version) if version else {}), **extra)
+
+    # -- publish (the atomic write) ------------------------------------------
+    def publish(self, model_key: str, version: str, model=None,
+                source_path: Optional[str] = None) -> Dict:
+        """Export `model` (or copy an already-exported mojo at
+        `source_path`) into the registry as (model_key, version).
+
+        The artifact is written through the persist layer to a ``.part``
+        name and `os.replace`d into place — the version record registers
+        only after the rename, so a mid-write failure (persist fault,
+        full disk, killed process) leaves `live()`/`versions()` exactly
+        as they were. Idempotent: re-publishing an existing (model,
+        version) returns the existing record untouched."""
+        with self._lock:
+            existing = self._entry(model_key)["versions"].get(version)
+        if existing is not None:
+            self._event("publish_noop", model_key, version,
+                        reason="already published")
+            return existing.describe()
+        os.makedirs(self.root, exist_ok=True)
+        final = os.path.join(self.root,
+                             f"{model_key}@{version}.zip")
+        part = final + ".part"
+        blob = self._export_blob(model_key, model, source_path)
+        try:
+            from ..runtime import persist
+
+            # write through the persist backend: the registry inherits the
+            # retry policy AND the persist.open fault point (the atomicity
+            # test arms it to kill a publish mid-write)
+            with persist.for_uri(part).open(part, "wb") as f:
+                f.write(blob)
+            os.replace(part, final)        # the atomic flip
+        except BaseException:
+            # a failed publish must leave no half-artifact a replica could
+            # ever list or load
+            try:
+                os.remove(part)
+            except OSError:
+                pass
+            self._event("publish_failed", model_key, version)
+            raise
+        rec = _Version(model_key, version, final)
+        with self._lock:
+            e = self._entry(model_key)
+            if version in e["versions"]:       # lost a publish race
+                return e["versions"][version].describe()
+            e["versions"][version] = rec
+        self._event("publish", model_key, version, artifact=final)
+        return rec.describe()
+
+    @staticmethod
+    def _export_blob(model_key: str, model, source_path: Optional[str]):
+        import tempfile
+
+        if source_path is not None:
+            with open(source_path, "rb") as f:
+                return f.read()
+        if model is None:
+            raise ValueError(
+                f"publish of {model_key!r} needs a model object or a "
+                "source_path to an exported mojo")
+        from .. import mojo
+
+        with tempfile.TemporaryDirectory(prefix="h2o3_pub_") as td:
+            out = mojo.save_model(model, path=td,
+                                  filename=f"{model_key}.h2o3")
+            with open(out, "rb") as f:
+                return f.read()
+
+    # -- lifecycle transitions ----------------------------------------------
+    def record_warm(self, model: str, version: str, replica: str,
+                    report: Optional[Dict] = None) -> Dict:
+        """One replica finished warm-loading (artifact in its DKV, scorer
+        cache primed). The version moves to `warm` on its first report."""
+        with self._lock:
+            rec = self._require(model, version)
+            rec.warmed[replica] = dict(report or {}, ts=time.time())
+            if rec.state == "published":
+                rec.state = "warm"
+                rec.events.append("warm")
+        self._event("warm", model, version, replica=replica)
+        return rec.describe()
+
+    def set_canary(self, model: str, version: str, pct: float) -> Dict:
+        """Start (or re-weight) a canary: `pct` % of `model` traffic goes
+        to `version`; the rest stays on live."""
+        pct = min(max(float(pct), 0.0), 100.0)
+        with self._lock:
+            rec = self._require(model, version)
+            e = self._entry(model)
+            if e["live"] == version:
+                raise ValueError(
+                    f"{versioned_key(model, version)} is already live")
+            e["canary"] = version
+            e["canary_pct"] = pct
+            if rec.state in ("published", "warm"):
+                rec.state = "canary"
+                rec.events.append("canary")
+        self._event("canary", model, version, pct=pct)
+        return rec.describe()
+
+    def promote(self, model: str, version: str) -> Dict:
+        """Atomic hot-swap: flip the live pointer to `version` under the
+        registry lock. The previous live version retires."""
+        with self._lock:
+            rec = self._require(model, version)
+            e = self._entry(model)
+            prev = e["live"]
+            e["live"] = version
+            if e["canary"] == version:
+                e["canary"], e["canary_pct"] = None, 0.0
+            rec.state = "live"
+            rec.events.append("live")
+            if prev and prev in e["versions"] and prev != version:
+                e["versions"][prev].state = "retired"
+                e["versions"][prev].events.append("retired")
+        self._event("promote", model, version, previous=prev)
+        return rec.describe()
+
+    def rollback(self, model: str, reason: str = "") -> Dict:
+        """Abort the canary (auto-rollback's hook, and the operator's).
+        With no canary running this is a NO-OP that still records a
+        timeline event — audit trails must cover the nothing-to-do case."""
+        with self._lock:
+            e = self._entry(model)
+            version = e["canary"]
+            if version is not None:
+                rec = e["versions"].get(version)
+                e["canary"], e["canary_pct"] = None, 0.0
+                if rec is not None:
+                    rec.state = "failed"
+                    rec.events.append("rollback")
+        self._event("rollback", model, version,
+                    **(dict(reason=reason) if reason else {}),
+                    noop=version is None)
+        return dict(model=model, rolled_back=version,
+                    noop=version is None, reason=reason or None)
+
+    def set_shadow(self, model: str, version: Optional[str]) -> Dict:
+        """Mirror `model` traffic to `version` (compare-only; None
+        stops shadowing)."""
+        with self._lock:
+            if version is not None:
+                self._require(model, version)
+            self._entry(model)["shadow"] = version
+        self._event("shadow", model, version or "-")
+        return dict(model=model, shadow=version)
+
+    def retire(self, model: str, version: str) -> Dict:
+        with self._lock:
+            rec = self._require(model, version)
+            e = self._entry(model)
+            if e["live"] == version:
+                raise ValueError(
+                    f"cannot retire the live version "
+                    f"{versioned_key(model, version)}; promote a "
+                    "replacement first")
+            if e["canary"] == version:
+                e["canary"], e["canary_pct"] = None, 0.0
+            if e["shadow"] == version:
+                e["shadow"] = None
+            rec.state = "retired"
+            rec.events.append("retired")
+        self._event("retire", model, version)
+        return rec.describe()
+
+    def _require(self, model: str, version: str) -> _Version:
+        # callers hold self._lock
+        rec = self._entry(model)["versions"].get(version)
+        if rec is None:
+            raise KeyError(versioned_key(model, version))
+        return rec
+
+    # -- read side -----------------------------------------------------------
+    def live(self, model: str) -> Optional[str]:
+        with self._lock:
+            return self._models.get(model, {}).get("live")
+
+    def canary(self, model: str):
+        """(version, pct) of the running canary, or (None, 0.0)."""
+        with self._lock:
+            e = self._models.get(model) or {}
+            return e.get("canary"), float(e.get("canary_pct") or 0.0)
+
+    def shadow(self, model: str) -> Optional[str]:
+        with self._lock:
+            return self._models.get(model, {}).get("shadow")
+
+    def artifact(self, model: str, version: str) -> str:
+        with self._lock:
+            return self._require(model, version).artifact
+
+    def versions(self, model: str) -> List[Dict]:
+        with self._lock:
+            e = self._models.get(model) or {}
+            return [r.describe() for r in (e.get("versions") or {}).values()]
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return dict(
+                root=self.root,
+                models={m: dict(live=e["live"], canary=e["canary"],
+                                canary_pct=e["canary_pct"],
+                                shadow=e["shadow"],
+                                versions=[r.describe()
+                                          for r in e["versions"].values()])
+                        for m, e in self._models.items()})
+
+
+_registry: Optional[ModelRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> ModelRegistry:
+    """The process-wide registry (lazily built from env config)."""
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = ModelRegistry()
+        return _registry
+
+
+def peek_registry() -> Optional[ModelRegistry]:
+    return _registry
+
+
+def reset_registry(root: Optional[str] = None) -> ModelRegistry:
+    """Swap in a fresh registry (tests / config reload)."""
+    global _registry
+    with _registry_lock:
+        _registry = ModelRegistry(root)
+        return _registry

@@ -403,8 +403,10 @@ def _register_all_subsystems():
     from h2o3_tpu.runtime import faults, fleet, memory_ledger, retry, \
         trainpool
     from h2o3_tpu.serving import metrics as serving_metrics
+    from h2o3_tpu.serving import router
 
     serving_metrics._registry()
+    router._router_registry()  # router families + /3/Router bindings
     ingest_stats._registry()
     munge_stats._registry()
     trainpool._registry()
