@@ -106,7 +106,10 @@ def bench_gbm():
              "n_devices": _note_devices(),
              "collective_skew_ms": _skew_embed(lane_seq0),
              "hist_updates_per_s": round(updates / comp),
-             "hist_stream_gbps": round(updates / comp / 1e9, 3)})
+             "hist_stream_gbps": round(updates / comp / 1e9, 3),
+             # present when the fit auto-streamed (device or host budget
+             # exceeded): block/spill counters beside the memory embeds
+             "stream": getattr(gbm.model, "_stream_stats", None) or None})
 
 
 def bench_gbm_cpu():
@@ -353,15 +356,13 @@ def bench_oversubscription():
                                  "H2O3_STREAM_BUDGET_MB": budget})
     st = getattr(m_stream.model, "_stream_stats", {}) or {}
     # in-core comparator shares the streamed fit's block grid so the two
-    # walls bracket the same bit-identical computation
-    blocks = str(st.get("blocks", 8))
-    # warm thread off for the comparator: streamed fits already skip it,
-    # and on 1-core hosts it can futex-hang the in-core pure_callback
-    # host kernel at >= 32768 padded rows (docs/perf.md) — this lane must
-    # never wedge on the comparator rep
+    # walls bracket the same bit-identical computation. Warm thread stays
+    # ON (round 19): the old H2O3_WARM_THREAD=0 here worked around the
+    # 1-core in-graph callback deadlock, which `host_callback_safe` now
+    # closes at method selection — single-core hosts keep the segment
+    # kernel, so the comparator rep can no longer wedge
     wall_incore, _ = run({"H2O3_TREE_OOC": "0", "H2O3_TREE_SHARD": "1",
-                          "H2O3_TREE_SHARD_BLOCKS": blocks,
-                          "H2O3_WARM_THREAD": "0"})
+                          "H2O3_TREE_SHARD_BLOCKS": blocks})
     wall_goss, m_goss = run({"H2O3_TREE_OOC": "1",
                              "H2O3_STREAM_BUDGET_MB": budget}, goss=True)
     gs = getattr(m_goss.model, "_stream_stats", {}) or {}
@@ -377,6 +378,95 @@ def bench_oversubscription():
              "goss_streamed_bytes": gs.get("streamed_bytes"),
              "resident_block_peak": st.get("resident_block_peak"),
              "stream": st or None})
+
+
+def bench_disk_oversubscription():
+    """Three-tier disk-spill lane (round 19): a GBM fit whose packed code
+    matrix exceeds BOTH a forced device budget and a forced HOST budget
+    (matrix/10 each), measured four ways in one record — SPILLED (host
+    blocks overflow to disk files and stream back through the resuming
+    reader), HOST-STREAMED (same device budget, disk tier off — the PR 14
+    two-tier shape), the IN-CORE comparator on the same block grid (the
+    bit-identical baseline), and GOSS-ON-DISK (sampling on: later trees
+    gather compact samples and read measurably fewer spill bytes). Forced
+    CPU like the oversubscription lane, so the record stays comparable
+    round over round and never emits a value-0.0 line. Embeds the spill
+    counters (`spilled/restored` blocks+bytes), `disk_bytes`, and the
+    host-resident watermark (asserted ≤ the forced host budget by the
+    tier-1 pins)."""
+    n_rows = int(os.environ.get("BENCH_ROWS", 120_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 12))
+    max_depth = int(os.environ.get("BENCH_DEPTH", 5))
+    n_feat = 16
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.dataset_cache import clear as _cache_clear
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+
+    X, y = make_higgs_like(n_rows, n_feat=n_feat)
+    names = [f"f{i}" for i in range(n_feat)] + ["label"]
+    budget_mb = max(n_rows * n_feat * 5 / 8 / 1e6 / 10, 0.05)
+    keys = ("H2O3_TREE_OOC", "H2O3_STREAM_BUDGET_MB",
+            "H2O3_STREAM_HOST_BUDGET_MB", "H2O3_TREE_OOC_DISK",
+            "H2O3_TREE_SHARD", "H2O3_TREE_SHARD_BLOCKS",
+            "H2O3_STREAM_BLOCKS")
+
+    def run(env, goss=False):
+        _cache_clear()
+        saved = {k: os.environ.pop(k, None) for k in keys}
+        os.environ.update(env)
+        try:
+            fr = Frame.from_numpy(np.column_stack([X, y]),
+                                  names=names).asfactor("label")
+            gbm = H2OGradientBoostingEstimator(
+                ntrees=ntrees, max_depth=max_depth, learn_rate=0.1,
+                histogram_type="UniformAdaptive", seed=42,
+                score_tree_interval=max(ntrees // 4, 1),
+                **(dict(goss=True, goss_start_tree=max(ntrees // 4, 1))
+                   if goss else {}))
+            t0 = time.perf_counter()
+            gbm.train(y="label", training_frame=fr)
+            return time.perf_counter() - t0, gbm
+        finally:
+            for k in keys:
+                os.environ.pop(k, None)
+                if saved.get(k) is not None:
+                    os.environ[k] = saved[k]
+
+    budget = f"{budget_mb:.3f}"
+    spill_env = {"H2O3_TREE_OOC": "1", "H2O3_STREAM_BUDGET_MB": budget,
+                 "H2O3_STREAM_HOST_BUDGET_MB": budget}
+    wall_spill, m_spill = run(spill_env)
+    st = getattr(m_spill.model, "_stream_stats", {}) or {}
+    blocks = str(st.get("blocks", 8))
+    # same device budget, disk tier OFF — isolates the spill tier's cost
+    # from the host↔device streaming it rides on
+    wall_host, m_host = run({"H2O3_TREE_OOC": "1",
+                             "H2O3_STREAM_BUDGET_MB": budget,
+                             "H2O3_TREE_OOC_DISK": "0"})
+    hs = getattr(m_host.model, "_stream_stats", {}) or {}
+    wall_incore, _ = run({"H2O3_TREE_OOC": "0", "H2O3_TREE_SHARD": "1",
+                          "H2O3_TREE_SHARD_BLOCKS": blocks})
+    wall_goss, m_goss = run(spill_env, goss=True)
+    gs = getattr(m_goss.model, "_stream_stats", {}) or {}
+    return (f"disk_oversub_{n_rows//1000}k_{ntrees}trees_wall_s",
+            wall_spill,
+            {"auc": round(float(m_spill.auc()), 5),
+             "n_devices": _note_devices(),
+             "stream_budget_mb": float(budget),
+             "host_budget_mb": float(budget),
+             "host_streamed_wall_s": round(wall_host, 3),
+             "incore_wall_s": round(wall_incore, 3),
+             "goss_wall_s": round(wall_goss, 3),
+             "vs_incore": round(wall_incore / wall_spill, 3),
+             "vs_host_streamed": round(wall_host / wall_spill, 3),
+             "spilled_bytes": st.get("spilled_bytes"),
+             "restored_bytes": st.get("restored_bytes"),
+             "goss_restored_bytes": gs.get("restored_bytes"),
+             "disk_bytes": st.get("disk_bytes"),
+             "resident_host_peak": st.get("resident_host_peak"),
+             "host_streamed_spilled_bytes": hs.get("spilled_bytes"),
+             "stream": st or None,
+             "goss_stream": gs or None})
 
 
 def bench_estimators():
@@ -1170,7 +1260,7 @@ R02_BASELINE = {
 DEFAULT_REPEATS = {"gbm": 3, "glm": 3, "xgb_rank": 2, "dl": 2, "automl": 2,
                    "scaling": 1, "ingest": 2, "munge": 2, "grid": 1,
                    "chaos": 1, "serving": 1, "gbm_cpu": 1, "estimators": 1,
-                   "fleet_serving": 1}
+                   "disk_oversubscription": 1, "fleet_serving": 1}
 
 
 def _probe_accelerator(timeout_s: float):
@@ -1532,7 +1622,7 @@ def main():
     cpu_fallback_reason = None
     forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
     if config in ("scaling", "munge", "chaos", "serving", "gbm_cpu",
-                  "oversubscription", "estimators",
+                  "oversubscription", "disk_oversubscription", "estimators",
                   "fleet_serving") or forced:
         # the scaling curve runs in CPU subprocesses, the munge bench is
         # pure host numpy, the chaos/serving lanes measure FAILOVER/SLO
@@ -1601,6 +1691,7 @@ def main():
           "grid": bench_grid, "chaos": bench_chaos,
           "serving": bench_serving, "gbm_cpu": bench_gbm_cpu,
           "oversubscription": bench_oversubscription,
+          "disk_oversubscription": bench_disk_oversubscription,
           "estimators": bench_estimators,
           "fleet_serving": bench_fleet_serving}[config]
     # cold is strictly one run: repeats within a process share the live

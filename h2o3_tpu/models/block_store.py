@@ -29,11 +29,26 @@ Accounting and shedding:
   land in the new ``h2d_stream`` phase bucket and upload/evict/reuse
   counters + streamed bytes feed the Prometheus scrape and the per-fit
   tree fold at ``/3/Profiler``.
+
+The disk tier (round 19) adds the third level of the LRU: host blocks
+that overflow ``H2O3_STREAM_HOST_BUDGET_MB`` (ledger-derived default:
+half the host budget; ``H2O3_TREE_OOC_DISK=0`` disables the tier) SPILL
+through the persist layer as atomic ``.part``+rename files and stream
+back through ``Persist.open_resuming`` — a torn or injected
+``persist.read`` failure resumes at the current offset under the shared
+retry policy instead of failing the fit. ``prefetch`` goes asynchronous
+once blocks live on disk, so the disk→host read of block ``b+1``
+overlaps block ``b``'s H2D and compute. Restored bytes are byte-identical
+to what was packed, so a spilled fit sharing the block grid stays
+BIT-IDENTICAL to in-core. Spill files are ledger-visible as
+``<owner>:spill`` owners in the new ``disk`` space — a store dropped
+without ``close()`` leaves files behind and surfaces as a leak.
 """
 
 from __future__ import annotations
 
 import os
+import tempfile
 import threading
 import time
 import weakref
@@ -45,12 +60,15 @@ import numpy as np
 from ..ops import packing
 from ..runtime import env_float
 from ..runtime import memory_ledger as _ml
+from ..runtime import persist as _persist
 from ..runtime import phases as _phases
 
 _TOTALS_LOCK = threading.Lock()
 # process-lifetime stream totals — the bench/loadgen record embed next to
 # the memory embeds (`streamed_bytes`, `resident_block_peak`)
-_TOTALS = {"streamed_bytes": 0, "resident_block_peak": 0}
+_TOTALS = {"streamed_bytes": 0, "resident_block_peak": 0,
+           "spilled_bytes": 0, "restored_bytes": 0,
+           "resident_host_peak": 0}
 
 _REG: Dict = {}
 
@@ -71,6 +89,20 @@ def _registry() -> Dict:
         _REG["resident_peak"] = reg.gauge(
             "h2o3_tree_stream_resident_peak_bytes",
             "high watermark of device-resident out-of-core block bytes")
+        _REG["spill_blocks"] = reg.counter(
+            "h2o3_tree_spill_blocks",
+            "disk-tier block events (spilled: host->disk write or host "
+            "drop with a disk copy kept; restored: disk->host read)",
+            labelnames=("event",))
+        _REG["spill_bytes"] = reg.counter(
+            "h2o3_tree_spill_bytes",
+            "disk-tier bytes by direction (spill: written host->disk; "
+            "restore: read disk->host)",
+            labelnames=("direction",))
+        _REG["spill_host_peak"] = reg.gauge(
+            "h2o3_tree_spill_resident_host_peak_bytes",
+            "high watermark of host-resident out-of-core block bytes "
+            "while the disk tier is active")
     return _REG
 
 
@@ -86,6 +118,21 @@ def stream_budget_bytes() -> int:
     return max(_ml.device_capacity_bytes() // 2, 1)
 
 
+def stream_host_budget_bytes() -> int:
+    """The HOST-resident byte budget of the disk spill tier:
+    ``H2O3_STREAM_HOST_BUDGET_MB`` when set, else half the ledger's host
+    budget (``H2O3_MEM_BUDGET_MB`` / MemTotal) — packed blocks past it
+    spill to disk through the persist layer. 0 (or
+    ``H2O3_TREE_OOC_DISK=0``) disables the tier: every block stays
+    host-resident, the pre-round-19 behavior."""
+    if os.environ.get("H2O3_TREE_OOC_DISK", "") == "0":
+        return 0
+    mb = env_float("H2O3_STREAM_HOST_BUDGET_MB", 0.0)
+    if mb > 0:
+        return int(mb * 1e6)
+    return max(_ml._host_budget_bytes() // 2, 1)
+
+
 def process_totals() -> Dict:
     """Cumulative stream totals for record embeds (0s when never used)."""
     with _TOTALS_LOCK:
@@ -99,14 +146,26 @@ def _account_totals(nbytes: int = 0, resident: int = 0) -> None:
             _TOTALS["resident_block_peak"] = int(resident)
 
 
+def _account_spill_totals(spilled: int = 0, restored: int = 0,
+                          host_peak: int = 0) -> None:
+    with _TOTALS_LOCK:
+        _TOTALS["spilled_bytes"] += int(spilled)
+        _TOTALS["restored_bytes"] += int(restored)
+        if host_peak > _TOTALS["resident_host_peak"]:
+            _TOTALS["resident_host_peak"] = int(host_peak)
+
+
 class BlockStore:
-    """Host-resident packed row-blocks + a bounded LRU device resident set."""
+    """Packed row-blocks across three LRU tiers: a bounded device resident
+    set, a bounded host set, and persist-backed spill files on disk."""
 
     _IDS = iter(range(1 << 62))
 
     def __init__(self, host_blocks: List[np.ndarray], block_rows: int,
                  pack_bits: int, owner: str = "",
-                 budget_bytes: Optional[int] = None, register: bool = True):
+                 budget_bytes: Optional[int] = None,
+                 host_budget_bytes: Optional[int] = None,
+                 register: bool = True):
         self.host_blocks = list(host_blocks)
         self.n_blocks = len(self.host_blocks)
         self.block_rows = int(block_rows)
@@ -117,13 +176,37 @@ class BlockStore:
         # heavy for the per-miss hot path in get()
         self._budget = (int(budget_bytes) if budget_bytes is not None
                         else stream_budget_bytes())
+        # host-tier budget (0 disables the disk tier); sizes and dtypes
+        # are pinned up front because a spilled slot holds None
+        self._host_budget = (int(host_budget_bytes)
+                             if host_budget_bytes is not None
+                             else stream_host_budget_bytes())
+        self._block_nbytes = [int(hb.nbytes) for hb in self.host_blocks]
+        self._block_meta = [(hb.shape, hb.dtype) for hb in self.host_blocks]
         self._lock = threading.Lock()
         self._resident: "OrderedDict[int, object]" = OrderedDict()
         self._resident_bytes = 0
         self._window_peak = 0
+        # host LRU: block id -> None for host-resident blocks, in LRU order
+        self._host_lru: "OrderedDict[int, None]" = OrderedDict()
+        for b in range(self.n_blocks):
+            self._host_lru[b] = None
+        self._host_bytes_resident = sum(self._block_nbytes)
+        self._host_window_peak = self._host_bytes_resident
+        self._on_disk: set = set()          # blocks with a spill file
+        self._spill_dir: Optional[str] = None
+        self._spill_registered = False
+        self._pending: set = set()          # async prefetches in flight
+        self._pool = None
+        # serializes the restore slow path (evict-then-read-then-insert)
+        # so concurrent prefetch + compute restores cannot both claim the
+        # same headroom and push the watermark over the host budget
+        self._restore_lock = threading.Lock()
         self.counters = dict(uploaded=0, evicted=0, reused=0,
-                             bytes_streamed=0)
+                             bytes_streamed=0, spilled=0, restored=0,
+                             bytes_spilled=0, bytes_restored=0)
         self.resident_peak_bytes = 0
+        self.host_resident_peak_bytes = self._host_bytes_resident
         self._registered = False
         if register:
             # standalone owner (cache-disabled fits): the referent is the
@@ -139,6 +222,8 @@ class BlockStore:
             _ml.register(self.owner, kind="block_store", bytes_fn=_bytes,
                          referent=self, type_name="blocks")
             self._registered = True
+        if self._host_budget > 0:
+            self._enforce_host_budget(keep=())
 
     # -- construction ------------------------------------------------------
 
@@ -171,11 +256,20 @@ class BlockStore:
     # -- sizes -------------------------------------------------------------
 
     def host_bytes(self) -> int:
-        return sum(int(hb.nbytes) for hb in self.host_blocks)
+        """HOST-RESIDENT block bytes (spilled slots hold None and do not
+        count — their bytes live in `disk_bytes()`)."""
+        with self._lock:
+            return self._host_bytes_resident
 
     def resident_bytes(self) -> int:
         with self._lock:
             return self._resident_bytes
+
+    def disk_bytes(self) -> int:
+        """Bytes held by spill files (kept even after a restore — the
+        'spilled copies kept' rule makes a later host shed free)."""
+        with self._lock:
+            return sum(self._block_nbytes[b] for b in self._on_disk)
 
     def nbytes_total(self) -> int:
         return self.host_bytes() + self.resident_bytes()
@@ -183,20 +277,257 @@ class BlockStore:
     def budget_bytes(self) -> int:
         """Resident budget, floored at two blocks so the double buffer
         (consume b, prefetch b+1) always fits."""
-        floor = 2 * max((int(hb.nbytes) for hb in self.host_blocks),
-                        default=0)
+        floor = 2 * max(self._block_nbytes, default=0)
         return max(self._budget, floor)
 
+    def host_budget_bytes(self) -> int:
+        """Host-tier budget (0: disk tier disabled), floored at two
+        blocks so the disk double buffer (restore b+1 while b computes)
+        always fits."""
+        if self._host_budget <= 0:
+            return 0
+        floor = 2 * max(self._block_nbytes, default=0)
+        return max(self._host_budget, floor)
+
     def peak_window_start(self) -> None:
-        """Reset the per-window resident peak — a fit sharing a cached
+        """Reset the per-window resident peaks — a fit sharing a cached
         store marks its own window so `peak_window_bytes()` reports THIS
         fit's watermark, not the store-lifetime one."""
         with self._lock:
             self._window_peak = self._resident_bytes
+            self._host_window_peak = self._host_bytes_resident
 
     def peak_window_bytes(self) -> int:
         with self._lock:
             return self._window_peak
+
+    def host_peak_window_bytes(self) -> int:
+        with self._lock:
+            return self._host_window_peak
+
+    # -- disk tier ---------------------------------------------------------
+
+    def _spill_dir_path(self) -> str:
+        """Lazily-created per-store spill directory; also registers the
+        ``<owner>:spill`` ledger owner whose bytes come from the
+        FILESYSTEM (not the store object), so a store dropped without
+        ``close()`` leaves a dead owner that still reports disk bytes —
+        the leak detector's cue."""
+        if self._spill_dir is None:
+            base = os.environ.get("H2O3_SPILL_DIR") or tempfile.gettempdir()
+            safe = self.owner.replace(":", "_").replace("/", "_")
+            d = os.path.join(base, f"h2o3_spill_{os.getpid()}_{safe}")
+            os.makedirs(d, exist_ok=True)
+            self._spill_dir = d
+        if not self._spill_registered:
+            self._spill_registered = True
+            d = self._spill_dir
+
+            def _disk():
+                try:
+                    with os.scandir(d) as it:
+                        return (0, 0, sum(e.stat().st_size for e in it
+                                          if e.is_file()))
+                except OSError:
+                    return (0, 0, 0)
+
+            _ml.register(f"{self.owner}:spill", kind="block_store",
+                         bytes_fn=_disk, referent=self, type_name="spill")
+        return self._spill_dir
+
+    def _spill_path(self, b: int) -> str:
+        return os.path.join(self._spill_dir_path(), f"block{b}.bin")
+
+    def _write_spill(self, b: int, hb: np.ndarray) -> None:
+        """host→disk through the persist layer: write ``.part``, fsync,
+        atomic rename — the registry publish pattern, so a crash mid-spill
+        never leaves a torn file where a restore would read it."""
+        path = self._spill_path(b)
+        part = path + ".part"
+        be = _persist.for_uri(path)
+        t0 = time.perf_counter()
+        fh = be.open(part, "wb")
+        try:
+            fh.write(hb.tobytes() if not hb.flags.c_contiguous
+                     else memoryview(hb).cast("B"))
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except (OSError, AttributeError):
+                pass
+        finally:
+            fh.close()
+        os.replace(part, path)
+        _phases.add("disk_stream", time.perf_counter() - t0, hb.nbytes)
+
+    def _read_spill(self, b: int) -> np.ndarray:
+        """disk→host via the persist layer's resuming reader: a torn or
+        fault-injected read resumes at the current offset under the
+        shared retry policy instead of failing the fit."""
+        path = self._spill_path(b)
+        expected = self._block_nbytes[b]
+        shape, dtype = self._block_meta[b]
+        be = _persist.for_uri(path)
+        t0 = time.perf_counter()
+        buf = bytearray()
+        with be.open_resuming(path) as src:
+            while len(buf) < expected:
+                chunk = src.read(min(1 << 20, expected - len(buf)))
+                if not chunk:
+                    break
+                buf += chunk
+        if len(buf) != expected:
+            raise IOError(f"spill file {path} truncated: "
+                          f"{len(buf)} of {expected} bytes")
+        _phases.add("disk_stream", time.perf_counter() - t0, expected)
+        return np.frombuffer(bytes(buf), dtype=dtype).reshape(shape)
+
+    def _pick_spill_victim_locked(self, keep) -> Optional[int]:
+        for b in self._host_lru:
+            if b not in keep:
+                return b
+        return None
+
+    def _enforce_host_budget(self, keep=(), trigger: str = "host_cap",
+                             headroom: int = 0) -> int:
+        """Spill LRU host blocks (except `keep`) until host-resident bytes
+        plus `headroom` (bytes an imminent restore is about to insert) fit
+        the host budget. File writes run OUTSIDE the lock; a block already
+        on disk just drops its host copy (spilled copies kept)."""
+        budget = self.host_budget_bytes()
+        if budget <= 0:
+            return 0
+        spilled = 0
+        while True:
+            with self._lock:
+                if self._host_bytes_resident + headroom <= budget:
+                    return spilled
+                b = self._pick_spill_victim_locked(keep)
+                if b is None:
+                    return spilled
+                hb = self.host_blocks[b]
+                on_disk = b in self._on_disk
+            if hb is None:
+                # raced with another spiller; bookkeeping already done
+                continue
+            if not on_disk:
+                self._write_spill(b, hb)
+            nbytes = self._block_nbytes[b]
+            with self._lock:
+                if self.host_blocks[b] is None:
+                    continue
+                self.host_blocks[b] = None
+                self._host_lru.pop(b, None)
+                self._host_bytes_resident -= nbytes
+                self._on_disk.add(b)
+                self.counters["spilled"] += 1
+                self.counters["bytes_spilled"] += nbytes
+            spilled += 1
+            try:
+                reg = _registry()
+                reg["spill_blocks"].inc(1, "spilled")
+                reg["spill_bytes"].inc(nbytes, "spill")
+            except Exception:
+                pass
+            _account_spill_totals(spilled=nbytes)
+            _ml.record_event("spill", f"{self.owner}:block{b}", nbytes,
+                             trigger=trigger, space="disk",
+                             kind="block_store")
+
+    def shed_host(self, keep=(), trigger: str = "pressure") -> int:
+        """Spill ALL host blocks except `keep` — the second stage of the
+        pressure response (device blocks shed first via `shed`; host
+        blocks spill after, and blocks already on disk just drop their
+        host copy). No-op when the disk tier is disabled."""
+        if self._host_budget <= 0:
+            return 0
+        spilled = 0
+        while True:
+            with self._lock:
+                b = self._pick_spill_victim_locked(keep)
+                if b is None:
+                    return spilled
+                hb = self.host_blocks[b]
+                on_disk = b in self._on_disk
+            if hb is None:
+                continue
+            if not on_disk:
+                self._write_spill(b, hb)
+            nbytes = self._block_nbytes[b]
+            with self._lock:
+                if self.host_blocks[b] is None:
+                    continue
+                self.host_blocks[b] = None
+                self._host_lru.pop(b, None)
+                self._host_bytes_resident -= nbytes
+                self._on_disk.add(b)
+                self.counters["spilled"] += 1
+                self.counters["bytes_spilled"] += nbytes
+            spilled += 1
+            try:
+                reg = _registry()
+                reg["spill_blocks"].inc(1, "spilled")
+                reg["spill_bytes"].inc(nbytes, "spill")
+            except Exception:
+                pass
+            _account_spill_totals(spilled=nbytes)
+            _ml.record_event("spill", f"{self.owner}:block{b}", nbytes,
+                             trigger=trigger, space="disk",
+                             kind="block_store")
+
+    def fetch_host(self, b: int) -> np.ndarray:
+        """Host array of block `b`, restoring from its spill file when the
+        host copy was shed. Touches the host LRU and enforces the host
+        budget (so a restore can spill a colder block in turn). This is
+        the ONE host read path — the streamed driver's host-method
+        kernels, GOSS gathers and device uploads all come through here,
+        which is what makes restored bytes bit-identical by construction."""
+        b = int(b)
+        with self._lock:
+            hb = self.host_blocks[b]
+            if hb is not None:
+                self._host_lru.move_to_end(b) if b in self._host_lru \
+                    else self._host_lru.setdefault(b, None)
+                return hb
+        nbytes = self._block_nbytes[b]
+        with self._restore_lock:
+            with self._lock:
+                cur = self.host_blocks[b]
+                if cur is not None:
+                    # a concurrent restore (prefetch) won; keep the winner
+                    return cur
+            # make room FIRST — the watermark must never exceed the
+            # budget, even transiently. Keep the block being restored and
+            # its successor (the disk double buffer); a colder block pays
+            # the spill
+            self._enforce_host_budget(keep={b, (b + 1) % self.n_blocks},
+                                      headroom=nbytes)
+            arr = self._read_spill(b)
+            with self._lock:
+                self.host_blocks[b] = arr
+                self._host_lru[b] = None
+                self._host_lru.move_to_end(b)
+                self._host_bytes_resident += nbytes
+                self.counters["restored"] += 1
+                self.counters["bytes_restored"] += nbytes
+                if self._host_bytes_resident > self.host_resident_peak_bytes:
+                    self.host_resident_peak_bytes = self._host_bytes_resident
+                if self._host_bytes_resident > self._host_window_peak:
+                    self._host_window_peak = self._host_bytes_resident
+                host_peak = self._host_bytes_resident
+        try:
+            reg = _registry()
+            reg["spill_blocks"].inc(1, "restored")
+            reg["spill_bytes"].inc(nbytes, "restore")
+            reg["spill_host_peak"].set(
+                max(self.host_resident_peak_bytes,
+                    reg["spill_host_peak"].value() or 0))
+        except Exception:
+            pass
+        _account_spill_totals(restored=nbytes, host_peak=host_peak)
+        _ml.record_event("restore", f"{self.owner}:block{b}", nbytes,
+                         trigger="stream", space="disk", kind="block_store")
+        return arr
 
     # -- resident-set management -------------------------------------------
 
@@ -204,7 +535,7 @@ class BlockStore:
         arr = self._resident.pop(b, None)
         if arr is None:
             return
-        nbytes = int(self.host_blocks[b].nbytes)
+        nbytes = self._block_nbytes[b]
         self._resident_bytes -= nbytes
         self.counters["evicted"] += 1
         try:
@@ -229,7 +560,7 @@ class BlockStore:
     def _upload(self, b: int):
         import jax
 
-        hb = self.host_blocks[b]
+        hb = self.fetch_host(b)
 
         def _put():
             return jax.device_put(hb)
@@ -268,7 +599,7 @@ class BlockStore:
                           trigger="pressure")
         except Exception:
             pass
-        hb_bytes = int(self.host_blocks[b].nbytes)
+        hb_bytes = self._block_nbytes[b]
         with self._lock:
             arr = self._resident.get(b)
             if arr is not None:
@@ -314,15 +645,54 @@ class BlockStore:
         _account_totals(hb_bytes, peak)
         return arr
 
+    def _prefetch_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._lock:
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix="h2o3-spill-prefetch")
+        return self._pool
+
     def prefetch(self, b: int) -> None:
         """Dispatch block `b`'s H2D now so the upload overlaps the
         caller's compute on the previous block (double buffering). The
         device_put is async on real backends; `get(b)` then finds it
-        resident."""
+        resident. Once blocks live on disk the whole fetch moves to a
+        single background worker — a synchronous prefetch would serialize
+        the disk read with the caller's compute, which is the one cost
+        the three-tier pipeline exists to hide; max_workers=1 keeps it a
+        strict double buffer (one restore+upload in flight)."""
+        b = int(b)
+        with self._lock:
+            disk_active = bool(self._on_disk)
+            if disk_active:
+                if b in self._pending:
+                    return
+                self._pending.add(b)
+        if not disk_active:
+            try:
+                self.get(b)
+            except Exception:
+                pass   # advisory; the blocking get reports real failures
+            return
+
+        def _run():
+            try:
+                self.get(b)
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    self._pending.discard(b)
+
         try:
-            self.get(b)
+            self._prefetch_pool().submit(_run)
         except Exception:
-            pass   # advisory; the blocking get reports real failures
+            with self._lock:
+                self._pending.discard(b)
 
     def account_external_bytes(self, nbytes: int) -> None:
         """Fold an out-of-band H2D (e.g. a GOSS compact-sample upload)
@@ -345,12 +715,44 @@ class BlockStore:
                    pack_bits=self.pack_bits,
                    host_bytes=self.host_bytes(),
                    resident_bytes=self.resident_bytes(),
+                   disk_bytes=self.disk_bytes(),
                    resident_peak_bytes=self.resident_peak_bytes,
-                   budget_bytes=self.budget_bytes())
+                   host_resident_peak_bytes=self.host_resident_peak_bytes,
+                   budget_bytes=self.budget_bytes(),
+                   host_budget_bytes=self.host_budget_bytes())
         return out
 
     def close(self) -> None:
         self.shed(trigger="clear")
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+        # remove spill files BEFORE retiring the :spill owner — its bytes
+        # come from the filesystem, so files left behind would read as a
+        # leak (which is exactly what an unclosed store should read as)
+        with self._lock:
+            on_disk = list(self._on_disk)
+            self._on_disk.clear()
+            sd = self._spill_dir
+        freed = 0
+        for b in on_disk:
+            try:
+                p = os.path.join(sd, f"block{b}.bin") if sd else None
+                if p and os.path.exists(p):
+                    freed += self._block_nbytes[b]
+                    os.remove(p)
+            except OSError:
+                pass
+        if sd:
+            try:
+                os.rmdir(sd)
+            except OSError:
+                pass
+        if self._spill_registered:
+            _ml.unregister(f"{self.owner}:spill",
+                           event="free" if freed else None, nbytes=freed,
+                           trigger="close", space="disk")
+            self._spill_registered = False
         if self._registered:
             _ml.unregister(self.owner)
             self._registered = False

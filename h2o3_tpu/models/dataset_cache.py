@@ -165,6 +165,15 @@ def _release_entry(e: "_Entry", trigger: str) -> None:
                     space="device" if e.device else "host")
     for layer in _LAYERS:
         ml.unregister(f"{e.owner_base}:{layer}")
+    # close block stores so their spill FILES go with the entry — a
+    # dropped entry that left files behind would (correctly) surface as a
+    # `<owner>:spill` leak, but the cache releasing an entry is the
+    # orderly path, not the leak
+    for st in list(e.blocks.values()):
+        try:
+            st.close()
+        except Exception:
+            pass
 
 
 def _drop(key) -> None:
@@ -229,13 +238,21 @@ def _evict_locked(keep=None) -> None:
     # unregistered, so re-reading per victim would only burn a full
     # accounting pass under _LOCK per pop): past the threshold, DEVICE
     # blocks shed FIRST (ISSUE 14 — a shed block keeps its host copy and
-    # costs only a re-upload, the cheapest byte to give back), then every
-    # LRU victim entry, oldest first
+    # costs only a re-upload, the cheapest byte to give back), then HOST
+    # blocks spill to disk (round 19 — the spilled copy is kept, so a
+    # re-shed is free and only a restore pays a read), then every LRU
+    # victim entry, oldest first
     if (victims or any(e.blocks for e in list(_ENTRIES.values()))) \
             and ml.pressure() >= ml.evict_threshold():
         for e in list(_ENTRIES.values()):
             for st in list(e.blocks.values()):
                 st.shed(trigger="pressure")
+        for e in list(_ENTRIES.values()):
+            for st in list(e.blocks.values()):
+                try:
+                    st.shed_host(trigger="pressure")
+                except Exception:
+                    pass
         while victims:
             _pop_entry_locked(victims.pop(0), "pressure")
 

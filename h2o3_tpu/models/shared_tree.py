@@ -454,6 +454,7 @@ def _concat_args(*xs):
 # histogram kernels and the partition step consume the packed words
 # directly); these aliases keep the driver's historical surface
 from ..ops import packing as _packing
+from ..ops.histogram import host_callback_safe as _host_callback_safe
 from ..ops.histogram import record_fit_plan as _record_fit_plan
 
 _pack_host = _packing.pack_host
@@ -1349,8 +1350,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
             "H2O3_HIST_METHOD", tp.get("hist_method", "auto"))
         if (hist_method == "auto" and not legacy and host_ok
                 and jax.default_backend() == "cpu"
+                and _host_callback_safe()
                 and npad >= int(os.environ.get(
                     "H2O3_HOST_HIST_MIN_ROWS", 32768))):
+            # host_callback_safe: on a 1-core host the in-graph callback
+            # deadlocks (the intra-op pool's only thread blocks inside the
+            # custom call while the operand producers queue behind it), so
+            # single-core hosts keep the bit-identical segment scatter
             hist_method = "host"
         return _StepCfg(
             npad=npad, K=K, F=F, nbins=nbins, problem=problem, dist=dist,
@@ -1438,10 +1444,23 @@ class H2OSharedTreeEstimator(H2OEstimator):
         headroom); ``H2O3_STREAM_BLOCKS`` forces it (tests pin the
         streamed-vs-in-core bit identity by sharing S).
 
-        Ineligible fits (legacy comparator, mesh-sharded, checkpoint,
-        DART, custom objectives, lossguide, monotone, nbins > 256) train
-        in-core exactly as before; a goss request on an ineligible fit
-        warns and trains unsampled."""
+        The disk tier rides the same decision: packed bytes past the HOST
+        budget (``H2O3_STREAM_HOST_BUDGET_MB``; ``H2O3_TREE_OOC_DISK=0``
+        disables) also stream — the store then spills overflow blocks to
+        persist-backed files and restores them bit-identically, so "fits
+        on disk" replaces "fits in host RAM" with the same contract.
+
+        Mesh-sharded fits are ELIGIBLE since round 19 (the PR 11 gap):
+        an oversubscribed mesh fit converts to the blocks lane and
+        streams — bit-identity with the mesh fit holds transitively
+        because both fold the same block grid in the same order (the
+        ordered_axis_fold contract; S stays a multiple of the mesh grid
+        via the ``base = max(base, n_shards)`` rule below).
+
+        Ineligible fits (legacy comparator, multiproc mesh_psum,
+        checkpoint, DART, custom objectives, lossguide, monotone,
+        nbins > 256) train in-core exactly as before; a goss request on
+        an ineligible fit warns and trains unsampled."""
         env = (os.environ.get("H2O3_TREE_OOC", "auto").strip() or "auto")
         goss_cfg = None
         if tp.get("goss"):
@@ -1460,7 +1479,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                             other_rate=float(tp["goss_other_rate"]),
                             start_tree=int(start))
         eligible = (env != "0" and not tree_legacy()
-                    and shard_mode in ("off", "blocks")
+                    and shard_mode in ("off", "blocks", "mesh")
                     and self._parms.get("checkpoint") is None
                     and not tp.get("dart")
                     and getattr(self, "_objective_fn", None) is None
@@ -1480,7 +1499,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
         from . import block_store as _bs
 
         budget = _bs.stream_budget_bytes()
-        if env != "1" and goss_cfg is None and codes_bytes <= budget:
+        host_budget = _bs.stream_host_budget_bytes()
+        over_host = host_budget > 0 and codes_bytes > host_budget
+        if env != "1" and goss_cfg is None and codes_bytes <= budget \
+                and not over_host:
             return 0, None
         base = max(int(os.environ.get("H2O3_TREE_SHARD_BLOCKS", "8") or 8),
                    1)
@@ -1814,17 +1836,24 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # is bit-identical by construction — pinned in
         # tests/test_tree_stream.py.
         ooc_blocks, goss_cfg = 0, None
-        if not multiproc and shard_mode in ("off", "blocks"):
+        if not multiproc and shard_mode in ("off", "blocks", "mesh"):
             ooc_blocks, goss_cfg = self._ooc_plan(
                 tp, npad, F, nbins, resident_bits, shard_mode, n_shards, K)
         elif tp.get("goss"):
-            # mesh/multi-process fits never stream, but a goss request
-            # must fail/warn IDENTICALLY to the 1-device path — not be
+            # multi-process fits never stream, but a goss request must
+            # fail/warn IDENTICALLY to the 1-device path — not be
             # silently dropped by the shard gate
             self._ooc_plan(tp, npad, F, nbins, resident_bits, shard_mode,
                            n_shards, K)
         if ooc_blocks:
+            # mesh-gap closure (round 19): an oversubscribed mesh fit
+            # converts to the single-lane blocks reduction and streams —
+            # ndev_eff MUST drop to 1 with it (the codes never get a
+            # device_put to a row sharding; the store uploads per block).
+            # Bit-identity with the mesh fit holds because S stays a
+            # multiple of the mesh grid and both fold blocks in order.
             shard_mode, n_shards = "blocks", ooc_blocks
+            ndev_eff = 1
             row_mult = max(n_shards * 8, 8)
             npad = cloudlib.pad_to_multiple(
                 _bucket_rows(cloudlib.pad_to_multiple(n, row_mult)),
@@ -2908,6 +2937,12 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 bytes_per_tree=int(delta["bytes_streamed"]
                                    / max(model.ntrees_built, 1)),
                 resident_block_peak=int(ooc_store.peak_window_bytes()),
+                spilled_blocks=delta.get("spilled", 0),
+                restored_blocks=delta.get("restored", 0),
+                spilled_bytes=delta.get("bytes_spilled", 0),
+                restored_bytes=delta.get("bytes_restored", 0),
+                disk_bytes=int(ooc_store.disk_bytes()),
+                resident_host_peak=int(ooc_store.host_peak_window_bytes()),
                 goss=bool(goss_cfg))
             from ..ops.histogram import attach_fit_stream
 

@@ -248,6 +248,9 @@ class _ResidentBlocks:
     def get(self, b: int):
         return self._dev[b]
 
+    def fetch_host(self, b: int) -> np.ndarray:
+        return self.host_blocks[b]
+
     def prefetch(self, b: int) -> None:
         pass
 
@@ -328,7 +331,7 @@ class StreamedTreeStep:
                         vals = np.stack([wt_np, g_np * wt_np,
                                          h_np * wt_np]).astype(np.float32)
                         part = jnp.asarray(host_hist_direct(
-                            provider.host_blocks[b],
+                            provider.fetch_host(b),
                             np.zeros(rows, np.int32), vals, 1, nbins,
                             pack_bits))
                     else:
@@ -349,7 +352,7 @@ class StreamedTreeStep:
                         vals = np.stack([w_eff, g_np * w_eff,
                                          h_np * w_eff]).astype(np.float32)
                         part = jnp.asarray(host_hist_direct(
-                            provider.host_blocks[b], idx_np // 2, vals,
+                            provider.fetch_host(b), idx_np // 2, vals,
                             L // 2, nbins, pack_bits))
                     else:
                         idx_b, part = _level_pass_jit(
@@ -425,7 +428,11 @@ class StreamedTreeStep:
         pos = 0
         for b in np.unique(blk):
             rb = sel[blk == b] - b * rows
-            hb = self.store.host_blocks[int(b)]
+            # a restoring fetch: GOSS-on-disk reads only the blocks the
+            # sample touches (all of them once, here) and the per-level
+            # passes then stream just the compact sample — the disk tier
+            # is where sampling pays most (arXiv 1806.11248)
+            hb = self.store.fetch_host(int(b))
             dense = packing.unpack_host(hb, bits) if bits else hb
             out[pos:pos + len(rb)] = dense[rb]
             pos += len(rb)

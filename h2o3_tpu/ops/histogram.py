@@ -28,7 +28,11 @@ loops updates at ~100 ns each. Strategies, selectable and benchmarked:
   program from the persistent compile cache — tiny fits keep the cacheable
   ``segment`` program instead of paying a fresh XLA compile per process.
   Consumes 4/5/6-bit packed codes directly, unpacking per row-chunk in
-  numpy. Single-shard only (never under a collective).
+  numpy. Single-shard only (never under a collective), and only when the
+  host has a SPARE core (`host_callback_safe`): with one usable CPU the
+  XLA CPU runtime deadlocks on any in-graph callback whose operands are
+  computed by a large (task-split) op — see `host_callback_safe` — so
+  1-core hosts keep the in-graph ``segment`` scatter (bit-identical).
 * ``pallas``/``pallas_factored``: the fused VMEM kernels in
   `hist_pallas.py`. With packed input they widen IN-GRAPH once per jitted
   tree program (XLA CSEs the widen across every level's histogram pass of
@@ -367,10 +371,30 @@ def _host_hist_cb(codes, node_id, vals, n_nodes: int, nbins: int,
 
 def _hist_host(codes, node_id, vals, n_nodes: int, nbins: int,
                pack_bits: int):
-    """`pure_callback` wrapper around `_host_hist_cb` (CPU fast path)."""
+    """`pure_callback` wrapper around `_host_hist_cb` (CPU fast path).
+
+    The callback BODY runs on the ONE dedicated host-hist worker thread
+    (round 19): hopping to the worker serializes every host accumulate —
+    warm thread and fit included — so concurrent dispatches can't thrash
+    numpy's indexed-add fast path, and XLA's callback thread just waits
+    on the future. Operands are materialized to numpy BEFORE the hop, on
+    the thread XLA handed us: a device->host conversion from the worker
+    thread would wait on the runtime while the runtime waits on our
+    future. Requires a spare core — `host_callback_safe` gates selection
+    (see the comment block below)."""
     F = codes.shape[1]
-    cb = functools.partial(_host_hist_cb, n_nodes=n_nodes, nbins=nbins,
-                           pack_bits=pack_bits)
+
+    def cb(codes_, node_id_, vals_):
+        # materialize to numpy HERE, on the thread XLA handed us: a
+        # device->host conversion from the worker thread would wait on
+        # the runtime while the runtime waits on our future
+        codes_ = np.asarray(codes_)
+        node_id_ = np.asarray(node_id_)
+        vals_ = np.asarray(vals_)
+        return _host_worker().submit(
+            _host_hist_cb, codes_, node_id_, vals_,
+            n_nodes=n_nodes, nbins=nbins, pack_bits=pack_bits).result()
+
     return jax.pure_callback(
         cb, jax.ShapeDtypeStruct((n_nodes, F, nbins, 3), jnp.float32),
         codes, node_id, vals)
@@ -379,17 +403,39 @@ def _hist_host(codes, node_id, vals, n_nodes: int, nbins: int,
 # -- dedicated host-histogram worker (ISSUE 14 satellite) -------------------
 #
 # The in-graph `pure_callback` route has a known failure mode on 1-core
-# sandboxes: with the warm-up thread racing the real fit, XLA's callback
-# thread can futex-deadlock at >= ~32768 padded rows (pre-existing,
-# reproduced on pristine code — see docs/perf.md, H2O3_HOST_HIST_MIN_ROWS).
+# hosts, root-caused in round 19 (it was previously blamed on the warm-up
+# thread; a pristine fit with H2O3_WARM_THREAD=0 hangs identically): the
+# XLA CPU runtime splits large ops into parallel tasks on its intra-op
+# pool, and with ONE usable core the pool's only thread is the very thread
+# that ends up blocked inside the callback custom-call — the producer
+# tasks behind it never drain, so `np.asarray` on any computed operand
+# over the task-split threshold (~256 KB) waits forever. Reproduced with a
+# 12-line minimal jit(pure_callback) at 32768x8 f32; operands that are
+# program INPUTS or small reductions are unaffected. `host_callback_safe`
+# below gates the auto host-method selection on a spare core; 1-core
+# hosts keep the in-graph `segment` scatter, which is pinned bit-exact.
 # The STREAMED tree path never goes through pure_callback at all: its
 # per-block host histograms run `_host_hist_cb` directly on ONE dedicated
-# worker thread — same math (bit-exact with the XLA segment scatter), no
-# XLA callback machinery to hang, and serialization keeps numpy's
-# indexed-add fast path from thrashing a 1-core host.
+# worker thread — same math, no XLA callback machinery to hang, and
+# serialization keeps numpy's indexed-add fast path from thrashing the
+# host — so big CPU fits on 1-core hosts still get the np.add.at win via
+# the out-of-core streaming lane (auto at >= the stream budget).
 
 _HOST_WORKER_LOCK = threading.Lock()
 _HOST_WORKER = [None]
+
+
+def host_callback_safe() -> bool:
+    """True when the CPU runtime has a spare thread to service an
+    in-graph host callback. With one usable core, XLA's intra-op pool
+    cannot make progress on the callback's producer ops while the
+    callback blocks (deadlock — see the comment block above), so the
+    fused path must keep the in-graph `segment` kernel there."""
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n = os.cpu_count() or 1
+    return n > 1
 
 
 def _host_worker():

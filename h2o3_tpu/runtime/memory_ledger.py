@@ -77,7 +77,7 @@ _LOCK_TYPE = type(threading.Lock())
 class _Owner:
     __slots__ = ("owner", "kind", "type_name", "bytes_fn", "ref", "dead",
                  "leaked", "t_register", "last_host", "last_device",
-                 "__weakref__")
+                 "last_disk", "__weakref__")
 
     def __init__(self, owner: str, kind: str, type_name: str,
                  bytes_fn: Callable[[], Tuple[int, int]]):
@@ -91,6 +91,7 @@ class _Owner:
         self.t_register = time.time()
         self.last_host = 0
         self.last_device = 0
+        self.last_disk = 0
 
 
 _REG_LOCK = threading.Lock()       # guards _OWNERS / _JOB_LEAKS only
@@ -104,11 +105,11 @@ _STATE_LOCK = threading.Lock()     # guards the cached-result REFERENCE
 # expose them to a transient KeyError mid-pass
 _STATE: Dict = dict(
     t=0.0, by_kind={}, totals=dict(host_bytes=0, device_bytes=0,
-                                   leaked_bytes=0,
+                                   disk_bytes=0, leaked_bytes=0,
                                    unaccounted_device_bytes=0,
                                    owner_count=0),
     owners=[], leaks=[], device={}, pressure={}, )
-_HWM = dict(host=0, device=0, total=0)
+_HWM = dict(host=0, device=0, disk=0, total=0)
 _PEAK_TOP: List[Dict] = []
 _PRESS_HIGH = [False]
 
@@ -152,6 +153,7 @@ def _registry() -> Dict:
             labelnames=("event", "owner_kind"))
         for f, m in (("host_bytes", "h2o3_memory_bytes"),
                      ("device_bytes", "h2o3_memory_bytes"),
+                     ("disk_bytes", "h2o3_memory_bytes"),
                      ("unaccounted_device_bytes", "h2o3_memory_bytes"),
                      ("leaked_bytes", "h2o3_memory_leaked_bytes"),
                      ("owner_count", "h2o3_memory_owners")):
@@ -351,7 +353,9 @@ def register(owner: str, kind: Optional[str] = None, *,
              device_fn: Optional[Callable[[], int]] = None,
              referent=None, type_name: str = "") -> str:
     """Register (or replace) a byte owner. `bytes_fn` returns
-    (host, device); or pass `host_fn`/`device_fn` separately. `referent`
+    (host, device) or (host, device, disk) — the optional third element
+    accounts persist-backed spill files (the block store's disk tier);
+    or pass `host_fn`/`device_fn` separately. `referent`
     is the object whose death marks the owner dead (weakref-backed —
     never pinned); callbacks must not strongly hold the referent either,
     or the ledger itself becomes the leak it exists to find."""
@@ -394,7 +398,7 @@ def unregister(owner: str, *, event: Optional[str] = None,
         return False
     if event:
         if nbytes is None:
-            nbytes = o.last_host + o.last_device
+            nbytes = o.last_host + o.last_device + o.last_disk
         record_event(event, owner, nbytes, trigger=trigger, space=space,
                      kind=o.kind)
     return True
@@ -414,7 +418,7 @@ def owners(prefix: str = "") -> List[Dict]:
         items = [o for k, o in _OWNERS.items() if k.startswith(prefix)]
     return [dict(owner=o.owner, kind=o.kind, type=o.type_name,
                  host_bytes=o.last_host, device_bytes=o.last_device,
-                 dead=o.dead) for o in items]
+                 disk_bytes=o.last_disk, dead=o.dead) for o in items]
 
 
 def record_event(event: str, owner: str, nbytes: int = 0, *,
@@ -534,7 +538,7 @@ def _refresh_locked(now: float) -> Dict:
         rows: List[Dict] = []
         leaks: List[Dict] = []
         retire: List[_Owner] = []
-        host_total = dev_total = leaked = 0
+        host_total = dev_total = disk_total = leaked = 0
         # job leaks FIRST: the leaked value usually also has a live `dkv:`
         # owner (the key never left the store), and the shared dedup set
         # attributes each buffer to whichever view measures it first — an
@@ -559,44 +563,50 @@ def _refresh_locked(now: float) -> Dict:
             leaked += b
             host_total += h
             dev_total += d
-            agg = by_kind.setdefault("leaked", [0, 0, 0])
+            agg = by_kind.setdefault("leaked", [0, 0, 0, 0])
             agg[0] += h
             agg[1] += d
-            agg[2] += 1
+            agg[3] += 1
             rows.append(dict(owner=f"dkv:{dest}", kind="leaked",
-                             host_bytes=h, device_bytes=d, dead=False))
+                             host_bytes=h, device_bytes=d, disk_bytes=0,
+                             dead=False))
             leaks.append(dict(owner=f"dkv:{dest}", kind="dkv", bytes=b,
                               reason=f"job_{info['status'].lower()}"))
         for o in owner_objs:
             try:
-                h, d = o.bytes_fn()
-                h, d = int(h), int(d)
+                vals = o.bytes_fn()
+                h, d = int(vals[0]), int(vals[1])
+                k = int(vals[2]) if len(vals) > 2 else 0
             except Exception:
-                h = d = 0
-            o.last_host, o.last_device = h, d
+                h = d = k = 0
+            o.last_host, o.last_device, o.last_disk = h, d, k
             if o.dead:
-                if h + d <= 0:
+                if h + d + k <= 0:
                     if o.leaked:
                         record_event("leak_cleared", o.owner, 0,
                                      kind=o.kind)
                     retire.append(o)
                     continue
-                leaked += h + d
+                leaked += h + d + k
                 leaks.append(dict(owner=o.owner, kind=o.kind,
-                                  bytes=h + d, reason="referent_dead"))
+                                  bytes=h + d + k, reason="referent_dead"))
                 if not o.leaked:
                     o.leaked = True
-                    record_event("leak", o.owner, h + d,
+                    record_event("leak", o.owner, h + d + k,
                                  trigger="referent_dead", kind=o.kind,
-                                 space="device" if d else "host")
+                                 space="disk" if (k and not d and not h)
+                                 else ("device" if d else "host"))
             host_total += h
             dev_total += d
-            agg = by_kind.setdefault(o.kind, [0, 0, 0])
+            disk_total += k
+            agg = by_kind.setdefault(o.kind, [0, 0, 0, 0])
             agg[0] += h
             agg[1] += d
-            agg[2] += 1
+            agg[2] += k
+            agg[3] += 1
             rows.append(dict(owner=o.owner, kind=o.kind,
-                             host_bytes=h, device_bytes=d, dead=o.dead))
+                             host_bytes=h, device_bytes=d, disk_bytes=k,
+                             dead=o.dead))
     finally:
         _TLS.seen = None
     with _REG_LOCK:
@@ -627,24 +637,30 @@ def _refresh_locked(now: float) -> Dict:
                      trigger=f"{press:.3f}", kind="ledger")
 
     # watermarks + top owners at the combined peak
-    total = host_total + dev_total
+    total = host_total + dev_total + disk_total
     _HWM["host"] = max(_HWM["host"], host_total)
     _HWM["device"] = max(_HWM["device"], dev_total)
+    _HWM["disk"] = max(_HWM["disk"], disk_total)
     if total > _HWM["total"]:
         _HWM["total"] = total
         top = sorted(rows, key=lambda r: -(r["host_bytes"]
-                                           + r["device_bytes"]))[:3]
+                                           + r["device_bytes"]
+                                           + r.get("disk_bytes", 0)))[:3]
         _PEAK_TOP[:] = [dict(owner=r["owner"], kind=r["kind"],
-                             bytes=r["host_bytes"] + r["device_bytes"])
+                             bytes=r["host_bytes"] + r["device_bytes"]
+                             + r.get("disk_bytes", 0))
                         for r in top]
 
     # gauges (zero kinds that vanished so stale series don't lie)
     seen_labels = set()
-    for kind, (h, d, _n) in by_kind.items():
+    for kind, (h, d, k, _n) in by_kind.items():
         reg["bytes"].set(h, kind, "host")
         reg["bytes"].set(d, kind, "device")
         seen_labels.add((kind, "host"))
         seen_labels.add((kind, "device"))
+        if k:
+            reg["bytes"].set(k, kind, "disk")
+            seen_labels.add((kind, "disk"))
     reg["bytes"].set(unaccounted, "unaccounted", "device")
     seen_labels.add(("unaccounted", "device"))
     for lv in reg["bytes"].children():
@@ -652,18 +668,21 @@ def _refresh_locked(now: float) -> Dict:
             reg["bytes"].set(0, *lv)
     reg["hwm"].set(_HWM["host"], "host")
     reg["hwm"].set(_HWM["device"], "device")
+    reg["hwm"].set(_HWM["disk"], "disk")
     reg["leaked"].set(leaked)
     reg["owners"].set(n_owners)
     reg["pressure"].set(round(press, 4))
 
-    rows.sort(key=lambda r: -(r["host_bytes"] + r["device_bytes"]))
+    rows.sort(key=lambda r: -(r["host_bytes"] + r["device_bytes"]
+                              + r.get("disk_bytes", 0)))
     state = dict(
         t=now,
         totals=dict(host_bytes=host_total, device_bytes=dev_total,
-                    leaked_bytes=leaked,
+                    disk_bytes=disk_total, leaked_bytes=leaked,
                     unaccounted_device_bytes=unaccounted,
                     owner_count=n_owners),
-        by_kind={k: dict(host_bytes=v[0], device_bytes=v[1], owners=v[2])
+        by_kind={k: dict(host_bytes=v[0], device_bytes=v[1],
+                         disk_bytes=v[2], owners=v[3])
                  for k, v in sorted(by_kind.items())},
         owners=rows[:_SNAPSHOT_OWNERS],
         leaks=leaks,
@@ -699,7 +718,8 @@ def peak() -> Dict:
     (the bench-record memory embed)."""
     refresh()
     return dict(host_bytes=_HWM["host"], device_bytes=_HWM["device"],
-                total_bytes=_HWM["total"], top_owners=list(_PEAK_TOP))
+                disk_bytes=_HWM["disk"], total_bytes=_HWM["total"],
+                top_owners=list(_PEAK_TOP))
 
 
 def snapshot(force: bool = True) -> Dict:
@@ -745,6 +765,6 @@ def clear() -> None:
         _JOB_LEAKS.clear()
     with _STATE_LOCK:
         _STATE = dict(_STATE, t=0.0)   # rebind: readers hold the old dict
-    _HWM.update(host=0, device=0, total=0)
+    _HWM.update(host=0, device=0, disk=0, total=0)
     _PEAK_TOP.clear()
     _PRESS_HIGH[0] = False

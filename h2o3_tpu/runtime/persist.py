@@ -79,9 +79,87 @@ class Persist:
     def size(self, uri: str) -> int:
         return os.path.getsize(self._strip(uri))
 
+    def open_resuming(self, uri: str):
+        """A read stream that survives torn reads: a failed read() re-opens
+        the URI through this backend and seeks (or skip-reads) back to the
+        current offset under the shared retry policy — the local-file
+        analog of the HTTP Range resume. The block store's disk spill tier
+        streams packed blocks back through this so one injected/transient
+        read failure resumes instead of failing the fit."""
+        return _ResumingStream(self, uri)
+
     @staticmethod
     def _strip(uri: str) -> str:
         return uri[len("file://"):] if uri.startswith("file://") else uri
+
+
+class _ResumingStream:
+    """Backend-generic resuming reader (file/pyarrow): tracks the byte
+    offset handed to the caller; a read failure marks the stream dead and
+    the next retry attempt re-opens via the backend and positions itself
+    at the offset — ``seek`` where the handle supports it, a skip-read
+    loop otherwise (the same shape as the Range-ignoring-server path of
+    ``_ResumingHttpStream``). ``persist.read`` faults are checked per
+    attempt, so an armed fault exercises exactly this resume."""
+
+    def __init__(self, backend: "Persist", uri: str):
+        self._backend = backend
+        self._uri = uri
+        self._pos = 0
+        self._fh = None
+        self._dead = True          # first read opens lazily via _reopen
+
+    def _reopen(self):
+        fh = self._backend.open(self._uri, "rb")
+        if self._pos:
+            try:
+                fh.seek(self._pos)
+            except (AttributeError, OSError, ValueError):
+                left = self._pos
+                while left > 0:
+                    chunk = fh.read(min(left, 1 << 20))
+                    if not chunk:
+                        break
+                    left -= len(chunk)
+        self._fh = fh
+
+    def read(self, n: int = -1) -> bytes:
+        def _read():
+            faults.check("persist.read", self._uri)
+            # reopen at the top of the attempt (see _ResumingHttpStream):
+            # a transiently-failing reopen must leave the stream dead, or
+            # the next retry would read the closed handle and truncate
+            if self._dead:
+                self._reopen()
+                self._dead = False
+            try:
+                return self._fh.read(n)
+            except (OSError, ValueError) as e:
+                self._dead = True
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+                raise ConnectionError(
+                    f"read of {self._uri} dropped at byte "
+                    f"{self._pos}: {e}") from e
+
+        out = _policy().call(_read)
+        self._pos += len(out)
+        return out
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class _ResumingHttpStream:
@@ -198,6 +276,10 @@ class HttpPersist(Persist):
         # the resuming wrapper preserves that while adding mid-stream
         # retry + Range-resume
         return _ResumingHttpStream(uri, _policy().call(_open))
+
+    def open_resuming(self, uri: str):
+        # http reads already resume (Range) — open() IS the resuming stream
+        return self.open(uri)
 
     def exists(self, uri: str) -> bool:
         import http.client as _http

@@ -14,7 +14,9 @@ need real replica PROCESSES live in the slow lane below.
 Slow section: the acceptance pin — loadgen drives the router open-loop
 against three live replica processes, one is killed mid-load, and the
 caller sees zero hard errors while `h2o3_fleet_peer_up` flips to 0 and
-post-drain p99 stays within 2x of the pre-kill baseline."""
+post-drain p99 stays within 2x of the pre-kill baseline — plus a
+one-minute `loadgen --router` soak whose `mem_growth_bytes_per_min`
+canary pins the router's RSS slope (round 19)."""
 
 import json
 import os
@@ -619,3 +621,40 @@ def test_router_survives_replica_kill_mid_load():
                 p.kill()
         if srv is not None:
             srv.stop()
+
+
+@pytest.mark.slow
+def test_router_soak_memory_growth_canary(cloud1, serving_engine):
+    """Sustained `loadgen --router` soak against a self-registered
+    replica: a minute of open-loop traffic completes with zero hard
+    errors and the RSS slope (`mem_growth_bytes_per_min`, the canary
+    loadgen already computes for the serving engine) stays under a
+    64 MB/min ceiling — a leaky router (response buffers, drained-replica
+    state, per-request inflight entries) shows up here as a positive
+    slope long before an OOM would."""
+    from h2o3_tpu.rest.server import start_server
+
+    loadgen = _load_loadgen()
+    mid, fkey = _train_gbm("soak")
+    srv = start_server(port=0)
+    try:
+        fleet.register_peer("self", f"http://127.0.0.1:{srv.port}")
+        router = reset_router(RouterConfig(refresh_s=60.0, max_attempts=3,
+                                           drain_errors=100))
+        router.refresh(force=True)
+        s = loadgen.run_load_open("127.0.0.1", srv.port, mid, fkey,
+                                  rate=12.0, duration_s=60.0,
+                                  timeout_s=30.0, router=True)
+        assert s["completed"] >= 300, s
+        assert s["errors"] == 0 and s["shed_429"] == 0, s
+        assert len(s["mem_samples"]) >= 5
+        growth = s["mem_growth_bytes_per_min"]
+        assert growth is not None
+        assert growth < 64 * 1024 * 1024, \
+            f"router soak leaked {growth / 1e6:.1f} MB/min of RSS"
+        # the ledger's view must not diverge either: accounted bytes
+        # growing while RSS is flat means an owner is accumulating state
+        lg = s["ledger_growth_bytes_per_min"]
+        assert lg is None or lg < 64 * 1024 * 1024
+    finally:
+        srv.stop()

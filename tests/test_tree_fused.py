@@ -326,7 +326,11 @@ def test_warm_fit_retraces_zero(cloud1, _no_legacy):
 def test_fit_plan_recorded_and_profiler_fold(cloud1, _no_legacy):
     X, y = make_classification(n=2048, f=5, seed=17)
     names = [f"f{i}" for i in range(5)] + ["label"]
-    os.environ["H2O3_HOST_HIST_MIN_ROWS"] = "1"   # small fit, host anyway
+    # force the host lane explicitly: auto only picks it past MIN_ROWS
+    # AND with a spare core to service the callback (host_callback_safe —
+    # 1-core hosts keep `segment`), and this test pins the host lane's
+    # plan/dispatch observability, not the selection policy
+    os.environ["H2O3_HIST_METHOD"] = "host"
     _fit_gbm(False, X, y, names, ntrees=2, max_depth=3)
     stats = histogram.kernel_stats()
     assert stats["plans"], "fit recorded no kernel plan"

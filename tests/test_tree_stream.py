@@ -11,9 +11,15 @@ only the double buffer), mirroring test_memory_ledger's LRU pin; (4) the
 stream is observable — per-fit `_stream_stats`, the plan's `stream` fold,
 the `h2d_stream` phase bucket and the Prometheus counters; (5) GOSS is
 deterministic per seed, streams FEWER bytes than the unsampled fit, and
-rejects invalid configs. The oversubscribed whole-fit (matrix ≥10× the
-budget, resident watermark under budget) and the mesh-ineligibility pin
-run as ``slow`` (tier-1 budget is tight)."""
+rejects invalid configs; (6) the disk tier (round 19) — spill LRU ORDER
+via timeline events, evict-then-restore keeps the host watermark under
+budget, restores are bit-identical (also mid-read under an armed
+`persist.read` fault), spilled copies are kept, and a spilled fit is
+bit-identical to in-core across GBM early-stop × DRF × CV fold reuse,
+with `H2O3_TREE_OOC_DISK=0` pinning the host-only escape hatch. The
+oversubscribed whole-fit (matrix ≥10× the budget, resident watermark
+under budget) and the mesh-oversubscription pin run as ``slow`` (tier-1
+budget is tight)."""
 
 import os
 
@@ -33,7 +39,9 @@ from conftest import make_classification
 _ENV_KEYS = ("H2O3_TREE_OOC", "H2O3_STREAM_BLOCKS", "H2O3_STREAM_BUDGET_MB",
              "H2O3_TREE_SHARD", "H2O3_TREE_SHARD_BLOCKS", "H2O3_TREE_LEGACY",
              "H2O3_HIST_METHOD", "H2O3_HOST_HIST_MIN_ROWS",
-             "H2O3_MEM_BUDGET_MB", "H2O3_MEM_EVICT_PRESSURE")
+             "H2O3_MEM_BUDGET_MB", "H2O3_MEM_EVICT_PRESSURE",
+             "H2O3_STREAM_HOST_BUDGET_MB", "H2O3_TREE_OOC_DISK",
+             "H2O3_SPILL_DIR")
 
 # the streamed fit and its in-core comparator share S=4 — the reduction
 # tree is a function of S alone (PR 9), which is what makes the pair
@@ -42,6 +50,10 @@ _STREAM_ENV = {"H2O3_TREE_OOC": "1", "H2O3_STREAM_BLOCKS": "4",
                "H2O3_STREAM_BUDGET_MB": "0.02"}
 _INCORE_ENV = {"H2O3_TREE_OOC": "0", "H2O3_TREE_SHARD": "1",
                "H2O3_TREE_SHARD_BLOCKS": "4"}
+# the spilled fit adds a host-tier budget under the packed matrix size,
+# so blocks overflow through the disk tier too — same S=4 grid, so the
+# whole bit-exactness matrix above applies unchanged
+_SPILL_ENV = dict(_STREAM_ENV, H2O3_STREAM_HOST_BUDGET_MB="0.005")
 
 _X, _Y = make_classification(n=1500, f=8, seed=3)
 _NAMES = [f"f{i}" for i in range(8)] + ["label"]
@@ -207,6 +219,125 @@ def test_dataset_cache_sheds_device_blocks_first(cloud1, _ooc_env):
     dsc.clear()
 
 
+# -- BlockStore: disk tier (round 19) ----------------------------------------
+
+def _mk_spill_store(tmp_path, n_blocks=4, rows=64, F=4):
+    """Store whose 4-block host set overflows a 2-block host budget, with
+    spill files rooted in the test's tmp dir; returns pristine copies of
+    the blocks for restore bit-compares."""
+    os.environ["H2O3_SPILL_DIR"] = str(tmp_path)
+    rng = np.random.default_rng(0)
+    blocks = [rng.integers(0, 16, (rows, F)).astype(np.uint8)
+              for _ in range(n_blocks)]
+    ref = [b.copy() for b in blocks]
+    nb = blocks[0].nbytes
+    st = bslib.BlockStore(blocks, rows, 0, budget_bytes=2 * nb,
+                          host_budget_bytes=2 * nb, register=False)
+    return st, ref, nb
+
+
+def test_block_store_disk_spill_lru_order_and_restore_bitexact(
+        _ooc_env, tmp_path):
+    """Overflowing the host budget spills LRU-first (timeline-pinned
+    order), a restore is bit-identical, its spill file is KEPT, and the
+    restore makes room FIRST so the host watermark never exceeds the
+    budget — the evict-then-restore ordering lands in the timeline too."""
+    cur = Timeline.cursor()
+    st, ref, nb = _mk_spill_store(tmp_path)
+    try:
+        evs = [e for e in Timeline.snapshot(since=cur, n=1000)
+               if e["kind"] == "memory" and e.get("space") == "disk"
+               and e["owner"].startswith(st.owner)]
+        assert [e["owner"] for e in evs] == [f"{st.owner}:block0",
+                                             f"{st.owner}:block1"]
+        assert all(e["detail"].startswith("spill ") and e["bytes"] == nb
+                   and e["trigger"] == "host_cap" for e in evs)
+        assert st.counters["spilled"] == 2
+        assert st.host_bytes() == 2 * nb and st.disk_bytes() == 2 * nb
+        assert sorted(os.listdir(st._spill_dir)) == ["block0.bin",
+                                                     "block1.bin"]
+        # construction necessarily sees all blocks resident (they are
+        # passed in); the watermark contract starts at the fit's window
+        st.peak_window_start()
+        cur2 = Timeline.cursor()
+        got = st.fetch_host(0)
+        np.testing.assert_array_equal(got, ref[0])
+        assert st.counters["restored"] == 1
+        # spilled copies kept: the restored block's file is still there
+        assert os.path.exists(st._spill_path(0))
+        # evict-then-restore: the colder victim's spill event precedes
+        # the restore event, so residency never exceeded the budget
+        evs2 = [e for e in Timeline.snapshot(since=cur2, n=1000)
+                if e["kind"] == "memory" and e.get("space") == "disk"
+                and e["owner"].startswith(st.owner)]
+        assert [e["detail"].split()[0] for e in evs2] == ["spill",
+                                                          "restore"]
+        assert evs2[0]["owner"] == f"{st.owner}:block2"
+        assert evs2[1]["owner"] == f"{st.owner}:block0"
+        assert st.host_peak_window_bytes() <= st.host_budget_bytes()
+        # every spilled block restores bit-identically
+        for b in range(4):
+            np.testing.assert_array_equal(st.fetch_host(b), ref[b])
+        assert st.host_peak_window_bytes() <= st.host_budget_bytes()
+    finally:
+        st.close()
+    # close() removes the spill files and the per-store directory
+    assert not os.path.exists(st._spill_dir)
+
+
+def test_block_store_spill_read_fault_resumes_bitexact(_ooc_env, tmp_path):
+    """An armed `persist.read` fault mid-restore resumes under the shared
+    retry policy and the restored block is still bit-identical — the
+    Range-resume machinery is the same one the ingest path uses."""
+    from h2o3_tpu.runtime import faults
+
+    st, ref, nb = _mk_spill_store(tmp_path)
+    try:
+        faults.arm("persist.read", error="io", count=1)
+        try:
+            got = st.fetch_host(1)
+            fired = faults.snapshot()["points"][0]["fires"]
+        finally:
+            faults.reset()
+        assert fired == 1, "the armed fault never fired"
+        np.testing.assert_array_equal(got, ref[1])
+        assert st.counters["restored"] == 1
+    finally:
+        st.close()
+
+
+def test_spill_ledger_disk_space_and_leak_detection(_ooc_env, tmp_path):
+    """Spill bytes surface as `h2o3_memory_bytes{space="disk"}` under the
+    block_store kind; a store dropped WITHOUT close() leaves its dead
+    `:spill` owner still reporting filesystem bytes — a leak — which
+    clears when the files go away."""
+    import gc
+
+    from h2o3_tpu.runtime import metrics_registry as reg
+
+    st, ref, nb = _mk_spill_store(tmp_path)
+    owner = st.owner
+    sd = st._spill_dir
+    snap = ml.refresh(force=True)
+    bk = snap["by_kind"].get("block_store")
+    assert bk is not None and bk["disk_bytes"] >= 2 * nb
+    assert snap["totals"]["disk_bytes"] >= 2 * nb
+    text = reg.prometheus_text()
+    assert 'h2o3_memory_bytes{owner_kind="block_store",space="disk"}' \
+        in text
+    del st
+    gc.collect()
+    snap = ml.refresh(force=True)
+    leaks = [l for l in snap["leaks"] if l["owner"] == f"{owner}:spill"]
+    assert leaks and leaks[0]["reason"] == "referent_dead"
+    assert leaks[0]["bytes"] >= 2 * nb
+    for f in os.listdir(sd):
+        os.remove(os.path.join(sd, f))
+    os.rmdir(sd)
+    snap = ml.refresh(force=True)
+    assert not any(l["owner"] == f"{owner}:spill" for l in snap["leaks"])
+
+
 # -- the bit-exactness matrix ------------------------------------------------
 
 def test_streamed_gbm_early_stop_bitexact_vs_incore(cloud1, _ooc_env):
@@ -279,6 +410,95 @@ def test_ooc_auto_streams_only_when_oversubscribed(cloud1, _ooc_env):
     assert small.model._stream_stats["blocks_uploaded"] > 0
     big = _fit({"H2O3_STREAM_BUDGET_MB": "100"}, ntrees=2, max_depth=3)
     assert not hasattr(big.model, "_stream_stats")
+
+
+# -- disk tier: spilled fits (round 19) --------------------------------------
+
+def _assert_spilled_under_budget(st):
+    """The fit genuinely crossed the disk tier AND its host-resident
+    watermark stayed under the effective host budget (configured value,
+    floored at the 2-block disk double buffer)."""
+    assert st["spilled_blocks"] > 0 and st["restored_blocks"] > 0
+    per_block = st["spilled_bytes"] // max(st["spilled_blocks"], 1)
+    budget = max(int(0.005 * 1e6), 2 * per_block)
+    assert st["resident_host_peak"] <= budget, \
+        f"host watermark {st['resident_host_peak']} over budget {budget}"
+
+
+def test_spilled_gbm_early_stop_bitexact_vs_incore(cloud1, _ooc_env):
+    """A fit overflowing BOTH the device and host budgets (blocks live on
+    disk mid-fit) is bit-identical to the in-core fit sharing S — forest,
+    varimp, scoring history, early-stop tree count."""
+    params = dict(ntrees=10, max_depth=3, learn_rate=0.3,
+                  score_tree_interval=2, stopping_rounds=2,
+                  stopping_tolerance=0.5)
+    a = _fit(dict(_SPILL_ENV), **params)
+    st = a.model._stream_stats
+    _assert_spilled_under_budget(st)
+    assert st["disk_bytes"] > 0
+    assert a.model.ntrees_built < 10, "early stop never fired"
+    b = _fit(dict(_INCORE_ENV), **params)
+    _assert_bitexact(a, b)
+    ha = [e.get("logloss") for e in a.model.scoring_history]
+    hb = [e.get("logloss") for e in b.model.scoring_history]
+    assert ha == hb
+
+
+def test_spilled_drf_bitexact_vs_incore(cloud1, _ooc_env):
+    """DRF (row sampling + mtries + OOB) through the disk tier streams
+    bit-identically."""
+    params = dict(ntrees=5, max_depth=3, sample_rate=0.7, mtries=3)
+    a = _fit(dict(_SPILL_ENV), mode="drf", **params)
+    _assert_spilled_under_budget(a.model._stream_stats)
+    _assert_bitexact(a, _fit(dict(_INCORE_ENV), mode="drf", **params))
+
+
+def test_spilled_cv_fold_reuse_bitexact(cloud1, _ooc_env):
+    """CV fold reuse composes with the disk tier: fold fits share the
+    spilled block grid and the cross-validated parent stays
+    bit-identical."""
+    params = dict(ntrees=4, max_depth=3, nfolds=2)
+    a = _fit(dict(_SPILL_ENV), **params)
+    st = a.model._stream_stats
+    assert st["restored_blocks"] > 0 and st["disk_bytes"] > 0
+    b = _fit(dict(_INCORE_ENV), **params)
+    _assert_bitexact(a, b)
+    ma, mb = a.model.cross_validation_metrics, b.model.cross_validation_metrics
+    assert ma is not None and mb is not None
+    np.testing.assert_array_equal(ma.logloss(), mb.logloss())
+
+
+def test_disk_tier_escape_hatch_streams_without_spilling(cloud1, _ooc_env):
+    """H2O3_TREE_OOC_DISK=0 under a tiny host budget keeps the two-tier
+    behaviour: the fit still streams, writes NOTHING to disk, and is
+    bit-identical to the spilled fit (same S)."""
+    params = dict(ntrees=4, max_depth=3)
+    a = _fit(dict(_SPILL_ENV, H2O3_TREE_OOC_DISK="0"), **params)
+    st = a.model._stream_stats
+    assert st["blocks_uploaded"] > 0
+    assert st["spilled_blocks"] == 0 and st["disk_bytes"] == 0
+    b = _fit(dict(_SPILL_ENV), **params)
+    assert b.model._stream_stats["spilled_blocks"] > 0
+    _assert_bitexact(a, b)
+
+
+def test_spilled_fit_survives_midstream_read_fault(cloud1, _ooc_env):
+    """An armed `persist.read` fault mid-fit (a torn spill read) resumes
+    under the retry policy and the fit is STILL bit-identical — fault
+    recovery never changes bits."""
+    from h2o3_tpu.runtime import faults
+
+    params = dict(ntrees=3, max_depth=3)
+    b = _fit(dict(_SPILL_ENV), **params)
+    faults.arm("persist.read", error="io", count=1)
+    try:
+        a = _fit(dict(_SPILL_ENV), **params)
+        fired = faults.snapshot()["points"][0]["fires"]
+    finally:
+        faults.reset()
+    assert fired == 1, "the armed fault never fired"
+    assert a.model._stream_stats["restored_blocks"] > 0
+    _assert_bitexact(a, b)
 
 
 # -- observability -----------------------------------------------------------
@@ -414,27 +634,31 @@ def test_oversubscribed_whole_fit_stays_under_budget(cloud1, _ooc_env):
     assert st["resident_block_peak"] <= budget
     assert st["blocks_evicted"] > 0
     assert float(est.auc()) > 0.75
-    # streamed vs in-core bit-exactness at this scale rides the segment
-    # kernel (H2O3_HOST_HIST_MIN_ROWS high keeps the in-core comparator
-    # off the known pure_callback warm-thread hang — docs/perf.md)
+    # streamed vs in-core bit-exactness at this scale: the in-core
+    # comparator only picks the host np.add.at kernel when a spare core
+    # can service the callback (`host_callback_safe` — the 1-core
+    # in-graph-callback deadlock this test used to dodge with a raised
+    # MIN_ROWS is now gated out at method selection), and host and
+    # segment are pinned bit-equal, so the pair compares on any host
     params = dict(ntrees=3, max_depth=4)
-    env_a = {"H2O3_TREE_OOC": "1", "H2O3_STREAM_BUDGET_MB": "0.015",
-             "H2O3_HOST_HIST_MIN_ROWS": "1000000"}
-    env_b = dict(_INCORE_ENV, H2O3_HOST_HIST_MIN_ROWS="1000000",
-                 H2O3_TREE_SHARD_BLOCKS=str(st["blocks"]))
+    env_a = {"H2O3_TREE_OOC": "1", "H2O3_STREAM_BUDGET_MB": "0.015"}
+    env_b = dict(_INCORE_ENV, H2O3_TREE_SHARD_BLOCKS=str(st["blocks"]))
     a = _fit(env_a, X=X, y=y, names=names, **params)
     b = _fit(env_b, X=X, y=y, names=names, **params)
     _assert_bitexact(a, b)
 
 
 @pytest.mark.slow
-def test_mesh_sharded_fit_is_ooc_ineligible(cloud8, _ooc_env):
-    """A mesh-sharded fit ignores H2O3_TREE_OOC=1 (its rows already live
-    across devices): no stream stats, bit-identical to the same mesh fit
-    without the env — the '2-device shard' cell of the matrix."""
+def test_mesh_sharded_fit_streams_when_oversubscribed(cloud8, _ooc_env):
+    """Round 19 closes PR 11's gap: a mesh-sharded fit under a tiny
+    budget is OOC-ELIGIBLE now — it converts to single-device streaming
+    over a block grid matching the mesh shard count (S=8), so the
+    streamed forest is bit-identical to the plain mesh fit."""
     params = dict(ntrees=3, max_depth=3)
-    a = _fit({"H2O3_TREE_OOC": "1", "H2O3_STREAM_BUDGET_MB": "0.001"},
-             **params)
-    assert not hasattr(a.model, "_stream_stats")
+    a = _fit({"H2O3_TREE_OOC": "1", "H2O3_STREAM_BUDGET_MB": "0.001",
+              "H2O3_STREAM_BLOCKS": "8"}, **params)
+    st = getattr(a.model, "_stream_stats", None)
+    assert st is not None, "oversubscribed mesh fit did not stream"
+    assert st["blocks"] == 8 and st["blocks_uploaded"] > 0
     b = _fit({}, **params)
     _assert_bitexact(a, b)
