@@ -61,6 +61,20 @@ def _n_devices() -> int:
     return int(_RUN_STATE.get("n_devices") or 1)
 
 
+def _note_ranks():
+    """`n_ranks` bench axis (ISSUE 18): process count of the pod this fit
+    spanned, None (dropped from the record) on single-process clouds —
+    a pod record is distinguishable from an N-virtual-device one."""
+    try:
+        import jax
+
+        nr = int(jax.process_count())
+    except Exception:
+        nr = 1
+    _RUN_STATE["n_ranks"] = nr
+    return nr if nr > 1 else None
+
+
 def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 0):
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
@@ -104,6 +118,7 @@ def bench_gbm():
     return (f"higgs_gbm_{n_rows//1000}k_{ntrees}trees_wall_s", wall,
             {"auc": round(float(gbm.auc()), 5),
              "n_devices": _note_devices(),
+             "n_ranks": _note_ranks(),
              "collective_skew_ms": _skew_embed(lane_seq0),
              "hist_updates_per_s": round(updates / comp),
              "hist_stream_gbps": round(updates / comp / 1e9, 3),
@@ -355,6 +370,7 @@ def bench_oversubscription():
     wall_stream, m_stream = run({"H2O3_TREE_OOC": "1",
                                  "H2O3_STREAM_BUDGET_MB": budget})
     st = getattr(m_stream.model, "_stream_stats", {}) or {}
+    blocks = str(st.get("blocks", 8))
     # in-core comparator shares the streamed fit's block grid so the two
     # walls bracket the same bit-identical computation. Warm thread stays
     # ON (round 19): the old H2O3_WARM_THREAD=0 here worked around the
@@ -1377,6 +1393,22 @@ def _lane_waits_embed():
         return None
 
 
+def _hang_report_embed():
+    """Multi-process hang attribution (ISSUE 18): the cached lane→rank
+    topology plus the open fence's missing lanes name the suspect RANK of
+    a hung pod collective — host dicts only, watchdog-thread safe. None
+    on single-process clouds (the lane waits embed already covers those)."""
+    try:
+        from h2o3_tpu.parallel import mesh as _mesh
+
+        rep = _mesh.lane_hang_report()
+        if rep and rep.get("n_ranks", 1) > 1:
+            return rep
+    except Exception:
+        pass
+    return None
+
+
 def _memory_embed() -> dict:
     """Memory trajectory every emitted record carries (ISSUE 8): process
     peak RSS, the ledger's device high watermark, and the top-3 owners
@@ -1432,6 +1464,11 @@ def _fail_line(config: str, why: str) -> dict:
         # everyone was waiting on is the one with the largest wait here
         # (or the one missing from the dict entirely)
         line["lane_waits_ms"] = lw
+    hr = _hang_report_embed()
+    if hr:
+        # pod runs: name the suspect RANK, not just the lane — the driver
+        # reads `ranks.suspect_ranks` straight off the fail line
+        line["ranks"] = hr
     xla = _observability_embed()
     if xla:
         line["xla"] = xla
@@ -1604,6 +1641,9 @@ def main():
                 lw = _lane_waits_embed()
                 if lw:
                     line["lane_waits_ms"] = lw
+                hr = _hang_report_embed()
+                if hr:
+                    line["ranks"] = hr
                 _emit(line)
             else:
                 _emit(_fail_line(config,

@@ -259,6 +259,72 @@ def _fleet_delta_report(before: Optional[Dict], after: Optional[Dict],
     return out
 
 
+def _peer_skew(base_url: str, timeout_s: float = 10.0) -> Optional[Dict]:
+    """One rank's collective-skew digest from its lossless registry
+    export (`h2o3_collective_skew_ms`): every tag's series merged over
+    the shared bounds, p50 via the same bucket interpolation as the
+    registry, max from the exact per-series max. None when the rank
+    recorded no instrumented fences (or is unreachable)."""
+    url = base_url.rstrip("/") + "/3/Metrics?format=json"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            doc = json.loads(r.read().decode())
+    except Exception:
+        return None
+    fam = doc.get("h2o3_collective_skew_ms")
+    if not fam:
+        return None
+    h = _BucketHist(fam.get("bounds") or LATENCY_MS_BOUNDS)
+    for s in fam.get("series") or ():
+        for i, c in enumerate(list(s.get("counts") or ())[: len(h.counts)]):
+            h.counts[i] += int(c)
+        h.n += int(s.get("n") or 0)
+        for fld, pick in (("min", min), ("max", max)):
+            v = s.get(fld)
+            if v is not None:
+                cur = getattr(h, f"v{fld}")
+                setattr(h, f"v{fld}", v if cur is None else pick(cur, v))
+    if not h.n:
+        return None
+    return dict(fences=h.n, skew_p50_ms=h.percentile(0.50),
+                skew_max_ms=h.vmax)
+
+
+def ranks_summary(host: str, port: int,
+                  timeout_s: float = 10.0) -> Optional[List[Dict]]:
+    """Pod-rank fold of the --fleet report (ISSUE 18): one row per
+    launcher-registered ``rank<N>`` peer — liveness (peer_up) plus that
+    rank's own collective-skew p50/max scraped from its registry export.
+    The aggregator itself is rank 0 (the launcher registers every OTHER
+    rank against it). None when no rank peers exist — single-process
+    fleets keep their old report shape."""
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}/3/Fleet",
+                                    timeout=timeout_s) as r:
+            doc = json.loads(r.read().decode())
+    except Exception:
+        return None
+    rows = doc.get("peers") or []
+    if not any(str(p.get("name", "")).startswith("rank")
+               and not p.get("is_self") for p in rows):
+        return None
+    out = []
+    for p in rows:
+        name = str(p.get("name", ""))
+        if not (name.startswith("rank") or p.get("is_self")):
+            continue   # serving replicas: already in the fleet section
+        row = dict(name=("rank0" if p.get("is_self") and
+                         not name.startswith("rank") else name),
+                   peer_up=1 if p.get("up") else 0)
+        base = p.get("url") or f"http://{host}:{port}"
+        if row["peer_up"]:
+            skew = _peer_skew(base, timeout_s)
+            if skew:
+                row.update(skew)
+        out.append(row)
+    return out or None
+
+
 def router_summary(host: str, port: int,
                    timeout_s: float = 10.0) -> Optional[Dict]:
     """Router fold of a fleet-router target (`GET /3/Router?probe=0`,
@@ -591,6 +657,9 @@ def main() -> int:
         stats["fleet"] = _fleet_delta_report(
             fleet_before, fleet_summary(args.host, args.port),
             stats.get("wall_s") or 0.0)
+        rk = ranks_summary(args.host, args.port)
+        if rk:
+            stats["ranks"] = rk
     if args.router:
         offered = stats.get("offered") or (
             stats.get("completed", 0) + stats.get("shed_429", 0)

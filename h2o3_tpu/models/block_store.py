@@ -316,7 +316,16 @@ class BlockStore:
         if self._spill_dir is None:
             base = os.environ.get("H2O3_SPILL_DIR") or tempfile.gettempdir()
             safe = self.owner.replace(":", "_").replace("/", "_")
-            d = os.path.join(base, f"h2o3_spill_{os.getpid()}_{safe}")
+            # rank-unique (ISSUE 18): pod ranks on different hosts can
+            # share H2O3_SPILL_DIR (NFS) and pids collide across hosts
+            try:
+                import jax
+
+                rank = int(jax.process_index())
+            except Exception:
+                rank = 0
+            d = os.path.join(base,
+                             f"h2o3_spill_r{rank}_{os.getpid()}_{safe}")
             os.makedirs(d, exist_ok=True)
             self._spill_dir = d
         if not self._spill_registered:

@@ -16,7 +16,11 @@ This module is the sweep-level analog: a fingerprinted multi-layer cache
   packed code matrix, so repeat candidates skip the pack + upload
   entirely. On a single-process multi-device cloud the artifact is the
   row-sharded jax.Array itself (per-shard placement reused across the
-  sweep, ISSUE 12); only multi-PROCESS global arrays are rebuilt per fit.
+  sweep, ISSUE 12). Multi-process POD fits cache their global row-sharded
+  array too (ISSUE 18): the canonical row exchange runs eagerly inside the
+  fit, so the cached builder is collective-free (a per-rank hit/miss
+  divergence can never strand a rank in a collective) and each rank's
+  entry accounts only its local shards' bytes.
 - **std**: + a caller-supplied standardization key (standardize /
   use_all_factor_levels / impute / intercept / pad grid, see
   `models/estimator_engine.py`) → the standardized float design matrix
@@ -114,7 +118,7 @@ class _Entry:
         for bm in self.bins.values():
             total += int(bm.codes.nbytes)
         for arr in self.device.values():
-            total += int(np.prod(arr.shape)) * arr.dtype.itemsize
+            total += _arr_nbytes(arr)
         for st in self.blocks.values():
             total += int(st.nbytes_total())
         for art in self.std.values():
@@ -123,6 +127,18 @@ class _Entry:
 
 
 _LAYERS = ("matrix", "bins", "device", "blocks", "std")
+
+
+def _arr_nbytes(arr) -> int:
+    """Per-PROCESS resident bytes of a cached device artifact: a pod fit's
+    global row-sharded array holds only this rank's shards locally, and the
+    per-rank ledger/caps must see that 1/N footprint (ISSUE 18)."""
+    try:
+        if getattr(arr, "is_fully_addressable", True) is False:
+            return sum(int(s.data.nbytes) for s in arr.addressable_shards)
+    except Exception:
+        pass
+    return int(np.prod(arr.shape)) * arr.dtype.itemsize
 
 
 def _register_ledger(e: "_Entry", frame) -> None:
@@ -340,8 +356,7 @@ def device_codes(frame, x, nbins: int, histogram_type: str, seed, npad: int,
         with _LOCK:   # see matrix(): publish vs nbytes()/snapshot() races
             e.device[dkey] = arr
         _memory.record_event(
-            "alloc", f"{e.owner_base}:device",
-            int(np.prod(arr.shape)) * arr.dtype.itemsize,
+            "alloc", f"{e.owner_base}:device", _arr_nbytes(arr),
             trigger="miss", kind="dataset_cache", space="device")
     with _LOCK:
         _evict_locked(keep=e.key)

@@ -62,16 +62,21 @@ def shard_plan(ndev: int, multiproc: bool) -> Tuple[str, int]:
     """(shard_mode, n_shards) for one estimator fit — the ONE place the
     decision is made (mirrors `shared_tree._shard_plan`).
 
-    "mesh": multi-device single-process cloud — S ordered blocks spread
-    over the lanes, merged by `ordered_axis_fold`. "blocks": 1 device,
+    "mesh": multi-device cloud — S ordered blocks spread over the lanes,
+    merged by `ordered_axis_fold`. "blocks": 1 device,
     ``H2O3_EST_SHARD=1`` — the same S-block structure forced on one chip
     (the bit-identity comparator lane). "off": plain full-row reductions
-    (1 device default — bit-exact with the pre-engine math). Multi-process
-    clouds and the legacy comparator always report "off": their fits take
-    the pre-engine paths."""
+    (1 device default — bit-exact with the pre-engine math). The legacy
+    comparator always reports "off". Multi-process POD clouds (ISSUE 18)
+    report "mesh" like any multi-device cloud — the caller decides whether
+    its fit supports the pod lane (GLM does; estimators that keep the
+    pre-engine multi-process paths gate on their own `engine_on`)."""
     env = os.environ.get("H2O3_EST_SHARD", "").strip()
-    if multiproc or legacy() or env == "0":
+    if legacy() or env == "0":
         return "off", 0
+    if multiproc:
+        base = shard_blocks()
+        return "mesh", base * ndev // math.gcd(base, ndev)
     base = shard_blocks()
     if ndev > 1:
         return "mesh", base * ndev // math.gcd(base, ndev)

@@ -636,19 +636,30 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
 
         cloud = cloudlib.cloud()
         multiproc = distdata.multiprocess()
-        # -- estimator-engine dispatch (ISSUE 15) -----------------------------
+        # -- estimator-engine dispatch (ISSUE 15 / ISSUE 18) ------------------
         # engine on: cached standardized design (one upload per sweep) +
         # fused whole-fit IRLS; gated off for the exotic corners — legacy
-        # comparator, multi-process clouds (their data lives elsewhere),
-        # and the mesh path for multinomial / degenerate row counts.
+        # comparator and the mesh path for multinomial / degenerate row
+        # counts. Multi-process clouds run the pod mesh lane (ISSUE 18:
+        # canonical global layout, blocked Gram fold over the pod mesh)
+        # for plain single-λ fits; lambda_search and multinomial keep the
+        # pre-engine multi-process paths.
         engine_on = not _est.legacy() and not multiproc
         shard_mode, n_shards = (_est.shard_plan(cloud.size, multiproc)
-                                if engine_on else ("off", 0))
-        if shard_mode == "mesh" and (n < cloud.size
-                                     or family == "multinomial"):
+                                if (engine_on or multiproc) else ("off", 0))
+        n_glob = n
+        if multiproc:
+            n_glob = int(getattr(train, "dist").global_nrow
+                         if getattr(train, "dist", None) else
+                         distdata.global_sum(np.asarray([n]))[0])
+        if shard_mode == "mesh" and (n_glob < cloud.size
+                                     or family == "multinomial"
+                                     or (multiproc and lambda_search)):
             shard_mode, n_shards = "off", 0
+        pod = multiproc and shard_mode == "mesh"
         use_cached_design = engine_on and (cloud.size == 1
                                            or shard_mode == "mesh")
+        y_host_fit, w_host_fit = yarr, w
         cache0 = None
         if use_cached_design:
             from . import dataset_cache as _dc
@@ -665,14 +676,40 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             X = dinfo.fit_transform(train)      # standardization stats are
             #                                     global (DataInfo collective)
             Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)
-            quota = distdata.local_quota(n)
-            Xd = distdata.global_row_array(Xi.astype(np.float32), quota, cloud)
-            yd = distdata.global_row_array(
-                np.asarray(yarr, np.float32), quota, cloud)
-            wd = distdata.global_row_array(w, quota, cloud)
-            n = int(getattr(train, "dist").global_nrow
-                    if getattr(train, "dist", None) else
-                    distdata.global_sum(np.asarray([n]))[0])
+            y_f32 = np.asarray(yarr, np.float32)
+            if pod:
+                # ISSUE 18 pod lane: relayout the ingest shards onto the
+                # CANONICAL padded grid the 1-device forced-shard
+                # comparator uses (pad_rows(n_global, S), all pad at the
+                # global tail), so the blocked Gram fold groups identical
+                # f32 partials in the identical order — bit-identical β.
+                # Rows move only at slice boundaries (exchange_rows); no
+                # rank ever materializes the global design matrix.
+                _counts = distdata.row_counts(n)
+                npad = _est.pad_rows(n_glob, n_shards)
+                quota = npad // jax.process_count()
+                Xd = distdata.global_row_array(
+                    distdata.to_canonical(Xi.astype(np.float32), npad,
+                                          counts=_counts), quota, cloud)
+                yd = distdata.global_row_array(
+                    distdata.to_canonical(y_f32, npad, counts=_counts),
+                    quota, cloud)
+                wd = distdata.global_row_array(
+                    distdata.to_canonical(w, npad, counts=_counts),
+                    quota, cloud)
+                # exact global response/weight columns (rank order =
+                # global ingest order) for the host f64 β₀ init sums — a
+                # psum of per-rank partials would not be bitwise the
+                # comparator's single np.sum
+                y_host_fit = distdata.allgather_rows(y_f32)
+                w_host_fit = distdata.allgather_rows(w)
+            else:
+                quota = distdata.local_quota(n)
+                Xd = distdata.global_row_array(
+                    Xi.astype(np.float32), quota, cloud)
+                yd = distdata.global_row_array(y_f32, quota, cloud)
+                wd = distdata.global_row_array(w, quota, cloud)
+            n = n_glob
         elif use_cached_design:
             ndev_eff = cloud.size if shard_mode == "mesh" else 1
             dinfo, Xd = _est.design_matrix(
@@ -763,11 +800,11 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 )
             else:
                 lam_v = float(lam[0] if isinstance(lam, (list, tuple)) else (lam or 0.0))
-                if engine_on:
+                if engine_on or pod:
                     beta = self._irls_fused(
                         Xd, yd, wd, family, lam_v, alpha, max_iter,
                         beta_eps, tweedie_p, cloud, shard_mode, n_shards,
-                        fitplan, y_host=yarr, w_host=w)
+                        fitplan, y_host=y_host_fit, w_host=w_host_fit)
                 else:
                     beta = self._irls(Xd, yd, wd, family, lam_v, alpha, max_iter, beta_eps, tweedie_p)
                 lam_best = lam_v

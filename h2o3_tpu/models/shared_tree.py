@@ -416,7 +416,8 @@ class _StepCfg(NamedTuple):
     #                 (H2O3_TREE_SHARD=1: the forced-CPU lane that is
     #                 bit-identical to any mesh fit sharing n_shards)
     #   "mesh_psum" — the pre-ISSUE-12 shard_map + psum path, kept for the
-    #                 legacy comparator, lossguide, and multi-process fits
+    #                 legacy comparator and lossguide growth (multi-process
+    #                 fits run "mesh" since ISSUE 18's pod lane)
     shard_mode: str = "off"
     n_shards: int = 0                # canonical total block count (S)
 
@@ -489,19 +490,26 @@ def _shard_plan(ndev: int, multiproc: bool, tp) -> tuple:
     holds a whole number of blocks — fits on 1/2/4/8 devices all share
     S=8 and are mutually bit-stable.
 
-    Legacy comparator, lossguide growth and multi-process clouds keep the
-    pre-ISSUE-12 shard_map + psum path ("mesh_psum"). The escape hatch
-    overrides legacy/lossguide too (a broken mesh must not run THEIR
-    collectives either); only multi-process clouds ignore it — their data
-    lives on other processes, so "train on one device" is not available."""
+    Multi-process pod clouds (ISSUE 18) run the SAME deterministic "mesh"
+    path over the global mesh: the canonical row layout (_fit's pod branch)
+    keeps all real rows contiguous in global ingest order with the pad at
+    the tail, so the S ordered block partials are the same sums a 1-device
+    forced-shard fit computes and an N-process fit is bit-identical to it.
+    Legacy comparator and lossguide growth keep the pre-ISSUE-12 shard_map
+    + psum path ("mesh_psum") — on pods too. H2O3_TREE_SHARD=0 demotes a
+    pod to mesh_psum rather than "off" (the data lives on other processes,
+    so "train on one device" is not available there)."""
     import math
 
     env = os.environ.get("H2O3_TREE_SHARD", "").strip()
+    legacy_lane = (tree_legacy()
+                   or tp.get("grow_policy", "depthwise") == "lossguide")
     if multiproc:
-        return ("mesh_psum" if ndev > 1 else "off"), 0
-    if env == "0":
+        if env == "0" or legacy_lane:
+            return ("mesh_psum" if ndev > 1 else "off"), 0
+    elif env == "0":
         return "off", 0
-    if tree_legacy() or tp.get("grow_policy", "depthwise") == "lossguide":
+    elif legacy_lane:
         return ("mesh_psum" if ndev > 1 else "off"), 0
     base = max(int(os.environ.get("H2O3_TREE_SHARD_BLOCKS", "8") or 8), 1)
     if ndev > 1:
@@ -1571,7 +1579,22 @@ class H2OSharedTreeEstimator(H2OEstimator):
         from . import dataset_cache as _dsc
 
         multiproc = distdata.multiprocess()
-        use_cache = (cvr is None and not multiproc and _dsc.enabled())
+        cloud = cloudlib.cloud()
+        ndev = cloud.size
+        # ISSUE 12 / ISSUE 18: the ONE sharding decision for this fit,
+        # taken up-front because the pod lane (deterministic multi-process
+        # SPMD) changes the data layout and cache eligibility below. On a
+        # pod the rows live in the CANONICAL global layout and every
+        # reduction folds the global block order, so the fit is
+        # bit-identical to the 1-device forced-shard fit sharing S.
+        shard_mode, n_shards = _shard_plan(ndev, multiproc, tp)
+        pod = multiproc and shard_mode == "mesh"
+        # pod fits reuse the dataset cache: their builders are
+        # collective-free (the canonical row exchange runs EAGERLY every
+        # fit, before any builder, so a cache hit/miss divergence across
+        # ranks can never strand one rank inside a collective)
+        use_cache = (cvr is None and (pod or not multiproc)
+                     and _dsc.enabled())
         if cvr is not None:
             pbm, cv_rows = cvr["bm"], np.asarray(cvr["rows"])
             X = None
@@ -1737,18 +1760,29 @@ class H2OSharedTreeEstimator(H2OEstimator):
             yk[np.arange(n), codes] = 1.0
 
         # initial margins (global moments on a multi-host cloud)
-        if multiproc:
+        if multiproc and not pod:
             sw = float(distdata.global_sum(np.asarray([w.sum()]))[0])
             swy = distdata.global_sum((yk * w[:, None]).sum(axis=0))
+        elif pod and self._mode != "drf" \
+                and getattr(self, "_objective_fn", None) is None:
+            # pod determinism: f0 must match the 1-device comparator's
+            # host computation BITWISE, and a sum of per-rank partials
+            # does not (numpy's pairwise reduction groups differently).
+            # The response/weight columns are small — gather them exactly
+            # (byte transport, rank order = global ingest order) and run
+            # the single-process formulas on the global vectors.
+            yk_g = distdata.allgather_rows(yk)
+            w_g = distdata.allgather_rows(w)
         if self._mode == "drf":
             f0 = np.zeros(K, np.float32)
         elif problem == "multinomial":
-            pri = (swy / max(sw, 1e-12) if multiproc
+            pri = (np.average(yk_g, axis=0, weights=w_g) if pod
+                   else swy / max(sw, 1e-12) if multiproc
                    else np.average(yk, axis=0, weights=w))
             f0 = np.log(np.clip(pri, 1e-10, 1.0)).astype(np.float32)
         elif getattr(self, "_objective_fn", None) is not None:
             f0 = np.zeros(1, np.float32)  # custom objectives start at 0 margin
-        elif multiproc and dist in ("quantile", "laplace"):
+        elif multiproc and not pod and dist in ("quantile", "laplace"):
             # order-statistic inits need GLOBAL quantiles of the response
             alpha = (float(self._parms.get("quantile_alpha", 0.5))
                      if dist == "quantile" else 0.5)
@@ -1756,28 +1790,36 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 distdata.global_quantiles(yk[:, 0], [alpha])[0])])
         else:
             f0 = np.float32(dist_mod.init_margin(
-                dist, yk[:, 0], w,
-                mu=(float(swy[0]) / max(sw, 1e-12)) if multiproc else None,
+                dist, yk_g[:, 0] if pod else yk[:, 0],
+                w_g if pod else w,
+                mu=(float(swy[0]) / max(sw, 1e-12))
+                if (multiproc and not pod) else None,
                 alpha=float(self._parms.get("quantile_alpha", 0.5))))
             f0 = np.asarray([f0])
 
-        cloud = cloudlib.cloud()
-        ndev = cloud.size
-        # ISSUE 12: the ONE sharding decision for this fit. `ndev_eff` is
-        # the device count the data will actually span — 1 under the
-        # H2O3_TREE_SHARD=0 escape hatch even on a mesh (everything lands
-        # on the default device, exactly the 1-device code path).
-        shard_mode, n_shards = _shard_plan(ndev, multiproc, tp)
+        # `ndev_eff` is the device count the data will actually span — 1
+        # under the H2O3_TREE_SHARD=0 escape hatch even on a mesh
+        # (everything lands on the default device, exactly the 1-device
+        # code path).
         ndev_eff = ndev if shard_mode in ("mesh", "mesh_psum") else 1
         # every mesh shard AND every deterministic reduction block must be
         # an equal, 8-row-aligned slice (pack groups divide 8)
         row_mult = max(ndev_eff * 8, n_shards * 8, 8)
-        if multiproc:
+        if multiproc and not pod:
             quota = distdata.local_quota(n)
             npad = quota * jax.process_count()
             pad = quota - n          # LOCAL padding (zero-weight rows)
         else:
-            npad = cloudlib.pad_to_multiple(n, row_mult)
+            n_layout = n
+            if pod:
+                # pod canonical layout (ISSUE 18): the padded GLOBAL shape
+                # comes from the SAME formula the 1-device comparator runs
+                # on the same global row count — identical npad and block
+                # grid are two legs of the bit-identity argument (the
+                # third is the canonical row order, parallel/distdata.py)
+                _counts = distdata.row_counts(n)
+                n_layout = int(_counts.sum())
+            npad = cloudlib.pad_to_multiple(n_layout, row_mult)
             # row-count bucketing (the ntrees-bucketing trick, applied to
             # rows): CV folds and near-same-size frames land on a shared
             # padded shape, so they reuse ONE compiled tree program instead
@@ -1798,12 +1840,34 @@ class H2OSharedTreeEstimator(H2OEstimator):
             floor = int(self._parms.get("_npad_floor") or 0)
             if floor > npad and floor % row_mult == 0:
                 npad = floor
-            pad = npad - n
+            pad = npad - n_layout
+            if pod:
+                # equal per-rank slice of the canonical layout. row_mult is
+                # a multiple of ndev·8 on the pod lane and the process
+                # count divides the device count, so the slice is 8-aligned
+                # (pack groups and local device shards both divide it).
+                quota = npad // jax.process_count()
+                pad = quota - int(distdata.canonical_counts(
+                    _counts, npad)[jax.process_index()])
 
         def padr(a, fill=0):
+            if pod:
+                # canonical relayout: rows move to the global-order slice
+                # this rank owns (a COLLECTIVE — call sites run it eagerly,
+                # never inside a dataset-cache builder)
+                return distdata.to_canonical(a, npad, counts=_counts,
+                                             fill=fill)
             if a.ndim == 1:
                 return np.concatenate([a, np.full(pad, fill, a.dtype)])
             return np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+
+        def unpadr(a):
+            """Inverse of padr for metric read-back: this rank's REAL rows
+            in INGEST order (pod slices hold canonical-order rows that must
+            pair with the local frame's response)."""
+            if pod:
+                return distdata.from_canonical(np.asarray(a), npad, _counts)
+            return np.asarray(a)[:n]
 
         _ph.mark("build_bins")
 
@@ -1817,8 +1881,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # `predict_codes` against the resident matrix (DART dropout,
         # checkpoint fast-forward) and the lossguide builder keep full
         # width; H2O3_TREE_LEGACY=1 restores the seed unpack-once path.
+        # Pod fits keep the packed-resident win: quota is 8-aligned, so
+        # packing this rank's canonical slice equals slicing the packed
+        # global matrix — same bytes the 1-device comparator holds.
         resident_bits = 0
-        if (not tree_legacy() and not multiproc
+        if (not tree_legacy() and (pod or not multiproc)
                 and self._parms.get("checkpoint") is None
                 and not tp.get("dart")
                 and tp.get("grow_policy", "depthwise") != "lossguide"
@@ -1958,7 +2025,37 @@ class H2OSharedTreeEstimator(H2OEstimator):
         if multiproc:
             # each process supplies its ingest shard of the global arrays,
             # homed on its own devices (the DKV chunk-home placement)
-            codes_d = distdata.global_row_array(padr(bm.codes), quota, cloud)
+            if pod:
+                from ..runtime import phases as _phases_mod
+
+                # the relayout collective runs EAGERLY; the cache builder
+                # below only packs + assembles the global array
+                # (make_array_from_process_local_data is metadata-only),
+                # so a per-rank cache hit/miss divergence is harmless.
+                # No rank ever materializes the global matrix: per-host
+                # pack + H2D is the 1/N canonical slice.
+                codes_canon = padr(bm.codes)
+
+                def _build_codes_pod():
+                    if resident_bits:
+                        packed = _pack_host(codes_canon, resident_bits)
+                        _phases_mod.add("h2d", 0.0, packed.nbytes)
+                        return distdata.global_row_array(
+                            packed, quota * resident_bits // 8, cloud)
+                    _phases_mod.add("h2d", 0.0, codes_canon.nbytes)
+                    return distdata.global_row_array(codes_canon, quota,
+                                                     cloud)
+
+                if use_cache:
+                    codes_d = _dsc.device_codes(
+                        train, x, nbins, tp["histogram_type"], seed, npad,
+                        builder=_build_codes_pod, pack_bits=resident_bits,
+                        n_devices=ndev_eff)
+                else:
+                    codes_d = _build_codes_pod()
+            else:
+                codes_d = distdata.global_row_array(padr(bm.codes), quota,
+                                                    cloud)
             y_d = distdata.global_row_array(
                 padr(yk).astype(np.float32), quota, cloud)
             w_d = distdata.global_row_array(padr(w), quota, cloud)
@@ -2083,8 +2180,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # real-row mask for device-side event metrics (pads excluded); on a
         # multi-process cloud it is global, so event sums come back global
         if multiproc:
-            row_mask_d = distdata.global_row_array(
-                np.ones(n, np.float32), quota, cloud)
+            if pod:
+                # canonical pad lives at the GLOBAL tail, so no exchange:
+                # a slice is real up to its canonical row count
+                cc_r = int(distdata.canonical_counts(
+                    _counts, npad)[jax.process_index()])
+                row_mask_d = distdata.global_row_array(
+                    (np.arange(quota) < cc_r).astype(np.float32), quota,
+                    cloud)
+            else:
+                row_mask_d = distdata.global_row_array(
+                    np.ones(n, np.float32), quota, cloud)
         else:
             row_mask_d = (jnp.arange(npad) < n).astype(jnp.float32)
             if ndev_eff > 1:
@@ -2451,14 +2557,22 @@ class H2OSharedTreeEstimator(H2OEstimator):
         _y_glob_d = None
         _row_off = 0
         _row_counts = None
+        _nn_loc = n
         if custom_obj is not None and multiproc:
             import jax as _jax
 
-            y_loc = distdata.to_local(y_d)[:n]
+            if pod:
+                # canonical slices concatenated in rank order ARE the
+                # global ingest order — gather/scatter below need no
+                # reordering, only the canonical per-rank counts
+                _row_counts = distdata.canonical_counts(_counts, npad)
+                _nn_loc = int(_row_counts[_jax.process_index()])
+            else:
+                _row_counts = distdata.row_counts(n)
+            y_loc = distdata.to_local(y_d)[:_nn_loc]
             y_loc = (y_loc[:, 0] if y_loc.ndim == 2 else y_loc)
             _y_glob_d = jnp.asarray(
                 distdata.allgather_rows(np.asarray(y_loc, np.float32)))
-            _row_counts = distdata.row_counts(n)
             _row_off = int(_row_counts[: _jax.process_index()].sum())
         # DART per-round state: one stored-contribution scale per committed
         # round (host floats), a dedicated RNG (deterministic from seed)
@@ -2565,7 +2679,7 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 # structures (lambdarank) therefore see whole queries even
                 # when they span ingest-shard boundaries.
                 if multiproc:
-                    m_loc = distdata.to_local(margins)[:n]
+                    m_loc = distdata.to_local(margins)[:_nn_loc]
                     m_loc = (m_loc[:, 0] if m_loc.ndim == 2
                              else m_loc).astype(np.float32)
                     # fixed-size gather: ONE collective per round (counts
@@ -2573,12 +2687,20 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     m_glob = distdata.allgather_rows_padded(
                         m_loc, quota, _row_counts)
                     g_g, h_g = custom_obj(jnp.asarray(m_glob), _y_glob_d)
-                    g_g = np.asarray(g_g)[_row_off: _row_off + n]
-                    h_g = np.asarray(h_g)[_row_off: _row_off + n]
-                    g_ext = distdata.global_row_array(
-                        padr(g_g.astype(np.float32)), quota, cloud)
-                    h_ext = distdata.global_row_array(
-                        padr(h_g.astype(np.float32)), quota, cloud)
+                    g_g = np.asarray(g_g)[_row_off: _row_off + _nn_loc]
+                    h_g = np.asarray(h_g)[_row_off: _row_off + _nn_loc]
+                    if pod:
+                        # rows are already this rank's canonical slice —
+                        # global_row_array pads to quota, no exchange
+                        g_ext = distdata.global_row_array(
+                            g_g.astype(np.float32), quota, cloud)
+                        h_ext = distdata.global_row_array(
+                            h_g.astype(np.float32), quota, cloud)
+                    else:
+                        g_ext = distdata.global_row_array(
+                            padr(g_g.astype(np.float32)), quota, cloud)
+                        h_ext = distdata.global_row_array(
+                            padr(h_g.astype(np.float32)), quota, cloud)
                 else:
                     g_ext, h_ext = custom_obj(margins[:, 0], y_d[:, 0])
                 margins, packed, gains = _single_jit(
@@ -2657,17 +2779,20 @@ class H2OSharedTreeEstimator(H2OEstimator):
             if do_score:
                 if self._mode == "drf" and row_sampled and n_prior == 0:
                     # score on OOB predictions (DRF scoring history is OOB;
-                    # pulls host arrays — stays synchronous, overlap off)
-                    osum = distdata.to_local(oob_sum)[:n].astype(np.float64)
-                    ocnt = distdata.to_local(oob_cnt)[:n].astype(np.float64)
+                    # pulls host arrays — stays synchronous, overlap off).
+                    # unpadr restores INGEST order on pods; the host event
+                    # path pairs the means with the local response, so pass
+                    # the host yk (identical values to y_d, same layout).
+                    osum = unpadr(distdata.to_local(oob_sum)).astype(np.float64)
+                    ocnt = unpadr(distdata.to_local(oob_cnt)).astype(np.float64)
                     have = ocnt > 0
-                    mnp = distdata.to_local(margins)[:n].astype(np.float64)
+                    mnp = unpadr(distdata.to_local(margins)).astype(np.float64)
                     oob_mean = np.where(have[:, None],
                                         osum / np.maximum(ocnt[:, None], 1.0),
                                         mnp / max(built, 1))
                     ev0 = self._score_event(problem, dist,
                                             oob_mean * max(built, 1),
-                                            y_d, w_d, n, built + n_prior)
+                                            yk, w_d, n, built + n_prior)
                     fin = lambda ev0=ev0: ev0
                 else:
                     # ENQUEUE the device loss program(s) now; block later
@@ -2779,8 +2904,17 @@ class H2OSharedTreeEstimator(H2OEstimator):
                           else np.concatenate(packed_host, axis=0))
             _ph.mark("forest_D2H")
             if multiproc:
-                gain_total += np.sum([np.asarray(g, np.float64)
-                                      for g in gains_chunks], axis=0)
+                # replicated chunks pull to host per-chunk (eager device sum
+                # would need jit for process-spanning arrays), but the fold
+                # stays f32 left-to-right like the single-process
+                # `sum(gains_chunks)` so pod varimp is bit-identical to the
+                # forced-shard comparator
+                acc = None
+                for g in gains_chunks:
+                    gh = np.asarray(g, np.float32)
+                    acc = gh if acc is None else acc + gh
+                if acc is not None:
+                    gain_total += np.asarray(acc, np.float64)
             else:
                 gain_total += np.asarray(sum(gains_chunks), np.float64)
             _ph.mark("gains_D2H")
@@ -2868,9 +3002,11 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 float(nll_b), float(sq_b))
             _ph.mark("training_metrics")
         if multiproc:
-            # this process's real rows (training metrics are local-shard on
-            # a multi-host cloud; the forest itself is identical everywhere)
-            margins_np = distdata.local_shard(margins)[:n].astype(np.float64)
+            # this process's real rows in INGEST order (training metrics
+            # are local-shard on a multi-host cloud; the forest itself is
+            # identical everywhere; pods undo the canonical relayout first)
+            margins_np = unpadr(
+                distdata.local_shard(margins)).astype(np.float64)
         elif not device_auc:
             margins_np = np.asarray(margins[:n]).astype(np.float64)
         _ph.mark("margins_D2H")
@@ -2887,8 +3023,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
             # row is scored only by trees that did not sample it; in-bag
             # margins back-fill rows every tree happened to include
             if multiproc:
-                osum = distdata.local_shard(oob_sum)[:n].astype(np.float64)
-                ocnt = distdata.local_shard(oob_cnt)[:n].astype(np.float64)
+                osum = unpadr(
+                    distdata.local_shard(oob_sum)).astype(np.float64)
+                ocnt = unpadr(
+                    distdata.local_shard(oob_cnt)).astype(np.float64)
             else:
                 osum = np.asarray(oob_sum[:n], np.float64)
                 ocnt = np.asarray(oob_cnt[:n], np.float64)

@@ -281,6 +281,145 @@ def global_quantiles(values: np.ndarray, probs: Sequence[float],
     return xk + frac * (xk2 - xk)
 
 
+# -- canonical row layout (pod training) -------------------------------------
+#
+# The quota layout above pads every rank's tail, so pad rows INTERLEAVE with
+# real rows at rank boundaries in the global order. A padded block then holds
+# a different subset of real rows than the same block of a single-process
+# fit, and the f32 blocked fold — deterministic per layout — cannot be
+# bit-identical across cloud sizes. The canonical layout fixes the geometry
+# instead of the algorithm: all real rows stay contiguous in global ingest
+# order, ALL pad sits at the global tail, and each rank owns an equal
+# `npad // nproc` slice. Byte-range ingest already lands each rank within a
+# few rows of its canonical slice, so the exchange moves only the misaligned
+# boundary spans (exact byte transport), never the bulk.
+
+
+def canonical_counts(counts: np.ndarray, npad: int) -> np.ndarray:
+    """Per-rank REAL-row counts under the canonical equal split: rank r owns
+    canonical rows [r·shard, (r+1)·shard) of [real rows | tail pad]."""
+    counts = np.asarray(counts, np.int64)
+    nproc = len(counts)
+    if npad % nproc:
+        raise ValueError(f"npad {npad} not divisible by nproc {nproc}")
+    shard = npad // nproc
+    n_global = int(counts.sum())
+    starts = np.minimum(np.arange(nproc, dtype=np.int64) * shard, n_global)
+    stops = np.minimum((np.arange(nproc, dtype=np.int64) + 1) * shard,
+                       n_global)
+    return stops - starts
+
+
+def export_spans(src_counts: np.ndarray, dst_counts: np.ndarray,
+                 rank: int) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """Pure routing math (unit-tested in-process): the (global_start, length)
+    head and tail spans of `rank`'s source rows that fall OUTSIDE its
+    destination range when the global row concatenation is re-split from
+    `src_counts` to `dst_counts`. Both count vectors must sum to the same
+    global total."""
+    src = np.asarray(src_counts, np.int64)
+    dst = np.asarray(dst_counts, np.int64)
+    soff = int(src[:rank].sum())
+    sn = int(src[rank])
+    doff = int(dst[:rank].sum())
+    dn = int(dst[rank])
+    head_stop = min(soff + sn, doff)
+    head = (soff, max(head_stop - soff, 0))
+    tail_start = max(soff, doff + dn)
+    tail = (tail_start, max(soff + sn - tail_start, 0))
+    return head, tail
+
+
+def exchange_rows(local: np.ndarray, src_counts: np.ndarray,
+                  dst_counts: np.ndarray) -> np.ndarray:
+    """Re-split the conceptual global row concatenation from `src_counts`
+    to `dst_counts`: each rank exports only the rows outside its own
+    destination range (one small allgather of the boundary spans, exact
+    byte transport) and assembles its destination slice from the local
+    overlap plus imports. O(misalignment) traffic, not O(n)."""
+    a = np.ascontiguousarray(local)
+    src = np.asarray(src_counts, np.int64)
+    dst = np.asarray(dst_counts, np.int64)
+    if int(src.sum()) != int(dst.sum()):
+        raise ValueError(f"count mismatch: {src.sum()} != {dst.sum()}")
+    if not multiprocess():
+        return a
+    import jax
+
+    r = jax.process_index()
+    soff, sn = int(src[:r].sum()), int(src[r])
+    doff, dn = int(dst[:r].sum()), int(dst[r])
+    if a.shape[0] != sn:
+        raise ValueError(f"rank {r} holds {a.shape[0]} rows, counts say {sn}")
+    (hs, hl), (ts, tl) = export_spans(src, dst, r)
+    header = np.asarray([hs, hl, ts, tl], np.int64).tobytes()
+    payload = (header + a[hs - soff: hs - soff + hl].tobytes()
+               + a[ts - soff: ts - soff + tl].tobytes())
+    blobs = allgather_bytes(payload)
+    trail = a.shape[1:]
+    rowbytes = int(a.dtype.itemsize * int(np.prod(trail, dtype=np.int64)))
+    out = np.empty((dn,) + trail, a.dtype)
+    ov_lo, ov_hi = max(soff, doff), min(soff + sn, doff + dn)
+    if ov_hi > ov_lo:
+        out[ov_lo - doff: ov_hi - doff] = a[ov_lo - soff: ov_hi - soff]
+    covered = max(ov_hi - ov_lo, 0)
+    for blob in blobs:
+        ghs, ghl, gts, gtl = np.frombuffer(blob[:32], np.int64)
+        off = 32
+        for gstart, glen in ((int(ghs), int(ghl)), (int(gts), int(gtl))):
+            span = blob[off: off + glen * rowbytes]
+            off += glen * rowbytes
+            lo, hi = max(gstart, doff), min(gstart + glen, doff + dn)
+            if hi > lo:
+                rows = np.frombuffer(span, a.dtype).reshape((glen,) + trail)
+                out[lo - doff: hi - doff] = rows[lo - gstart: hi - gstart]
+                covered += hi - lo
+    if covered != dn:
+        raise RuntimeError(
+            f"rank {r}: canonical exchange covered {covered}/{dn} rows")
+    return out
+
+
+def to_canonical(local: np.ndarray, npad: int,
+                 counts: Optional[np.ndarray] = None, fill=0) -> np.ndarray:
+    """This rank's canonical slice (npad // nproc rows) of the global padded
+    layout [all real rows in ingest order | tail pad]. Single-process: the
+    local rows padded to npad — the exact layout a 1-device fit builds, which
+    is what makes the pod blocked fold bit-identical to it."""
+    a = np.ascontiguousarray(local)
+    if not multiprocess():
+        pad = npad - a.shape[0]
+        if pad:
+            a = np.concatenate(
+                [a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+        return a
+    if counts is None:
+        counts = row_counts(a.shape[0])
+    out = exchange_rows(a, counts, canonical_counts(counts, npad))
+    shard = npad // len(counts)
+    pad = shard - out.shape[0]
+    if pad:
+        out = np.concatenate(
+            [out, np.full((pad,) + out.shape[1:], fill, out.dtype)])
+    return out
+
+
+def from_canonical(local_padded: np.ndarray, npad: int,
+                   counts: np.ndarray) -> np.ndarray:
+    """Inverse of `to_canonical`: this rank's INGEST rows recovered from its
+    canonical-layout slice (metric read-back — training margins, OOB sums —
+    must pair with the local frame's response rows)."""
+    counts = np.asarray(counts, np.int64)
+    if not multiprocess():
+        return np.ascontiguousarray(local_padded[: int(counts.sum())])
+    import jax
+
+    r = jax.process_index()
+    canon = canonical_counts(counts, npad)
+    return exchange_rows(
+        np.ascontiguousarray(local_padded[: int(canon[r])]), canon, counts)
+
+
 def local_shard(garr) -> np.ndarray:
     """This process's rows of a global row-sharded array, in device order."""
     shards = sorted(garr.addressable_shards, key=lambda s: s.index[0].start)

@@ -124,6 +124,7 @@ def init(
             devices = jax.devices()
         mesh = Mesh(np.asarray(devices), (ROWS_AXIS,))
         _cloud = Cloud(mesh=mesh, name=name)
+        _lane_cache_topology(_cloud)
         return _cloud
 
 
@@ -291,6 +292,28 @@ _LANE_STREAK: dict = {}            # lane -> consecutive flagged fences
 _LANE_FIRED: dict = {}             # lane -> total straggler firings
 _LANE_REG: dict = {}
 _F32_ZERO = np.float32(0.0)
+# Topology cached at init() so watchdog threads can map a lane to its
+# owning RANK without ever touching jax (a hung backend blocks any jax
+# call — the round-4 rc:124 failure mode the bench watchdog exists for).
+_LANE_PROC: dict = {}              # lane -> owning process index
+_LANE_SELF: int = 0                # this process's index
+_LANE_EXPECT: int = 0              # lanes whose callbacks run IN this process
+_LANE_LAST_TS: float = 0.0         # wall time of the last fence flush
+
+
+def _lane_cache_topology(c: "Cloud") -> None:
+    """Cache {lane: process_index} for the new cloud (called under _lock
+    from init). On a pod only the LOCAL lanes' io_callbacks ever run in
+    this process, so the fence-flush threshold is the local lane count."""
+    global _LANE_SELF, _LANE_EXPECT
+    self_idx = int(jax.process_index())
+    topo = {i: int(getattr(d, "process_index", 0))
+            for i, d in enumerate(c.mesh.devices.flat)}
+    with _LANE_LOCK:
+        _LANE_PROC.clear()
+        _LANE_PROC.update(topo)
+        _LANE_SELF = self_idx
+        _LANE_EXPECT = sum(1 for pr in topo.values() if pr == self_idx)
 
 
 def lane_timing_enabled() -> bool:
@@ -349,7 +372,12 @@ def _lane_arrive_cb(tag: str, lane) -> np.float32:
             _LANE_OPEN[tag] = open_ = {}
         open_[lane] = t
         c = _cloud
-        if c is not None and len(open_) >= c.size:
+        # flush when every lane THIS process will ever hear from has
+        # reported: all lanes single-process, the local lanes on a pod
+        # (remote lanes' callbacks run on their own ranks — waiting for
+        # them here would leave every fence open forever)
+        expect = _LANE_EXPECT or (c.size if c is not None else 0)
+        if c is not None and len(open_) >= expect:
             acts2 = _flush_locked(tag)
             actions = (actions or []) + acts2 if acts2 else actions
     if actions:
@@ -361,10 +389,32 @@ def _flush_locked(tag: str):
     """Fold one fence's arrivals into a record (+ detector update). Caller
     holds _LANE_LOCK; returns deferred registry/timeline actions so the
     lock never nests into other subsystems' locks."""
-    global _LANE_SEQ
+    global _LANE_SEQ, _LANE_LAST_TS
     arrivals = _LANE_OPEN.pop(tag, None)
-    if not arrivals or len(arrivals) < 2:
+    if not arrivals:
         return None
+    _LANE_LAST_TS = _time.time()
+    if len(arrivals) < 2:
+        _LANE_LAST.clear()
+        _LANE_LAST.update({lane: 0.0 for lane in arrivals})
+        if not (len(arrivals) == 1 and _LANE_EXPECT == 1
+                and len(_LANE_PROC) > 1):
+            # incomplete fence (a lane re-reported before its local peers
+            # landed): nothing comparable to record
+            return None
+        # 1-local-lane pod rank: this IS the complete local fence. There is
+        # no within-rank skew to measure (peer lanes' callbacks run on
+        # their own ranks), but the record itself is the pod observable:
+        # the fences counter and skew series on every rank's scrape prove
+        # that rank's collectives are moving — the fleet aggregator's
+        # per-rank liveness and the watchdog's hang evidence both read
+        # them — so record the fence with zero wait.
+        lane0 = next(iter(arrivals))
+        _LANE_SEQ += 1
+        _LANE_RECORDS.append(dict(
+            seq=_LANE_SEQ, ts=_time.time(), tag=tag,
+            waits_ms={str(lane0): 0.0}, skew_ms=0.0))
+        return [("fence", tag, 0.0, {lane0: 0.0})]
     tmin = min(arrivals.values())
     waits = {lane: (t - tmin) * 1e3 for lane, t in arrivals.items()}
     skew = max(waits.values())
@@ -475,6 +525,51 @@ def lane_last_waits() -> dict:
         return {int(lv): round(w, 3) for lv, w in _LANE_LAST.items()}
 
 
+def lane_ranks() -> dict:
+    """{lane: owning process index}, cached at init() — host dict only,
+    safe from watchdog threads while the backend hangs."""
+    with _LANE_LOCK:
+        return dict(_LANE_PROC)
+
+
+def lane_hang_report() -> dict:
+    """The bench/MULTICHIP watchdog's hung-collective attribution: which
+    lanes arrived at the currently-open fence, which are missing, and the
+    RANKS owning the missing lanes (cached topology — never a jax call).
+
+    On a pod each process only hears its own lanes, so the report is
+    rank-local evidence: a missing LOCAL lane names this rank (its shard
+    never reached the rendezvous); all local lanes arrived at the last
+    fence but the program is hung → the suspects are the REMOTE ranks.
+    Empty dict when no mesh topology was cached (no sharded fit ran)."""
+    with _LANE_LOCK:
+        topo = dict(_LANE_PROC)
+        if not topo:
+            return {}
+        self_rank = _LANE_SELF
+        local = sorted(lv for lv, pr in topo.items() if pr == self_rank)
+        remote_ranks = sorted({pr for pr in topo.values() if pr != self_rank})
+        out = dict(self_rank=self_rank, local_lanes=local,
+                   n_ranks=len(set(topo.values())))
+        if _LANE_LAST_TS:
+            out["last_fence_age_s"] = round(_time.time() - _LANE_LAST_TS, 1)
+        for tag, open_ in _LANE_OPEN.items():
+            if open_:
+                tmin = min(open_.values())
+                missing = [lv for lv in local if lv not in open_]
+                out.update(
+                    open_fence=tag,
+                    arrived={int(lv): round((t - tmin) * 1e3, 3)
+                             for lv, t in sorted(open_.items())},
+                    missing_local_lanes=missing,
+                    suspect_ranks=([self_rank] if missing else remote_ranks))
+                return out
+        # no open fence: every local lane made its last rendezvous — if the
+        # run is hung on a collective, the lanes never heard from are remote
+        out.update(suspect_ranks=remote_ranks if remote_ranks else [])
+        return out
+
+
 def lane_records(since_seq: int = 0) -> list:
     with _LANE_LOCK:
         return [dict(r) for r in _LANE_RECORDS if r["seq"] > since_seq]
@@ -521,9 +616,10 @@ def lane_stats() -> dict:
 def lane_reset() -> None:
     """Drop lane-timing state (tests). Registry families are monotone and
     stay — only the host-side rings/streaks reset."""
-    global _LANE_SEQ
+    global _LANE_SEQ, _LANE_LAST_TS
     with _LANE_LOCK:
         _LANE_SEQ = 0
+        _LANE_LAST_TS = 0.0
         _LANE_OPEN.clear()
         _LANE_RECORDS.clear()
         _LANE_LAST.clear()

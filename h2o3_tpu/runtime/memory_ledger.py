@@ -307,7 +307,14 @@ def _measure_into(x, acc, seen, depth) -> None:
     jx = sys.modules.get("jax")
     if jx is not None and isinstance(x, jx.Array):
         try:
-            acc[1] += int(x.nbytes)
+            if getattr(x, "is_fully_addressable", True):
+                acc[1] += int(x.nbytes)
+            else:
+                # process-spanning global array (pod training): count only
+                # THIS rank's resident shards — the per-rank ledger must
+                # show the 1/N local footprint, not the global bytes
+                acc[1] += sum(int(s.data.nbytes)
+                              for s in x.addressable_shards)
         except Exception:
             pass
         return

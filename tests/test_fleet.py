@@ -424,3 +424,24 @@ def test_fleet_scrape_two_live_peer_processes(fleet_server):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_loadgen_ranks_summary_lists_rank_peers(fleet_server, stub_peer):
+    """deploy/loadgen --fleet `ranks` section (ISSUE 18): one row per
+    launcher-registered rank peer, aggregator counted as rank0."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deploy"))
+    from loadgen import ranks_summary
+
+    # no rank peers: single-process fleets keep their old report shape
+    assert ranks_summary("127.0.0.1", fleet_server.port) is None
+    _post(fleet_server.port, "/3/Fleet",
+          dict(name="rank1", url=f"http://127.0.0.1:{stub_peer.server_port}"))
+    rows = ranks_summary("127.0.0.1", fleet_server.port)
+    assert rows is not None
+    byname = {r["name"]: r for r in rows}
+    assert byname["rank0"]["peer_up"] == 1          # the aggregator itself
+    assert byname["rank1"]["peer_up"] == 1          # the registered rank

@@ -1,0 +1,206 @@
+"""Pod canonical-layout math (ISSUE 18) — in-process tier-1 coverage.
+
+The multi-interpreter pod fits live in the slow lane
+(test_multiprocess.py); everything here is the PURE routing/grid math
+those fits depend on — `canonical_counts` / `export_spans` /
+`to_canonical` and the shard-plan grid invariants — exercised without
+spawning a single worker, so a broken re-split is caught in seconds, not
+after a 2-process gloo bring-up.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.models import estimator_engine as _est
+from h2o3_tpu.parallel import distdata
+
+
+# -- canonical_counts ---------------------------------------------------------
+
+def _simulate_resplit(src_counts, dst_counts):
+    """Reference re-split: every rank's destination slice assembled from
+    the overlap + every rank's exported spans — the exact assembly
+    `exchange_rows` performs, minus the byte transport."""
+    src = np.asarray(src_counts, np.int64)
+    dst = np.asarray(dst_counts, np.int64)
+    n = int(src.sum())
+    glob = np.arange(n, dtype=np.int64)     # global rows = their indices
+    nproc = len(src)
+    out = []
+    for r in range(nproc):
+        doff = int(dst[:r].sum())
+        dn = int(dst[r])
+        dest = np.full(dn, -1, np.int64)
+        # own overlap
+        soff, sn = int(src[:r].sum()), int(src[r])
+        lo, hi = max(soff, doff), min(soff + sn, doff + dn)
+        if hi > lo:
+            dest[lo - doff: hi - doff] = glob[lo:hi]
+        # every rank's exported spans that land in this destination
+        for q in range(nproc):
+            for gstart, glen in distdata.export_spans(src, dst, q):
+                s_lo, s_hi = max(gstart, doff), min(gstart + glen, doff + dn)
+                if s_hi > s_lo:
+                    seg = dest[s_lo - doff: s_hi - doff]
+                    assert (seg == -1).all(), "span overlaps prior coverage"
+                    dest[s_lo - doff: s_hi - doff] = glob[s_lo:s_hi]
+        out.append(dest)
+    return out
+
+
+@pytest.mark.parametrize("counts,npad", [
+    ([5, 5], 16), ([7, 3], 16), ([0, 10], 16), ([10, 0], 16),
+    ([3, 3, 3, 3], 16), ([1, 2, 3, 4], 24), ([13, 1, 1, 1], 16),
+])
+def test_canonical_counts_partition(counts, npad):
+    cc = distdata.canonical_counts(np.asarray(counts), npad)
+    nproc = len(counts)
+    shard = npad // nproc
+    n = int(np.sum(counts))
+    # real rows conserved, no shard overfilled, pad all at the tail
+    assert int(cc.sum()) == n
+    assert (cc <= shard).all() and (cc >= 0).all()
+    # the split is the equal canonical split of [real | pad]: every shard
+    # before the pad boundary is FULL, everything after it empty
+    full = n // shard
+    assert (cc[:full] == shard).all()
+    if full < nproc:
+        assert int(cc[full]) == n - full * shard
+        assert (cc[full + 1:] == 0).all()
+
+
+def test_canonical_counts_rejects_ragged_grid():
+    with pytest.raises(ValueError):
+        distdata.canonical_counts(np.asarray([5, 5, 5]), 16)
+
+
+# -- export_spans / re-split coverage ----------------------------------------
+
+@pytest.mark.parametrize("src", [
+    [5, 5], [7, 3], [0, 10], [16, 0], [4, 4, 4, 4], [1, 7, 2, 6],
+    [13, 1, 1, 1], [0, 0, 8, 8],
+])
+def test_resplit_to_canonical_is_exact_and_ordered(src):
+    src = np.asarray(src, np.int64)
+    npad = 16 if len(src) == 2 else 32
+    dst = distdata.canonical_counts(src, npad)
+    slices = _simulate_resplit(src, dst)
+    # full coverage, exactly once, order preserved: concatenating the
+    # destination slices in rank order IS the global ingest order
+    got = np.concatenate(slices)
+    assert (got == np.arange(int(src.sum()))).all()
+
+
+def test_export_spans_stay_outside_destination():
+    src = np.asarray([7, 3], np.int64)
+    dst = distdata.canonical_counts(src, 16)       # [8, 2]
+    for r in range(2):
+        doff, dn = int(dst[:r].sum()), int(dst[r])
+        for gstart, glen in distdata.export_spans(src, dst, r):
+            if glen:
+                # an exported span never overlaps the exporter's own
+                # destination range (it would be a pointless self-send)
+                assert gstart + glen <= doff or gstart >= doff + dn
+
+
+def test_exchange_rows_single_process_identity():
+    a = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out = distdata.exchange_rows(a, np.asarray([6]), np.asarray([6]))
+    assert (out == a).all()
+    with pytest.raises(ValueError):
+        distdata.exchange_rows(a, np.asarray([6]), np.asarray([5]))
+
+
+def test_to_from_canonical_single_process_roundtrip():
+    a = np.arange(10, dtype=np.float32)
+    c = distdata.to_canonical(a, 16, fill=-1)
+    assert c.shape == (16,)
+    assert (c[:10] == a).all() and (c[10:] == -1).all()
+    back = distdata.from_canonical(c, 16, np.asarray([10]))
+    assert (back == a).all()
+    # 2-D rows travel as rows
+    m = np.arange(12, dtype=np.float32).reshape(6, 2)
+    cm = distdata.to_canonical(m, 8)
+    assert cm.shape == (8, 2) and (cm[6:] == 0).all()
+
+
+# -- shard-plan grid invariants ----------------------------------------------
+
+@pytest.mark.parametrize("ndev", [2, 4, 8])
+def test_estimator_pod_shard_plan_grid(monkeypatch, ndev):
+    monkeypatch.delenv("H2O3_EST_SHARD", raising=False)
+    monkeypatch.delenv("H2O3_EST_LEGACY", raising=False)
+    mode, s = _est.shard_plan(ndev, multiproc=True)
+    assert mode == "mesh"
+    # S shared with the 1-device forced-shard comparator lane: a multiple
+    # of the base block count AND of the device count, so npad splits
+    # into equal 8-row-aligned per-rank quotas for any nproc | ndev
+    assert s % _est.shard_blocks() == 0 and s % ndev == 0
+    for n in (ndev, 1000, 4096, 100_003):
+        npad = _est.pad_rows(n, s)
+        assert npad >= n and npad % s == 0
+        for nproc in (1, 2, ndev):
+            if ndev % nproc == 0:
+                assert npad % nproc == 0          # canonical split exists
+                quota = npad // nproc
+                assert quota % (ndev // nproc) == 0   # per-device rows
+
+
+def test_estimator_pod_shard_plan_escape_hatches(monkeypatch):
+    monkeypatch.setenv("H2O3_EST_SHARD", "0")
+    assert _est.shard_plan(4, multiproc=True) == ("off", 0)
+    monkeypatch.delenv("H2O3_EST_SHARD", raising=False)
+    monkeypatch.setenv("H2O3_EST_LEGACY", "1")
+    assert _est.shard_plan(4, multiproc=True) == ("off", 0)
+
+
+def test_block_grid_matches_between_blocks_and_mesh():
+    # the bit-identity contract's geometry: S blocks cut on one device
+    # and S/ndev blocks per device over the same npad rows land on the
+    # SAME global row boundaries
+    s = 8
+    npad = _est.pad_rows(1000, s)
+    whole = _est.block_slices(npad, s)
+    ndev = 2
+    per_dev = npad // ndev
+    stitched = []
+    for d in range(ndev):
+        for sl in _est.block_slices(per_dev, s // ndev):
+            stitched.append(slice(d * per_dev + sl.start,
+                                  d * per_dev + sl.stop))
+    assert [(sl.start, sl.stop) for sl in whole] == \
+           [(sl.start, sl.stop) for sl in stitched]
+
+# -- watchdog rank attribution ------------------------------------------------
+
+def test_lane_hang_report_names_suspect_ranks(monkeypatch):
+    """bench/MULTICHIP watchdog embed (ISSUE 18): a hung collective's
+    partial line names the suspect RANK from the cached lane→process
+    topology — pure host-dict logic, exercised without a mesh."""
+    from h2o3_tpu.parallel import mesh
+
+    monkeypatch.setattr(mesh, "_LANE_PROC", {0: 0, 1: 0, 2: 1, 3: 1})
+    monkeypatch.setattr(mesh, "_LANE_SELF", 0)
+    monkeypatch.setattr(mesh, "_LANE_OPEN", {})
+    monkeypatch.setattr(mesh, "_LANE_LAST_TS", 0.0)
+    rep = mesh.lane_hang_report()
+    assert rep["n_ranks"] == 2 and rep["self_rank"] == 0
+    assert rep["local_lanes"] == [0, 1]
+    # no open fence: every local lane made its last rendezvous — a hang
+    # is waiting on lanes this process never hears from
+    assert rep["suspect_ranks"] == [1]
+    # an open fence missing a LOCAL lane blames THIS rank
+    monkeypatch.setattr(mesh, "_LANE_OPEN", {"hist": {0: 1.0}})
+    rep = mesh.lane_hang_report()
+    assert rep["open_fence"] == "hist"
+    assert rep["missing_local_lanes"] == [1]
+    assert rep["suspect_ranks"] == [0]
+    # every local lane arrived yet the fence is still open: remote ranks
+    monkeypatch.setattr(mesh, "_LANE_OPEN", {"hist": {0: 1.0, 1: 1.002}})
+    rep = mesh.lane_hang_report()
+    assert rep["missing_local_lanes"] == []
+    assert rep["suspect_ranks"] == [1]
+    # no topology cached (no sharded fit ran): empty — the watchdog
+    # embeds nothing rather than guessing
+    monkeypatch.setattr(mesh, "_LANE_PROC", {})
+    assert mesh.lane_hang_report() == {}
