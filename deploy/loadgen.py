@@ -608,6 +608,144 @@ def run_load_open(host: str, port: int, model: str, frame: str,
     )
 
 
+def _merge_open_windows(windows: List[Dict]) -> Dict:
+    """Fold several `run_load_open` reports into one: bucket counts add
+    (same fixed bounds), percentiles re-estimated from the merged
+    histogram, counters summed. The contended phase of a concurrent sweep
+    is measured as repeated windows (the sweep's wall is not known up
+    front), and the SLO verdict wants ONE p99 over all of them."""
+    h = _BucketHist()
+    out = dict(mode="open", windows=len(windows), completed=0, offered=0,
+               shed_429=0, errors=0, dropped=0, wall_s=0.0)
+    for w in windows:
+        for i, c in enumerate(w.get("hist_counts") or []):
+            h.counts[i] += int(c)
+            h.n += int(c)
+        if w.get("mean_ms") is not None and w.get("completed"):
+            h.total += w["mean_ms"] * w["completed"]
+        if w.get("max_ms") is not None:
+            h.vmax = (w["max_ms"] if h.vmax is None
+                      else max(h.vmax, w["max_ms"]))
+        for k in ("completed", "offered", "shed_429", "errors", "dropped"):
+            out[k] += int(w.get(k) or 0)
+        out["wall_s"] = round(out["wall_s"] + (w.get("wall_s") or 0.0), 3)
+    s = h.summary()
+    out.update(p50_ms=(round(s["p50"], 3) if s["p50"] is not None else None),
+               p95_ms=(round(s["p95"], 3) if s["p95"] is not None else None),
+               p99_ms=(round(s["p99"], 3) if s["p99"] is not None else None),
+               hist_bounds_ms=s["bounds"], hist_counts=s["counts"])
+    if out["wall_s"]:
+        out["achieved_rps"] = round(out["completed"] / out["wall_s"], 2)
+    return out
+
+
+def _run_sweep_inprocess(candidates: int, rows: int, ntrees: int,
+                         out: Dict) -> None:
+    """The training half of `--concurrent-sweep`: a GBM grid (depths 3..)
+    through the TrainPool in THIS process — the same accelerator the
+    serving path scores on. `score_tree_interval=1` gives per-tree chunk
+    boundaries, i.e. the densest QoS yield cadence the tree driver offers.
+    NOT stdlib-only (the plain CLI modes stay so): requires the platform
+    importable where the server runs."""
+    import numpy as np
+
+    from h2o3_tpu.frame.frame import Frame
+    from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+    from h2o3_tpu.runtime.trainpool import TrainPool
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(rows, 8))
+    yv = (X @ rng.normal(size=8) + 0.5 * rng.normal(size=rows) > 0)
+    fr = Frame.from_numpy(
+        np.column_stack([X, yv.astype(float)]),
+        names=[f"f{i}" for i in range(8)] + ["label"]).asfactor("label")
+
+    def make(depth: int):
+        def fit(job=None):
+            est = H2OGradientBoostingEstimator(
+                ntrees=ntrees, max_depth=depth, seed=42,
+                score_tree_interval=1)
+            est.train(y="label", training_frame=fr)
+            return est
+
+        return fit
+
+    t0 = time.monotonic()
+    pool = TrainPool(parallelism=1, label="qos_sweep")
+    recs = pool.run([(f"gbm_depth{3 + i}", make(3 + i))
+                     for i in range(candidates)])
+    out["wall_s"] = round(time.monotonic() - t0, 3)
+    out["candidates"] = candidates
+    out["done"] = sum(1 for r in recs if r.status == "done")
+    out["statuses"] = {r.name: r.status for r in recs}
+
+
+def run_concurrent_sweep(host: str, port: int, model: str, frame: str,
+                         rate: float, window_s: float = 8.0,
+                         candidates: int = 4, sweep_rows: int = 20000,
+                         sweep_ntrees: int = 10, timeout_s: float = 60.0,
+                         max_inflight: int = 256, router: bool = False,
+                         idle: bool = True) -> Dict:
+    """`--concurrent-sweep`: the multi-tenant QoS measurement shape.
+
+    Phase 1 (idle, optional): one open-loop window against a quiet server —
+    the near-idle SLO baseline. Phase 2 (contended): the SAME open-loop
+    load re-run in repeated `window_s` windows while an in-process
+    `candidates`-way GBM grid sweep trains on the same accelerator; windows
+    repeat until the sweep completes and fold into one histogram. The
+    report carries split idle-vs-contended p50/p95/p99 plus the sweep's
+    wall time — the numbers the `BENCH_CONFIG=qos` lane embeds.
+
+    Requires the platform importable in this process (the sweep trains
+    here); the plain closed/open CLI modes stay stdlib-only."""
+    out: Dict = dict(mode="concurrent_sweep", rate_rps=rate,
+                     window_s=window_s)
+    if idle:
+        out["idle"] = run_load_open(host, port, model, frame, rate=rate,
+                                    duration_s=window_s, timeout_s=timeout_s,
+                                    max_inflight=max_inflight, router=router)
+    sweep: Dict = {}
+    err: List[BaseException] = []
+
+    def _sweep():
+        try:
+            _run_sweep_inprocess(candidates, sweep_rows, sweep_ntrees, sweep)
+        except BaseException as e:   # surfaced in the report, not swallowed
+            err.append(e)
+
+    th = threading.Thread(target=_sweep, daemon=True,
+                          name="loadgen-concurrent-sweep")
+    t0 = time.monotonic()
+    th.start()
+    windows: List[Dict] = []
+    # at least one contended window, then keep offering load until the
+    # sweep lands (hard cap so a hung sweep cannot spin the loadgen
+    # forever — the partial report still carries every finished window)
+    while True:
+        windows.append(run_load_open(host, port, model, frame, rate=rate,
+                                     duration_s=window_s,
+                                     timeout_s=timeout_s,
+                                     max_inflight=max_inflight,
+                                     router=router))
+        if not th.is_alive():
+            break
+        if time.monotonic() - t0 > 1200:
+            out["sweep_timeout"] = True
+            break
+    th.join(timeout=60.0)
+    if err:
+        sweep["error"] = f"{type(err[0]).__name__}: {err[0]}"
+    out["contended"] = _merge_open_windows(windows)
+    out["contended_windows"] = windows
+    out["sweep"] = sweep
+    out["completed"] = out["contended"]["completed"]
+    idle_p99 = (out.get("idle") or {}).get("p99_ms")
+    cont_p99 = out["contended"].get("p99_ms")
+    if idle_p99 and cont_p99:
+        out["p99_contended_over_idle"] = round(cont_p99 / idle_p99, 3)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -636,9 +774,33 @@ def main() -> int:
                          "/3/Predictions, and report shed rate, per-"
                          "version p99 split and rollback events from "
                          "GET /3/Router in the summary")
+    ap.add_argument("--concurrent-sweep", action="store_true",
+                    help="multi-tenant QoS mode: launch an in-process GBM "
+                         "grid sweep and report split idle-vs-contended "
+                         "p50/p95/p99 plus sweep wall time (open-loop; "
+                         "requires --rate and the platform importable in "
+                         "this process)")
+    ap.add_argument("--sweep-candidates", type=int, default=4,
+                    help="concurrent-sweep: grid size (default 4)")
+    ap.add_argument("--sweep-rows", type=int, default=20000,
+                    help="concurrent-sweep: synthetic training rows")
+    ap.add_argument("--sweep-ntrees", type=int, default=10,
+                    help="concurrent-sweep: trees per candidate")
     args = ap.parse_args()
     if args.rate is not None and args.rate <= 0:
         ap.error("--rate must be > 0 (requests per second)")
+    if args.concurrent_sweep:
+        if args.rate is None:
+            ap.error("--concurrent-sweep is open-loop: set --rate")
+        stats = run_concurrent_sweep(
+            args.host, args.port, args.model, args.frame, rate=args.rate,
+            window_s=args.duration_s or 8.0,
+            candidates=args.sweep_candidates, sweep_rows=args.sweep_rows,
+            sweep_ntrees=args.sweep_ntrees, max_inflight=args.max_inflight,
+            router=args.router)
+        print(json.dumps(stats, indent=2))
+        return 0 if (stats["completed"]
+                     and stats["sweep"].get("done")) else 1
     fleet_before = (fleet_summary(args.host, args.port)
                     if args.fleet else None)
     router_before = (router_summary(args.host, args.port)

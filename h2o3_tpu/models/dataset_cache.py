@@ -247,9 +247,11 @@ def _evict_locked(keep=None) -> None:
         _pop_entry_locked(victims.pop(0), "cap")
     while victims and sum(e.nbytes() for e in list(_ENTRIES.values())) > max_bytes:
         _pop_entry_locked(victims.pop(0), "cap")
-    from ..runtime import memory_ledger as ml
+    from ..runtime import qos as _qos
 
-    # ONE cached pressure read decides (pressure is RSS/HBM-budget
+    # ONE pressure snapshot decides — qos.pressure_view(), the same view
+    # serving admission reads, so shed-serving can never be true here
+    # while evict-training-artifacts is false (pressure is RSS/HBM-budget
     # dominated — it cannot drop mid-loop just because entries were
     # unregistered, so re-reading per victim would only burn a full
     # accounting pass under _LOCK per pop): past the threshold, DEVICE
@@ -257,9 +259,10 @@ def _evict_locked(keep=None) -> None:
     # costs only a re-upload, the cheapest byte to give back), then HOST
     # blocks spill to disk (round 19 — the spilled copy is kept, so a
     # re-shed is free and only a restore pays a read), then every LRU
-    # victim entry, oldest first
+    # victim entry, oldest first — training artifacts always go before
+    # serving sheds (the eviction threshold sits below the serving one)
     if (victims or any(e.blocks for e in list(_ENTRIES.values()))) \
-            and ml.pressure() >= ml.evict_threshold():
+            and _qos.pressure_view().evict_cache:
         for e in list(_ENTRIES.values()):
             for st in list(e.blocks.values()):
                 st.shed(trigger="pressure")

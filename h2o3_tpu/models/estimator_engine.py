@@ -377,6 +377,44 @@ def reset_plans() -> None:
         _PLANS.clear()
 
 
+# -- QoS dispatch segmentation (ISSUE 19) -------------------------------------
+
+def max_iters_per_dispatch() -> int:
+    """Cap on ``while_loop`` iterations per device dispatch.
+
+    Under the multi-tenant QoS gate a fused estimator fit becomes a
+    RESUMABLE sequence of bounded device programs: each segment runs at
+    most this many iterations (the loop cond gains ``it < stop_at``), the
+    carry round-trips on device between segments, and the call site visits
+    ``qos.yield_point("est_segment")`` between dispatches so serving never
+    waits behind an unbounded fused loop. 0 = unbounded (one fused
+    dispatch — the default whenever QoS is off). ``stop_at = max_iter``
+    makes segmentation the identity: same trip count, same body, same
+    bits (pinned)."""
+    import os
+
+    try:
+        cap = int(os.environ.get("H2O3_QOS_EST_ITERS_PER_DISPATCH", "0"))
+    except ValueError:
+        cap = 0
+    if cap > 0:
+        return cap
+    from ..runtime import qos
+
+    return 32 if qos.enabled() else 0
+
+
+def segment_stops(max_iter: int):
+    """The ``stop_at`` schedule for one fused fit under the dispatch cap:
+    ``[cap, 2·cap, …, max_iter]``, or ``[max_iter]`` when uncapped (the
+    single-dispatch identity path)."""
+    max_iter = int(max_iter)
+    cap = max_iters_per_dispatch()
+    if cap <= 0 or cap >= max_iter:
+        return [max_iter]
+    return list(range(cap, max_iter, cap)) + [max_iter]
+
+
 @contextmanager
 def iter_phase():
     """Book a fused iteration loop's wall into the ``est_iter`` phase

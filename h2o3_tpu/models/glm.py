@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 from ..frame.frame import Frame
 from ..parallel import distdata
 from ..parallel import mesh as cloudlib
+from ..runtime import qos as _qos
 from . import estimator_engine as _est
 from .metrics import (
     ModelMetricsBinomial,
@@ -277,8 +278,14 @@ def _irls_device_fn(cloud, shard_mode: str, n_shards: int, family: str,
            bool(one_step))
 
     def build():
-        def inner(X, y, w, beta0, lam, alpha, n_obs, max_iter, beta_eps,
-                  tweedie_p):
+        # carry (it, beta, delta) enters as traced arguments and cond gains
+        # `it < stop_at`, so the QoS gate can run the fit as a resumable
+        # sequence of bounded segments (est.segment_stops) — stop_at =
+        # max_iter is the single-dispatch identity (same trip count, same
+        # body, same bits; pinned). The gaussian Gram hoist is β-independent,
+        # so recomputing it per segment is also bit-identical.
+        def inner(X, y, w, beta0, it0, delta0, lam, alpha, n_obs, max_iter,
+                  stop_at, beta_eps, tweedie_p):
             pdim = X.shape[1]
             pen_mask = jnp.ones(pdim, jnp.float32).at[pdim - 1].set(0.0)
 
@@ -316,7 +323,7 @@ def _irls_device_fn(cloud, shard_mode: str, n_shards: int, family: str,
 
             def cond(state):
                 it, b, delta = state
-                return (it < max_iter) & (delta >= beta_eps)
+                return (it < max_iter) & (delta >= beta_eps) & (it < stop_at)
 
             def body(state):
                 it, b, _ = state
@@ -326,7 +333,7 @@ def _irls_device_fn(cloud, shard_mode: str, n_shards: int, family: str,
                 return it + 1, nb, jnp.max(jnp.abs(nb - b))
 
             it, beta, delta = jax.lax.while_loop(
-                cond, body, (jnp.int32(0), beta0, jnp.float32(jnp.inf)))
+                cond, body, (it0, beta0, delta0))
             return beta, it, delta
 
         if axis is not None:
@@ -334,7 +341,7 @@ def _irls_device_fn(cloud, shard_mode: str, n_shards: int, family: str,
             rep = P()
             inner = cloudlib.shard_call(
                 inner, cloud,
-                in_specs=(rspec, rspec, rspec) + (rep,) * 7,
+                in_specs=(rspec, rspec, rspec) + (rep,) * 10,
                 out_specs=(rep, rep, rep), check_rep=False)
         return jax.jit(inner)
 
@@ -904,11 +911,23 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
         fn = _irls_device_fn(cloud, shard_mode, n_shards, family,
                              bool(self._parms.get("non_negative")), one_step)
         with _est.iter_phase():
-            beta_d, it_d, delta_d = fn(
-                Xd, yd, wd, jnp.asarray(beta0, jnp.float32),
-                jnp.float32(lam), jnp.float32(alpha), jnp.float32(n_obs),
-                jnp.int32(max_iter), jnp.float32(beta_eps),
-                jnp.float32(tweedie_p))
+            # segmented dispatch under QoS (one_step stays a single solve);
+            # the β carry round-trips on device between bounded segments
+            beta_d = jnp.asarray(beta0, jnp.float32)
+            it_d = jnp.int32(0)
+            delta_d = jnp.float32(jnp.inf)
+            stops = [max_iter] if one_step else _est.segment_stops(max_iter)
+            for stop in stops:
+                beta_d, it_d, delta_d = fn(
+                    Xd, yd, wd, beta_d, it_d, delta_d,
+                    jnp.float32(lam), jnp.float32(alpha),
+                    jnp.float32(n_obs), jnp.int32(max_iter),
+                    jnp.int32(stop), jnp.float32(beta_eps),
+                    jnp.float32(tweedie_p))
+                if stop < max_iter:
+                    if int(it_d) >= max_iter or float(delta_d) < beta_eps:
+                        break
+                    _qos.yield_point("est_segment", compensate="est_iter")
             cloudlib.collective_fence(beta_d)
             beta = np.asarray(beta_d, np.float64)
         if not np.isfinite(beta).all():

@@ -32,6 +32,7 @@ import numpy as np
 from ..frame.frame import Frame
 from ..parallel import distdata
 from ..parallel import mesh as cloudlib
+from ..runtime import qos as _qos
 from . import estimator_engine as _est
 from .metrics import ModelMetricsBase
 from .model_base import DataInfo, H2OEstimator, H2OModel
@@ -47,7 +48,12 @@ def _glrm_fit_fn(cloud):
     (n, p, k) key the traced program as usual."""
 
     def build():
-        def inner(A, M, X0, Y0, gx, gy, max_it, tol):
+        # carry (X, Y, obj, it, done) enters as traced arguments and cond
+        # gains `it < stop_at` so the QoS gate can run the fit as bounded
+        # resumable segments; the every-5th objective cadence keys off the
+        # ABSOLUTE iteration index, so it survives segmentation bit-exactly
+        def inner(A, M, X0, Y0, prev0, it0, done0, gx, gy, max_it, stop_at,
+                  tol):
             kk = X0.shape[1]
             AM = A * M
             eyek = jnp.eye(kk)
@@ -69,7 +75,7 @@ def _glrm_fit_fn(cloud):
 
             def cond(state):
                 _, _, _, it, done = state
-                return (~done) & (it < max_it)
+                return (~done) & (it < max_it) & (it < stop_at)
 
             def body(state):
                 Xc, Yc, prev, it, _ = state
@@ -84,8 +90,7 @@ def _glrm_fit_fn(cloud):
                 return Xc, Yc, obj, it + 1, done
 
             X, Y, obj, it, done = jax.lax.while_loop(
-                cond, body, (X0, Y0, jnp.float32(jnp.inf), jnp.int32(0),
-                             jnp.asarray(False)))
+                cond, body, (X0, Y0, prev0, it0, done0))
             return X, Y, obj, it, done
 
         return jax.jit(inner)
@@ -236,10 +241,22 @@ class H2OGeneralizedLowRankEstimator(H2OEstimator):
             fn = _glrm_fit_fn(cloudlib.cloud())
             t0 = time.perf_counter()
             with _est.iter_phase():
-                Xj, Yj, obj_d, it_d, done_d = fn(
-                    Aj, Mj, jnp.asarray(X), jnp.asarray(Y),
-                    jnp.float32(gx), jnp.float32(gy), jnp.int32(iters),
-                    jnp.float32(1e-8))
+                # segmented dispatch under QoS: bounded device programs
+                # with the (X, Y, obj, it, done) carry kept on device
+                Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
+                obj_d = jnp.float32(jnp.inf)
+                it_d = jnp.int32(0)
+                done_d = jnp.asarray(False)
+                for stop in _est.segment_stops(iters):
+                    Xj, Yj, obj_d, it_d, done_d = fn(
+                        Aj, Mj, Xj, Yj, obj_d, it_d, done_d,
+                        jnp.float32(gx), jnp.float32(gy), jnp.int32(iters),
+                        jnp.int32(stop), jnp.float32(1e-8))
+                    if stop < iters:
+                        if bool(done_d) or int(it_d) >= iters:
+                            break
+                        _qos.yield_point("est_segment",
+                                         compensate="est_iter")
                 obj = float(obj_d)
             _est.record_fit("glrm", "fused", iterations=int(it_d),
                             converged=bool(done_d),

@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from ..frame.frame import Frame
 from ..parallel import distdata
 from ..parallel import mesh as cloudlib
+from ..runtime import qos as _qos
 from . import estimator_engine as _est
 from .metrics import ModelMetricsClustering
 from .model_base import DataInfo, H2OEstimator, H2OModel
@@ -73,7 +74,12 @@ def _lloyd_fit_fn(cloud, shard_mode: str, n_shards: int, k: int):
     key = ("kmeans_lloyd", k, local_blocks, axis)
 
     def build():
-        def inner(X, w, cents0, max_iter, tol):
+        # carry (cents, prev_wss, it, done) enters as traced arguments so
+        # the QoS gate can run the fit as a resumable sequence of bounded
+        # segments (est.segment_stops); cond's extra `it < stop_at`
+        # conjunct makes stop_at = max_iter the single-dispatch identity —
+        # same trip count, same body, same bits (pinned)
+        def inner(X, w, cents0, prev0, it0, done0, max_iter, stop_at, tol):
             xsq = jnp.sum(X * X, axis=1)
             karange = jnp.arange(k, dtype=jnp.int32)[None, :]
 
@@ -108,7 +114,7 @@ def _lloyd_fit_fn(cloud, shard_mode: str, n_shards: int, k: int):
 
             def cond(state):
                 cents, prev, it, done = state
-                return (~done) & (it < max_iter)
+                return (~done) & (it < max_iter) & (it < stop_at)
 
             def body(state):
                 cents, prev, it, _ = state
@@ -118,16 +124,14 @@ def _lloyd_fit_fn(cloud, shard_mode: str, n_shards: int, k: int):
                 return new_cents, wss, it + 1, done
 
             cents, wss, it, done = jax.lax.while_loop(
-                cond, body,
-                (cents0, jnp.float32(jnp.inf), jnp.int32(0),
-                 jnp.asarray(False)))
+                cond, body, (cents0, prev0, it0, done0))
             return cents, wss, it, done
 
         if axis is not None:
             rspec = P(cloudlib.ROWS_AXIS)
             rep = P()
             inner = cloudlib.shard_call(
-                inner, cloud, in_specs=(rspec, rspec, rep, rep, rep),
+                inner, cloud, in_specs=(rspec, rspec) + (rep,) * 7,
                 out_specs=(rep, rep, rep, rep), check_rep=False)
         return jax.jit(inner)
 
@@ -297,9 +301,22 @@ class H2OKMeansEstimator(H2OEstimator):
             fn = _lloyd_fit_fn(cloud, shard_mode, n_shards, k)
             t0 = time.perf_counter()
             with _est.iter_phase():
-                cd, wss_d, it_d, done_d = fn(
-                    Xd, wd, jnp.asarray(cents, jnp.float32),
-                    jnp.int32(max_iter), jnp.float32(1e-7))
+                # segmented dispatch under QoS: each segment is one bounded
+                # device program; the carry round-trips on device, only the
+                # tiny it/done scalars are read between segments
+                cd = jnp.asarray(cents, jnp.float32)
+                wss_d = jnp.float32(jnp.inf)
+                it_d = jnp.int32(0)
+                done_d = jnp.asarray(False)
+                for stop in _est.segment_stops(max_iter):
+                    cd, wss_d, it_d, done_d = fn(
+                        Xd, wd, cd, wss_d, it_d, done_d,
+                        jnp.int32(max_iter), jnp.int32(stop),
+                        jnp.float32(1e-7))
+                    if stop < max_iter:
+                        if bool(done_d) or int(it_d) >= max_iter:
+                            break
+                        _qos.yield_point("est_segment", compensate="est_iter")
                 cloudlib.collective_fence(cd)
                 cents_out = np.asarray(cd)
             _est.record_fit(

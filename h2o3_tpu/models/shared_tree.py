@@ -25,6 +25,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence
 _PROFILE = bool(os.environ.get("H2O3_PROFILE"))
 
 from ..runtime import phases as _phases_acct
+from ..runtime import qos as _qos
 
 
 class _Phase:
@@ -2638,6 +2639,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 spec = None
 
         while m < ntrees_target:
+            # QoS chunk-boundary yield: while a serving dispatch is in
+            # flight the next chunk's programs hold back here. The wait
+            # lands inside the next chunk mark's interval (which books to
+            # "compute"), so it is compensated out of that bucket.
+            _qos.yield_point(
+                "tree_chunk",
+                compensate=("compute" if (_PROFILE or _phases_acct.ENABLED)
+                            else None))
             nsteps = min(chunk, ntrees_target - m)
             drop_idx = ()
             dsum = dsum_v = None
@@ -3199,6 +3208,9 @@ class H2OSharedTreeEstimator(H2OEstimator):
         (validation frames, the escape hatch) stay fully async so the
         overlapped speculative chunk keeps the device busy behind them."""
         if row_mask is not None and not isinstance(margins, np.ndarray):
+            # QoS chunk-fence yield: the loss program is a training-class
+            # dispatch — hold it back while serving is in flight
+            _qos.yield_point("score_event")
             if loss_fn is not None:
                 val_dev = loss_fn(margins, y_d, row_mask,
                                   jnp.float32(1.0 / max(ntrees, 1)))

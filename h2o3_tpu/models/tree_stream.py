@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import packing
+from ..runtime import qos as _qos
 from ..ops.histogram import (host_hist_direct, ordered_axis_fold,
                              resolve_method, run_block_kernel)
 from . import distributions as dist_mod
@@ -323,6 +324,10 @@ class StreamedTreeStep:
                 host_rows = self._host_rows(g, h, wt)
             parts = []
             for b in range(S):
+                # per-BLOCK QoS yield: the streamed grid is the natural
+                # preemption point — serving dispatches slot in between
+                # block visits instead of behind a whole level
+                _qos.yield_point("tree_block")
                 codes_b = provider.get(b)
                 if d == 0:
                     if method == "host":
@@ -396,6 +401,7 @@ class StreamedTreeStep:
         use_oh = Lf <= 2 * _ONEHOT_LOOKUP_MAX
         parts = []
         for b in range(S):
+            _qos.yield_point("tree_block")
             codes_b = provider.get(b)
             idx_b, tot_b = _leaf_pass_jit(
                 codes_b, idx_blocks[b], g[b * rows:(b + 1) * rows],
@@ -486,6 +492,7 @@ class StreamedTreeStep:
         tr = tr._replace(value=tr.value * scale)
         vals = []
         for b in range(self.S):
+            _qos.yield_point("tree_block")
             codes_b = self.store.get(b)
             vals.append(_predict_block_jit(tr, codes_b, cfg.pack_bits,
                                            cfg.max_depth))

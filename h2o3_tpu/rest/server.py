@@ -1320,6 +1320,7 @@ class _Handler(BaseHTTPRequestHandler):
                         memory=profiler.memory_stats(),
                         fleet=profiler.fleet_stats(),
                         router=profiler.router_stats(),
+                        qos=profiler.qos_stats(),
                         metrics=profiler.registry_stats()))
 
     def h_metadata_schemas(self):

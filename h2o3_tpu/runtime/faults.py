@@ -21,6 +21,16 @@ Wired points (each named like the layer it lives in):
                             the lane) — the deterministic straggler
                             injection the skew profiler/detector is proven
                             against (parallel/mesh lane timing, ISSUE 13)
+``qos.starve``              armed with ``error="none"``: every QoS
+                            yield point sees a CLOSED gate (sustained
+                            serving-load simulation) — the chaos lane's
+                            proof that ``H2O3_QOS_TRAIN_MIN_SHARE`` still
+                            guarantees training forward progress
+                            (``match=`` scopes to one yield site)
+``qos.preempt_delay``       sleeps ``latency_ms`` at a QoS yield point
+                            itself (``error="none"``) — injected
+                            preemption latency, surfaced in
+                            ``h2o3_qos_preempt_latency_ms``
 ==========================  ==================================================
 
 Arming — programmatic, env, or REST:
@@ -53,7 +63,7 @@ from typing import Dict, Optional
 
 __all__ = ["FaultInjected", "InjectedIOError", "InjectedConnectionError",
            "InjectedDeviceError", "InjectedCrash", "arm", "disarm", "reset",
-           "check", "snapshot", "active"]
+           "check", "is_armed", "snapshot", "active"]
 
 
 class FaultInjected(Exception):
@@ -241,6 +251,29 @@ def check(point: str, detail: str = "", lane: Optional[int] = None) -> None:
     if fire and kind is not None:
         raise kind(f"injected fault at {point}"
                    + (f" ({detail})" if detail else ""))
+
+
+def is_armed(point: str, detail: str = "",
+             lane: Optional[int] = None) -> bool:
+    """Read-only probe: is `point` armed and in scope for this check?
+
+    Unlike `check` it never sleeps and never raises — sites that need a
+    boolean CONDITION rather than an injected failure use it (the QoS
+    gate's ``qos.starve`` sustained-load simulation). Honors the same
+    ``lane=`` / ``match=`` scoping; counts as a check for GET /3/Faults
+    visibility. Free when nothing is armed."""
+    if not _ACTIVE:
+        return False
+    with _LOCK:
+        p = _POINTS.get(point)
+        if p is None:
+            return False
+        if p.lane is not None and (lane is None or int(lane) != p.lane):
+            return False
+        if p.match is not None and p.match not in (detail or ""):
+            return False
+        p.checks += 1
+        return True
 
 
 _FIRED = None

@@ -182,6 +182,20 @@ def router_stats() -> Dict:
     return out
 
 
+def qos_stats() -> Dict:
+    """Multi-tenant QoS fold (ISSUE 19): gate state (who holds it —
+    serving/training/idle), cumulative yield/wait totals, admission
+    throttle state and the live knobs. Pure counter read — never waits
+    at the gate."""
+    from . import qos
+
+    out = qos.stats()
+    t = out.get("totals", {})
+    out["active"] = bool(out.get("enabled") or t.get("yields")
+                         or t.get("serving_dispatches"))
+    return out
+
+
 def registry_stats() -> Dict:
     """The central metrics registry's JSON view (counters/gauges/histogram
     summaries + windowed rates) — the /3/Profiler fold of the same store

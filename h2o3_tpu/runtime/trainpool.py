@@ -388,6 +388,12 @@ class TrainPool:
             if stop_when is not None and stop_when():
                 rec.status = "skipped"
                 return
+            # QoS admission throttle (hysteresis over ledger pressure +
+            # live serving p99): hold the candidate back while the device
+            # is contended; bounded wait, booked to the qos_wait phase
+            from . import qos as _qos
+
+            _qos.admission_gate(name)
             t1 = time.perf_counter()
             with _tracing.span(f"candidate:{name}", kind="candidate",
                                trace_id=trace_id, parent_id=parent_id,

@@ -44,12 +44,17 @@ class AdmissionController:
         # host/device budget is nearly exhausted — shedding at the door
         # (cheap cached read between refresh intervals) beats OOMing the
         # process mid-batch. Same 429 + Retry-After contract as the
-        # queue bounds.
+        # queue bounds. The read goes through qos.pressure_view() — the
+        # ONE snapshot the dataset cache's eviction also reads, so a
+        # scrape-time refresh between the two sites can't shed serving
+        # requests while admitting training work: within a view,
+        # shed-serving implies evict-training-artifacts.
         if cfg.shed_pressure > 0:
-            from ..runtime import memory_ledger
+            from ..runtime import qos
 
-            pr = memory_ledger.pressure()
-            if pr >= cfg.shed_pressure:
+            view = qos.pressure_view()
+            pr = view.value
+            if view.decide(cfg.shed_pressure):
                 self.metrics.record_rejection(model_key)
                 raise RejectedError(
                     f"memory pressure {pr:.2f} >= shed threshold "

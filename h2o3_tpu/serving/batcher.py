@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..runtime import qos as _qos
 from .config import ServingConfig
 from .metrics import ServingMetrics
 from .model_cache import ScorerCache
@@ -153,7 +154,10 @@ class _Worker:
                           n_requests=len(batch),
                           n_rows=sum(p.nrow for p in batch),
                           **(dict(other_trace_ids=extra) if extra else {})):
-            self._score_inner(batch)
+            # serving-class QoS dispatch: closes the gate for training
+            # while the batch scores; entry never waits (SERVING > TRAINING)
+            with _qos.serving_dispatch(self.model_key):
+                self._score_inner(batch)
 
     def _score_inner(self, batch: List[_Pending]) -> None:
         from ..frame.frame import Frame

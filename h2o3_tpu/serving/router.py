@@ -516,9 +516,14 @@ class Router:
                           trace_id=trace_id, replica=r.name, model=key):
             faults.check("router.forward", detail=f"{r.name}:{key}")
             req = urllib.request.Request(url, data=body, headers=headers)
-            with urllib.request.urlopen(
-                    req, timeout=self.config.timeout_s) as resp:
-                return json.loads(resp.read().decode())
+            # serving-class QoS dispatch: in a single-process fleet the
+            # forward scores in THIS runtime — the gate must see it
+            from ..runtime import qos as _qos
+
+            with _qos.serving_dispatch(f"router:{key}"):
+                with urllib.request.urlopen(
+                        req, timeout=self.config.timeout_s) as resp:
+                    return json.loads(resp.read().decode())
 
     # -- canary health -------------------------------------------------------
     def _lane_window(self, model: str, lane: str) -> Optional[_Lane]:
