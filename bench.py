@@ -983,6 +983,219 @@ def bench_chaos():
              "breaker_opens": eng.get("breaker_opens", 0)})
 
 
+_POD_CHAOS_WORKER = """
+import os, sys, json, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass
+jax.distributed.initialize(
+    coordinator_address=os.environ["H2O3_POD_COORD"],
+    num_processes=int(os.environ["H2O3_POD_NPROCS"]),
+    process_id=int(os.environ["H2O3_POD_RANK"]),
+)
+import numpy as np
+import h2o3_tpu as h2o
+from h2o3_tpu.models.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.runtime import supervisor
+h2o.init()
+fr = h2o.import_file({csv!r})
+fr["y"] = fr["y"].asfactor()
+g = H2OGradientBoostingEstimator(ntrees=20, max_depth=3, seed=5,
+                                 score_tree_interval=5)
+t0 = time.time()
+err = None
+try:
+    g.train(x=[f"x{{i}}" for i in range(6)], y="y", training_frame=fr)
+except BaseException as e:
+    err = f"{{type(e).__name__}}: {{e}}"
+snap = supervisor.snapshot()
+info = dict(rank=jax.process_index(), error=err, wall_s=time.time() - t0,
+            aborts=snap["totals"]["aborts"], last_abort=snap["last_abort"],
+            last_resume=snap["last_resume"],
+            resumes=snap["totals"]["resumes"])
+if jax.process_index() == 0:
+    with open({info!r}, "w") as f:
+        json.dump(info, f, default=str)
+    if err is None:
+        m = g.model
+        np.savez({out!r},
+                 feat=np.stack([np.asarray(t.feat) for t in m.forest]),
+                 bins=np.stack([np.asarray(t.bin) for t in m.forest]),
+                 thr=np.stack([np.asarray(t.thr) for t in m.forest]),
+                 val=np.stack([np.asarray(t.value) for t in m.forest]),
+                 ntrees=m.ntrees_built,
+                 sh_ll=np.asarray([ev.get("logloss")
+                                   for ev in m.scoring_history], np.float64),
+                 vi_gain=np.asarray([r[1] for r in m.varimp_table],
+                                    np.float64))
+print("rank", jax.process_index(), "done err=", err)
+"""
+
+
+def _pod_chaos_spawn(nproc, csv, out, info, extra_env=None, rank_env=None,
+                     timeout=600):
+    """Spawn an n-rank loopback pod running the pod_chaos worker. Unlike a
+    test harness this does NOT assert rc==0 — rank death (rc 43) is the
+    scenario. Returns per-rank (rc, output)."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    repo = os.path.dirname(os.path.abspath(__file__))
+    script = _POD_CHAOS_WORKER.format(repo=repo, csv=csv, out=out, info=info)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["H2O3_POD_COORD"] = coord
+    env["H2O3_POD_NPROCS"] = str(nproc)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache")
+    env.update(extra_env or {})
+    procs = []
+    for rank in range(nproc):
+        e = dict(env)
+        e["H2O3_POD_RANK"] = str(rank)
+        e.update((rank_env or {}).get(rank, {}))
+        p = subprocess.Popen([sys.executable, "-c", script], env=e,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True,
+                             start_new_session=True)
+        _LIVE_CHILD_PGIDS.add(p.pid)
+        procs.append(p)
+    results = []
+    for rank, p in enumerate(procs):
+        try:
+            outp, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            for q in procs:
+                try:
+                    os.killpg(q.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            raise RuntimeError(
+                f"pod_chaos rank {rank} exceeded {timeout}s — the abort "
+                "deadline did not fire (the hang this lane exists to "
+                "catch)") from None
+        finally:
+            _LIVE_CHILD_PGIDS.discard(p.pid)
+        results.append((p.returncode, outp))
+    return results
+
+
+def bench_pod_chaos():
+    """Pod chaos lane (ISSUE 20): a 2-process pod GBM fit loses one rank
+    mid-fit (armed ``mesh.rank_kill`` hard-exits it at a collective
+    arrival), the survivor's deadline'd fence aborts within
+    H2O3_FENCE_DEADLINE_S instead of hanging (never a silent rc:124), and
+    a degraded single-host resume (H2O3_TREE_SHARD=1, same shard plan S)
+    restores the rank-sharded checkpoints and completes BIT-IDENTICAL to
+    an undisturbed comparator fit. Reports detection latency, abort
+    count, and trees retrained after the kill."""
+    import csv as _csv
+    import json as _json
+    import tempfile
+
+    deadline_s = float(os.environ.get("BENCH_POD_DEADLINE_S", 15))
+    # the rank_kill point is checked at the ONE instrumented fence per
+    # scoring interval (ops/histogram ordered_axis_fold's event-loss tag),
+    # so a 20-tree fit at score_tree_interval=5 sees only ~4 arrivals per
+    # rank: after=2 lands the kill at the 3rd arrival (~tree 15), with the
+    # tree-5/10 checkpoints already committed
+    kill_after = int(os.environ.get("BENCH_POD_KILL_AFTER", 2))
+    tmp = tempfile.mkdtemp(prefix="pod_chaos_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    csv_p = os.path.join(tmp, "data.csv")
+    rng = np.random.default_rng(7)
+    Xc = rng.normal(size=(5000, 6))
+    yc = (Xc[:, 0] + 0.8 * Xc[:, 1] * Xc[:, 2]
+          + 0.3 * rng.normal(size=5000) > 0).astype(int)
+    with open(csv_p, "w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow([f"x{i}" for i in range(6)] + ["y"])
+        for i in range(5000):
+            w.writerow([f"{v:.6f}" for v in Xc[i]] + [int(yc[i])])
+
+    shared = {"H2O3_CKPT_DIR": ckpt_dir, "H2O3_CKPT_TREES": "5"}
+    # A: undisturbed 1-process forced-shard comparator (same S as the pod)
+    ref_out = os.path.join(tmp, "ref.npz")
+    res = _pod_chaos_spawn(1, csv_p, ref_out, os.path.join(tmp, "ref.json"),
+                           extra_env={"H2O3_TREE_SHARD": "1",
+                                      "H2O3_CKPT": "0"})
+    if res[0][0] != 0 or not os.path.exists(ref_out):
+        raise RuntimeError(f"comparator fit failed: {res[0][1][-2000:]}")
+    # B: 2-rank pod; rank 1 dies at its (kill_after+1)-th collective
+    # arrival; rank 0's fences run under the supervisor deadline. The
+    # doomed pod gets a THROWAWAY compilation cache: os._exit mid-write
+    # would tear the shared persistent cache and the resume leg then
+    # segfaults deserializing the torn entry (observed once) — cache
+    # poisoning is a different failure than the one this lane pins
+    info_p = os.path.join(tmp, "chaos.json")
+    t_kill = time.time()
+    res = _pod_chaos_spawn(
+        2, csv_p, os.path.join(tmp, "pod.npz"), info_p,
+        extra_env=dict(shared, H2O3_FENCE_DEADLINE_S=str(deadline_s),
+                       JAX_COMPILATION_CACHE_DIR=os.path.join(
+                           tmp, "xla_cache_b")),
+        rank_env={1: {"H2O3_FAULT_MESH_RANK_KILL":
+                      f"error=crash,count=1,after={kill_after}"}},
+        timeout=max(deadline_s * 8, 240))
+    detect_wall = time.time() - t_kill
+    assert res[1][0] == 43, (
+        f"rank 1 should have been hard-killed (rc 43), got {res[1][0]}:"
+        f"\n{res[1][1][-2000:]}")
+    chaos = _json.loads(open(info_p).read()) if os.path.exists(info_p) \
+        else {}
+    assert chaos.get("error"), (
+        "rank 0 completed despite a dead peer — the kill never landed:"
+        f"\n{res[0][1][-2000:]}")
+    ckpts = [f for f in os.listdir(ckpt_dir)] if os.path.isdir(ckpt_dir) \
+        else []
+    assert ckpts, "no fit checkpoints were committed before the kill"
+    # C: degraded single-host resume on the SAME shard plan S — restores
+    # the rank-sharded snapshots (rank-ordered concat) and completes
+    res_out = os.path.join(tmp, "resumed.npz")
+    res_info = os.path.join(tmp, "resumed.json")
+    res = _pod_chaos_spawn(1, csv_p, res_out, res_info,
+                           extra_env=dict(shared, H2O3_TREE_SHARD="1"))
+    if res[0][0] != 0 or not os.path.exists(res_out):
+        raise RuntimeError(f"degraded resume failed: {res[0][1][-2000:]}")
+    rinfo = _json.loads(open(res_info).read())
+    restored = int((rinfo.get("last_resume") or {}).get("restored") or 0)
+    assert restored > 0, f"resume did not restore a checkpoint: {rinfo}"
+    ref, got = np.load(ref_out), np.load(res_out)
+    assert int(got["ntrees"]) == int(ref["ntrees"])
+    for k in ("feat", "bins", "thr", "val", "vi_gain", "sh_ll"):
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+    abort = chaos.get("last_abort") or {}
+    detect_s = abort.get("latency_s", None)
+    return ("pod_chaos_detect_s",
+            float(detect_s if detect_s is not None else detect_wall),
+            {"unit_override": "s",
+             "aborts": int(chaos.get("aborts") or 0),
+             "abort_error": str(chaos.get("error"))[:160],
+             "suspect_ranks": (abort.get("suspect_ranks") if abort
+                               else None),
+             "detect_wall_s": round(detect_wall, 2),
+             "deadline_s": deadline_s,
+             "restored_at_tree": restored,
+             "trees_retrained": int(got["ntrees"]) - restored,
+             "ckpt_files": len(ckpts),
+             "resumed_mid_fit": int(rinfo.get("resumes") or 0),
+             "bitexact": True})
+
+
 def bench_serving():
     """Serving-SLO lane (ROADMAP item 4 groundwork): open-loop loadgen at
     a FIXED arrival rate against a live REST serving engine — queueing
@@ -1537,6 +1750,23 @@ def _hang_report_embed():
     return None
 
 
+def _mark_suspects_down(hr) -> None:
+    """Watchdog-fired pod hang (ISSUE 20 satellite): the hang report's
+    suspect ranks flip their ``h2o3_fleet_peer_up`` series to 0 and a
+    Timeline event names them — the failure the watchdog just attributed
+    reaches the fleet scrape and the driver immediately, instead of
+    waiting for the next failed peer scrape."""
+    if not hr:
+        return
+    try:
+        from h2o3_tpu.runtime import supervisor as _sup
+
+        _sup.mark_ranks_down(list(hr.get("suspect_ranks") or []),
+                             reason="bench_watchdog")
+    except Exception:
+        pass
+
+
 def _memory_embed() -> dict:
     """Memory trajectory every emitted record carries (ISSUE 8): process
     peak RSS, the ledger's device high watermark, and the top-3 owners
@@ -1820,6 +2050,7 @@ def main():
                 hr = _hang_report_embed()
                 if hr:
                     line["ranks"] = hr
+                    _mark_suspects_down(hr)
                 gs = _qos_gate_embed()
                 if gs:
                     # name the class (serving/training) holding the QoS
@@ -1827,6 +2058,7 @@ def main():
                     line["qos_gate"] = gs
                 _emit(line)
             else:
+                _mark_suspects_down(_hang_report_embed())
                 _emit(_fail_line(config,
                                  f"bench exceeded {watchdog_s:.0f}s "
                                  "watchdog with no completed rep"))
@@ -1842,9 +2074,9 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     cpu_fallback_reason = None
     forced = os.environ.get("BENCH_PLATFORM")  # e.g. "cpu" for local checks
-    if config in ("scaling", "munge", "chaos", "serving", "gbm_cpu",
-                  "oversubscription", "disk_oversubscription", "estimators",
-                  "fleet_serving", "qos") or forced:
+    if config in ("scaling", "munge", "chaos", "pod_chaos", "serving",
+                  "gbm_cpu", "oversubscription", "disk_oversubscription",
+                  "estimators", "fleet_serving", "qos") or forced:
         # the scaling curve runs in CPU subprocesses, the munge bench is
         # pure host numpy, the chaos/serving lanes measure FAILOVER/SLO
         # behavior (CPU is representative), and gbm_cpu IS the forced-CPU
@@ -1910,6 +2142,7 @@ def main():
           "score": bench_score, "scaling": bench_scaling,
           "ingest": bench_ingest, "munge": bench_munge,
           "grid": bench_grid, "chaos": bench_chaos,
+          "pod_chaos": bench_pod_chaos,
           "serving": bench_serving, "gbm_cpu": bench_gbm_cpu,
           "oversubscription": bench_oversubscription,
           "disk_oversubscription": bench_disk_oversubscription,

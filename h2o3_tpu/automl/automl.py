@@ -210,6 +210,17 @@ class H2OAutoML:
         def fn(job):
             est = cls(**parms)
             est._external_job = job   # pool cancel reaches the driver
+            if self._ckpt is not None:
+                from ..runtime import supervisor as _sup
+
+                # in-flight pointer (mid-fit resume rider): a sweep killed
+                # DURING this candidate leaves the breadcrumb a re-run
+                # needs — the retrained candidate's fit restores its newest
+                # snapshot from ckpt_dir (same run fingerprint), so only
+                # the uncheckpointed tail rebuilds (totals.resumed_mid_fit)
+                if _sup.ckpt_enabled() and _sup.ckpt_dir():
+                    self._ckpt.mark_inflight(
+                        name, dict(ckpt_dir=_sup.ckpt_dir(), algo=str(cls.__name__)))
             est.train(x=x, y=y, training_frame=training_frame)
             est._automl_name = name
             return est
@@ -439,6 +450,17 @@ class H2OAutoML:
                 self.event_log.log(
                     "resume", f"checkpoint has {len(self._ckpt)} completed "
                     "candidate(s); they will be restored, not retrained")
+            stranded = self._ckpt.inflight()
+            if stranded:
+                # candidates the killed run left mid-fit: they retrain, but
+                # their fits restore the newest valid mid-fit snapshot via
+                # the supervisor store, so only the uncheckpointed tail is
+                # rebuilt (runtime/supervisor.py; totals.resumed_mid_fit)
+                self.event_log.log(
+                    "resume", f"{len(stranded)} candidate(s) were mid-fit "
+                    "when the prior run died "
+                    f"({', '.join(sorted(stranded))}); their fits will "
+                    "resume from fit-level checkpoints where available")
         problem, nclass, domain = response_info(training_frame.vec(y))
         sort_metric = self.sort_metric
         if sort_metric == "AUTO":

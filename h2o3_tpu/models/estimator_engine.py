@@ -415,6 +415,82 @@ def segment_stops(max_iter: int):
     return list(range(cap, max_iter, cap)) + [max_iter]
 
 
+# -- mid-fit carry snapshots (ISSUE 20) ---------------------------------------
+# A fused estimator fit is a sequence of bounded device programs under the
+# QoS dispatch cap (segment_stops above); each boundary is also a natural
+# checkpoint: the while_loop carry IS the whole fit state. Snapshotting it
+# through the supervisor store makes a killed kmeans/GLM fit resume at the
+# last completed segment instead of iteration 0 — and because the carry
+# round-trips the exact f32 values, the resumed fit is bit-identical to an
+# undisturbed one (the remaining segments run the same body on the same
+# carry). Disabled (fingerprint None) unless H2O3_CKPT_DIR is set.
+
+def segment_fingerprint(algo: str, **fields):
+    """Run fingerprint for one fused fit's carry snapshots, or None when
+    fit checkpointing is off — the single gate the call sites branch on."""
+    from ..runtime import supervisor as _sup
+
+    if not (_sup.ckpt_enabled() and _sup.ckpt_dir()):
+        return None
+    return _sup.run_fingerprint(algo=algo, **fields)
+
+
+def _carry_host(a):
+    """One carry leaf to host bits. Replicated process-spanning arrays
+    (the only multi-host carry shape — β/centroids are replicated) read
+    their local copy; everything else is directly materializable."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.asarray(a)
+    if getattr(a, "is_fully_addressable", True):
+        return np.asarray(a)
+    return np.asarray(a.addressable_data(0))
+
+
+def segment_carry_save(algo: str, fingerprint, stop: int, carry) -> None:
+    """Snapshot the fused loop's carry tuple at a completed segment
+    boundary (``stop`` iterations done). No-op when fingerprint is None."""
+    if fingerprint is None:
+        return
+    import jax
+
+    from ..runtime import supervisor as _sup
+
+    arrays = {f"c{i}": _carry_host(c) for i, c in enumerate(carry)}
+    _sup.save_fit_checkpoint(
+        _sup.ckpt_dir(), f"est{algo}", fingerprint, int(stop), arrays,
+        meta=dict(ncarry=len(carry)),
+        rank=jax.process_index(), nproc=jax.process_count())
+
+
+def segment_carry_restore(algo: str, fingerprint):
+    """Newest valid carry snapshot for this fit → ``(stop, carry_tuple)``
+    or None. The carry is replicated, so any rank's shard reconstructs it;
+    multi-process clouds take a consensus vote first (a rank-divergent
+    restore would deadlock the segment collectives)."""
+    if fingerprint is None:
+        return None
+    import jax.numpy as jnp
+
+    from ..parallel import distdata
+    from ..runtime import supervisor as _sup
+
+    rec = _sup.latest_fit_checkpoint(_sup.ckpt_dir(), f"est{algo}",
+                                     fingerprint)
+    ok = rec is not None
+    if distdata.multiprocess():
+        ok = distdata.global_all(bool(ok))
+    if not ok:
+        return None
+    sh = rec["shards"][0]
+    n = int(rec["meta"].get("ncarry", len(sh)))
+    carry = tuple(jnp.asarray(sh[f"c{i}"]) for i in range(n))
+    _sup.note_mid_fit_resume(f"est{algo}", int(rec["step"]),
+                             restored=int(rec["step"]))
+    return int(rec["step"]), carry
+
+
 @contextmanager
 def iter_phase():
     """Book a fused iteration loop's wall into the ``est_iter`` phase

@@ -917,6 +917,21 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
             it_d = jnp.int32(0)
             delta_d = jnp.float32(jnp.inf)
             stops = [max_iter] if one_step else _est.segment_stops(max_iter)
+            # mid-fit carry snapshots (ISSUE 20): β/it/δ at a segment
+            # boundary ARE the whole fit state — a killed fit resumes at
+            # the last completed segment, bit-identical (exact f32 carry).
+            # λ is in the fingerprint, so every lambda-path solve keeps its
+            # own snapshot line.
+            ck_fp = _est.segment_fingerprint(
+                "glm", rows=int(Xd.shape[0]), p=int(pdim),
+                family=str(family), lam=float(lam), alpha=float(alpha),
+                max_iter=int(max_iter), beta_eps=float(beta_eps),
+                tweedie_p=float(tweedie_p), n_shards=int(n_shards),
+                shard_mode=str(shard_mode)) if len(stops) > 1 else None
+            rest = _est.segment_carry_restore("glm", ck_fp)
+            if rest is not None:
+                s0, (beta_d, it_d, delta_d) = rest
+                stops = [s for s in stops if s > s0] or [max_iter]
             for stop in stops:
                 beta_d, it_d, delta_d = fn(
                     Xd, yd, wd, beta_d, it_d, delta_d,
@@ -927,6 +942,8 @@ class H2OGeneralizedLinearEstimator(H2OEstimator):
                 if stop < max_iter:
                     if int(it_d) >= max_iter or float(delta_d) < beta_eps:
                         break
+                    _est.segment_carry_save("glm", ck_fp, stop,
+                                            (beta_d, it_d, delta_d))
                     _qos.yield_point("est_segment", compensate="est_iter")
             cloudlib.collective_fence(beta_d)
             beta = np.asarray(beta_d, np.float64)

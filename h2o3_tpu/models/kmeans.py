@@ -308,7 +308,21 @@ class H2OKMeansEstimator(H2OEstimator):
                 wss_d = jnp.float32(jnp.inf)
                 it_d = jnp.int32(0)
                 done_d = jnp.asarray(False)
-                for stop in _est.segment_stops(max_iter):
+                stops = _est.segment_stops(max_iter)
+                # mid-fit carry snapshots (ISSUE 20): a killed fit resumes
+                # at the last completed segment; exact f32 carry round-trip
+                # keeps the remaining segments bit-identical
+                ck_fp = _est.segment_fingerprint(
+                    "kmeans", rows=int(npad), p=int(Xd.shape[1]), k=int(k),
+                    seed=int(self._parms.get("seed") or 0),
+                    max_iter=int(max_iter), n_shards=int(n_shards),
+                    shard_mode=str(shard_mode), std=bool(std),
+                    init=str(init)) if len(stops) > 1 else None
+                rest = _est.segment_carry_restore("kmeans", ck_fp)
+                if rest is not None:
+                    s0, (cd, wss_d, it_d, done_d) = rest
+                    stops = [s for s in stops if s > s0] or [max_iter]
+                for stop in stops:
                     cd, wss_d, it_d, done_d = fn(
                         Xd, wd, cd, wss_d, it_d, done_d,
                         jnp.int32(max_iter), jnp.int32(stop),
@@ -316,6 +330,9 @@ class H2OKMeansEstimator(H2OEstimator):
                     if stop < max_iter:
                         if bool(done_d) or int(it_d) >= max_iter:
                             break
+                        _est.segment_carry_save(
+                            "kmeans", ck_fp, stop,
+                            (cd, wss_d, it_d, done_d))
                         _qos.yield_point("est_segment", compensate="est_iter")
                 cloudlib.collective_fence(cd)
                 cents_out = np.asarray(cd)

@@ -2606,6 +2606,135 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     tree_fn=dense_jit)
             return margins, oob_sum, oob_cnt, packed, gains
 
+        # ---- mid-fit checkpointing (ISSUE 20 tentpole) -------------------
+        # Snapshot the LIVE loop state every H2O3_CKPT_TREES trees so a
+        # killed or aborted fit resumes here instead of at tree 0. The
+        # saved margins/OOB/gain arrays are the exact f32 values — a
+        # forest fast-forward (`_margin_ffwd_jit`) rounds differently than
+        # the incremental per-tree adds, and resume must be BIT-IDENTICAL
+        # to the undisturbed fit. Per-rank shards are saved in the pod
+        # canonical layout, so a fit that lost ranks restores on ONE host
+        # by rank-ordered concatenation (the degrade path), with the shard
+        # plan S pinned in the run fingerprint. Gated off for paths whose
+        # loop state lives elsewhere (DART round scales, custom-objective
+        # host state, out-of-core streams, checkpoint= continuations);
+        # H2O3_CKPT=0 disables everything (bit-identical escape hatch).
+        from ..runtime import faults as _rfaults
+        from ..runtime import supervisor as _sup
+
+        ckpt_fp = None
+        ckpt_every = _sup.ckpt_every_trees()
+        ckpt_dirp = _sup.ckpt_dir()
+        ckpt_rank = jax.process_index() if multiproc else 0
+        ckpt_nproc = jax.process_count() if multiproc else 1
+        if (_sup.ckpt_enabled() and ckpt_dirp and not dart
+                and custom_obj is None and not ooc_blocks
+                and not prior_stacked):
+            _glob_rows = (int(_counts.sum()) if pod
+                          else (npad if multiproc else n))
+            ckpt_fp = _sup.run_fingerprint(
+                mode=self._mode, problem=problem, cols=list(x), y=y,
+                rows=int(_glob_rows), npad=int(npad), K=int(K), F=int(F),
+                nbins=int(nbins), seed=int(seed),
+                n_shards=int(cfg.n_shards), ntrees=int(tp["ntrees"]),
+                max_depth=int(tp["max_depth"]),
+                learn_rate=float(tp.get("learn_rate") or 0.0),
+                sample_rate=float(tp.get("sample_rate") or 1.0),
+                col_sample=float(colp),
+                min_rows=float(tp.get("min_rows") or 1.0),
+                dist=str(dist), has_valid=valid_state is not None)
+
+        def _save_fit_ckpt():
+            """Commit one snapshot: forest-so-far + f32 gain partial sum
+            (restored as gains_chunks[0], the same left-fold prefix) +
+            live margins/OOB local shards + scoring history + early-stop
+            cursor. The .part+rename commit and torn-write rejection live
+            in runtime/supervisor."""
+            _flush_packed()
+            all_p = (packed_host[0] if len(packed_host) == 1
+                     else np.concatenate(packed_host, axis=0))
+            gacc = None
+            for g in gains_chunks:
+                gh = np.asarray(g, np.float32)
+                gacc = gh if gacc is None else gacc + gh
+            arrays = dict(
+                packed=all_p,
+                gains=(gacc if gacc is not None
+                       else np.zeros(F, np.float32)),
+                margins=(distdata.to_local(margins) if multiproc
+                         else np.asarray(margins)))
+            if self._mode == "drf":
+                arrays["oob_sum"] = (distdata.to_local(oob_sum)
+                                     if multiproc else np.asarray(oob_sum))
+                arrays["oob_cnt"] = (distdata.to_local(oob_cnt)
+                                     if multiproc else np.asarray(oob_cnt))
+            if valid_state is not None:
+                arrays["margins_v"] = (
+                    distdata.to_local(valid_state[2]) if multiproc
+                    else np.asarray(valid_state[2]))
+            meta = dict(
+                history=history,
+                stopper_history=(list(stopper.history)
+                                 if stopper is not None else None),
+                has_valid=valid_state is not None, npad=int(npad),
+                n_shards=int(cfg.n_shards))
+            _sup.save_fit_checkpoint(
+                ckpt_dirp, "tree", ckpt_fp, built, arrays, meta,
+                rank=ckpt_rank, nproc=ckpt_nproc)
+
+        if ckpt_fp is not None:
+            rec = _sup.latest_fit_checkpoint(ckpt_dirp, "tree", ckpt_fp)
+            ok = (rec is not None and 0 < rec["step"] <= ntrees_target
+                  and (rec["nproc"] == ckpt_nproc
+                       or (ckpt_nproc == 1
+                           and not rec["meta"].get("has_valid"))))
+            if multiproc:
+                # consensus: every rank restores the same snapshot or none
+                # (a rank-divergent restore would deadlock the collectives)
+                ok = distdata.global_all(bool(ok))
+            if ok:
+                sh = rec["shards"]
+                meta0 = rec["meta"]
+
+                def _rows_back(name):
+                    """One checkpointed row-sharded state array back onto
+                    the CURRENT topology: same-nproc ranks recommit their
+                    own shard; a shrunken (1-host) resume concatenates the
+                    rank shards — canonical layout makes that the global
+                    padded array."""
+                    if multiproc and rec["nproc"] == ckpt_nproc:
+                        return distdata.global_row_array(
+                            sh[ckpt_rank][name], quota, cloud)
+                    a = (sh[0][name] if rec["nproc"] == 1 else
+                         np.concatenate([s[name] for s in sh], axis=0))
+                    a = jnp.asarray(a)
+                    if ndev_eff > 1:
+                        a = jax.device_put(a, cloud.row_sharding())
+                    return a
+
+                margins = _rows_back("margins")
+                if self._mode == "drf":
+                    oob_sum = _rows_back("oob_sum")
+                    oob_cnt = _rows_back("oob_cnt")
+                if valid_state is not None and "margins_v" in sh[0]:
+                    if multiproc:
+                        valid_state[2] = distdata.global_row_array(
+                            sh[ckpt_rank]["margins_v"], quota_v, cloud)
+                    else:
+                        valid_state[2] = jnp.asarray(sh[0]["margins_v"])
+                # forest + gain prefix are replicated — rank 0's copy is
+                # everyone's copy
+                packed_host.append(np.asarray(sh[0]["packed"], np.float32))
+                gains_chunks.append(np.asarray(sh[0]["gains"], np.float32))
+                history.extend(meta0.get("history") or [])
+                if stopper is not None and meta0.get("stopper_history"):
+                    stopper.history = [
+                        float(v) for v in meta0["stopper_history"]]
+                m = built = int(rec["step"])
+                _sup.note_mid_fit_resume("tree", m, restored=m)
+        ckpt_last_m = built
+        _sup.fit_started("tree", ckpt_fp or "", ntrees_target)
+
         # overlapped chunk scoring (ISSUE 7 tentpole part 3): double-buffer
         # — chunk m+1's tree programs are ENQUEUED while chunk m's metric
         # transfers and evaluates, so the device stays busy through
@@ -2620,10 +2749,13 @@ class H2OSharedTreeEstimator(H2OEstimator):
         # is host-driven, so a "speculative" chunk would consume real
         # stream bandwidth synchronously before the stop decision — the
         # double buffer lives INSIDE its level loop instead.)
+        # (checkpointing also disables overlap: the speculative chunk
+        # donates the very margins buffers the snapshot saver reads)
         overlap = (not tree_legacy() and not multiproc
                    and custom_obj is None and not dart
                    and not cfg.compact_cap and not ooc_blocks
                    and not (self._mode == "drf" and row_sampled)
+                   and ckpt_fp is None
                    and os.environ.get("H2O3_TREE_OVERLAP", "1") != "0")
         spec = None        # speculatively dispatched next chunk (+ nsteps)
         spec_snap = None   # pre-dispatch state copies (its buffers donate)
@@ -2647,6 +2779,10 @@ class H2OSharedTreeEstimator(H2OEstimator):
                 "tree_chunk",
                 compensate=("compute" if (_PROFILE or _phases_acct.ENABLED)
                             else None))
+            # in-process candidate-crash injection (kill-and-resume pins)
+            # + supervisor heartbeat: liveness at every chunk boundary
+            _rfaults.check("supervisor.fit_abort", detail=f"m={m}")
+            _sup.pulse("tree", m)
             nsteps = min(chunk, ntrees_target - m)
             drop_idx = ()
             dsum = dsum_v = None
@@ -2858,7 +2994,14 @@ class H2OSharedTreeEstimator(H2OEstimator):
                     break
             if self.job:
                 self.job.update(built / max(ntrees_target, 1))
+            if (ckpt_fp is not None and m < ntrees_target
+                    and built - ckpt_last_m >= ckpt_every):
+                # cadenced snapshot — never after a stopper break (resume
+                # replays the final chunk deterministically instead)
+                _save_fit_ckpt()
+                ckpt_last_m = built
 
+        _sup.fit_finished("tree")
         if dart:
             # bake the per-round DART scales into the stored leaf values so
             # scoring / MOJO / TreeSHAP see ordinary trees (xgboost keeps a

@@ -48,6 +48,7 @@ import numpy as np
 
 from ..ops import packing
 from ..runtime import qos as _qos
+from ..runtime import supervisor as _supervisor
 from ..ops.histogram import (host_hist_direct, ordered_axis_fold,
                              resolve_method, run_block_kernel)
 from . import distributions as dist_mod
@@ -328,6 +329,10 @@ class StreamedTreeStep:
                 # preemption point — serving dispatches slot in between
                 # block visits instead of behind a whole level
                 _qos.yield_point("tree_block")
+                # supervisor heartbeat (ISSUE 20): a streamed fit's chunk
+                # boundaries can be minutes apart — per-block pulses keep
+                # its liveness signal fresh for the failure detector
+                _supervisor.pulse("tree_stream", d * S + b)
                 codes_b = provider.get(b)
                 if d == 0:
                     if method == "host":

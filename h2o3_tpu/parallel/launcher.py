@@ -76,6 +76,14 @@ def initialize_multihost(
         else:
             facts["fleet_registered"] = fleet.register_with(
                 agg, f"rank{facts['process_index']}", self_url)
+    # failure-detection hook (ISSUE 20): with a fence deadline configured,
+    # every pod rank runs the supervisor watcher — a peer that dies
+    # mid-collective is detected by the SURVIVORS (lane_hang_report ages),
+    # never by the victim, so detection must be armed on all of them
+    from ..runtime import supervisor
+
+    if facts["process_count"] > 1 and supervisor.fence_deadline_s() > 0:
+        facts["supervisor_watcher"] = supervisor.start()
     return facts
 
 

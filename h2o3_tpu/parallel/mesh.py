@@ -187,7 +187,17 @@ def collective_fence(x) -> None:
     c = _cloud
     if c is not None and c.size > 1 and jax.default_backend() == "cpu":
         t0 = _time.perf_counter()
-        jax.block_until_ready(x)
+        from ..runtime import supervisor as _sup
+
+        deadline = _sup.fence_deadline_s()
+        if deadline > 0:
+            # deadline'd fence (ISSUE 20): a peer rank dying mid-collective
+            # leaves this block waiting on the rendezvous forever — the
+            # supervisor aborts it with CollectiveTimeout instead, marks
+            # the suspect ranks down, and the caller resumes elsewhere
+            _sup.deadline_block(x, deadline, tag="collective_fence")
+        else:
+            jax.block_until_ready(x)
         try:
             from ..runtime import phases as _phases
 
@@ -357,12 +367,21 @@ def _lane_arrive_cb(tag: str, lane) -> np.float32:
     record when every lane of the cloud has reported (or when a lane
     reports twice — a new fence started before a peer's callback landed)."""
     lane = int(lane)
-    try:
-        from ..runtime import faults as _faults
+    from ..runtime import faults as _faults
 
+    try:
         _faults.check("mesh.lane_delay", lane=lane)
     except Exception:
         pass   # latency-only point; an injected error class is a misconfig
+    if _faults.active():
+        # rank death at fence N (pod chaos lane): a hard exit from inside
+        # the arrival callback is exactly a process dying mid-collective —
+        # peers are left at the rendezvous, which the supervisor's fence
+        # deadline must abort. os._exit: no atexit/finalizers, like a kill.
+        try:
+            _faults.check("mesh.rank_kill", detail=f"lane{lane}", lane=lane)
+        except Exception:
+            os._exit(43)
     t = _time.perf_counter()
     actions = None
     with _LANE_LOCK:

@@ -64,6 +64,7 @@ TRAINING_SCHEMA_NAME = "TrainingMetricsV3"
 OBSERVABILITY_SCHEMA_NAME = "ObservabilityV3"
 MEMORY_SCHEMA_NAME = "MemoryV3"
 ROUTER_SCHEMA_NAME = "RouterV3"
+SUPERVISOR_SCHEMA_NAME = "SupervisorV3"
 
 # the per-subsystem JSON metrics endpoints whose counter fields must be
 # backed by central-registry metrics (metrics_registry.bind_rest_field);
@@ -76,6 +77,7 @@ METRICS_ENDPOINTS = {
     "memory": "/3/Memory",
     "fleet": "/3/Fleet?probe=0",
     "router": "/3/Router?probe=0",
+    "supervisor": "/3/Supervisor",
 }
 
 
@@ -208,6 +210,49 @@ def router_schema() -> Dict:
     )
 
 
+def supervisor_schema() -> Dict:
+    """Field metadata of the `GET /3/Supervisor` document (the elastic
+    training supervisor's observability schema — docs/robustness.md
+    "Recovery matrix" mirrors this)."""
+    fields = [
+        ("state", "string",
+         "supervisor state machine: idle (no supervised fit) / watching"
+         " (a fit is inside its loop) / aborted (the last fence breach"
+         " has not been superseded by a new fit)"),
+        ("fit", "FitInfo",
+         "the supervised fit in flight: tag (tree/estkmeans/estglm),"
+         " run fingerprint, total steps, start timestamp"),
+        ("heartbeat", "Heartbeat",
+         "last liveness pulse from inside a supervised loop (chunk/"
+         "segment/stream-block boundary): tag, step, timestamp — the"
+         " background watcher reads its age"),
+        ("last_abort", "AbortRecord",
+         "most recent hung-collective abort: tag, detection latency (s),"
+         " suspect ranks marked down, timestamp"),
+        ("last_resume", "ResumeRecord",
+         "most recent mid-fit checkpoint restore: tag, restored step,"
+         " timestamp"),
+        ("last_ckpt", "CkptRecord",
+         "most recent committed snapshot: path, step, save wall (s)"),
+        ("totals", "SupervisorTotals",
+         "cumulative counters, each bind_rest_field-backed by an"
+         " h2o3_supervisor_* family: aborts, resumes, ckpt_saves,"
+         " ckpt_rejects (torn/wrong-fingerprint/incomplete-rank-set files"
+         " skipped at restore), marked_down"),
+        ("detect_ms", "histogram",
+         "failure detection latency (ms): fence dispatch to abort"),
+        ("config", "SupervisorConfig",
+         "resolved knobs: ckpt_enabled (H2O3_CKPT), ckpt_dir"
+         " (H2O3_CKPT_DIR), ckpt_trees (H2O3_CKPT_TREES),"
+         " fence_deadline_s (H2O3_FENCE_DEADLINE_S), watcher (background"
+         " failure watcher running)"),
+    ]
+    return dict(
+        name=SUPERVISOR_SCHEMA_NAME,
+        fields=[dict(name=n, type=t, help=h) for n, t, h in fields],
+    )
+
+
 def training_metrics_schema() -> Dict:
     """Field metadata of the `GET /3/Training/metrics` document (the
     multi-model training engine's observability schema — docs/training.md
@@ -246,6 +291,10 @@ def training_metrics_schema() -> Dict:
          "sweep candidates satisfied from checkpoint records instead of"
          " retrained (grid recovery_dir auto-resume, AutoML"
          " checkpoint_dir — docs/robustness.md)"),
+        ("totals.resumed_mid_fit", "int",
+         "fits that restored a MID-FIT checkpoint and continued past"
+         " tree/iteration 0 (runtime/supervisor, H2O3_CKPT_DIR —"
+         " docs/robustness.md 'Recovery matrix')"),
         ("retry", "RetryStats",
          "shared retry-policy counters per policy (persist/client/"
          "trainpool): calls, retries, recovered, permanent_failures,"

@@ -153,6 +153,7 @@ class _Handler(BaseHTTPRequestHandler):
         ("GET", r"^/3/Metrics$", "metrics"),
         ("GET", r"^/3/Memory$", "memory"),
         ("GET", r"^/3/Trace$", "trace"),
+        ("GET", r"^/3/Supervisor$", "supervisor_get"),
         ("GET", r"^/3/Fleet$", "fleet_get"),
         ("POST", r"^/3/Fleet$", "fleet_set"),
         ("DELETE", r"^/3/Fleet$", "fleet_delete"),
@@ -933,7 +934,8 @@ class _Handler(BaseHTTPRequestHandler):
             seed=int(p.get("seed", 0) or 0),
             lane=int(p["lane"]) if p.get("lane") not in (None, "")
             else None,
-            match=str(p["match"]) if p.get("match") else None)
+            match=str(p["match"]) if p.get("match") else None,
+            after=int(p.get("after", 0) or 0))
         self._send(out)
 
     def h_faults_delete(self):
@@ -1144,6 +1146,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(tracing.export_chrome(tid))
 
     # -- fleet aggregation (runtime/fleet — docs/observability.md) ----------
+    def h_supervisor_get(self):
+        """`GET /3/Supervisor[?schema=1]` — the elastic training
+        supervisor: state machine, last abort/resume/checkpoint, counters,
+        resolved config (runtime/supervisor; docs/robustness.md
+        'Recovery matrix')."""
+        from ..runtime import supervisor
+
+        p = self._params()
+        if self._flag(p, "schema"):
+            self._send(schemas.supervisor_schema())
+            return
+        self._send(dict(
+            __meta=dict(schema_type=schemas.SUPERVISOR_SCHEMA_NAME),
+            **supervisor.snapshot()))
+
     def h_fleet_get(self):
         """`GET /3/Fleet[?probe=0]` — the fleet fold: per-replica liveness
         + serving counters + predict p99, fleet-merged totals. Scrapes

@@ -31,20 +31,36 @@ Wired points (each named like the layer it lives in):
                             itself (``error="none"``) — injected
                             preemption latency, surfaced in
                             ``h2o3_qos_preempt_latency_ms``
+``mesh.rank_kill``          HARD-EXITS this process (``os._exit``) inside a
+                            mesh lane's collective-arrival callback — the
+                            rank-death injection of the pod chaos lane
+                            (``BENCH_CONFIG=pod_chaos``); ``after=N`` delays
+                            the kill to the N+1-th fence so checkpoints
+                            exist before the death (parallel/mesh)
+``supervisor.ckpt_corrupt`` truncates a fit checkpoint's serialized blob
+                            BEFORE its atomic rename — the committed file
+                            is torn exactly like a mid-write crash, and
+                            restore must reject it (runtime/supervisor)
+``supervisor.fit_abort``    raises at a tree-fit chunk boundary — the
+                            in-process candidate-crash injection the
+                            kill-and-resume pins use (models/shared_tree)
 ==========================  ==================================================
 
 Arming — programmatic, env, or REST:
 
 * ``faults.arm("serving.scorer", error="device", rate=0.01, seed=7)``
-* ``H2O3_FAULT_SERVING_SCORER="error=device,rate=0.01,seed=7"`` (dots map
-  to underscores, upper-cased)
+* ``H2O3_FAULT_SERVING_SCORER="error=device,rate=0.01,seed=7"`` (the
+  subsystem dot maps to the FIRST underscore, upper-cased — later
+  underscores stay, so ``H2O3_FAULT_MESH_RANK_KILL`` → ``mesh.rank_kill``)
 * ``POST /3/Faults`` with the same fields; ``GET /3/Faults`` shows armed
   points + fire counts; ``DELETE /3/Faults[?point=]`` disarms.
 
 Determinism: ``count=N`` fires the FIRST N checks of a point (the
-retry-then-succeed shape tests pin); ``rate=p`` draws from a dedicated
-``numpy.random.default_rng(seed)`` per point, so the same seed produces the
-same fire sequence. Fault points are DEFAULT-OFF; `reset()` disarms all.
+retry-then-succeed shape tests pin); ``after=K`` skips the first K checks
+before the count/rate schedule applies (fire at fence N, not fence 1);
+``rate=p`` draws from a dedicated ``numpy.random.default_rng(seed)`` per
+point, so the same seed produces the same fire sequence. Fault points are
+DEFAULT-OFF; `reset()` disarms all.
 
 ``latency_ms`` injects sleep without (or in addition to) an error — the
 injected-latency fault of the issue spec. ``match=substr`` scopes a point
@@ -97,11 +113,12 @@ ERROR_KINDS = {
 
 class _Point:
     __slots__ = ("name", "kind", "rate", "count", "latency_ms", "seed",
-                 "lane", "match", "checks", "fires", "_rng")
+                 "lane", "match", "after", "checks", "fires", "_rng")
 
     def __init__(self, name: str, kind: str, rate: float,
                  count: Optional[int], latency_ms: float, seed: int,
-                 lane: Optional[int] = None, match: Optional[str] = None):
+                 lane: Optional[int] = None, match: Optional[str] = None,
+                 after: int = 0):
         if kind not in ERROR_KINDS:
             raise ValueError(f"unknown fault error kind {kind!r} "
                              f"(one of {sorted(ERROR_KINDS)})")
@@ -118,12 +135,17 @@ class _Point:
         # `match` fire — e.g. arm("serving.scorer", match="m@v2") fails
         # exactly one model version's traffic (the canary-rollback pin)
         self.match = match or None
+        # deferred arming: the first `after` in-scope checks never fire —
+        # "kill at fence N" needs fences 1..N-1 to pass undisturbed
+        self.after = int(after or 0)
         self.checks = 0
         self.fires = 0
         self._rng = None    # built lazily; numpy import stays off hot path
 
     def should_fire(self) -> bool:
         if self.kind == "none":
+            return False
+        if self.checks <= self.after:
             return False
         if self.count is not None:
             return self.fires < self.count
@@ -141,7 +163,7 @@ class _Point:
         return dict(point=self.name, error=self.kind, rate=self.rate,
                     count=self.count, latency_ms=self.latency_ms,
                     seed=self.seed, lane=self.lane, match=self.match,
-                    checks=self.checks, fires=self.fires)
+                    after=self.after, checks=self.checks, fires=self.fires)
 
 
 _LOCK = threading.Lock()
@@ -155,7 +177,9 @@ def _env_parse() -> None:
     for k, v in os.environ.items():
         if not k.startswith("H2O3_FAULT_") or not v:
             continue
-        point = k[len("H2O3_FAULT_"):].lower().replace("_", ".")
+        # point names are <subsystem>.<name> where <name> may itself
+        # carry underscores (mesh.rank_kill): only the first maps to a dot
+        point = k[len("H2O3_FAULT_"):].lower().replace("_", ".", 1)
         if v in ("1", "true", "on"):
             arm(point)
             continue
@@ -171,7 +195,8 @@ def _env_parse() -> None:
                 latency_ms=float(kw.get("latency_ms", 0.0)),
                 seed=int(kw.get("seed", 0)),
                 lane=int(kw["lane"]) if kw.get("lane") else None,
-                match=kw.get("match") or None)
+                match=kw.get("match") or None,
+                after=int(kw.get("after", 0) or 0))
         except (ValueError, TypeError) as e:
             raise ValueError(f"bad {k}={v!r}: {e}") from None
 
@@ -179,13 +204,14 @@ def _env_parse() -> None:
 def arm(point: str, error: str = "io", rate: float = 1.0,
         count: Optional[int] = None, latency_ms: float = 0.0,
         seed: int = 0, lane: Optional[int] = None,
-        match: Optional[str] = None) -> Dict:
+        match: Optional[str] = None, after: int = 0) -> Dict:
     """Arm one fault point; returns its description. `match` scopes the
     point to checks whose detail contains the substring (version-targeted
-    canary faults)."""
+    canary faults); `after=K` lets the first K in-scope checks pass before
+    the count/rate schedule applies (fire at fence N, not fence 1)."""
     global _ACTIVE
     p = _Point(point, error, rate, count, latency_ms, seed, lane=lane,
-               match=match)
+               match=match, after=after)
     with _LOCK:
         _POINTS[point] = p
         _ACTIVE = True

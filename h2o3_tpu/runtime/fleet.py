@@ -61,10 +61,10 @@ from typing import Dict, List, Optional, Tuple
 from . import env_float, metrics_registry as _reg
 from .metrics_registry import _escape_label, _fmt_value
 
-__all__ = ["register_peer", "remove_peer", "peers", "scrape_states",
-           "merge_states", "render_prometheus", "fleet_metrics_text",
-           "merge_traces", "fleet_trace", "snapshot", "register_with",
-           "origin", "same_origin", "reset"]
+__all__ = ["register_peer", "remove_peer", "mark_down", "peers",
+           "scrape_states", "merge_states", "render_prometheus",
+           "fleet_metrics_text", "merge_traces", "fleet_trace", "snapshot",
+           "register_with", "origin", "same_origin", "reset"]
 
 _LOCK = threading.Lock()
 _PEERS: "OrderedDict[str, Dict]" = OrderedDict()
@@ -158,6 +158,33 @@ def remove_peer(name: str) -> bool:
             # gated set, so an in-flight scrape cannot resurrect it.
             reg["peer_up"].remove_series(name)
     return removed
+
+
+def mark_down(name: str, reason: str = "") -> None:
+    """Flip a replica's liveness to DOWN immediately — failure detection
+    (the training supervisor's hung-collective abort, the bench
+    watchdog's suspect attribution) must reach the fleet scrape NOW, not
+    at the next failed scrape of the wedged rank. Sets the
+    ``h2o3_fleet_peer_up`` series to 0 whether or not the name is a
+    registered peer (a pod rank detected dead locally may only be
+    registered at the aggregator) and records the reason on the peer row
+    when one exists. Emits a Timeline event naming the replica."""
+    reg = _registry()
+    with _LOCK:
+        reg["peer_up"].set(0.0, name)
+        if name in _PEERS:
+            _PEERS[name].update(up=False,
+                                last_error=reason or "marked down")
+    try:
+        from .timeline import Timeline
+        Timeline.record("fleet_peer_down", name, reason=reason)
+    except Exception:
+        pass
+    try:
+        from . import tracing
+        tracing.event("fleet_peer_down", replica=name, reason=reason)
+    except Exception:
+        pass
 
 
 def peers() -> List[Dict]:

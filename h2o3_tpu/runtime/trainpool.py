@@ -78,7 +78,7 @@ _CAND_PHASES = ("host_prep", "h2d", "compile", "trace", "deserialize",
 _LOCK = threading.Lock()
 _TOTALS = dict(pools=0, submitted=0, completed=0, failed=0, cancelled=0,
                skipped=0, retried=0, watchdog_cancelled=0, resumed=0,
-               busy_s=0.0, wall_s=0.0)
+               resumed_mid_fit=0, busy_s=0.0, wall_s=0.0)
 _CV = dict(reuse_folds=0, rebin_folds=0)
 _CANDIDATES: deque = deque(maxlen=int(os.environ.get(
     "H2O3_TRAIN_CANDIDATE_LOG", 64)))
@@ -92,7 +92,8 @@ def legacy() -> bool:
 
 
 _TOTAL_FIELDS = ("pools", "submitted", "completed", "failed", "cancelled",
-                 "skipped", "retried", "watchdog_cancelled", "resumed")
+                 "skipped", "retried", "watchdog_cancelled", "resumed",
+                 "resumed_mid_fit")
 
 
 _REGISTRY = None
@@ -143,6 +144,17 @@ def record_resumed(n: int = 1) -> None:
     _registry()["resumed"].inc(n)
 
 
+def bump_total(field: str, n: int = 1) -> None:
+    """Increment one /3/Training/metrics total by name from another
+    subsystem (the supervisor bumps ``resumed_mid_fit`` when a fit
+    restores a mid-fit snapshot)."""
+    if field not in _TOTAL_FIELDS:
+        raise KeyError(f"unknown train total {field!r}")
+    with _LOCK:
+        _TOTALS[field] += n
+    _registry()[field].inc(n)
+
+
 @dataclass
 class JobRecord:
     """Outcome of one submitted candidate, in submission order."""
@@ -176,7 +188,16 @@ class SweepCheckpoint:
     else's records: candidate names like ``GBM_1`` are constants, so
     without it a checkpoint written for dataset A would silently serve
     A's models under a re-run on dataset B. A stored file whose
-    fingerprint differs is treated as "no records"."""
+    fingerprint differs is treated as "no records".
+
+    **In-flight records** (mid-fit resume rider): ``mark_inflight(key,
+    info)`` persists that a candidate STARTED and where its fit-level
+    checkpoints live (the supervisor's run fingerprint + checkpoint dir).
+    A sweep killed mid-candidate therefore leaves a pointer a re-run can
+    follow: the candidate retrains, its fit restores the newest valid
+    mid-fit snapshot via that fingerprint, and only the uncheckpointed
+    tail is rebuilt (``totals.resumed_mid_fit``). ``mark`` clears the
+    key's in-flight record — a completed candidate needs no pointer."""
 
     def __init__(self, directory: str, sweep_id: str,
                  fingerprint: Optional[Dict] = None):
@@ -186,6 +207,7 @@ class SweepCheckpoint:
         self.path = os.path.join(directory, f"{sweep_id}.sweep.json")
         self._lock = threading.Lock()
         self._records: Dict[str, Dict] = {}
+        self._inflight: Dict[str, Dict] = {}
         if os.path.exists(self.path):
             try:
                 with open(self.path) as f:
@@ -202,6 +224,7 @@ class SweepCheckpoint:
                         "seed?); ignoring its records")
                 else:
                     self._records = dict(data.get("candidates") or {})
+                    self._inflight = dict(data.get("inflight") or {})
             except (ValueError, OSError):
                 # a torn/corrupt checkpoint means "no records", not a crash
                 self._records = {}
@@ -214,16 +237,34 @@ class SweepCheckpoint:
         with self._lock:
             return list(self._records)
 
+    def inflight(self, key: Optional[str] = None):
+        """The interrupted-candidate pointers the prior run left behind
+        (all of them, or one key's)."""
+        with self._lock:
+            if key is not None:
+                return self._inflight.get(key)
+            return dict(self._inflight)
+
+    def _write_locked(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dict(sweep_id=self.sweep_id,
+                           fingerprint=self.fingerprint,
+                           candidates=self._records,
+                           inflight=self._inflight), f)
+        os.replace(tmp, self.path)
+
     def mark(self, key: str, payload: Dict) -> None:
         with self._lock:
             self._records[key] = payload
-            os.makedirs(self.directory, exist_ok=True)
-            tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(dict(sweep_id=self.sweep_id,
-                               fingerprint=self.fingerprint,
-                               candidates=self._records), f)
-            os.replace(tmp, self.path)
+            self._inflight.pop(key, None)
+            self._write_locked()
+
+    def mark_inflight(self, key: str, info: Optional[Dict] = None) -> None:
+        with self._lock:
+            self._inflight[key] = dict(info or {}, ts=time.time())
+            self._write_locked()
 
     def __len__(self) -> int:
         with self._lock:
@@ -330,16 +371,34 @@ class TrainPool:
                 return
             except JobCancelled:
                 if getattr(job, "_watchdog_fired", False):
-                    rec.status = "failed"
-                    rec.error = (f"candidate exceeded its {deadline:g}s "
-                                 "watchdog deadline and was cancelled")
                     with _LOCK:
                         _TOTALS["watchdog_cancelled"] += 1
                     _registry()["watchdog_cancelled"].inc()
                     _tracing.event("watchdog_cancelled",
                                    deadline_s=deadline)
-                else:
-                    rec.status = "cancelled"
+                    _cleanup_partial(job)
+                    # mid-fit resume: with fit checkpointing active the
+                    # re-attempt restores the newest snapshot and finishes
+                    # the tail instead of retraining from tree 0 — so a
+                    # watchdog kill is worth retrying (runtime/supervisor)
+                    from . import supervisor as _sup
+
+                    if (attempt < max_tries and _sup.ckpt_enabled()
+                            and _sup.ckpt_dir()
+                            and _retry.default_budget().try_spend()):
+                        rec.retries += 1
+                        _retry.record("trainpool", "retries")
+                        with _LOCK:
+                            _TOTALS["retried"] += 1
+                        _registry()["retried"].inc()
+                        _tracing.event("retry", attempt=attempt,
+                                       error="watchdog_cancelled")
+                        continue
+                    rec.status = "failed"
+                    rec.error = (f"candidate exceeded its {deadline:g}s "
+                                 "watchdog deadline and was cancelled")
+                    return
+                rec.status = "cancelled"
                 _cleanup_partial(job)
                 return
             except Exception as e:  # error isolation: sweep continues
@@ -488,7 +547,7 @@ def reset() -> None:
     with _LOCK:
         _TOTALS.update(pools=0, submitted=0, completed=0, failed=0,
                        cancelled=0, skipped=0, retried=0,
-                       watchdog_cancelled=0, resumed=0,
+                       watchdog_cancelled=0, resumed=0, resumed_mid_fit=0,
                        busy_s=0.0, wall_s=0.0)
         _CV.update(reuse_folds=0, rebin_folds=0)
         _CANDIDATES.clear()

@@ -319,6 +319,62 @@ def test_http_stream_is_iterable_and_tracks_position(http_stub):
         assert f.read() == b"1,2\n"     # mixed readline+read stays aligned
 
 
+def test_http_stream_multifault_streak_at_range_boundary(http_stub):
+    """ISSUE 20 satellite: a STREAK of faults inside one read() — two
+    injected drops back-to-back while the response is already dead — must
+    resume with a Range reopen at the exact byte boundary, not truncate
+    or re-serve bytes (the stub ignores Range, so the skip-read path is
+    exercised too)."""
+    from h2o3_tpu.runtime.persist import HttpPersist
+
+    uri = http_stub + "/data.csv"
+    f = HttpPersist().open(uri)
+    assert f.read(4) == _HttpStub.content[:4]
+    f._dead = True                  # the prior read marked the resp dead
+    faults.arm("persist.read", error="io", count=2)
+    assert f.read() == _HttpStub.content[4:]   # exact tail, no overlap
+    assert f._pos == len(_HttpStub.content)
+    assert faults.snapshot()["points"][0]["fires"] == 2
+
+
+def test_file_open_resuming_multifault_streak_in_one_read(tmp_path):
+    """Same discipline on the file backend: two injected faults PLUS a
+    genuinely-dead file handle inside a single read() — three failures,
+    recovered on the policy's last attempt by a reopen+seek to the exact
+    offset."""
+    from h2o3_tpu.runtime.persist import for_uri
+
+    payload = bytes(range(256)) * 8
+    p = tmp_path / "blob.bin"
+    p.write_bytes(payload)
+    s = for_uri(str(p)).open_resuming(str(p))
+    assert s.read(100) == payload[:100]
+    s._fh.close()                   # next attempt reads a closed handle
+    faults.arm("persist.read", error="io", count=2)
+    assert s.read() == payload[100:]
+    assert faults.snapshot()["points"][0]["fires"] == 2
+    s.close()
+
+
+def test_file_open_resuming_streak_exhaustion_keeps_exact_offset(tmp_path):
+    """A streak LONGER than the retry policy's attempts fails the read —
+    but the stream's offset must not move, so the caller's own retry
+    resumes at the exact boundary with no lost or duplicated bytes."""
+    from h2o3_tpu.runtime.persist import for_uri
+
+    payload = b"0123456789" * 50
+    p = tmp_path / "blob.bin"
+    p.write_bytes(payload)
+    s = for_uri(str(p)).open_resuming(str(p))
+    assert s.read(7) == payload[:7]
+    faults.arm("persist.read", error="io", count=100)
+    with pytest.raises(IOError):
+        s.read()
+    faults.reset()
+    assert s.read() == payload[7:]  # resumes at byte 7 exactly
+    s.close()
+
+
 # -- client wiring ------------------------------------------------------------
 
 class _RetryAfterStub(BaseHTTPRequestHandler):

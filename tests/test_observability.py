@@ -401,7 +401,7 @@ def _register_all_subsystems():
     from h2o3_tpu.frame import ingest_stats, munge_stats
     from h2o3_tpu.parallel import mesh
     from h2o3_tpu.runtime import faults, fleet, memory_ledger, retry, \
-        trainpool
+        supervisor, trainpool
     from h2o3_tpu.serving import metrics as serving_metrics
     from h2o3_tpu.serving import router
 
@@ -415,6 +415,7 @@ def _register_all_subsystems():
     memory_ledger._registry()
     fleet._registry()          # fleet families + /3/Fleet bindings
     mesh._lane_registry()      # collective-skew/straggler families
+    supervisor._registry()     # supervisor families + /3/Supervisor bindings
 
 
 def test_rest_metrics_prometheus_endpoint(obs_server, cloud1):

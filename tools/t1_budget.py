@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Tier-1 wall-clock budget watchdog (ISSUE 20 satellite).
+
+The tier-1 verify recipe runs under a hard 870 s ``timeout``; ROADMAP.md
+has tracked the suite creeping toward it for several PRs, and a breach
+is indistinguishable from a hung test (rc 124, partial log). This tool
+makes the creep VISIBLE per PR instead of discovered at the cliff:
+
+    python -m pytest tests/ -q -m 'not slow' --durations=0 \
+        --durations-min=0.05 ... | tee /tmp/_t1.log
+    python tools/t1_budget.py /tmp/_t1.log
+
+It parses pytest's ``--durations`` report (and the final summary wall as
+a cross-check), prints the top offenders and the projected wall, and
+exits nonzero once the measured wall passes the SOFT threshold
+(``T1_BUDGET_SOFT_S``, default 700 of the 870 s hard timeout) — the PR
+that pushes past it should move pins to the slow lane *in that PR*, not
+leave the cliff for a later one.
+
+Exit codes: 0 ok, 1 soft threshold exceeded, 2 log unparsable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+HARD_TIMEOUT_S = 870.0
+
+# "0.32s call     tests/test_x.py::test_y" (pytest --durations line)
+_DUR_RE = re.compile(
+    r"^\s*(?P<s>\d+(?:\.\d+)?)s\s+(?P<phase>call|setup|teardown)\s+"
+    r"(?P<test>\S+)")
+# "709 passed, 1 skipped in 633.50s" / "... in 633.50s (0:10:33)"
+_WALL_RE = re.compile(r"\bin (?P<s>\d+(?:\.\d+)?)s(?:\s|\b)")
+
+
+def parse(path: str):
+    """→ (durations: list[(seconds, phase, test)], wall_s or None)."""
+    durations, wall = [], None
+    with open(path, "r", errors="replace") as f:
+        for ln in f:
+            m = _DUR_RE.match(ln)
+            if m:
+                durations.append((float(m.group("s")), m.group("phase"),
+                                  m.group("test")))
+                continue
+            if " passed" in ln or " failed" in ln or " error" in ln:
+                w = _WALL_RE.search(ln)
+                if w:
+                    wall = float(w.group("s"))
+    return durations, wall
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    log = argv[0] if argv else "/tmp/_t1.log"
+    soft = float(os.environ.get("T1_BUDGET_SOFT_S", 700))
+    top_n = int(os.environ.get("T1_BUDGET_TOP", 20))
+    if not os.path.exists(log):
+        print(f"t1_budget: log {log!r} not found", file=sys.stderr)
+        return 2
+    durations, wall = parse(log)
+    if wall is None and not durations:
+        print(f"t1_budget: no pytest summary or --durations lines in "
+              f"{log!r} (run pytest with --durations=0 --durations-min=0.05)",
+              file=sys.stderr)
+        return 2
+
+    # per-test cost: sum call+setup+teardown under one test id
+    per_test: dict = {}
+    for s, _phase, test in durations:
+        per_test[test] = per_test.get(test, 0.0) + s
+    ranked = sorted(per_test.items(), key=lambda kv: -kv[1])
+    tracked = sum(per_test.values())
+    # projected wall: the measured summary wall when present (it includes
+    # collection + interpreter startup the durations report does not),
+    # else the tracked sum as a floor
+    projected = wall if wall is not None else tracked
+
+    print(f"tier-1 budget: projected wall {projected:.0f}s "
+          f"of {HARD_TIMEOUT_S:.0f}s hard timeout "
+          f"(soft threshold {soft:.0f}s)")
+    if durations:
+        print(f"  {len(per_test)} tests with tracked phases, "
+              f"{tracked:.0f}s tracked; top {min(top_n, len(ranked))}:")
+        for test, s in ranked[:top_n]:
+            print(f"  {s:7.2f}s  {test}")
+    headroom = HARD_TIMEOUT_S - projected
+    if projected > soft:
+        print(f"t1_budget: FAIL — projected wall {projected:.0f}s exceeds "
+              f"the {soft:.0f}s soft threshold ({headroom:.0f}s headroom "
+              f"to the hard timeout). Move the top offenders above to the "
+              f"slow lane in THIS PR.", file=sys.stderr)
+        return 1
+    print(f"  ok: {headroom:.0f}s headroom to the hard timeout")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe mid-report: no traceback,
+        # and never exit 0 — the verdict may not have been printed
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        rc = 1
+    sys.exit(rc)
